@@ -1,0 +1,48 @@
+"""antidote_ccrdt_tpu: a TPU-native computational-CRDT framework.
+
+A from-scratch rebuild of the capabilities of the Erlang library
+``antidote_ccrdt`` (see SURVEY.md) designed for JAX/XLA on TPU:
+
+* **Scalar level** — faithful single-op semantics of the six reference data
+  types (average, topk, topk_rmv, leaderboard, wordcount,
+  worddocumentcount) behind the 12-callback behaviour contract
+  (``antidote_ccrdt.erl:47-59``). Ground truth for tests and the CPU
+  baseline for benchmarks.
+
+* **Dense level** — states as fixed-shape array pytrees with
+  ``[n_replicas, n_keys, ...]`` batch axes; ``apply_ops`` / ``merge`` as
+  jitted batched kernels (the north-star ``batch_merge`` entry point).
+
+* **Harness** — synthetic multi-DC replay standing in for the Antidote
+  host: op generation, causal delivery, convergence checking, fault
+  injection, benchmarking.
+
+* **Parallel** — replica/key sharding over a ``jax.sharding.Mesh`` with
+  collective merges riding ICI.
+"""
+
+from .core.behaviour import (  # noqa: F401
+    DenseCCRDT,
+    MergeKind,
+    Registry,
+    ScalarCCRDT,
+    registry,
+)
+from .core.clock import LogicalClock, ReplicaContext, WallClock, make_contexts  # noqa: F401
+
+# Importing the model modules registers every type.
+from .models import average, leaderboard, topk, topk_rmv, wordcount  # noqa: F401
+
+
+def is_type(name) -> bool:
+    """Rebuild of ``antidote_ccrdt:is_type/1`` (``antidote_ccrdt.erl:61-62``)."""
+    return registry.is_type(name)
+
+
+def generates_extra_operations(name) -> bool:
+    """Rebuild of ``antidote_ccrdt:generates_extra_operations/1``
+    (``antidote_ccrdt.erl:64-65``)."""
+    return registry.generates_extra_operations(name)
+
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+from . import behaviour, clock, serial  # noqa: F401

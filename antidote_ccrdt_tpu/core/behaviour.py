@@ -1,0 +1,220 @@
+"""The CCRDT behaviour contract: the interface every computational CRDT implements.
+
+This is the TPU-native re-design of the reference's Erlang behaviour
+(``src/antidote_ccrdt.erl:47-59``), which defines 12 callbacks:
+
+    new/0, value/1, downstream/2, update/2, require_state_downstream/1,
+    is_operation/1, can_compact/2, compact_ops/2, is_replicate_tagged/1,
+    equal/2, to_binary/1, from_binary/1
+
+We keep the same surface at two levels:
+
+* **Scalar level** (`ScalarCCRDT`): one CRDT instance, one op at a time,
+  pure Python. Semantically faithful to the reference — used for golden
+  tests, differential testing against the dense kernels, and as the
+  CPU baseline the benchmarks compare against.
+
+* **Dense level** (`DenseCCRDT`): states are pytrees of fixed-shape arrays
+  with leading batch axes ``[n_replicas, n_keys, ...]``; `apply_ops` and
+  `merge` are jit-compiled batched kernels that process thousands of
+  (replica, key) instances in one XLA dispatch. This is the north-star
+  entry point (`batch_merge`).
+
+Two deliberate departures from the reference (documented in SURVEY.md §2
+"Quirks"):
+
+1. The reference marks dead op-log slots inconsistently — ``{noop}`` tuple
+   in average/topk_rmv/leaderboard (``antidote_ccrdt_average.erl:127``)
+   but bare ``noop`` atom in topk/wordcount (``antidote_ccrdt_topk.erl:138``)
+   — and separately uses ``noop`` for "no downstream effect". Here ``None``
+   uniformly means both "no effect" (downstream) and "dead slot" (compaction).
+
+2. The reference has no state-merge (it is op-based only; replication is
+   delegated to the Antidote host). The dense level adds an explicit
+   ``merge`` with a declared algebra (`MergeKind`), which is what lets
+   replica-state reconciliation become one batched XLA reduction.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Iterable, Optional, Protocol, Sequence, Tuple, runtime_checkable
+
+from .clock import ReplicaContext
+
+# A prepare-side operation submitted by a client, e.g. ("add", (id, score)).
+PrepareOp = Tuple[str, Any]
+# A downstream effect op, e.g. ("add", (id, score, (dc, ts))). Effects are
+# what gets logged, shipped between DCs, and applied via `update`.
+EffectOp = Tuple[str, Any]
+
+
+class MergeKind(enum.Enum):
+    """Algebra of the dense `merge` operator.
+
+    JOIN: idempotent join-semilattice — merging full replica states is safe
+        under duplication and reordering (topk, topk_rmv, leaderboard).
+    MONOID: non-idempotent commutative monoid — per-replica states are
+        *deltas* (accumulations of locally-applied ops since the last
+        exchange) and merge combines deltas exactly once (average,
+        wordcount, worddocumentcount). Merging full states would
+        double-count, mirroring how the reference relies on the host's
+        exactly-once op delivery (SURVEY.md §1).
+    """
+
+    JOIN = "join"
+    MONOID = "monoid"
+
+
+@runtime_checkable
+class ScalarCCRDT(Protocol):
+    """Single-instance, single-op semantics. Mirrors the reference callbacks.
+
+    All methods are pure; replica identity and time come in explicitly via
+    `ReplicaContext` (the reference reads them from ambient gen_servers —
+    ``?TIME`` / ``?DC_META_DATA``, ``antidote_ccrdt_topk_rmv.erl:28-35`` —
+    which is the only nondeterminism in the whole library; making the
+    context an argument is what lets everything batch later).
+    """
+
+    type_name: str
+
+    def new(self, *args: Any) -> Any:
+        """Fresh state. Per-type parameters (e.g. top-K size) mirror new/1,2."""
+        ...
+
+    def value(self, state: Any) -> Any:
+        """Observable value of the state (the 'computation' in CCRDT)."""
+        ...
+
+    def downstream(
+        self, op: PrepareOp, state: Any, ctx: ReplicaContext
+    ) -> Optional[EffectOp]:
+        """Turn a prepare op into an effect op at the origin replica.
+
+        Returns None when the op cannot change any replica's state
+        (the reference's ``{ok, noop}``).
+        """
+        ...
+
+    def update(self, effect: EffectOp, state: Any) -> Tuple[Any, list]:
+        """Apply an effect op. Returns (new_state, extra_effect_ops).
+
+        extra_effect_ops must re-enter the replication pipeline — the
+        reference returns ``{ok, S'}`` or ``{ok, S', [Ops]}``
+        (``antidote_ccrdt.erl:50``); here the list is always present
+        (empty when there is nothing to propagate).
+        """
+        ...
+
+    def require_state_downstream(self, op: PrepareOp) -> bool:
+        ...
+
+    def is_operation(self, op: Any) -> bool:
+        ...
+
+    def can_compact(self, e1: EffectOp, e2: EffectOp) -> bool:
+        ...
+
+    def compact_ops(
+        self, e1: EffectOp, e2: EffectOp
+    ) -> Tuple[Optional[EffectOp], Optional[EffectOp]]:
+        """Pairwise op-log compaction; None marks a deleted slot."""
+        ...
+
+    def is_replicate_tagged(self, effect: EffectOp) -> bool:
+        """True for non-observable effects that must still ship inter-DC."""
+        ...
+
+    def equal(self, a: Any, b: Any) -> bool:
+        ...
+
+    def to_binary(self, state: Any) -> bytes:
+        ...
+
+    def from_binary(self, data: bytes) -> Any:
+        ...
+
+
+@runtime_checkable
+class DenseCCRDT(Protocol):
+    """Batched dense-array semantics: the TPU compute path.
+
+    States are pytrees whose leaves all carry leading batch axes
+    ``[n_replicas, n_keys, ...]`` (some types collapse n_keys into the
+    state, e.g. leaderboard's player table). `apply_ops` and `merge` must
+    be jit-compatible: static shapes, no Python control flow on traced
+    values.
+    """
+
+    type_name: str
+    merge_kind: MergeKind
+
+    def init(self, n_replicas: int, n_keys: int, **params: Any) -> Any:
+        """Batched fresh state for a [n_replicas, n_keys] grid of instances."""
+        ...
+
+    def apply_ops(self, state: Any, ops: Any) -> Tuple[Any, Any]:
+        """Apply a dense batch of effect ops in one dispatch.
+
+        `ops` is a per-type struct-of-arrays with a [n_replicas, batch]
+        layout (see each type's OpBatch). Returns (new_state, extras) where
+        extras encodes generated extra ops (dense, fixed capacity) for the
+        types that produce them (topk_rmv, leaderboard — mirror of
+        ``antidote_ccrdt.erl:37-40``).
+        """
+        ...
+
+    def merge(self, a: Any, b: Any) -> Any:
+        """Two-way merge with `merge_kind` algebra. Associative+commutative;
+        idempotent iff JOIN."""
+        ...
+
+    def observe(self, state: Any) -> Any:
+        """Dense observable value (e.g. top-K ids/scores arrays)."""
+        ...
+
+
+class Registry:
+    """Type registry: the rebuild of ``antidote_ccrdt:is_type/1`` and
+    ``generates_extra_operations/1`` (``antidote_ccrdt.erl:61-65``)."""
+
+    def __init__(self) -> None:
+        self._scalar: dict[str, ScalarCCRDT] = {}
+        self._dense: dict[str, DenseCCRDT] = {}
+        self._extra_ops: set[str] = set()
+
+    def register(
+        self,
+        name: str,
+        scalar: Optional[ScalarCCRDT] = None,
+        dense: Optional[DenseCCRDT] = None,
+        generates_extra_operations: bool = False,
+    ) -> None:
+        if scalar is not None:
+            self._scalar[name] = scalar
+        if dense is not None:
+            self._dense[name] = dense
+        if generates_extra_operations:
+            self._extra_ops.add(name)
+
+    def is_type(self, name: Any) -> bool:
+        return isinstance(name, str) and (name in self._scalar or name in self._dense)
+
+    def generates_extra_operations(self, name: Any) -> bool:
+        return self.is_type(name) and name in self._extra_ops
+
+    def scalar(self, name: str) -> ScalarCCRDT:
+        return self._scalar[name]
+
+    def dense(self, name: str) -> DenseCCRDT:
+        return self._dense[name]
+
+    def scalar_types(self) -> Iterable[str]:
+        return self._scalar.keys()
+
+    def dense_types(self) -> Iterable[str]:
+        return self._dense.keys()
+
+
+registry = Registry()

@@ -1,0 +1,188 @@
+"""Versioned state serialization.
+
+The reference serializes whole states with ``term_to_binary`` /
+``binary_to_term`` in every type (e.g. ``antidote_ccrdt_topk_rmv.erl:156-163``)
+— no schema, no version tag. SURVEY.md §5 flags this for repair: snapshots
+must carry enough header to survive format evolution.
+
+Wire layout (little-endian):
+
+    magic   b"CCRD"             4 bytes
+    version u8                  format version (currently 1)
+    kind    u8                  0 = scalar (msgpack-less python payload),
+                                1 = dense (npz payload)
+    name    u8 len + utf-8      registered type name
+    payload rest
+
+Scalar payloads are encoded with a small self-describing codec (no pickle:
+pickle is neither stable across versions nor safe to load from an untrusted
+replica). Dense payloads are ``np.savez`` archives of the pytree leaves plus
+a JSON treedef manifest.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+from typing import Any
+
+import numpy as np
+
+MAGIC = b"CCRD"
+VERSION = 1
+KIND_SCALAR = 0
+KIND_DENSE = 1
+
+# --- scalar payload codec -------------------------------------------------
+# Self-describing, canonical (sorted map keys), covering the value shapes
+# scalar CRDT states use: ints, strings, bytes, floats, bools, None,
+# tuples, lists, dicts, frozensets.
+
+_T_NONE, _T_INT, _T_STR, _T_BYTES, _T_FLOAT, _T_BOOL = 0, 1, 2, 3, 4, 5
+_T_TUPLE, _T_LIST, _T_DICT, _T_FSET = 6, 7, 8, 9
+
+
+def _enc(obj: Any, out: io.BytesIO) -> None:
+    if obj is None:
+        out.write(bytes([_T_NONE]))
+    elif isinstance(obj, bool):
+        out.write(bytes([_T_BOOL, int(obj)]))
+    elif isinstance(obj, int):
+        b = obj.to_bytes((obj.bit_length() + 8) // 8 + 1, "little", signed=True)
+        out.write(bytes([_T_INT]))
+        out.write(struct.pack("<I", len(b)))
+        out.write(b)
+    elif isinstance(obj, float):
+        out.write(bytes([_T_FLOAT]))
+        out.write(struct.pack("<d", obj))
+    elif isinstance(obj, str):
+        b = obj.encode("utf-8")
+        out.write(bytes([_T_STR]))
+        out.write(struct.pack("<I", len(b)))
+        out.write(b)
+    elif isinstance(obj, bytes):
+        out.write(bytes([_T_BYTES]))
+        out.write(struct.pack("<I", len(obj)))
+        out.write(obj)
+    elif isinstance(obj, tuple):
+        out.write(bytes([_T_TUPLE]))
+        out.write(struct.pack("<I", len(obj)))
+        for x in obj:
+            _enc(x, out)
+    elif isinstance(obj, list):
+        out.write(bytes([_T_LIST]))
+        out.write(struct.pack("<I", len(obj)))
+        for x in obj:
+            _enc(x, out)
+    elif isinstance(obj, dict):
+        out.write(bytes([_T_DICT]))
+        out.write(struct.pack("<I", len(obj)))
+        for k in sorted(obj.keys(), key=repr):
+            _enc(k, out)
+            _enc(obj[k], out)
+    elif isinstance(obj, frozenset):
+        out.write(bytes([_T_FSET]))
+        out.write(struct.pack("<I", len(obj)))
+        for x in sorted(obj, key=repr):
+            _enc(x, out)
+    else:
+        raise TypeError(f"unserializable scalar-state value: {type(obj)!r}")
+
+
+def _dec(buf: io.BytesIO) -> Any:
+    tag = buf.read(1)[0]
+    if tag == _T_NONE:
+        return None
+    if tag == _T_BOOL:
+        return bool(buf.read(1)[0])
+    if tag == _T_INT:
+        (n,) = struct.unpack("<I", buf.read(4))
+        return int.from_bytes(buf.read(n), "little", signed=True)
+    if tag == _T_FLOAT:
+        return struct.unpack("<d", buf.read(8))[0]
+    if tag == _T_STR:
+        (n,) = struct.unpack("<I", buf.read(4))
+        return buf.read(n).decode("utf-8")
+    if tag == _T_BYTES:
+        (n,) = struct.unpack("<I", buf.read(4))
+        return buf.read(n)
+    if tag == _T_TUPLE:
+        (n,) = struct.unpack("<I", buf.read(4))
+        return tuple(_dec(buf) for _ in range(n))
+    if tag == _T_LIST:
+        (n,) = struct.unpack("<I", buf.read(4))
+        return [_dec(buf) for _ in range(n)]
+    if tag == _T_DICT:
+        (n,) = struct.unpack("<I", buf.read(4))
+        return {(_dec(buf)): _dec(buf) for _ in range(n)}
+    if tag == _T_FSET:
+        (n,) = struct.unpack("<I", buf.read(4))
+        return frozenset(_dec(buf) for _ in range(n))
+    raise ValueError(f"bad tag {tag}")
+
+
+def _header(kind: int, name: str) -> bytes:
+    nb = name.encode("utf-8")
+    return MAGIC + bytes([VERSION, kind, len(nb)]) + nb
+
+
+def _parse_header(data: bytes) -> tuple[int, str, int]:
+    if data[:4] != MAGIC:
+        raise ValueError("not a CCRDT snapshot (bad magic)")
+    version, kind, nlen = data[4], data[5], data[6]
+    if version > VERSION:
+        raise ValueError(f"snapshot version {version} is newer than supported {VERSION}")
+    name = data[7 : 7 + nlen].decode("utf-8")
+    return kind, name, 7 + nlen
+
+
+def dumps_scalar(name: str, state: Any) -> bytes:
+    out = io.BytesIO()
+    out.write(_header(KIND_SCALAR, name))
+    _enc(state, out)
+    return out.getvalue()
+
+
+def loads_scalar(data: bytes) -> tuple[str, Any]:
+    kind, name, off = _parse_header(data)
+    if kind != KIND_SCALAR:
+        raise ValueError("snapshot is not a scalar state")
+    return name, _dec(io.BytesIO(data[off:]))
+
+
+def dumps_dense(name: str, state: Any) -> bytes:
+    """Serialize a pytree of arrays: npz of leaves + JSON treedef manifest."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    arrs = {f"leaf{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    bio = io.BytesIO()
+    np.savez(bio, manifest=np.frombuffer(
+        json.dumps({"treedef": str(treedef), "n": len(leaves)}).encode(), dtype=np.uint8
+    ), **arrs)
+    return _header(KIND_DENSE, name) + bio.getvalue()
+
+
+def loads_dense(data: bytes, like: Any) -> tuple[str, Any]:
+    """Restore a dense state into the structure of `like` (same treedef)."""
+    import jax
+
+    kind, name, off = _parse_header(data)
+    if kind != KIND_DENSE:
+        raise ValueError("snapshot is not a dense state")
+    npz = np.load(io.BytesIO(data[off:]))
+    manifest = json.loads(bytes(npz["manifest"]).decode())
+    _, treedef = jax.tree_util.tree_flatten(like)
+    if manifest["n"] != treedef.num_leaves:
+        raise ValueError(
+            f"snapshot has {manifest['n']} leaves but target structure has "
+            f"{treedef.num_leaves}"
+        )
+    if manifest["treedef"] != str(treedef):
+        raise ValueError(
+            f"snapshot treedef mismatch: stored {manifest['treedef']!r} vs "
+            f"target {str(treedef)!r}"
+        )
+    leaves = [npz[f"leaf{i}"] for i in range(manifest["n"])]
+    return name, jax.tree_util.tree_unflatten(treedef, leaves)

@@ -1,0 +1,1 @@
+from . import average, topk, topk_rmv, leaderboard, wordcount  # noqa: F401

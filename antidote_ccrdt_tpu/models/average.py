@@ -1,0 +1,158 @@
+"""average: aggregated mean as a (sum, count) pair.
+
+Reference: ``src/antidote_ccrdt_average.erl``. State is ``{Sum, N}``
+(``:57-58``); adds carry either a bare value or a partial ``{Sum, N}``
+(``:78-81``); downstream is stateless (``:132``); two adds compact into one
+(``:127``). One deliberate fix (SURVEY.md §2 quirk #2): ``value/1`` on a
+fresh state divides by zero in the reference (``average.erl:69-70``) — here
+it returns 0.0.
+
+Dense design (SURVEY.md §7): state is ``int64[R, K, 2]`` (sum, n) over
+[n_replicas, n_keys]; applying an op batch is one ``segment_sum`` per
+replica, and the cross-replica merge is elementwise ``+`` (MONOID algebra:
+per-replica states are deltas — see `MergeKind`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import serial
+from ..core.behaviour import EffectOp, MergeKind, PrepareOp, registry
+from ..core.clock import ReplicaContext
+
+
+class AverageScalar:
+    type_name = "average"
+
+    def new(self, sum_: int = 0, num: int = 0) -> Tuple[int, int]:
+        return (int(sum_), int(num))
+
+    def value(self, state: Tuple[int, int]) -> float:
+        s, n = state
+        if n == 0:
+            return 0.0
+        return s / n
+
+    def downstream(
+        self, op: PrepareOp, state: Any, ctx: ReplicaContext
+    ) -> Optional[EffectOp]:
+        kind, payload = op
+        assert kind == "add"
+        if isinstance(payload, tuple):
+            v, n = payload
+            return ("add", (int(v), int(n)))
+        return ("add", (int(payload), 1))
+
+    def update(self, effect: EffectOp, state: Tuple[int, int]) -> Tuple[Any, list]:
+        kind, payload = effect
+        assert kind == "add"
+        if isinstance(payload, tuple):
+            v, n = payload
+        else:
+            v, n = int(payload), 1
+        if n == 0:  # reference no-op guard, average.erl:89
+            return state, []
+        s, cn = state
+        return (s + v, cn + n), []
+
+    def require_state_downstream(self, op: PrepareOp) -> bool:
+        return False
+
+    def is_operation(self, op: Any) -> bool:
+        if not (isinstance(op, tuple) and len(op) == 2 and op[0] == "add"):
+            return False
+        p = op[1]
+        if isinstance(p, tuple):
+            return len(p) == 2 and all(isinstance(x, int) for x in p)
+        return isinstance(p, int)
+
+    def can_compact(self, e1: EffectOp, e2: EffectOp) -> bool:
+        return e1[0] == "add" and e2[0] == "add"
+
+    def compact_ops(self, e1: EffectOp, e2: EffectOp):
+        (v1, n1), (v2, n2) = e1[1], e2[1]
+        return None, ("add", (v1 + v2, n1 + n2))
+
+    def is_replicate_tagged(self, effect: EffectOp) -> bool:
+        return False
+
+    def equal(self, a: Any, b: Any) -> bool:
+        return a == b
+
+    def to_binary(self, state: Any) -> bytes:
+        return serial.dumps_scalar(self.type_name, state)
+
+    def from_binary(self, data: bytes) -> Any:
+        name, state = serial.loads_scalar(data)
+        assert name == self.type_name
+        return state
+
+
+# --- dense (TPU) level ----------------------------------------------------
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class AverageState:
+    """sum/n accumulators, shape [n_replicas, n_keys]."""
+
+    sum: jax.Array
+    num: jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class AverageOps:
+    """A batch of add ops per replica: op b on replica r targets key[r, b]
+    adding (value[r, b], count[r, b]). count==0 marks padding (the
+    reference's own no-op guard makes 0 the natural null)."""
+
+    key: jax.Array  # int32[R, B]
+    value: jax.Array  # [R, B], state dtype
+    count: jax.Array  # [R, B], state dtype
+
+
+class AverageDense:
+    """dtype defaults to int32: TPUs emulate int64 (pairs of i32 registers,
+    2x HBM traffic), and the harness's logical clocks / bench workloads fit
+    i32 comfortably. Pass int64 where real wall-clock sums demand it."""
+
+    type_name = "average"
+    merge_kind = MergeKind.MONOID
+
+    def __init__(self, dtype=jnp.int32):
+        self.dtype = dtype
+
+    def init(self, n_replicas: int, n_keys: int) -> AverageState:
+        z = jnp.zeros((n_replicas, n_keys), dtype=self.dtype)
+        return AverageState(sum=z, num=z)
+
+    def apply_ops(self, state: AverageState, ops: AverageOps):
+        # count==0 ops are no-ops end to end (average.erl:89): their value
+        # must not leak into the sum either.
+        value = jnp.where(ops.count == 0, 0, ops.value)
+
+        def per_replica(s, n, key, value, count):
+            s = s.at[key].add(value, mode="drop")
+            n = n.at[key].add(count, mode="drop")
+            return s, n
+
+        new_sum, new_num = jax.vmap(per_replica)(
+            state.sum, state.num, ops.key, value, ops.count
+        )
+        return AverageState(sum=new_sum, num=new_num), None
+
+    def merge(self, a: AverageState, b: AverageState) -> AverageState:
+        return AverageState(sum=a.sum + b.sum, num=a.num + b.num)
+
+    def observe(self, state: AverageState) -> jax.Array:
+        return jnp.where(state.num == 0, 0.0, state.sum / jnp.maximum(state.num, 1))
+
+
+registry.register("average", scalar=AverageScalar(), dense=AverageDense())

@@ -1,0 +1,74 @@
+"""Tests for scalar average, ported from antidote_ccrdt_average.erl:144-189."""
+
+from antidote_ccrdt_tpu.core.clock import LogicalClock, ReplicaContext
+from antidote_ccrdt_tpu.models.average import AverageScalar
+
+A = AverageScalar()
+CTX = ReplicaContext(dc_id=0, clock=LogicalClock())
+
+
+def test_new():
+    assert A.new() == (0, 0)
+    assert A.new(4, 5) == (4, 5)
+
+
+def test_value():
+    assert A.value((4, 5)) == 4 / 5
+    # Deliberate fix of quirk #2: the reference divides by zero on a fresh
+    # state (average.erl:69-70); we define value(new()) = 0.0.
+    assert A.value(A.new()) == 0.0
+
+
+def test_update_add():
+    st = A.new()
+    st, _ = A.update(("add", 1), st)
+    st, _ = A.update(("add", 2), st)
+    st, _ = A.update(("add", 1), st)
+    assert A.value(st) == 4 / 3
+
+
+def test_update_add_parameters():
+    st, _ = A.update(("add", (7, 2)), A.new())
+    assert A.value(st) == 7 / 2
+
+
+def test_update_negative_params():
+    st, _ = A.update(("add", -7), A.new())
+    st, _ = A.update(("add", (-5, 5)), st)
+    assert A.value(st) == -12 / 6
+
+
+def test_zero_count_noop():
+    st = (5, 2)
+    st2, _ = A.update(("add", (100, 0)), st)
+    assert st2 == st
+
+
+def test_downstream():
+    assert A.downstream(("add", 3), A.new(), CTX) == ("add", (3, 1))
+    assert A.downstream(("add", (3, 4)), A.new(), CTX) == ("add", (3, 4))
+    assert not A.require_state_downstream(("add", 3))
+
+
+def test_equal():
+    assert not A.equal((4, 1), (4, 2))
+    assert A.equal((4, 2), (4, 2))
+
+
+def test_binary_roundtrip():
+    st = (4, 1)
+    assert A.from_binary(A.to_binary(st)) == st
+
+
+def test_compaction():
+    assert A.can_compact(("add", (1, 1)), ("add", (2, 3)))
+    dead, merged = A.compact_ops(("add", (1, 1)), ("add", (2, 3)))
+    assert dead is None
+    assert merged == ("add", (3, 4))
+
+
+def test_is_operation():
+    assert A.is_operation(("add", 1))
+    assert A.is_operation(("add", (1, 2)))
+    assert not A.is_operation(("sub", 1))
+    assert not A.is_operation(("add", "x"))

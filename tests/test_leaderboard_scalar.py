@@ -1,0 +1,197 @@
+"""Golden tests for scalar leaderboard, ported from the reference EUnit
+suite (antidote_ccrdt_leaderboard.erl:316-655)."""
+
+from antidote_ccrdt_tpu.core.clock import LogicalClock, ReplicaContext
+from antidote_ccrdt_tpu.models.leaderboard import (
+    NIL,
+    LeaderboardScalar,
+    LeaderboardState,
+    _cmp,
+    _largest,
+    _min_pair,
+)
+
+L = LeaderboardScalar()
+CTX = ReplicaContext(dc_id=0, clock=LogicalClock())
+
+
+def test_create():
+    assert L.new() == LeaderboardState({}, {}, frozenset(), NIL, 100)
+    assert L.new(100) == L.new()
+
+
+def test_cmp():
+    """Port of cmp_test (leaderboard.erl:326-334)."""
+    assert not _cmp(NIL, NIL)
+    assert not _cmp(NIL, (1, 2))
+    assert _cmp((1, 2), NIL)
+    assert not _cmp((1, 2), (1, 2))
+    assert not _cmp((1, 2), (1, 3))
+    assert not _cmp((1, 2), (2, 2))
+    assert _cmp((1, 3), (1, 2))
+    assert _cmp((2, 2), (1, 2))
+
+
+def test_mixed():
+    """Port of mixed_test (leaderboard.erl:339-417)."""
+    size = 2
+    lb = L.new(size)
+
+    assert L.downstream(("add", (1, 2)), lb, CTX) == ("add", (1, 2))
+    lb1, _ = L.update(("add", (1, 2)), lb)
+    assert lb1 == LeaderboardState({1: 2}, {}, frozenset(), (1, 2), size)
+
+    assert L.downstream(("add", (2, 2)), lb1, CTX) == ("add", (2, 2))
+    lb2, _ = L.update(("add", (2, 2)), lb1)
+    assert lb2 == LeaderboardState({1: 2, 2: 2}, {}, frozenset(), (1, 2), size)
+
+    # dominated add -> noop
+    assert L.downstream(("add", (1, 0)), lb2, CTX) is None
+
+    # ban of an unseen player
+    assert L.downstream(("ban", 42), lb2, CTX) == ("ban", 42)
+    lb4, extras = L.update(("ban", 42), lb2)
+    assert extras == []
+    assert lb4 == LeaderboardState({1: 2, 2: 2}, {}, frozenset([42]), (1, 2), size)
+
+    # full board, score below min -> tagged add
+    assert L.downstream(("add", (100, 1)), lb4, CTX) == ("add_r", (100, 1))
+    lb5, _ = L.update(("add_r", (100, 1)), lb4)
+    assert lb5 == LeaderboardState(
+        {1: 2, 2: 2}, {100: 1}, frozenset([42]), (1, 2), size
+    )
+
+    # ban of an observed player promotes the largest masked and emits an
+    # extra add (leaderboard.erl:279-283)
+    assert L.downstream(("ban", 2), lb5, CTX) == ("ban", 2)
+    lb6, extras = L.update(("ban", 2), lb5)
+    assert extras == [("add", (100, 1))]
+    assert lb6 == LeaderboardState(
+        {1: 2, 100: 1}, {}, frozenset([42, 2]), (100, 1), size
+    )
+
+    # adds/bans of banned players are noops at the origin
+    assert L.downstream(("add", (42, 50)), lb6, CTX) is None
+    assert L.downstream(("ban", 42), lb6, CTX) is None
+
+
+def test_ban_after_add():
+    """Port of ban_after_add_test (leaderboard.erl:420-447)."""
+    lb = L.new(2)
+    lb1, _ = L.update(("add", (1, 2)), lb)
+    assert lb1 == LeaderboardState({1: 2}, {}, frozenset(), (1, 2), 2)
+    lb2, extras = L.update(("ban", 1), lb1)
+    assert extras == []
+    assert lb2 == LeaderboardState({}, {}, frozenset([1]), NIL, 2)
+
+
+def test_ban_min_no_replacement():
+    """Port of ban_test (leaderboard.erl:450-491)."""
+    lb = L.new(2)
+    lb1, _ = L.update(("add", (1, 2)), lb)
+    lb2, _ = L.update(("add", (2, 1)), lb1)
+    assert lb2 == LeaderboardState({1: 2, 2: 1}, {}, frozenset(), (2, 1), 2)
+    lb3, extras = L.update(("ban", 1), lb2)
+    assert extras == []
+    assert lb3 == LeaderboardState({2: 1}, {}, frozenset([1]), (2, 1), 2)
+
+
+def test_add_after_ban():
+    """Port of add_after_ban_test (leaderboard.erl:494-499)."""
+    lb = L.new()
+    lb2, _ = L.update(("ban", 5), lb)
+    lb3, _ = L.update(("add", (5, 30)), lb2)
+    assert lb2 == lb3
+
+
+def test_noop_adds():
+    """Port of noop_add_test (leaderboard.erl:503-513)."""
+    lb = L.new(1)
+    lb2, _ = L.update(("add", (5, 10)), lb)
+    lb3, _ = L.update(("add", (5, 5)), lb2)
+    assert lb3 == lb2
+    lb4, _ = L.update(("add", (10, 9)), lb3)
+    lb5, _ = L.update(("add", (10, 6)), lb4)
+    assert lb4 == lb5
+
+
+def test_ban_min_with_replacement():
+    """Port of ban_min_with_replacement_test (leaderboard.erl:516-572)."""
+    lb = L.new(2)
+    lb1, _ = L.update(("add", (1, 2)), lb)
+    lb2, _ = L.update(("add", (2, 1)), lb1)
+    # add(3, 100): full board, beats min -> min (2,1) demoted to masked
+    assert L.downstream(("add", (3, 100)), lb2, CTX) == ("add", (3, 100))
+    lb3, _ = L.update(("add", (3, 100)), lb2)
+    assert lb3 == LeaderboardState(
+        {3: 100, 1: 2}, {2: 1}, frozenset(), (1, 2), 2
+    )
+    lb4, extras = L.update(("ban", 1), lb3)
+    assert extras == [("add", (2, 1))]
+    assert lb4 == LeaderboardState(
+        {3: 100, 2: 1}, {}, frozenset([1]), (2, 1), 2
+    )
+
+
+def test_add_several():
+    """Port of add_several_test (leaderboard.erl:575-627)."""
+    lb1 = L.new(2)
+    lb2, _ = L.update(("add", (5, 50)), lb1)
+    assert lb2 == LeaderboardState({5: 50}, {}, frozenset(), (5, 50), 2)
+    assert L.downstream(("add", (6, 60)), lb2, CTX) == ("add", (6, 60))
+    lb3, _ = L.update(("add", (6, 60)), lb2)
+    assert lb3 == LeaderboardState({5: 50, 6: 60}, {}, frozenset(), (5, 50), 2)
+    assert L.downstream(("add", (3, 30)), lb3, CTX) == ("add_r", (3, 30))
+    lb4, _ = L.update(("add_r", (3, 30)), lb3)
+    assert lb4 == LeaderboardState({5: 50, 6: 60}, {3: 30}, frozenset(), (5, 50), 2)
+    assert L.downstream(("add", (5, 100)), lb4, CTX) == ("add", (5, 100))
+    lb5, _ = L.update(("add", (5, 100)), lb4)
+    assert lb5 == LeaderboardState({5: 100, 6: 60}, {3: 30}, frozenset(), (6, 60), 2)
+    assert L.downstream(("add", (3, 40)), lb5, CTX) == ("add_r", (3, 40))
+    lb6, _ = L.update(("add_r", (3, 40)), lb5)
+    assert lb6 == LeaderboardState({5: 100, 6: 60}, {3: 40}, frozenset(), (6, 60), 2)
+    assert L.downstream(("add", (3, 10)), lb6, CTX) is None
+
+
+def test_value():
+    """Port of value_test (leaderboard.erl:630-636)."""
+    lb = L.new()
+    assert L.value(lb) == []
+    lb2, _ = L.update(("add", (50, 5)), lb)
+    assert L.value(lb2) == [(50, 5)]
+    lb3, _ = L.update(("add", (45, 6)), lb2)
+    assert L.value(lb3) == [(45, 6), (50, 5)]
+
+
+def test_min_and_largest():
+    """Ports of min_test / largest_test (leaderboard.erl:639-648)."""
+    assert _min_pair({}) == NIL
+    assert _min_pair({1: 1}) == (1, 1)
+    assert _min_pair({1: 1, 2: 5}) == (1, 1)
+    assert _largest({}) == NIL
+    assert _largest({1: 1}) == (1, 1)
+    assert _largest({1: 1, 2: 5}) == (2, 5)
+
+
+def test_binary_roundtrip():
+    """Port of binary_test (leaderboard.erl:651-655)."""
+    lb = L.new()
+    lb2, _ = L.update(("add", (1, 10)), lb)
+    lb3, _ = L.update(("ban", 9), lb2)
+    restored = L.from_binary(L.to_binary(lb3))
+    assert L.equal(lb3, restored)
+    assert restored == lb3
+
+
+def test_compaction():
+    a1, a2 = ("add", (1, 10)), ("add_r", (1, 20))
+    assert L.can_compact(a1, a2)
+    assert L.compact_ops(a1, a2) == (None, a2)
+    assert L.compact_ops(a2, a1) == (a2, None)
+    assert not L.can_compact(a1, ("add", (2, 5)))
+    b = ("ban", 1)
+    assert L.can_compact(a1, b)
+    assert L.compact_ops(a1, b) == (None, b)
+    assert L.can_compact(b, b)
+    assert L.compact_ops(b, b) == (None, b)
+    assert not L.can_compact(("ban", 1), ("ban", 2))

@@ -1,0 +1,258 @@
+"""Golden state-transition tests for scalar topk_rmv, ported from the
+reference EUnit suite (antidote_ccrdt_topk_rmv.erl:411-593) as cross-checks
+against reference semantics."""
+
+import pytest
+
+from antidote_ccrdt_tpu.core.clock import LogicalClock, ReplicaContext
+from antidote_ccrdt_tpu.models.topk_rmv import (
+    NIL,
+    TopkRmvScalar,
+    TopkRmvState,
+    _cmp,
+    _merge_vcs,
+)
+
+T = TopkRmvScalar()
+
+
+def ctx_with_clock(dc=0):
+    return ReplicaContext(dc_id=dc, clock=LogicalClock(), dc_index=dc)
+
+
+def test_mixed():
+    """Port of mixed_test (topk_rmv.erl:416-519)."""
+    ctx = ctx_with_clock()
+    dc = ctx.dc_id
+    size = 2
+    top = T.new(size)
+    assert top == TopkRmvState({}, {}, {}, {}, NIL, size)
+
+    # add(1, 2) -> observable add
+    op1 = T.downstream(("add", (1, 2)), top, ctx)
+    t1 = ctx.clock.get_time()
+    e1 = (2, 1, (dc, t1))
+    assert op1 == ("add", (1, 2, (dc, t1)))
+    top1, extra = T.update(op1, top)
+    assert extra == []
+    assert top1 == TopkRmvState(
+        {1: e1}, {1: frozenset([e1])}, {}, {dc: t1}, e1, size
+    )
+
+    # add(2, 2) -> observable add (room for two)
+    op2 = T.downstream(("add", (2, 2)), top1, ctx)
+    t2 = ctx.clock.get_time()
+    e2 = (2, 2, (dc, t2))
+    assert op2 == ("add", (2, 2, (dc, t2)))
+    top2, _ = T.update(op2, top1)
+    assert top2 == TopkRmvState(
+        {1: e1, 2: e2},
+        {1: frozenset([e1]), 2: frozenset([e2])},
+        {},
+        {dc: t2},
+        e1,
+        size,
+    )
+
+    # add(1, 0): dominated by the current observed elem for id 1 -> add_r
+    op3 = T.downstream(("add", (1, 0)), top2, ctx)
+    t3 = ctx.clock.get_time()
+    e3 = (0, 1, (dc, t3))
+    assert op3 == ("add_r", (1, 0, (dc, t3)))
+    top3, _ = T.update(op3, top2)
+    assert top3 == TopkRmvState(
+        {1: e1, 2: e2},
+        {1: frozenset([e1, e3]), 2: frozenset([e2])},
+        {},
+        {dc: t3},
+        e1,
+        size,
+    )
+
+    # rmv of an id nobody has seen -> noop
+    assert T.downstream(("rmv", 100), top3, ctx) is None
+
+    # add(100, 1): top is full and 1 < min score -> add_r
+    op4 = T.downstream(("add", (100, 1)), top3, ctx)
+    t4 = ctx.clock.get_time()
+    e4 = (1, 100, (dc, t4))
+    assert op4 == ("add_r", (100, 1, (dc, t4)))
+    top4, _ = T.update(op4, top3)
+    assert top4 == TopkRmvState(
+        {1: e1, 2: e2},
+        {1: frozenset([e1, e3]), 2: frozenset([e2]), 100: frozenset([e4])},
+        {},
+        {dc: t4},
+        e1,
+        size,
+    )
+
+    # rmv(1): removes observed id 1, promotes masked id 100, and the
+    # promotion is re-broadcast as an extra add op (topk_rmv.erl:291-295).
+    op5 = T.downstream(("rmv", 1), top4, ctx)
+    vc = {dc: t4}
+    assert op5 == ("rmv", (1, vc))
+    top5, extras = T.update(op5, top4)
+    assert extras == [("add", (100, 1, (dc, t4)))]
+    assert top5 == TopkRmvState(
+        {2: e2, 100: e4},
+        {2: frozenset([e2]), 100: frozenset([e4])},
+        {1: vc},
+        {dc: t4},
+        e4,
+        size,
+    )
+
+
+def test_masked_delete():
+    """Port of masked_delete_test (topk_rmv.erl:522-554)."""
+    ctx = ctx_with_clock()
+    dc = ctx.dc_id
+    top = T.new(1)
+    top1, _ = T.update(("add", (1, 42, (dc, 1))), top)
+    top2, _ = T.update(("add", (2, 5, (dc, 2))), top1)
+    rmv_op = T.downstream(("rmv", 2), top2, ctx)
+    # id 2 is masked but not observed -> tagged removal
+    assert rmv_op == ("rmv_r", (2, {dc: 2}))
+    top3, extras = T.update(rmv_op, top2)
+    assert extras == []
+    e1 = (42, 1, (dc, 1))
+    assert top3 == TopkRmvState(
+        {1: e1}, {1: frozenset([e1])}, {2: {dc: 2}}, {dc: 2}, e1, 1
+    )
+    # Re-adding the removed element bounces the stored removal back out.
+    top4, extras = T.update(("add", (2, 5, (dc, 2))), top3)
+    assert extras == [("rmv", (2, {dc: 2}))]
+    assert top4 == top3
+    # Removal of a never-seen id just records the tombstone.
+    top5, extras = T.update(("rmv", (50, {dc: 42})), top4)
+    assert extras == []
+    assert top5 == TopkRmvState(
+        {1: e1},
+        {1: frozenset([e1])},
+        {2: {dc: 2}, 50: {dc: 42}},
+        {dc: 2},
+        e1,
+        1,
+    )
+
+
+def test_merge_vcs():
+    """Port of simple_merge_vc_test (topk_rmv.erl:557-569)."""
+    assert _merge_vcs({}, {"a": 3}) == {"a": 3}
+    assert _merge_vcs({"a": 3}, {"a": 3}) == {"a": 3}
+    assert _merge_vcs({"a": 3}, {"a": 5}) == {"a": 5}
+    assert _merge_vcs({"a": 3, "b": 7}, {"a": 5}) == {"a": 5, "b": 7}
+
+
+def test_delete_semantics():
+    """Port of delete_semantics_test (topk_rmv.erl:572-593): two simulated
+    DCs, ops shipped across, convergence + add-after-remove bounce."""
+    ctx = ctx_with_clock()
+    dc = ctx.dc_id
+    dc1_top = T.new(1)
+    dc2_top = T.new(1)
+    id_ = 1
+    add_op = T.downstream(("add", (id_, 45)), dc1_top, ctx)
+    dc1_top2, _ = T.update(add_op, dc1_top)
+    add_op2 = T.downstream(("add", (id_, 50)), dc1_top, ctx)
+    t2 = ctx.clock.get_time()
+    assert add_op2 == ("add", (id_, 50, (dc, t2)))
+    dc1_top3, _ = T.update(add_op2, dc1_top2)
+    dc2_top2, _ = T.update(add_op2, dc2_top)
+    del_op = T.downstream(("rmv", id_), dc2_top2, ctx)
+    dc2_top3, _ = T.update(del_op, dc2_top2)
+    dc1_top4, _ = T.update(del_op, dc1_top3)
+    assert dc1_top4 == TopkRmvState(
+        {}, {}, {id_: {dc: t2}}, {dc: t2}, NIL, 1
+    )
+    assert dc1_top4 == dc2_top3
+    # Applying the earlier (already-dominated) add on DC2 re-broadcasts the rmv.
+    dc2_top4, extras = T.update(add_op, dc2_top3)
+    assert extras == [del_op]
+    assert dc2_top4 == dc2_top3
+
+
+def test_cmp_order():
+    assert _cmp((2, 1, (0, 1)), NIL)
+    assert not _cmp(NIL, (2, 1, (0, 1)))
+    assert _cmp((3, 1, (0, 1)), (2, 9, (0, 9)))  # score dominates
+    assert _cmp((2, 2, (0, 1)), (2, 1, (0, 9)))  # id breaks ties
+    assert _cmp((2, 1, (0, 5)), (2, 1, (0, 1)))  # ts breaks ties
+    assert not _cmp((2, 1, (0, 1)), (2, 1, (0, 1)))
+
+
+def test_value_and_equal():
+    ctx = ctx_with_clock()
+    top = T.new(2)
+    op = T.downstream(("add", (7, 10)), top, ctx)
+    top1, _ = T.update(op, top)
+    assert T.value(top1) == [(7, 10)]
+    top_b, _ = T.update(op, T.new(2))
+    assert T.equal(top1, top_b)
+    # equal ignores non-observable fields (topk_rmv.erl:151-153)
+    top_c = top_b._replace(removals={99: {0: 5}})
+    assert T.equal(top1, top_c)
+    assert not T.equal(top1, T.new(2))
+
+
+def test_serialization_roundtrip():
+    ctx = ctx_with_clock()
+    top = T.new(3)
+    for i, (idv, s) in enumerate([(1, 10), (2, 20), (3, 30), (1, 5)]):
+        op = T.downstream(("add", (idv, s)), top, ctx)
+        top, _ = T.update(op, top)
+    rmv = T.downstream(("rmv", 2), top, ctx)
+    top, _ = T.update(rmv, top)
+    blob = T.to_binary(top)
+    restored = T.from_binary(blob)
+    assert restored == top
+
+
+def test_compaction_rules():
+    """topk_rmv.erl:178-223: the pairwise compaction protocol."""
+    a1 = ("add", (1, 10, (0, 1)))
+    a2 = ("add", (1, 20, (0, 2)))
+    assert T.can_compact(a1, a2)
+    c1, c2 = T.compact_ops(a1, a2)
+    # keep-best, demote the other to a tagged add
+    assert c1 == ("add_r", (1, 10, (0, 1)))
+    assert c2 == ("add", (1, 20, (0, 2)))
+    c1, c2 = T.compact_ops(a2, a1)
+    assert c1 == ("add", (1, 20, (0, 2)))
+    assert c2 == ("add_r", (1, 10, (0, 1)))
+
+    # different ids never compact
+    assert not T.can_compact(a1, ("add", (2, 10, (0, 3))))
+
+    # add dominated by rmv: add dies
+    r = ("rmv", (1, {0: 5}))
+    assert T.can_compact(a1, r)
+    assert T.compact_ops(a1, r) == (None, r)
+    # add NOT dominated (newer ts) does not compact
+    a_new = ("add", (1, 10, (0, 9)))
+    assert not T.can_compact(a_new, r)
+    # (add, rmv_r) has no compaction clause in the reference
+    assert not T.can_compact(a1, ("rmv_r", (1, {0: 5})))
+
+    # rmv/rmv vc-merge
+    r1 = ("rmv", (1, {0: 5, 1: 2}))
+    r2 = ("rmv_r", (1, {1: 7}))
+    assert T.can_compact(r1, r2)
+    c1, c2 = T.compact_ops(r1, r2)
+    assert c1 is None
+    assert c2 == ("rmv", (1, {0: 5, 1: 7}))
+    # rmv_r pair stays tagged
+    c1, c2 = T.compact_ops(("rmv_r", (1, {0: 1})), ("rmv_r", (1, {2: 3})))
+    assert c2[0] == "rmv_r"
+
+
+def test_is_operation_and_tagging():
+    assert T.is_operation(("add", (1, 2)))
+    assert T.is_operation(("rmv", 1))
+    assert not T.is_operation(("add", 1))
+    assert not T.is_operation(("ban", 1))
+    assert T.is_replicate_tagged(("add_r", (1, 2, (0, 1))))
+    assert T.is_replicate_tagged(("rmv_r", (1, {})))
+    assert not T.is_replicate_tagged(("add", (1, 2, (0, 1))))
+    assert T.require_state_downstream(("add", (1, 2)))

@@ -1,0 +1,83 @@
+"""Tests for the rebuilt bounded topk (reference: antidote_ccrdt_topk.erl,
+rebuilt per SURVEY.md §2 quirk #1 as a real bounded top-K)."""
+
+from antidote_ccrdt_tpu.core.clock import LogicalClock, ReplicaContext
+from antidote_ccrdt_tpu.models.topk import TopkScalar, TopkState
+
+K = TopkScalar()
+CTX = ReplicaContext(dc_id=0, clock=LogicalClock())
+
+
+def test_new():
+    assert K.new(100) == TopkState({}, 100)
+    assert K.new() == TopkState({}, 100)
+
+
+def test_value_sorted_desc():
+    """Port of value_test (topk.erl:178-180): score desc, id desc tiebreak."""
+    st = TopkState({"foo": 102, "bar": 101}, 100)
+    assert K.value(st) == [("foo", 102), ("bar", 101)]
+    st2 = TopkState({1: 5, 2: 5}, 100)
+    assert K.value(st2) == [(2, 5), (1, 5)]
+
+
+def test_downstream_filters():
+    """Reference downstream drops ops that can't change state (topk.erl:90-94),
+    here with real bounded-top-K semantics."""
+    st = TopkState({"foo": 102, "bar": 101}, 2)
+    # full and below the min -> noop
+    assert K.downstream(("add", ("baz", 1)), st, CTX) is None
+    # beats the min -> ships
+    assert K.downstream(("add", ("baz", 500)), st, CTX) == ("add", ("baz", 500))
+    # dominated update of an existing id -> noop
+    assert K.downstream(("add", ("foo", 50)), st, CTX) is None
+    # improvement of an existing id -> ships
+    assert K.downstream(("add", ("foo", 200)), st, CTX) == ("add", ("foo", 200))
+    # room available -> ships
+    st_small = TopkState({"foo": 102}, 2)
+    assert K.downstream(("add", ("zap", 1)), st_small, CTX) == ("add", ("zap", 1))
+
+
+def test_update_bounded():
+    st = K.new(2)
+    st, _ = K.update(("add", (1, 10)), st)
+    st, _ = K.update(("add", (2, 20)), st)
+    st, _ = K.update(("add", (3, 30)), st)  # evicts id 1
+    assert st.entries == {2: 20, 3: 30}
+    st, _ = K.update(("add", (2, 5)), st)  # dominated: per-id max keeps 20
+    assert st.entries == {2: 20, 3: 30}
+
+
+def test_update_add_map():
+    st = K.new(100)
+    st, _ = K.update(("add_map", {"foo": 150, "bar": 200}), st)
+    assert st.entries == {"foo": 150, "bar": 200}
+
+
+def test_compaction_max_merge():
+    """Quirk #4 fix: duplicate ids compact to max, not last-wins."""
+    dead, merged = K.compact_ops(("add", (1, 50)), ("add", (1, 30)))
+    assert dead is None
+    assert merged == ("add_map", {1: 50})
+    dead, merged = K.compact_ops(("add", (1, 30)), ("add_map", {1: 50, 2: 10}))
+    assert merged == ("add_map", {1: 50, 2: 10})
+    dead, merged = K.compact_ops(
+        ("add_map", {"foo": 150}), ("add_map", {"bar": 200})
+    )
+    assert merged == ("add_map", {"foo": 150, "bar": 200})
+
+
+def test_convergence_is_order_independent():
+    ops = [("add", (i % 7, (i * 13) % 50)) for i in range(40)]
+    st1 = K.new(3)
+    for op in ops:
+        st1, _ = K.update(op, st1)
+    st2 = K.new(3)
+    for op in reversed(ops):
+        st2, _ = K.update(op, st2)
+    assert K.equal(st1, st2)
+
+
+def test_binary_roundtrip():
+    st, _ = K.update(("add", (1, 10)), K.new(5))
+    assert K.from_binary(K.to_binary(st)) == st

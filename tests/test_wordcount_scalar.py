@@ -1,0 +1,78 @@
+"""Tests for scalar wordcount / worddocumentcount, ported from
+antidote_ccrdt_wordcount.erl:90-98 and worddocumentcount.erl:91-101."""
+
+from antidote_ccrdt_tpu.core.clock import LogicalClock, ReplicaContext
+from antidote_ccrdt_tpu.models.wordcount import (
+    WordcountScalar,
+    WordDocumentCountScalar,
+    tokenize,
+)
+
+W = WordcountScalar()
+D = WordDocumentCountScalar()
+CTX = ReplicaContext(dc_id=0, clock=LogicalClock())
+
+
+def test_new():
+    assert W.new() == {}
+    assert D.new() == {}
+
+
+def test_wordcount_file():
+    """Port of file_test (wordcount.erl:95-98)."""
+    st, _ = W.update(("add", "foo bar baz baz"), W.new())
+    assert st == {"foo": 1, "bar": 1, "baz": 2}
+
+
+def test_worddocumentcount_file():
+    """Port of file_test (worddocumentcount.erl:96-101): per-document dedup."""
+    st, _ = D.update(("add", "foo bar baz baz"), D.new())
+    assert st == {"foo": 1, "bar": 1, "baz": 1}
+    st, _ = D.update(("add", "foo bar baz baz hello"), st)
+    assert st == {"foo": 2, "bar": 2, "baz": 2, "hello": 1}
+
+
+def test_tokenize_keeps_empties():
+    """Erlang binary:split/3 [global] parity: empty segments are words."""
+    assert tokenize("foo  bar") == ["foo", "", "bar"]
+    assert tokenize("a\nb c") == ["a", "b", "c"]
+    assert tokenize("") == [""]
+
+
+def test_newline_split():
+    st, _ = W.update(("add", "a\nb a"), W.new())
+    assert st == {"a": 2, "b": 1}
+
+
+def test_downstream_passthrough():
+    assert W.downstream(("add", "doc"), W.new(), CTX) == ("add", "doc")
+    assert not W.require_state_downstream(("add", "doc"))
+
+
+def test_compaction_fuses_counts():
+    """Quirk #3 fix: the reference drops both ops (wordcount.erl:70-72);
+    we fuse them into one add_counts op."""
+    dead, merged = W.compact_ops(("add", "foo bar"), ("add", "bar baz"))
+    assert dead is None
+    assert merged == ("add_counts", {"foo": 1, "bar": 2, "baz": 1})
+    # applying the fused op equals applying both originals
+    st1, _ = W.update(("add", "foo bar"), W.new())
+    st1, _ = W.update(("add", "bar baz"), st1)
+    st2, _ = W.update(merged, W.new())
+    assert st1 == st2
+
+
+def test_document_compaction_respects_dedup():
+    dead, merged = D.compact_ops(("add", "x x y"), ("add", "y"))
+    assert merged == ("add_counts", {"x": 1, "y": 2})
+
+
+def test_binary_roundtrip():
+    st, _ = W.update(("add", "hello world"), W.new())
+    assert W.from_binary(W.to_binary(st)) == st
+
+
+def test_is_operation():
+    assert W.is_operation(("add", "doc"))
+    assert not W.is_operation(("add", 5))
+    assert not W.is_replicate_tagged(("add", "doc"))

@@ -1,0 +1,32 @@
+"""Sort/segment primitives shared by the dense CRDT kernels.
+
+Everything here is shaped for XLA on TPU: multi-key lexicographic sorts via
+``lax.sort(num_keys=...)``, ranks within sorted groups via cumulative max —
+no data-dependent shapes, no scatter conflicts.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def group_rank(group_keys: Sequence[jax.Array]) -> jax.Array:
+    """Rank of each element within its group, for *already sorted* inputs.
+
+    `group_keys` are 1-D arrays that jointly identify the group (e.g. (key,
+    id)); elements of one group must be contiguous. Returns int32 ranks
+    0,1,2,... restarting at each group boundary.
+    """
+    n = group_keys[0].shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    first = jnp.zeros(n, dtype=bool).at[0].set(True)
+    for k in group_keys:
+        first = first | (k != jnp.roll(k, 1))
+    first = first.at[0].set(True)
+    # Position of each element's group start: running max of start indices.
+    start = lax.cummax(jnp.where(first, idx, 0))
+    return idx - start

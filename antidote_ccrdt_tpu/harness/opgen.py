@@ -1,0 +1,107 @@
+"""Synthetic workload generation for the multi-DC replay harness.
+
+Replaces the reference's hand-written EUnit scenarios and the absent host
+with parameterized op streams per BASELINE.md configs: Zipf-skewed id
+popularity, configurable add/remove mix, per-DC logical clocks.
+
+Two product shapes:
+
+* `prepare_stream` — prepare ops (("add", (id, score)) / ("rmv", id)) to be
+  run through each type's `downstream` at an origin replica: the faithful
+  op-based pipeline, used for parity replay and the CPU baseline.
+* `effect_batches` — pre-stamped dense effect-op batches (TopkRmvOps etc.)
+  for the TPU kernels: timestamps are assigned from per-DC logical clocks
+  and removal vcs track the generator's global delivery frontier, which
+  models causal broadcast (every op is delivered in generation order).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Workload:
+    n_replicas: int
+    n_ids: int
+    rmv_frac: float = 0.0
+    rmv_kind: str = "rmv"  # "ban" for leaderboard
+    zipf_a: float = 1.2  # Zipf exponent; <= 1.0 means uniform
+    score_max: int = 10_000
+    seed: int = 0
+
+
+def _draw_ids(rng: np.random.Generator, wl: Workload, n: int) -> np.ndarray:
+    if wl.zipf_a <= 1.0:
+        return rng.integers(0, wl.n_ids, size=n).astype(np.int32)
+    # Zipf over the id space: rejection-free via truncated zipf mod n_ids.
+    raw = rng.zipf(wl.zipf_a, size=n)
+    return ((raw - 1) % wl.n_ids).astype(np.int32)
+
+
+def prepare_stream(wl: Workload, n_ops: int) -> Iterator[Tuple[int, tuple]]:
+    """Yield (origin_replica, prepare_op) pairs."""
+    rng = np.random.default_rng(wl.seed)
+    origins = rng.integers(0, wl.n_replicas, size=n_ops)
+    ids = _draw_ids(rng, wl, n_ops)
+    scores = rng.integers(1, wl.score_max, size=n_ops)
+    rmv = rng.random(n_ops) < wl.rmv_frac
+    for j in range(n_ops):
+        if rmv[j]:
+            yield int(origins[j]), (wl.rmv_kind, int(ids[j]))
+        else:
+            yield int(origins[j]), ("add", (int(ids[j]), int(scores[j])))
+
+
+class TopkRmvEffectGen:
+    """Pre-stamped topk_rmv effect batches for the dense kernels.
+
+    Each replica r is a DC with its own monotone clock; removal vcs carry
+    the generator's frontier (max ts emitted per DC before the rmv), which
+    is exactly the state vc a replica would hold under in-order broadcast
+    delivery (the reference ships `Vc` from downstream, topk_rmv.erl:121).
+    """
+
+    def __init__(self, wl: Workload):
+        assert wl.n_replicas >= 1
+        self.wl = wl
+        self.rng = np.random.default_rng(wl.seed)
+        self.clock = np.zeros(wl.n_replicas, dtype=np.int64)  # per-DC ts
+        self.frontier = np.zeros(wl.n_replicas, dtype=np.int32)
+
+    def next_batch(self, adds_per_replica: int, rmvs_per_replica: int):
+        """Build one TopkRmvOps batch [R, B] / [R, Br]."""
+        from ..models.topk_rmv_dense import TopkRmvOps
+        import jax.numpy as jnp
+
+        wl, rng = self.wl, self.rng
+        R, B, Br = wl.n_replicas, adds_per_replica, rmvs_per_replica
+        add_id = np.stack([_draw_ids(rng, wl, B) for _ in range(R)])
+        add_score = rng.integers(1, wl.score_max, size=(R, B)).astype(np.int32)
+        add_dc = np.broadcast_to(
+            np.arange(R, dtype=np.int32)[:, None], (R, B)
+        ).copy()
+        add_ts = np.empty((R, B), dtype=np.int32)
+        for r in range(R):
+            add_ts[r] = np.arange(1, B + 1, dtype=np.int32) + self.clock[r]
+            self.clock[r] += B
+        rmv_id = np.stack([_draw_ids(rng, wl, Br) for _ in range(R)]) if Br else np.zeros((R, 0), np.int32)
+        # Removal vc: the emitting DC's causal frontier — everything emitted
+        # in earlier batches (all DCs) plus its own adds in this batch.
+        rmv_vc = np.broadcast_to(self.frontier[None, None, :], (R, Br, R)).copy()
+        for r in range(R):
+            rmv_vc[r, :, r] = self.clock[r]
+        self.frontier = self.clock.astype(np.int32).copy()
+        return TopkRmvOps(
+            add_key=jnp.zeros((R, B), jnp.int32),
+            add_id=jnp.asarray(add_id),
+            add_score=jnp.asarray(add_score),
+            add_dc=jnp.asarray(add_dc),
+            add_ts=jnp.asarray(add_ts),
+            rmv_key=jnp.zeros((R, max(Br, 1)), jnp.int32) if Br == 0 else jnp.zeros((R, Br), jnp.int32),
+            rmv_id=jnp.asarray(rmv_id) if Br else jnp.full((R, 1), -1, jnp.int32),
+            rmv_vc=jnp.asarray(rmv_vc) if Br else jnp.zeros((R, 1, R), jnp.int32),
+        )

@@ -234,3 +234,162 @@ class LeaderboardScalar:
 registry.register(
     "leaderboard", scalar=LeaderboardScalar(), generates_extra_operations=True
 )
+
+
+# --- dense (TPU) level ----------------------------------------------------
+
+import dataclasses  # noqa: E402
+import functools  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from ..core.behaviour import MergeKind  # noqa: E402
+from ..ops.dense_table import (  # noqa: E402
+    NEG_INF,
+    masked_topk,
+    observables_equal,
+    observe_value,
+)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class LeaderboardDenseState:
+    """Direct-indexed player table per (replica, instance): the best known
+    score per player and a permanent ban mask. The reference's
+    observed/masked/min split (leaderboard.erl:62-68) is an incremental-
+    computation artifact; the underlying lattice is exactly (per-player max
+    score, ban set), with the observable top-K *derived* — which makes the
+    cross-replica merge pure elementwise max/or."""
+
+    best_score: jax.Array  # i32[R, NK, P]; NEG_INF = never seen
+    banned: jax.Array  # bool[R, NK, P]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class LeaderboardOps:
+    """Effect-op batch per replica. add_valid/ban_valid mask padding."""
+
+    add_key: jax.Array  # i32[R, B]
+    add_id: jax.Array  # i32[R, B]
+    add_score: jax.Array  # i32[R, B]
+    add_valid: jax.Array  # bool[R, B]
+    ban_key: jax.Array  # i32[R, Bb]
+    ban_id: jax.Array  # i32[R, Bb]
+    ban_valid: jax.Array  # bool[R, Bb]
+
+
+class LeaderboardDense:
+    """Batched leaderboard over [n_replicas, n_keys]; P = player-id space,
+    K = board size. Cites: ban permanence (leaderboard.erl:21-27), ban wins
+    over any add (add_after_ban_test :494-499)."""
+
+    type_name = "leaderboard"
+    merge_kind = MergeKind.JOIN
+
+    def __init__(self, n_players: int, size: int = 100):
+        self.P = n_players
+        self.K = size
+
+    def init(self, n_replicas: int, n_keys: int = 1) -> LeaderboardDenseState:
+        shape = (n_replicas, n_keys, self.P)
+        return LeaderboardDenseState(
+            best_score=jnp.full(shape, NEG_INF, dtype=jnp.int32),
+            banned=jnp.zeros(shape, dtype=bool),
+        )
+
+    @functools.partial(jax.jit, static_argnums=(0, 3))
+    def apply_ops(
+        self,
+        state: LeaderboardDenseState,
+        ops: LeaderboardOps,
+        collect_promotions: bool = False,
+    ):
+        old_obs = self.observe(state) if collect_promotions else None
+        NK = state.best_score.shape[1]
+
+        def per_replica(score, banned, o: LeaderboardOps):
+            ak = jnp.where(o.add_valid, o.add_key, NK)  # OOB -> dropped
+            score = score.at[ak, o.add_id].max(o.add_score, mode="drop")
+            bk = jnp.where(o.ban_valid, o.ban_key, NK)
+            banned = banned.at[bk, o.ban_id].set(True, mode="drop")
+            return score, banned
+
+        score, banned = jax.vmap(per_replica)(state.best_score, state.banned, ops)
+        new_state = LeaderboardDenseState(score, banned)
+        promoted = None
+        if collect_promotions:
+            promoted = self._promotions(old_obs, self.observe(new_state), ops)
+        return new_state, promoted
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def merge(self, a: LeaderboardDenseState, b: LeaderboardDenseState):
+        return LeaderboardDenseState(
+            best_score=jnp.maximum(a.best_score, b.best_score),
+            banned=a.banned | b.banned,
+        )
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def observe(self, state: LeaderboardDenseState):
+        """(ids, scores, valid) of the top-K non-banned players, score desc
+        with id-desc tiebreak (leaderboard cmp, :289-294)."""
+        return masked_topk(
+            jnp.where(state.banned, NEG_INF, state.best_score), self.K
+        )
+
+    def value(self, state: LeaderboardDenseState):
+        return observe_value(self.observe, state)
+
+    def equal(self, a, b) -> bool:
+        return observables_equal(self.observe(a), self.observe(b))
+
+    def _promotions(self, old, new, ops: LeaderboardOps):
+        """Entries of the new observable absent from both the old observable
+        and this batch's adds *to the same instance* — i.e. uncovered by
+        bans (leaderboard.erl:279-283); identity is (id, score) since adds
+        carry no timestamps."""
+        old_ids, old_scores, old_valid = old
+        new_ids, new_scores, new_valid = new
+
+        def one(nk, n_ids, n_scores, n_valid, o_ids, o_scores, o_valid, a_key, a_id, a_score, a_valid):
+            in_old = jnp.any(
+                (n_ids[:, None] == o_ids[None, :])
+                & (n_scores[:, None] == o_scores[None, :])
+                & o_valid[None, :],
+                axis=1,
+            )
+            in_batch = jnp.any(
+                (n_ids[:, None] == a_id[None, :])
+                & (n_scores[:, None] == a_score[None, :])
+                & (a_key[None, :] == nk)
+                & a_valid[None, :],
+                axis=1,
+            )
+            return n_ids, n_scores, n_valid & ~in_old & ~in_batch
+
+        def per_replica(n_i, n_s, n_v, o_i, o_s, o_v, a_key, a_id, a_score, a_valid):
+            nks = jnp.arange(n_i.shape[0], dtype=jnp.int32)
+            return jax.vmap(
+                lambda nk, ni, ns, nv, oi, osc, ov: one(
+                    nk, ni, ns, nv, oi, osc, ov, a_key, a_id, a_score, a_valid
+                )
+            )(nks, n_i, n_s, n_v, o_i, o_s, o_v)
+
+        return jax.vmap(per_replica)(
+            new_ids,
+            new_scores,
+            new_valid,
+            old_ids,
+            old_scores,
+            old_valid,
+            ops.add_key,
+            ops.add_id,
+            ops.add_score,
+            ops.add_valid,
+        )
+
+
+def make_dense(n_players: int, size: int = 100) -> LeaderboardDense:
+    return LeaderboardDense(n_players=n_players, size=size)

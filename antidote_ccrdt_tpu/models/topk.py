@@ -141,3 +141,89 @@ class TopkScalar:
 
 
 registry.register("topk", scalar=TopkScalar())
+
+
+# --- dense (TPU) level ----------------------------------------------------
+
+import dataclasses  # noqa: E402
+import functools  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from ..core.behaviour import MergeKind  # noqa: E402
+from ..ops.dense_table import (  # noqa: E402
+    NEG_INF,
+    masked_topk,
+    observables_equal,
+    observe_value,
+)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TopkDenseState:
+    """Per-id best-score table [R, NK, I]; the bounded top-K observable is
+    derived. The dense lattice keeps every id's max (join = elementwise
+    max), which refines the scalar bounded state without changing the
+    observable — eviction is a reader-side concern on TPU."""
+
+    best_score: jax.Array  # i32[R, NK, I]; NEG_INF = never seen
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TopkOps:
+    key: jax.Array  # i32[R, B]
+    id: jax.Array  # i32[R, B]
+    score: jax.Array  # i32[R, B]
+    valid: jax.Array  # bool[R, B]
+
+
+class TopkDense:
+    type_name = "topk"
+    merge_kind = MergeKind.JOIN
+
+    def __init__(self, n_ids: int, size: int = 100):
+        self.I = n_ids
+        self.K = size
+
+    def init(self, n_replicas: int, n_keys: int = 1) -> TopkDenseState:
+        return TopkDenseState(
+            best_score=jnp.full((n_replicas, n_keys, self.I), NEG_INF, jnp.int32)
+        )
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def apply_ops(self, state: TopkDenseState, ops: TopkOps):
+        NK = state.best_score.shape[1]
+
+        def per_replica(score, key, id_, s, valid):
+            k = jnp.where(valid, key, NK)
+            return score.at[k, id_].max(s, mode="drop")
+
+        return (
+            TopkDenseState(
+                jax.vmap(per_replica)(
+                    state.best_score, ops.key, ops.id, ops.score, ops.valid
+                )
+            ),
+            None,
+        )
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def merge(self, a: TopkDenseState, b: TopkDenseState):
+        return TopkDenseState(jnp.maximum(a.best_score, b.best_score))
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def observe(self, state: TopkDenseState):
+        return masked_topk(state.best_score, self.K)
+
+    def value(self, state: TopkDenseState):
+        return observe_value(self.observe, state)
+
+    def equal(self, a, b) -> bool:
+        return observables_equal(self.observe(a), self.observe(b))
+
+
+def make_dense(n_ids: int, size: int = 100) -> TopkDense:
+    return TopkDense(n_ids=n_ids, size=size)

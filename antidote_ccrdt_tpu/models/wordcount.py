@@ -121,3 +121,121 @@ class WordDocumentCountScalar(_WordcountBase):
 
 registry.register("wordcount", scalar=WordcountScalar())
 registry.register("worddocumentcount", scalar=WordDocumentCountScalar())
+
+
+# --- dense (TPU) level ----------------------------------------------------
+
+import dataclasses  # noqa: E402
+import functools  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from ..core.behaviour import MergeKind  # noqa: E402
+
+
+class VocabEncoder:
+    """Exact token -> dense id mapping (host-side), grown on demand.
+
+    Tokenization happens on the host (the reference also does the split in
+    the update itself, wordcount.erl:76-85); the device only ever sees
+    integer token ids. For the ragged/unbounded-vocab benchmark config use
+    `hash_token` instead — collisions then conflate words, the standard
+    hashed-vocabulary trade."""
+
+    def __init__(self):
+        self.vocab: Dict[str, int] = {}
+
+    def encode(self, doc: str, per_document: bool = False) -> list:
+        tokens = tokenize(doc)
+        if per_document:
+            # worddocumentcount: <=1 contribution per word per document
+            # (worddocumentcount.erl:76-86).
+            tokens = sorted(set(tokens))
+        out = []
+        for t in tokens:
+            if t not in self.vocab:
+                self.vocab[t] = len(self.vocab)
+            out.append(self.vocab[t])
+        return out
+
+    def decode_counts(self, counts) -> Dict[str, int]:
+        inv = {i: t for t, i in self.vocab.items()}
+        return {
+            inv[i]: int(c) for i, c in enumerate(counts) if int(c) != 0 and i in inv
+        }
+
+
+def hash_token(token: str, n_buckets: int) -> int:
+    """FNV-1a 32-bit, stable across runs/processes (unlike Python's hash)."""
+    h = 2166136261
+    for b in token.encode("utf-8"):
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    return h % n_buckets
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class WordcountDenseState:
+    counts: jax.Array  # i32[R, NK, V]
+    lost: jax.Array  # i32[R, NK] — tokens dropped because id >= V
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class WordcountOps:
+    """Token-id batch per replica; token < 0 marks padding."""
+
+    key: jax.Array  # i32[R, B]
+    token: jax.Array  # i32[R, B]
+
+
+class WordcountDense:
+    """Both wordcount variants share this kernel: the per-document dedup of
+    worddocumentcount is an encode-time concern (VocabEncoder per_document).
+    Counts form a commutative monoid, so per-replica states are deltas and
+    merge is + (MONOID; cf. MergeKind)."""
+
+    type_name = "wordcount"
+    merge_kind = MergeKind.MONOID
+
+    def __init__(self, n_buckets: int):
+        self.V = n_buckets
+
+    def init(self, n_replicas: int, n_keys: int = 1) -> WordcountDenseState:
+        return WordcountDenseState(
+            counts=jnp.zeros((n_replicas, n_keys, self.V), jnp.int32),
+            lost=jnp.zeros((n_replicas, n_keys), jnp.int32),
+        )
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def apply_ops(self, state: WordcountDenseState, ops: WordcountOps):
+        NK, V = state.counts.shape[1], self.V
+
+        def per_replica(counts, lost, key, token):
+            k = jnp.where(token >= 0, key, NK)  # padding -> dropped
+            counts = counts.at[k, token].add(1, mode="drop")
+            # Token ids beyond the table are dropped by the scatter; record
+            # them so exactness loss is visible (cf. topk_rmv's lossy flag).
+            over = jnp.where(token >= V, k, NK)
+            lost = lost.at[over].add(1, mode="drop")
+            return counts, lost
+
+        counts, lost = jax.vmap(per_replica)(
+            state.counts, state.lost, ops.key, ops.token
+        )
+        return WordcountDenseState(counts, lost), None
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def merge(self, a: WordcountDenseState, b: WordcountDenseState):
+        return WordcountDenseState(a.counts + b.counts, a.lost + b.lost)
+
+    def observe(self, state: WordcountDenseState):
+        return state.counts
+
+    def equal(self, a, b) -> bool:
+        return bool(jnp.all(a.counts == b.counts))
+
+
+def make_dense(n_buckets: int) -> WordcountDense:
+    return WordcountDense(n_buckets=n_buckets)

@@ -1,0 +1,58 @@
+"""Shared helpers for direct-indexed dense score tables.
+
+Used by the dense topk and leaderboard kernels (and NEG_INF by topk_rmv):
+a per-id best-score table [R, NK, P] whose observable is the masked top-K,
+derived by one 2-key sort — score desc, id desc tiebreak, matching both
+reference cmp orders (topk.erl:83, leaderboard.erl:289-294).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Safe "minus infinity" score sentinel: negatable in int32.
+NEG_INF = jnp.int32(-(2**31 - 1))
+
+
+def masked_topk(scores: jax.Array, k: int):
+    """(ids, scores, valid) of the top-k entries of a [..., P] score table;
+    NEG_INF marks absent entries."""
+    ids = jnp.broadcast_to(
+        jnp.arange(scores.shape[-1], dtype=jnp.int32), scores.shape
+    )
+    ns, ni = lax.sort((-scores, -ids), num_keys=2, dimension=-1)
+    top = -ns[..., :k]
+    return (-ni[..., :k], top, top > NEG_INF)
+
+
+def observe_value(observe_fn, state):
+    """Materialize an (ids, scores, valid) observable to host as nested
+    [(id, score)] lists per (replica, instance) — the value/1 shape."""
+    ids, scores, valid = jax.device_get(observe_fn(state))
+    R, NK, K = ids.shape
+    return [
+        [
+            [
+                (int(ids[r, nk, j]), int(scores[r, nk, j]))
+                for j in range(K)
+                if valid[r, nk, j]
+            ]
+            for nk in range(NK)
+        ]
+        for r in range(R)
+    ]
+
+
+def observables_equal(a_obs, b_obs) -> bool:
+    """Observable-state equality on (ids, scores, valid) triples."""
+    ia, sa, va = a_obs
+    ib, sb, vb = b_obs
+    return bool(
+        jnp.all(
+            (va == vb)
+            & jnp.where(va, ia == ib, True)
+            & jnp.where(va, sa == sb, True)
+        )
+    )

@@ -1,0 +1,143 @@
+"""North-star benchmark: topk_rmv effect-op merge throughput.
+
+Config (BASELINE.md): topk_rmv K=100, 100k-element id space, 32 simulated
+replicas/DCs, concurrent add/rmv workload. Compares:
+
+* dense TPU path — `TopkRmvDense.apply_ops` over [32] replicas in one
+  dispatch per round, plus one whole-grid replica-state merge dispatch;
+* CPU baseline — the scalar (reference-semantics) implementation applying
+  the identical effect ops one at a time (the "BEAM stand-in": the
+  reference publishes no numbers, SURVEY.md §6, so the baseline is measured
+  by reimplementing its semantics faithfully).
+
+Metric: "merges/sec" = effect-op applications per second summed over
+replicas (every applied op is one CRDT merge of an op into a state), the
+BASELINE.json headline; plus p50 per-round merge latency and the
+batched replica-state merge rate.
+
+Prints exactly ONE JSON line.
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+import numpy as np
+
+
+def bench_dense(R, I, D_DCS, K, M, B, Br, rounds):
+    import jax
+
+    from antidote_ccrdt_tpu.harness.opgen import TopkRmvEffectGen, Workload
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import make_dense
+
+    D = make_dense(n_ids=I, n_dcs=D_DCS, size=K, slots_per_id=M)
+    state = D.init(n_replicas=R, n_keys=1)
+    gen = TopkRmvEffectGen(
+        Workload(n_replicas=R, n_ids=I, zipf_a=1.2, score_max=100_000, seed=7)
+    )
+    batches = [gen.next_batch(B, Br) for _ in range(rounds + 2)]
+
+    # Warmup (compile)
+    state, _ = D.apply_ops(state, batches[0])
+    state, _ = D.apply_ops(state, batches[1])
+    jax.block_until_ready(state.slot_ts)
+
+    times = []
+    for i in range(rounds):
+        t0 = time.perf_counter()
+        state, _ = D.apply_ops(state, batches[2 + i])
+        jax.block_until_ready(state.slot_ts)
+        times.append(time.perf_counter() - t0)
+    ops_per_round = R * (B + Br)
+    apply_rate = ops_per_round * rounds / sum(times)
+    p50_ms = statistics.median(times) * 1e3
+
+    # Batched replica-state merge: all R pairwise merges in ONE dispatch
+    # (state row r joined with row (r+1) mod R) — the literal north-star
+    # "merge thousands of replica states in one vectorized step".
+    def rolled(s):
+        return jax.tree.map(lambda x: jnp_roll(x), s)
+
+    import jax.numpy as jnp
+
+    def jnp_roll(x):
+        return jnp.roll(x, 1, axis=0)
+
+    merged = D.merge(state, rolled(state))  # compile
+    jax.block_until_ready(merged.slot_ts)
+    t0 = time.perf_counter()
+    MERGE_REPS = 10
+    for _ in range(MERGE_REPS):
+        merged = D.merge(merged, rolled(merged))
+    jax.block_until_ready(merged.slot_ts)
+    state_merges_per_sec = MERGE_REPS * R / (time.perf_counter() - t0)
+
+    return apply_rate, p50_ms, state_merges_per_sec
+
+
+def bench_scalar_baseline(R, I, D_DCS, K, n_ops):
+    """Apply the same kind of effect ops through the scalar reference
+    semantics, one op per `update` call, on one CPU core."""
+    from antidote_ccrdt_tpu.models.topk_rmv import TopkRmvScalar
+
+    S = TopkRmvScalar()
+    state = S.new(K)
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, I, n_ops)
+    scores = rng.integers(1, 100_000, n_ops)
+    dcs = rng.integers(0, D_DCS, n_ops)
+    is_rmv = rng.random(n_ops) < 0.1
+    frontier = {}
+    effects = []
+    for j in range(n_ops):
+        dc = int(dcs[j])
+        if is_rmv[j]:
+            effects.append(("rmv", (int(ids[j]), dict(frontier))))
+        else:
+            ts = frontier.get(dc, 0) + 1
+            frontier[dc] = ts
+            effects.append(("add", (int(ids[j]), int(scores[j]), (dc, ts))))
+    t0 = time.perf_counter()
+    for eff in effects:
+        state, _extras = S.update(eff, state)
+    dt = time.perf_counter() - t0
+    return n_ops / dt
+
+
+def main():
+    import jax
+
+    backend = jax.default_backend()
+    if backend == "cpu":
+        # CI / no-accelerator fallback: shrink so the bench still completes.
+        R, I, B, Br, rounds, base_ops = 8, 10_000, 1024, 64, 5, 5_000
+    else:
+        R, I, B, Br, rounds, base_ops = 32, 100_000, 4096, 256, 10, 20_000
+    D_DCS, K, M = R, 100, 4  # every simulated replica is a DC: vc width = R
+
+    apply_rate, p50_ms, state_merge_rate = bench_dense(
+        R, I, D_DCS, K, M, B, Br, rounds
+    )
+    baseline_rate = bench_scalar_baseline(R, I, D_DCS, K, base_ops)
+
+    print(
+        json.dumps(
+            {
+                "metric": f"topk_rmv merges/sec ({I//1000}k ids x {R} replicas, K={K})",
+                "value": round(apply_rate),
+                "unit": "merges/sec",
+                "vs_baseline": round(apply_rate / baseline_rate, 2),
+                "p50_round_latency_ms": round(p50_ms, 2),
+                "replica_state_merges_per_sec": round(state_merge_rate, 1),
+                "baseline_cpu_merges_per_sec": round(baseline_rate),
+                "backend": backend,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,13 +1,16 @@
 """Test rig: run JAX on a virtual 8-device CPU mesh.
 
-Multi-chip TPU hardware is not available in CI; sharding/collective tests run
-against XLA's host-platform device-count override instead (the same compiled
-programs run unchanged on a real TPU mesh). Must run before jax imports.
+Multi-chip TPU hardware is not available in CI; sharding/collective tests
+run against 8 virtual CPU devices instead (the same compiled programs run
+unchanged on a real TPU mesh).
+
+Note: this environment's axon TPU plugin force-selects ``jax_platforms=
+"axon,cpu"`` from sitecustomize, overriding JAX_PLATFORMS/XLA_FLAGS env
+vars — so the override must go through jax.config, before any backend
+initialization (conftest imports early enough).
 """
 
-import os
+import jax
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

@@ -1,0 +1,280 @@
+"""Dense leaderboard / topk / wordcount kernels: differential tests against
+the scalar (reference-semantics) implementations."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from antidote_ccrdt_tpu.core.clock import LogicalClock, ReplicaContext
+from antidote_ccrdt_tpu.models import leaderboard as lb
+from antidote_ccrdt_tpu.models import topk as tk
+from antidote_ccrdt_tpu.models import wordcount as wc
+
+CTX = ReplicaContext(dc_id=0, clock=LogicalClock())
+
+
+# --- leaderboard ----------------------------------------------------------
+
+def lb_pack(effects, pad=64):
+    adds = [e[1] for e in effects if e[0] in ("add", "add_r")]
+    bans = [e[1] for e in effects if e[0] == "ban"]
+    B, Bb = max(pad, len(adds)), max(8, len(bans))
+    a_id = np.zeros(B, np.int32)
+    a_sc = np.zeros(B, np.int32)
+    a_v = np.zeros(B, bool)
+    for j, (i, s) in enumerate(adds):
+        a_id[j], a_sc[j], a_v[j] = i, s, True
+    b_id = np.zeros(Bb, np.int32)
+    b_v = np.zeros(Bb, bool)
+    for j, i in enumerate(bans):
+        b_id[j], b_v[j] = i, True
+    z = np.zeros_like
+    return lb.LeaderboardOps(
+        add_key=jnp.asarray(z(a_id)[None]),
+        add_id=jnp.asarray(a_id[None]),
+        add_score=jnp.asarray(a_sc[None]),
+        add_valid=jnp.asarray(a_v[None]),
+        ban_key=jnp.asarray(z(b_id)[None]),
+        ban_id=jnp.asarray(b_id[None]),
+        ban_valid=jnp.asarray(b_v[None]),
+    )
+
+
+def gen_lb_log(rng, n_ops, n_players, size, ban_frac=0.1):
+    S = lb.LeaderboardScalar()
+    origin = S.new(size)
+    log = []
+    for _ in range(n_ops):
+        if rng.random() < ban_frac:
+            op = ("ban", int(rng.integers(n_players)))
+        else:
+            op = ("add", (int(rng.integers(n_players)), int(rng.integers(1, 500))))
+        eff = S.downstream(op, origin, CTX)
+        if eff is None:
+            continue
+        origin, extras = S.update(eff, origin)
+        log.append(eff)
+        # extras (promotions) would re-ship; locally already applied
+    return origin, log
+
+
+def test_leaderboard_differential():
+    S = lb.LeaderboardScalar()
+    rng = np.random.default_rng(2)
+    for trial in range(5):
+        n_players, size = 40, 5
+        origin, log = gen_lb_log(rng, 150, n_players, size)
+        D = lb.make_dense(n_players=n_players, size=size)
+        st = D.init(1, 1)
+        st, _ = D.apply_ops(st, lb_pack(log, pad=256))
+        assert set(D.value(st)[0][0]) == set(S.value(origin)), f"trial {trial}"
+
+
+def test_leaderboard_ban_wins_any_order():
+    D = lb.make_dense(n_players=8, size=2)
+    a = D.init(1, 1)
+    b = D.init(1, 1)
+    add = [("add", (3, 50))]
+    ban = [("ban", 3)]
+    a, _ = D.apply_ops(a, lb_pack(add))
+    a, _ = D.apply_ops(a, lb_pack(ban))
+    b, _ = D.apply_ops(b, lb_pack(ban))
+    b, _ = D.apply_ops(b, lb_pack(add))
+    assert D.equal(a, b)
+    assert D.value(a)[0][0] == []
+
+
+def test_leaderboard_merge_laws():
+    rng = np.random.default_rng(9)
+    D = lb.make_dense(n_players=20, size=4)
+
+    def rand_state(seed):
+        r = np.random.default_rng(seed)
+        _, log = gen_lb_log(r, 60, 20, 4)
+        st = D.init(1, 1)
+        st, _ = D.apply_ops(st, lb_pack(log, pad=128))
+        return st
+
+    a, b, c = rand_state(1), rand_state(2), rand_state(3)
+    assert D.equal(D.merge(a, b), D.merge(b, a))
+    assert D.equal(D.merge(D.merge(a, b), c), D.merge(a, D.merge(b, c)))
+    assert D.equal(D.merge(a, a), a)
+
+
+def test_leaderboard_promotion_collected():
+    """Dense analogue of ban_min_with_replacement_test (leaderboard.erl:
+    516-572): banning an observed player uncovers the masked one."""
+    D = lb.make_dense(n_players=8, size=2)
+    st = D.init(1, 1)
+    st, _ = D.apply_ops(st, lb_pack([("add", (1, 2)), ("add", (2, 1)), ("add", (3, 100))]))
+    assert set(D.value(st)[0][0]) == {(3, 100), (1, 2)}
+    st, promoted = D.apply_ops(st, lb_pack([("ban", 1)]), collect_promotions=True)
+    assert set(D.value(st)[0][0]) == {(3, 100), (2, 1)}
+    ids, scores, valid = promoted
+    got = [
+        (int(ids[0, 0, j]), int(scores[0, 0, j]))
+        for j in range(ids.shape[-1])
+        if bool(valid[0, 0, j])
+    ]
+    assert got == [(2, 1)]
+
+
+def test_leaderboard_promotion_not_suppressed_cross_instance():
+    """Regression: an add to one instance must not mask a same-(id,score)
+    promotion in another instance (promotion matching is key-aware)."""
+    import jax.numpy as jnp
+
+    D = lb.make_dense(n_players=8, size=2)
+    st = D.init(1, 2)
+    # instance 1: full board {1:100, 2:50} with masked 3:10
+    setup = lb.LeaderboardOps(
+        add_key=jnp.asarray([[1, 1, 1]], jnp.int32),
+        add_id=jnp.asarray([[1, 2, 3]], jnp.int32),
+        add_score=jnp.asarray([[100, 50, 10]], jnp.int32),
+        add_valid=jnp.asarray([[True, True, True]]),
+        ban_key=jnp.zeros((1, 1), jnp.int32),
+        ban_id=jnp.zeros((1, 1), jnp.int32),
+        ban_valid=jnp.asarray([[False]]),
+    )
+    st, _ = D.apply_ops(st, setup)
+    # One batch: ban id=1 in instance 1 AND add (3, 10) to instance 0.
+    batch = lb.LeaderboardOps(
+        add_key=jnp.asarray([[0]], jnp.int32),
+        add_id=jnp.asarray([[3]], jnp.int32),
+        add_score=jnp.asarray([[10]], jnp.int32),
+        add_valid=jnp.asarray([[True]]),
+        ban_key=jnp.asarray([[1]], jnp.int32),
+        ban_id=jnp.asarray([[1]], jnp.int32),
+        ban_valid=jnp.asarray([[True]]),
+    )
+    st, promoted = D.apply_ops(st, batch, collect_promotions=True)
+    ids, scores, valid = promoted
+    got_inst1 = [
+        (int(ids[0, 1, j]), int(scores[0, 1, j]))
+        for j in range(ids.shape[-1])
+        if bool(valid[0, 1, j])
+    ]
+    assert got_inst1 == [(3, 10)]
+
+
+# --- topk -----------------------------------------------------------------
+
+def tk_pack(items, pad=64):
+    B = max(pad, len(items))
+    i_ = np.zeros(B, np.int32)
+    s_ = np.zeros(B, np.int32)
+    v_ = np.zeros(B, bool)
+    for j, (i, s) in enumerate(items):
+        i_[j], s_[j], v_[j] = i, s, True
+    return tk.TopkOps(
+        key=jnp.asarray(np.zeros_like(i_)[None]),
+        id=jnp.asarray(i_[None]),
+        score=jnp.asarray(s_[None]),
+        valid=jnp.asarray(v_[None]),
+    )
+
+
+def test_topk_differential():
+    S = tk.TopkScalar()
+    rng = np.random.default_rng(4)
+    for trial in range(5):
+        n_ids, size = 30, 4
+        scalar = S.new(size)
+        items = []
+        for _ in range(100):
+            op = ("add", (int(rng.integers(n_ids)), int(rng.integers(1, 300))))
+            eff = S.downstream(op, scalar, CTX)
+            if eff is None:
+                continue
+            scalar, _ = S.update(eff, scalar)
+            items.append(eff[1])
+        D = tk.make_dense(n_ids=n_ids, size=size)
+        st = D.init(1, 1)
+        st, _ = D.apply_ops(st, tk_pack(items, pad=128))
+        assert set(D.value(st)[0][0]) == set(
+            (i, s) for i, s in S.value(scalar)
+        ), f"trial {trial}"
+
+
+def test_topk_merge_is_join():
+    D = tk.make_dense(n_ids=10, size=3)
+    a = D.init(1, 1)
+    a, _ = D.apply_ops(a, tk_pack([(1, 10), (2, 20)]))
+    b = D.init(1, 1)
+    b, _ = D.apply_ops(b, tk_pack([(1, 15), (3, 5)]))
+    m = D.merge(a, b)
+    assert set(D.value(m)[0][0]) == {(1, 15), (2, 20), (3, 5)}
+    assert D.equal(D.merge(m, a), m)  # idempotent absorption
+
+
+# --- wordcount ------------------------------------------------------------
+
+def wc_pack(token_ids, pad=256):
+    B = max(pad, len(token_ids))
+    t = np.full(B, -1, np.int32)
+    t[: len(token_ids)] = token_ids
+    return wc.WordcountOps(
+        key=jnp.asarray(np.zeros(B, np.int32)[None]), token=jnp.asarray(t[None])
+    )
+
+
+def test_wordcount_differential():
+    S = wc.WordcountScalar()
+    enc = wc.VocabEncoder()
+    docs = ["foo bar baz baz", "foo  bar", "a\nb a", ""]
+    scalar = S.new()
+    tokens = []
+    for d in docs:
+        scalar, _ = S.update(("add", d), scalar)
+        tokens.extend(enc.encode(d))
+    D = wc.make_dense(n_buckets=64)
+    st = D.init(1, 1)
+    st, _ = D.apply_ops(st, wc_pack(tokens))
+    counts = np.asarray(st.counts[0, 0])
+    assert enc.decode_counts(counts) == S.value(scalar)
+
+
+def test_worddocumentcount_differential():
+    S = wc.WordDocumentCountScalar()
+    enc = wc.VocabEncoder()
+    docs = ["foo bar baz baz", "foo bar baz baz hello"]
+    scalar = S.new()
+    tokens = []
+    for d in docs:
+        scalar, _ = S.update(("add", d), scalar)
+        tokens.extend(enc.encode(d, per_document=True))
+    D = wc.make_dense(n_buckets=64)
+    st = D.init(1, 1)
+    st, _ = D.apply_ops(st, wc_pack(tokens))
+    assert enc.decode_counts(np.asarray(st.counts[0, 0])) == S.value(scalar)
+
+
+def test_wordcount_monoid_merge():
+    """Per-replica deltas combine exactly once across replicas."""
+    enc = wc.VocabEncoder()
+    D = wc.make_dense(n_buckets=32)
+    a = D.init(1, 1)
+    a, _ = D.apply_ops(a, wc_pack(enc.encode("x y")))
+    b = D.init(1, 1)
+    b, _ = D.apply_ops(b, wc_pack(enc.encode("y z")))
+    m = D.merge(a, b)
+    assert enc.decode_counts(np.asarray(m.counts[0, 0])) == {"x": 1, "y": 2, "z": 1}
+
+
+def test_wordcount_overflow_tracked():
+    """Token ids beyond the table must be counted as lost, not silently
+    dropped (regression)."""
+    D = wc.make_dense(n_buckets=4)
+    st = D.init(1, 1)
+    st, _ = D.apply_ops(st, wc_pack([0, 1, 4, 5, 2], pad=8))
+    assert st.counts[0, 0].tolist() == [1, 1, 1, 0]
+    assert int(st.lost[0, 0]) == 2
+    m = D.merge(st, st)
+    assert int(m.lost[0, 0]) == 4
+
+
+def test_hash_token_stable():
+    assert wc.hash_token("hello", 1024) == wc.hash_token("hello", 1024)
+    assert 0 <= wc.hash_token("hello", 1024) < 1024
+    # distinct under a reasonable bucket count for these tokens
+    assert wc.hash_token("hello", 1 << 20) != wc.hash_token("world", 1 << 20)

@@ -1,0 +1,101 @@
+"""Multi-DC replay harness: convergence across replicas for every type,
+and fault injection demonstrating which delivery guarantees matter."""
+
+import numpy as np
+import pytest
+
+from antidote_ccrdt_tpu.harness.opgen import Workload, prepare_stream
+from antidote_ccrdt_tpu.harness.replay import FaultInjector, ScalarReplay
+from antidote_ccrdt_tpu.models.average import AverageScalar
+from antidote_ccrdt_tpu.models.leaderboard import LeaderboardScalar
+from antidote_ccrdt_tpu.models.topk import TopkScalar
+from antidote_ccrdt_tpu.models.topk_rmv import TopkRmvScalar
+from antidote_ccrdt_tpu.models.wordcount import WordcountScalar
+
+
+@pytest.mark.parametrize(
+    "crdt,new_args,rmv_frac,rmv_kind",
+    [
+        (TopkRmvScalar(), (5,), 0.25, "rmv"),
+        (TopkRmvScalar(), (3,), 0.5, "rmv"),
+        (LeaderboardScalar(), (5,), 0.1, "ban"),
+        (TopkScalar(), (5,), 0.0, "rmv"),
+    ],
+)
+def test_scalar_replay_converges(crdt, new_args, rmv_frac, rmv_kind):
+    wl = Workload(
+        n_replicas=4, n_ids=30, rmv_frac=rmv_frac, rmv_kind=rmv_kind, seed=42
+    )
+    rp = ScalarReplay(crdt, wl.n_replicas, new_args=new_args)
+    rp.run(prepare_stream(wl, 200))
+    assert rp.converged(), crdt.type_name
+
+
+def test_scalar_replay_converges_with_interleaved_syncs():
+    """Ops submitted between syncs see partial remote knowledge — the
+    concurrent multi-master case; must still converge."""
+    crdt = TopkRmvScalar()
+    wl = Workload(n_replicas=3, n_ids=20, rmv_frac=0.3, seed=7)
+    rp = ScalarReplay(crdt, wl.n_replicas, new_args=(4,))
+    ops = list(prepare_stream(wl, 150))
+    for chunk in np.array_split(np.arange(len(ops)), 5):
+        for j in chunk:
+            rp.submit(*ops[j])
+        rp.sync()
+    assert rp.converged()
+
+
+def test_average_replay_mean():
+    crdt = AverageScalar()
+    rp = ScalarReplay(crdt, 2)
+    for origin, v in [(0, 4), (1, 8), (0, 6)]:
+        rp.submit(origin, ("add", v))
+    rp.sync()
+    assert rp.converged()
+    assert rp.values()[0] == 6.0
+
+
+def test_wordcount_replay():
+    crdt = WordcountScalar()
+    rp = ScalarReplay(crdt, 3)
+    rp.submit(0, ("add", "a b"))
+    rp.submit(1, ("add", "b c"))
+    rp.sync()
+    assert rp.converged()
+    assert rp.values()[0] == {"a": 1, "b": 2, "c": 1}
+
+
+def test_duplication_breaks_monoid_types():
+    """The op-based pipeline relies on exactly-once delivery: duplicating
+    non-idempotent effect ops diverges state — the reference's implicit
+    host assumption (SURVEY.md §1), made visible."""
+    crdt = AverageScalar()
+    rp = ScalarReplay(crdt, 2, faults=FaultInjector(dup_prob=1.0, seed=1))
+    rp.submit(0, ("add", 10))
+    rp.sync()
+    # replica 1 saw the op twice
+    assert rp.states[0] == (10, 1)
+    assert rp.states[1] == (20, 2)
+    assert not rp.converged()
+
+
+def test_duplication_harmless_for_topk_rmv():
+    """Add-wins top-K updates are idempotent (set-union masked state), so
+    duplicate delivery does not diverge the observable."""
+    wl = Workload(n_replicas=3, n_ids=15, rmv_frac=0.3, seed=3)
+    rp = ScalarReplay(
+        TopkRmvScalar(), wl.n_replicas, new_args=(4,),
+        faults=FaultInjector(dup_prob=0.5, seed=2),
+    )
+    rp.run(prepare_stream(wl, 120))
+    assert rp.converged()
+
+
+def test_drop_breaks_convergence():
+    wl = Workload(n_replicas=2, n_ids=10, rmv_frac=0.0, seed=5)
+    rp = ScalarReplay(
+        TopkRmvScalar(), 2, new_args=(8,),
+        faults=FaultInjector(drop_prob=0.7, seed=4),
+    )
+    rp.run(prepare_stream(wl, 80))
+    assert not rp.converged()

@@ -101,7 +101,9 @@ class TopkRmvEffectGen:
             add_score=jnp.asarray(add_score),
             add_dc=jnp.asarray(add_dc),
             add_ts=jnp.asarray(add_ts),
-            rmv_key=jnp.zeros((R, max(Br, 1)), jnp.int32) if Br == 0 else jnp.zeros((R, Br), jnp.int32),
+            # Br == 0 still needs one (padded) rmv column: XLA shapes are
+            # static, so an all-invalid row stands in for "no removals".
+            rmv_key=jnp.zeros((R, max(Br, 1)), jnp.int32),
             rmv_id=jnp.asarray(rmv_id) if Br else jnp.full((R, 1), -1, jnp.int32),
             rmv_vc=jnp.asarray(rmv_vc) if Br else jnp.zeros((R, 1, R), jnp.int32),
         )

@@ -250,6 +250,7 @@ from ..ops.dense_table import (  # noqa: E402
     masked_topk,
     observables_equal,
     observe_value,
+    promotion_mask,
 )
 
 
@@ -352,43 +353,16 @@ class LeaderboardDense:
         carry no timestamps."""
         old_ids, old_scores, old_valid = old
         new_ids, new_scores, new_valid = new
-
-        def one(nk, n_ids, n_scores, n_valid, o_ids, o_scores, o_valid, a_key, a_id, a_score, a_valid):
-            in_old = jnp.any(
-                (n_ids[:, None] == o_ids[None, :])
-                & (n_scores[:, None] == o_scores[None, :])
-                & o_valid[None, :],
-                axis=1,
-            )
-            in_batch = jnp.any(
-                (n_ids[:, None] == a_id[None, :])
-                & (n_scores[:, None] == a_score[None, :])
-                & (a_key[None, :] == nk)
-                & a_valid[None, :],
-                axis=1,
-            )
-            return n_ids, n_scores, n_valid & ~in_old & ~in_batch
-
-        def per_replica(n_i, n_s, n_v, o_i, o_s, o_v, a_key, a_id, a_score, a_valid):
-            nks = jnp.arange(n_i.shape[0], dtype=jnp.int32)
-            return jax.vmap(
-                lambda nk, ni, ns, nv, oi, osc, ov: one(
-                    nk, ni, ns, nv, oi, osc, ov, a_key, a_id, a_score, a_valid
-                )
-            )(nks, n_i, n_s, n_v, o_i, o_s, o_v)
-
-        return jax.vmap(per_replica)(
-            new_ids,
-            new_scores,
+        keep = promotion_mask(
+            (new_ids, new_scores),
             new_valid,
-            old_ids,
-            old_scores,
+            (old_ids, old_scores),
             old_valid,
             ops.add_key,
-            ops.add_id,
-            ops.add_score,
+            (ops.add_id, ops.add_score),
             ops.add_valid,
         )
+        return new_ids, new_scores, keep
 
 
 def make_dense(n_players: int, size: int = 100) -> LeaderboardDense:

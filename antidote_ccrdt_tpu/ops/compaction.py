@@ -1,0 +1,306 @@
+"""Batched op-log compaction: one vectorized pass over the whole log.
+
+The reference compacts op logs *pairwise*: the host walks the log calling
+``can_compact/2`` then ``compact_ops/2`` on adjacent pairs, with ``{noop}``
+marking dead slots (richest rules in ``antidote_ccrdt_topk_rmv.erl:178-223``).
+That protocol is inherently sequential — O(L) dependent steps per log, each
+touching two ops. The TPU re-design compacts the *entire log in one dispatch*
+(SURVEY.md §7 step 4): sort ops by (key, id), segmented reduce within each
+group, rewrite tags, compress. The scalar pairwise protocol survives on the
+``ScalarCCRDT`` types for parity; this module is what a host should actually
+call.
+
+Semantics preserved (differentially tested against scalar replay):
+
+* **topk_rmv** (``topk_rmv.erl:197-223``): per (key, id) —
+  - all removals fuse into ONE rmv op with the vc join of every removal vc
+    (rmv/rmv rule :216-223); tagged ``rmv`` if any input was untagged
+    (rmv absorbs rmv_r).
+  - adds dominated by the fused tombstone (``vc[dc] >= ts``, :182-187) are
+    deleted — exactly the adds ``update/2`` would reject. (Like the
+    reference's add/rmv rule, this forgets the dominated add's clock
+    advance; observable state is unaffected.)
+  - exact duplicate adds (same score/dc/ts) are deduped (:255-259).
+  - surviving adds keep the best ``m_keep`` per id by cmp order (score desc,
+    ts desc); the winner carries the observable ``add`` tag iff any live add
+    of the group was untagged, the rest are demoted to ``add_r``
+    (add/add keep-best-demote-other, :198-202). ``update/2`` is
+    tag-agnostic, so demotion never changes replayed state — tags only
+    drive the host's shipping policy (``is_replicate_tagged``).
+
+* **average** (``average.erl:127``): all adds per key fuse into one
+  ``(sum, n)`` — the reference's perfect pairwise fusion, generalized.
+
+* **topk**: adds per (key, id) keep the max score (fixing quirk #4: the
+  reference's ``maps:merge`` is last-wins, ``topk.erl:160-161``).
+
+* **wordcount/worddocumentcount**: counts fuse per (key, token) (fixing
+  quirk #3: the reference *discards both ops*, ``wordcount.erl:70-72``).
+
+All kernels are jit-compiled with static log length L; dead/padding rows are
+pushed to the end and ``n_live`` reports the compacted length.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .dense_table import NEG_INF
+
+# Op kinds for the dense topk_rmv log. DEAD marks padding on input and
+# deleted slots on output (the reference's {noop}).
+KIND_ADD = 0
+KIND_ADD_R = 1
+KIND_RMV = 2
+KIND_RMV_R = 3
+KIND_DEAD = 4
+
+_BIG = jnp.int32(2**31 - 1)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TopkRmvLog:
+    """A dense effect-op log for topk_rmv instances on a [n_keys] grid.
+
+    Row i is one effect op; ``kind == KIND_DEAD`` marks padding. ``vc`` is
+    only meaningful for rmv rows (zeros otherwise); score/dc/ts only for
+    adds.
+    """
+
+    kind: jax.Array  # i32[L]
+    key: jax.Array  # i32[L] instance index
+    id: jax.Array  # i32[L] element id
+    score: jax.Array  # i32[L]
+    dc: jax.Array  # i32[L]
+    ts: jax.Array  # i32[L]
+    vc: jax.Array  # i32[L, D]
+
+
+def _segment_starts(*keys: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """For sorted key columns: (first-in-group flag, index of group start per
+    row, segment id per row)."""
+    n = keys[0].shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    first = jnp.zeros(n, dtype=bool)
+    for k in keys:
+        first = first | (k != jnp.roll(k, 1, axis=0))
+    first = first.at[0].set(True)
+    start = lax.cummax(jnp.where(first, idx, 0))
+    seg = jnp.cumsum(first.astype(jnp.int32)) - 1
+    return first, start, seg
+
+
+def _prefix_rank(flag: jax.Array, start: jax.Array) -> jax.Array:
+    """Rank of each True `flag` row among the True rows of its segment
+    (segments given by per-row group-start indices)."""
+    excl = jnp.cumsum(flag.astype(jnp.int32)) - flag.astype(jnp.int32)
+    return excl - jnp.take(excl, start)
+
+
+def _compress(live: jax.Array, rows: Tuple[jax.Array, ...]):
+    """Stable-partition live rows to the front. Returns (rows', n_live)."""
+    order = jnp.argsort(~live, stable=True)
+    return tuple(jnp.take(r, order, axis=0) for r in rows), jnp.sum(live)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def compact_topk_rmv_log(log: TopkRmvLog, m_keep: int = 4):
+    """Compact a topk_rmv effect log in one dispatch.
+
+    Returns (compacted TopkRmvLog, n_live). Replaying the compacted log from
+    any state yields the same observable state as the original log (modulo
+    masked history beyond the best `m_keep` live adds per id — the same
+    capacity bound as the dense state's M slots).
+    """
+    L, D = log.vc.shape
+    is_add = (log.kind == KIND_ADD) | (log.kind == KIND_ADD_R)
+    is_rmv = (log.kind == KIND_RMV) | (log.kind == KIND_RMV_R)
+    dead = ~(is_add | is_rmv)
+
+    # Sort: dead rows last; within a (key, id) group rmvs first, then adds
+    # by cmp order desc (score, then ts — topk_rmv.erl:390-395).
+    skey = jnp.where(dead, _BIG, log.key)
+    sort_keys = (
+        skey,
+        jnp.where(dead, _BIG, log.id),
+        is_add.astype(jnp.int32),
+        -log.score,
+        -log.ts,
+        log.dc,  # exact duplicates must land adjacent for the dedup pass
+    )
+    payload = (log.kind, log.score, log.ts, jnp.arange(L, dtype=jnp.int32))
+    sorted_all = lax.sort(sort_keys + payload, num_keys=6)
+    key_s, id_s, _, _, _, dc_s, kind_s, score_s, ts_s, row_s = sorted_all
+    vc_s = jnp.take(log.vc, row_s, axis=0)
+    dead_s = kind_s == KIND_DEAD
+    is_add_s = (kind_s == KIND_ADD) | (kind_s == KIND_ADD_R)
+    is_rmv_s = (kind_s == KIND_RMV) | (kind_s == KIND_RMV_R)
+
+    first, start, seg = _segment_starts(key_s, id_s)
+
+    # Fused tombstone per (key, id): vc join over the group's rmv rows
+    # (merge_vcs, topk_rmv.erl:378-386).
+    rmv_vc_rows = jnp.where(is_rmv_s[:, None], vc_s, 0)
+    seg_vc = jax.ops.segment_max(
+        rmv_vc_rows, seg, num_segments=L, indices_are_sorted=True
+    )
+    merged_vc = jnp.take(seg_vc, seg, axis=0)  # [L, D] per-row group vc
+    group_has_rmv = jnp.take(
+        jax.ops.segment_max(
+            is_rmv_s.astype(jnp.int32), seg, num_segments=L, indices_are_sorted=True
+        ),
+        seg,
+    ).astype(bool)
+    group_rmv_observable = jnp.take(
+        jax.ops.segment_max(
+            (kind_s == KIND_RMV).astype(jnp.int32),
+            seg,
+            num_segments=L,
+            indices_are_sorted=True,
+        ),
+        seg,
+    ).astype(bool)
+
+    # Keep ONE rmv per group (the first), carrying the fused vc.
+    rmv_rank = _prefix_rank(is_rmv_s, start)
+    keep_rmv = is_rmv_s & (rmv_rank == 0)
+    out_vc = jnp.where(keep_rmv[:, None], merged_vc, 0)
+
+    # Adds: delete tombstone-dominated ones (vc[dc] >= ts, :182-187) and
+    # exact duplicates (adjacent after the sort, :255-259).
+    dom = (
+        jnp.take_along_axis(merged_vc, jnp.clip(dc_s, 0, D - 1)[:, None], axis=1)[:, 0]
+        >= ts_s
+    )
+    dup = (
+        is_add_s
+        & ~first
+        & (jnp.roll(is_add_s, 1))
+        & (score_s == jnp.roll(score_s, 1))
+        & (ts_s == jnp.roll(ts_s, 1))
+        & (dc_s == jnp.roll(dc_s, 1))
+    )
+    live_add = is_add_s & ~(group_has_rmv & dom) & ~dup
+    add_rank = _prefix_rank(live_add, start)
+    live_add = live_add & (add_rank < m_keep)
+
+    # Tags: winner observable iff the group still ships an untagged add;
+    # the rest demote to add_r (:198-202).
+    group_has_obs_add = jnp.take(
+        jax.ops.segment_max(
+            (live_add & (kind_s == KIND_ADD)).astype(jnp.int32),
+            seg,
+            num_segments=L,
+            indices_are_sorted=True,
+        ),
+        seg,
+    ).astype(bool)
+    add_kind = jnp.where(
+        (add_rank == 0) & group_has_obs_add, KIND_ADD, KIND_ADD_R
+    )
+    rmv_kind = jnp.where(group_rmv_observable, KIND_RMV, KIND_RMV_R)
+
+    live = live_add | keep_rmv
+    out_kind = jnp.where(
+        live_add, add_kind, jnp.where(keep_rmv, rmv_kind, KIND_DEAD)
+    )
+
+    (out_kind, key_o, id_o, score_o, dc_o, ts_o, vc_o), n_live = _compress(
+        live, (out_kind, key_s, id_s, score_s, dc_s, ts_s, out_vc)
+    )
+    blank = out_kind == KIND_DEAD
+    return (
+        TopkRmvLog(
+            kind=out_kind,
+            key=jnp.where(blank, 0, key_o),
+            id=jnp.where(blank, 0, id_o),
+            score=jnp.where(blank, 0, score_o),
+            dc=jnp.where(blank, 0, dc_o),
+            ts=jnp.where(blank, 0, ts_o),
+            vc=jnp.where(blank[:, None], 0, vc_o),
+        ),
+        n_live,
+    )
+
+
+@jax.jit
+def compact_average_log(key: jax.Array, val: jax.Array, num: jax.Array):
+    """Fuse every add per key into one (sum, n) op (average.erl:127).
+
+    Padding: num <= 0 (the reference's N=0 no-op guard, average.erl:89).
+    Returns (key', sum', n', n_live) with live rows first.
+    """
+    L = key.shape[0]
+    pad = num <= 0
+    skey = jnp.where(pad, _BIG, key)
+    key_s, val_s, num_s = lax.sort((skey, val, num), num_keys=1)
+    first, _, seg = _segment_starts(key_s)
+    sums = jax.ops.segment_sum(
+        jnp.where(key_s == _BIG, 0, val_s), seg, num_segments=L, indices_are_sorted=True
+    )
+    nums = jax.ops.segment_sum(
+        jnp.where(key_s == _BIG, 0, num_s), seg, num_segments=L, indices_are_sorted=True
+    )
+    keep = first & (key_s != _BIG)
+    out_val = jnp.where(keep, jnp.take(sums, seg), 0)
+    out_num = jnp.where(keep, jnp.take(nums, seg), 0)
+    (key_o, val_o, num_o), n_live = _compress(keep, (key_s, out_val, out_num))
+    key_o = jnp.where(num_o > 0, key_o, 0)
+    return key_o, val_o, num_o, n_live
+
+
+@jax.jit
+def compact_topk_log(key: jax.Array, id_: jax.Array, score: jax.Array):
+    """One add per (key, id), keeping the MAX score (fixes quirk #4 — the
+    reference merges duplicate ids last-wins, topk.erl:160-161).
+
+    Padding: score < 0. Returns (key', id', score', n_live), live first.
+    """
+    pad = score < 0
+    skey = jnp.where(pad, _BIG, key)
+    key_s, id_s, nscore = lax.sort((skey, id_, -score), num_keys=3)
+    score_s = -nscore
+    first, _, _ = _segment_starts(key_s, id_s)
+    keep = first & (key_s != _BIG)
+    (key_o, id_o, score_o), n_live = _compress(keep, (key_s, id_s, score_s))
+    blank = jnp.arange(key.shape[0]) >= n_live
+    return (
+        jnp.where(blank, 0, key_o),
+        jnp.where(blank, 0, id_o),
+        jnp.where(blank, -1, score_o),
+        n_live,
+    )
+
+
+@jax.jit
+def compact_wordcount_log(key: jax.Array, token: jax.Array, count: jax.Array):
+    """Fuse counts per (key, token) (fixes quirk #3 — the reference's
+    compact_ops discards both ops, wordcount.erl:70-72).
+
+    Padding: token < 0. Returns (key', token', count', n_live), live first.
+    """
+    L = key.shape[0]
+    pad = token < 0
+    skey = jnp.where(pad, _BIG, key)
+    key_s, tok_s, cnt_s = lax.sort((skey, token, count), num_keys=2)
+    first, _, seg = _segment_starts(key_s, tok_s)
+    sums = jax.ops.segment_sum(
+        jnp.where(key_s == _BIG, 0, cnt_s), seg, num_segments=L, indices_are_sorted=True
+    )
+    keep = first & (key_s != _BIG)
+    out_cnt = jnp.where(keep, jnp.take(sums, seg), 0)
+    (key_o, tok_o, cnt_o), n_live = _compress(keep, (key_s, tok_s, out_cnt))
+    blank = jnp.arange(L) >= n_live
+    return (
+        jnp.where(blank, 0, key_o),
+        jnp.where(blank, -1, tok_o),
+        jnp.where(blank, 0, cnt_o),
+        n_live,
+    )

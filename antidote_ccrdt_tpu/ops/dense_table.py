@@ -45,6 +45,50 @@ def observe_value(observe_fn, state):
     ]
 
 
+def promotion_mask(
+    new_cols,
+    new_valid: jax.Array,
+    old_cols,
+    old_valid: jax.Array,
+    batch_key: jax.Array,
+    batch_cols,
+    batch_valid: jax.Array,
+) -> jax.Array:
+    """Which entries of a new observable were *uncovered* (promoted) rather
+    than carried over or freshly added — the shared core of extra-op
+    collection for topk_rmv (:291-295) and leaderboard (:279-283).
+
+    Identity is the tuple of column arrays: `new_cols`/`old_cols` are
+    [R, NK, K] observables, `batch_cols` are [R, B] add columns matched only
+    against adds targeting the same instance (`batch_key == nk`). Returns
+    the promoted mask [R, NK, K]: valid entries present in neither."""
+
+    def all_eq(pairs):
+        acc = None
+        for n, o in pairs:
+            eq = n == o
+            acc = eq if acc is None else (acc & eq)
+        return acc
+
+    in_old = jnp.any(
+        all_eq((n[..., :, None], o[..., None, :]) for n, o in zip(new_cols, old_cols))
+        & old_valid[..., None, :],
+        axis=-1,
+    )
+    NK = new_valid.shape[1]
+    nk = jnp.arange(NK, dtype=jnp.int32)[None, :, None, None]
+    in_batch = jnp.any(
+        all_eq(
+            (n[..., :, None], b[:, None, None, :])
+            for n, b in zip(new_cols, batch_cols)
+        )
+        & (batch_key[:, None, None, :] == nk)
+        & batch_valid[:, None, None, :],
+        axis=-1,
+    )
+    return new_valid & ~in_old & ~in_batch
+
+
 def observables_equal(a_obs, b_obs) -> bool:
     """Observable-state equality on (ids, scores, valid) triples."""
     ia, sa, va = a_obs

@@ -47,9 +47,10 @@ def lattice_all_reduce(x: Any, axis_name: str, merge: Callable[[Any, Any], Any],
 
     Recursive doubling: in round k each device exchanges its accumulator
     with its partner across hypercube dimension k and merges, so after
-    log2(n) rounds every device holds the full merge. Requires power-of-two
-    axis_size (pad the mesh or fall back to gather-reduce otherwise)."""
-    assert axis_size & (axis_size - 1) == 0, "axis_size must be a power of two"
+    log2(n) rounds every device holds the full merge. Non-power-of-two axes
+    fall back to gather-reduce (correct, O(n) memory)."""
+    if axis_size & (axis_size - 1) != 0:
+        return all_gather_reduce(x, axis_name, merge, axis_size)
     k = 1
     while k < axis_size:
         perm = [(i, i ^ k) for i in range(axis_size)]

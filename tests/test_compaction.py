@@ -1,0 +1,251 @@
+"""Differential tests: batched log compaction vs scalar replay.
+
+The contract (ops/compaction.py): replaying the compacted log from a fresh
+state yields the same *observable* state as replaying the original log —
+the guarantee the reference's pairwise compact_ops protocol provides
+(topk_rmv.erl:178-223), generalized to whole-log single-dispatch form.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from antidote_ccrdt_tpu.models.average import AverageScalar  # noqa: E402
+from antidote_ccrdt_tpu.models.topk import TopkScalar  # noqa: E402
+from antidote_ccrdt_tpu.models.topk_rmv import TopkRmvScalar  # noqa: E402
+from antidote_ccrdt_tpu.models.wordcount import WordcountScalar  # noqa: E402
+from antidote_ccrdt_tpu.ops.compaction import (  # noqa: E402
+    KIND_ADD,
+    KIND_ADD_R,
+    KIND_DEAD,
+    KIND_RMV,
+    KIND_RMV_R,
+    TopkRmvLog,
+    compact_average_log,
+    compact_topk_log,
+    compact_topk_rmv_log,
+    compact_wordcount_log,
+)
+
+
+def _random_topk_rmv_log(rng, L, n_ids, n_dcs, rmv_frac=0.3, dup_frac=0.1):
+    """A causally-plausible effect log: per-DC clocks advance; removal vcs
+    are snapshots of the generator's frontier at removal time."""
+    kind = np.full(L, KIND_DEAD, np.int32)
+    key = np.zeros(L, np.int32)
+    id_ = np.zeros(L, np.int32)
+    score = np.zeros(L, np.int32)
+    dc = np.zeros(L, np.int32)
+    ts = np.zeros(L, np.int32)
+    vc = np.zeros((L, n_dcs), np.int32)
+    frontier = np.zeros(n_dcs, np.int32)
+    n_real = int(L * 0.9)  # leave some padding rows
+    prev = None
+    for i in range(n_real):
+        if prev is not None and rng.random() < dup_frac:
+            (kind[i], id_[i], score[i], dc[i], ts[i], vc[i]) = prev
+            continue
+        d = rng.integers(0, n_dcs)
+        x = rng.integers(0, n_ids)
+        if rng.random() < rmv_frac:
+            kind[i] = KIND_RMV if rng.random() < 0.7 else KIND_RMV_R
+            id_[i] = x
+            # vc snapshot: current frontier, jittered down (concurrent adds
+            # it did not observe survive — the add-wins case).
+            vc[i] = np.maximum(frontier - rng.integers(0, 3, n_dcs), 0)
+        else:
+            frontier[d] += 1
+            kind[i] = KIND_ADD if rng.random() < 0.7 else KIND_ADD_R
+            id_[i] = x
+            score[i] = rng.integers(1, 1000)
+            dc[i] = d
+            ts[i] = frontier[d]
+        prev = (kind[i], id_[i], score[i], dc[i], ts[i], vc[i].copy())
+    return TopkRmvLog(
+        kind=jnp.asarray(kind),
+        key=jnp.asarray(key),
+        id=jnp.asarray(id_),
+        score=jnp.asarray(score),
+        dc=jnp.asarray(dc),
+        ts=jnp.asarray(ts),
+        vc=jnp.asarray(vc),
+    )
+
+
+def _replay_scalar(log_np, size=10):
+    S = TopkRmvScalar()
+    state = S.new(size)
+    kind, key, id_, score, dc, ts, vc = log_np
+    names = {KIND_ADD: "add", KIND_ADD_R: "add_r", KIND_RMV: "rmv", KIND_RMV_R: "rmv_r"}
+    for i in range(len(kind)):
+        k = int(kind[i])
+        if k == KIND_DEAD:
+            continue
+        if k in (KIND_ADD, KIND_ADD_R):
+            eff = (names[k], (int(id_[i]), int(score[i]), (int(dc[i]), int(ts[i]))))
+        else:
+            vcd = {d: int(vc[i, d]) for d in range(vc.shape[1]) if vc[i, d] > 0}
+            eff = (names[k], (int(id_[i]), vcd))
+        state, _extras = S.update(eff, state)
+    return S, state
+
+
+def _log_to_np(log):
+    return tuple(
+        np.asarray(x) for x in (log.kind, log.key, log.id, log.score, log.dc, log.ts, log.vc)
+    )
+
+
+class TestTopkRmvCompaction:
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_observable_equal_after_compaction(self, seed):
+        rng = np.random.default_rng(seed)
+        log = _random_topk_rmv_log(rng, L=128, n_ids=12, n_dcs=4)
+        # m_keep large enough to be lossless for this id density
+        clog, n_live = compact_topk_rmv_log(log, 16)
+        assert int(n_live) < 128 * 0.9  # it actually compacts
+        S, ref_state = _replay_scalar(_log_to_np(log))
+        _, cmp_state = _replay_scalar(_log_to_np(clog))
+        # value/1 mirrors the reference's *unsorted* observed fold
+        # (topk_rmv.erl:92-95) — order is not part of the contract.
+        assert sorted(S.value(ref_state)) == sorted(S.value(cmp_state))
+        assert S.equal(ref_state, cmp_state)
+
+    def test_idempotent(self):
+        rng = np.random.default_rng(7)
+        log = _random_topk_rmv_log(rng, L=64, n_ids=8, n_dcs=3)
+        c1, n1 = compact_topk_rmv_log(log, 8)
+        c2, n2 = compact_topk_rmv_log(c1, 8)
+        assert int(n1) == int(n2)
+        S, s1 = _replay_scalar(_log_to_np(c1))
+        _, s2 = _replay_scalar(_log_to_np(c2))
+        assert S.equal(s1, s2)
+
+    def test_rmv_fusion_single_op_per_id(self):
+        # Three removals of one id fuse into one rmv with the vc join.
+        D = 3
+        vcs = np.array([[5, 0, 0], [0, 7, 0], [2, 1, 9]], np.int32)
+        log = TopkRmvLog(
+            kind=jnp.asarray([KIND_RMV, KIND_RMV_R, KIND_RMV], np.int32),
+            key=jnp.zeros(3, jnp.int32),
+            id=jnp.full(3, 4, jnp.int32),
+            score=jnp.zeros(3, jnp.int32),
+            dc=jnp.zeros(3, jnp.int32),
+            ts=jnp.zeros(3, jnp.int32),
+            vc=jnp.asarray(vcs),
+        )
+        clog, n_live = compact_topk_rmv_log(log, 4)
+        assert int(n_live) == 1
+        assert int(clog.kind[0]) == KIND_RMV  # rmv absorbs rmv_r
+        np.testing.assert_array_equal(np.asarray(clog.vc[0]), [5, 7, 9])
+
+    def test_dominated_add_deleted(self):
+        # add (dc0, ts=3) dominated by rmv vc [5,0]; concurrent add at dc1
+        # survives (add-wins).
+        log = TopkRmvLog(
+            kind=jnp.asarray([KIND_ADD, KIND_RMV, KIND_ADD], np.int32),
+            key=jnp.zeros(3, jnp.int32),
+            id=jnp.asarray([1, 1, 1], np.int32),
+            score=jnp.asarray([50, 0, 60], np.int32),
+            dc=jnp.asarray([0, 0, 1], np.int32),
+            ts=jnp.asarray([3, 0, 2], np.int32),
+            vc=jnp.asarray([[0, 0], [5, 0], [0, 0]], np.int32),
+        )
+        clog, n_live = compact_topk_rmv_log(log, 4)
+        assert int(n_live) == 2  # fused rmv + surviving add
+        kinds = set(int(k) for k in np.asarray(clog.kind[:2]))
+        assert kinds == {KIND_RMV, KIND_ADD}
+        add_row = int(np.argmax(np.asarray(clog.kind[:2]) == KIND_ADD))
+        assert int(clog.dc[add_row]) == 1 and int(clog.score[add_row]) == 60
+
+    def test_winner_demotion_tags(self):
+        # Two untagged adds same id: winner stays add, loser demoted add_r.
+        log = TopkRmvLog(
+            kind=jnp.asarray([KIND_ADD, KIND_ADD], np.int32),
+            key=jnp.zeros(2, jnp.int32),
+            id=jnp.asarray([2, 2], np.int32),
+            score=jnp.asarray([10, 90], np.int32),
+            dc=jnp.asarray([0, 1], np.int32),
+            ts=jnp.asarray([1, 1], np.int32),
+            vc=jnp.zeros((2, 2), np.int32),
+        )
+        clog, n_live = compact_topk_rmv_log(log, 4)
+        assert int(n_live) == 2
+        assert int(clog.score[0]) == 90 and int(clog.kind[0]) == KIND_ADD
+        assert int(clog.score[1]) == 10 and int(clog.kind[1]) == KIND_ADD_R
+
+
+class TestSimpleTypeCompaction:
+    def test_average(self):
+        rng = np.random.default_rng(0)
+        L, NK = 64, 4
+        key = rng.integers(0, NK, L).astype(np.int32)
+        val = rng.integers(-50, 100, L).astype(np.int32)
+        num = rng.integers(0, 4, L).astype(np.int32)  # some zero: padding
+        k, v, n, n_live = compact_average_log(
+            jnp.asarray(key), jnp.asarray(val), jnp.asarray(num)
+        )
+        assert int(n_live) <= NK
+        S = AverageScalar()
+        for nk in range(NK):
+            ref = S.new()
+            for i in range(L):
+                if key[i] == nk and num[i] > 0:
+                    ref, _ = S.update(("add", (int(val[i]), int(num[i]))), ref)
+            got = S.new()
+            for i in range(int(n_live)):
+                if int(k[i]) == nk:
+                    got, _ = S.update(("add", (int(v[i]), int(n[i]))), got)
+            assert S.equal(ref, got)
+
+    def test_topk_max_not_last_wins(self):
+        key = jnp.zeros(4, jnp.int32)
+        id_ = jnp.asarray([7, 7, 3, 7], jnp.int32)
+        score = jnp.asarray([50, 90, 20, 60], jnp.int32)
+        k, i, s, n_live = compact_topk_log(key, id_, score)
+        assert int(n_live) == 2
+        got = {(int(i[j]), int(s[j])) for j in range(2)}
+        assert got == {(7, 90), (3, 20)}  # max, not last-wins (quirk #4)
+
+    def test_topk_differential(self):
+        rng = np.random.default_rng(3)
+        L = 100
+        key = np.zeros(L, np.int32)
+        id_ = rng.integers(0, 10, L).astype(np.int32)
+        score = rng.integers(0, 500, L).astype(np.int32)
+        score[rng.random(L) < 0.1] = -1  # padding
+        k, i, s, n_live = compact_topk_log(
+            jnp.asarray(key), jnp.asarray(id_), jnp.asarray(score)
+        )
+        S = TopkScalar()
+        ref = S.new(5)
+        for j in range(L):
+            if score[j] >= 0:
+                ref, _ = S.update(("add", (int(id_[j]), int(score[j]))), ref)
+        got = S.new(5)
+        for j in range(int(n_live)):
+            got, _ = S.update(("add", (int(i[j]), int(s[j]))), got)
+        assert S.value(ref) == S.value(got)
+
+    def test_wordcount(self):
+        rng = np.random.default_rng(5)
+        L = 80
+        key = rng.integers(0, 2, L).astype(np.int32)
+        tok = rng.integers(0, 12, L).astype(np.int32)
+        cnt = rng.integers(1, 5, L).astype(np.int32)
+        tok[rng.random(L) < 0.15] = -1  # padding
+        k, t, c, n_live = compact_wordcount_log(
+            jnp.asarray(key), jnp.asarray(tok), jnp.asarray(cnt)
+        )
+        for nk in range(2):
+            ref = {}
+            for j in range(L):
+                if tok[j] >= 0 and key[j] == nk:
+                    ref[int(tok[j])] = ref.get(int(tok[j]), 0) + int(cnt[j])
+            got = {}
+            for j in range(int(n_live)):
+                if int(k[j]) == nk:
+                    got[int(t[j])] = int(c[j])
+            assert ref == got

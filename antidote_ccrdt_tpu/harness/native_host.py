@@ -1,0 +1,254 @@
+"""Python binding for the native (C++) host runtime.
+
+``native/ccrdt_host.cpp`` implements the op-log store, causal delivery
+scheduler, and dense batch builder — the host services Antidote provides to
+the reference library (SURVEY.md §1) — as a shared library. This module
+builds it on demand (``make`` in ``native/``), binds it via ctypes (no
+pybind11 in this image), and adapts drained batches to the dense op structs
+the TPU kernels consume.
+
+The boundary is batched in both directions: ``submit_batch`` hands N ops to
+C++ in one call; ``drain`` returns a struct-of-arrays batch ready to wrap as
+``TopkRmvOps``. Python never loops over individual ops on the hot path.
+
+If the toolchain is unavailable the import still succeeds; ``available()``
+reports False and the pure-Python ``ScalarReplay`` pipeline remains the
+fallback host.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libccrdt_host.so")
+
+# Op kinds shared with ops/compaction.py (KIND_* there) and, by convention,
+# reinterpreted per type: for average, score=value aux=n; for wordcount,
+# id=token score=count.
+KIND_ADD = 0
+KIND_ADD_R = 1
+KIND_RMV = 2
+KIND_RMV_R = 3
+
+_lib = None
+_build_error: Optional[str] = None
+
+
+def _ensure_lib():
+    global _lib, _build_error
+    if _lib is not None or _build_error is not None:
+        return _lib
+    try:
+        if not os.path.exists(_LIB_PATH):
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR],
+                check=True,
+                capture_output=True,
+                text=True,
+            )
+        lib = ctypes.CDLL(_LIB_PATH)
+    except (subprocess.CalledProcessError, OSError) as e:
+        _build_error = str(e)
+        return None
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.ccrdt_host_new.restype = ctypes.c_void_p
+    lib.ccrdt_host_new.argtypes = [ctypes.c_int]
+    lib.ccrdt_host_free.argtypes = [ctypes.c_void_p]
+    lib.ccrdt_host_submit.restype = ctypes.c_int32
+    lib.ccrdt_host_submit.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, i32p,
+    ]
+    lib.ccrdt_host_submit_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+        i32p, i32p, i32p, i32p, i32p, i32p, i32p,
+    ]
+    lib.ccrdt_host_drain.restype = ctypes.c_int
+    lib.ccrdt_host_drain.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+        i32p, i32p, i32p, i32p, i32p, i32p, i32p, i32p,
+    ]
+    lib.ccrdt_host_backlog.restype = ctypes.c_int64
+    lib.ccrdt_host_backlog.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ccrdt_host_stats.argtypes = [ctypes.c_void_p, i64p]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    """True iff the native library built (or was already built)."""
+    return _ensure_lib() is not None
+
+
+def build_error() -> Optional[str]:
+    _ensure_lib()
+    return _build_error
+
+
+def _i32(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+class NativeHost:
+    """One multi-master host: D replicas, each a DC.
+
+    Ops are effect ops (already through ``downstream``); the host stamps
+    adds with the origin's lamport time, tracks causal dependencies, and
+    delivers per replica in causal order, exactly once.
+    """
+
+    def __init__(self, n_dcs: int):
+        lib = _ensure_lib()
+        if lib is None:
+            raise RuntimeError(f"native host unavailable: {_build_error}")
+        self._lib = lib
+        self.D = n_dcs
+        self._h = lib.ccrdt_host_new(n_dcs)
+        if not self._h:
+            raise RuntimeError("ccrdt_host_new failed")
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.ccrdt_host_free(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- submit ------------------------------------------------------------
+
+    def submit(self, origin: int, kind: int, key: int, id_: int,
+               score: int = 0, aux: int = 0,
+               vc: Optional[np.ndarray] = None) -> int:
+        """Submit one effect op at `origin`; returns the lamport stamp."""
+        vcp = None
+        if vc is not None:
+            vc = np.ascontiguousarray(vc, dtype=np.int32)
+            assert vc.shape == (self.D,)
+            vcp = _i32(vc)
+        return self._lib.ccrdt_host_submit(
+            self._h, origin, kind, key, id_, score, aux, vcp
+        )
+
+    def submit_batch(self, origin: int, kinds, keys, ids, scores=None,
+                     auxs=None, vcs=None) -> np.ndarray:
+        """Submit N ops in one native call; returns their lamport stamps."""
+        kinds = np.ascontiguousarray(kinds, dtype=np.int32)
+        n = kinds.shape[0]
+
+        def arr(x):
+            if x is None:
+                return np.zeros(n, np.int32)
+            return np.ascontiguousarray(x, dtype=np.int32)
+
+        keys, ids, scores, auxs = arr(keys), arr(ids), arr(scores), arr(auxs)
+        vcp = None
+        if vcs is not None:
+            vcs = np.ascontiguousarray(vcs, dtype=np.int32)
+            assert vcs.shape == (n, self.D)
+            vcp = _i32(vcs)
+        out_ts = np.zeros(n, np.int32)
+        self._lib.ccrdt_host_submit_batch(
+            self._h, origin, n, _i32(kinds), _i32(keys), _i32(ids),
+            _i32(scores), _i32(auxs), vcp, _i32(out_ts),
+        )
+        return out_ts
+
+    # -- drain -------------------------------------------------------------
+
+    def drain(self, replica: int, max_n: int):
+        """Deliver up to max_n causally-ready ops for `replica`.
+
+        Returns a dict of SoA numpy arrays sliced to the delivered count:
+        kind, key, id, score, aux, dc, ts ([n]) and vc ([n, D]).
+        """
+        bufs = {name: np.zeros(max_n, np.int32)
+                for name in ("kind", "key", "id", "score", "aux", "dc", "ts")}
+        vc = np.zeros((max_n, self.D), np.int32)
+        n = self._lib.ccrdt_host_drain(
+            self._h, replica, max_n,
+            _i32(bufs["kind"]), _i32(bufs["key"]), _i32(bufs["id"]),
+            _i32(bufs["score"]), _i32(bufs["aux"]), _i32(bufs["dc"]),
+            _i32(bufs["ts"]), _i32(vc),
+        )
+        out = {k: v[:n] for k, v in bufs.items()}
+        out["vc"] = vc[:n]
+        return out
+
+    def drain_topk_rmv_ops(self, replica: int, batch_adds: int,
+                           batch_rmvs: int) -> Tuple[object, int, int]:
+        """Drain into a padded single-replica ``TopkRmvOps`` batch (leading
+        replica axis of 1 — vmap-ready). Returns (ops, n_adds, n_rmvs).
+
+        Sized so a full drain fits: delivers at most batch_adds + batch_rmvs
+        ops, then stops (backpressure; the rest arrives next drain). Splits
+        adds/rmvs while preserving causal order *within* the batch: the
+        dense kernel applies removals' tombstones and add-domination checks
+        order-independently (lattice join), so the split is safe.
+        """
+        import jax.numpy as jnp
+
+        from ..models.topk_rmv_dense import TopkRmvOps
+
+        got = self.drain(replica, batch_adds + batch_rmvs)
+        is_add = got["kind"] <= KIND_ADD_R
+        adds = {k: got[k][is_add] for k in ("key", "id", "score", "dc", "ts")}
+        rmvs = {k: got[k][~is_add] for k in ("key", "id")}
+        rmv_vc = got["vc"][~is_add]
+        na, nr = int(is_add.sum()), int((~is_add).sum())
+        if na > batch_adds or nr > batch_rmvs:
+            # Oversized split: re-run with conservative cap. Rare; the drain
+            # cap already bounds the total.
+            raise ValueError(
+                f"drained {na} adds / {nr} rmvs exceed batch {batch_adds}/{batch_rmvs}"
+            )
+
+        def pad(a, n, fill):
+            out = np.full(n, fill, np.int32)
+            out[: len(a)] = a
+            return out[None]  # [1, n]
+
+        ops = TopkRmvOps(
+            add_key=jnp.asarray(pad(adds["key"], batch_adds, 0)),
+            add_id=jnp.asarray(pad(adds["id"], batch_adds, 0)),
+            add_score=jnp.asarray(pad(adds["score"], batch_adds, 0)),
+            add_dc=jnp.asarray(pad(adds["dc"], batch_adds, 0)),
+            add_ts=jnp.asarray(pad(adds["ts"], batch_adds, 0)),  # 0 pad = invalid
+            rmv_key=jnp.asarray(pad(rmvs["key"], batch_rmvs, 0)),
+            rmv_id=jnp.asarray(pad(rmvs["id"], batch_rmvs, -1)),  # -1 pad
+            rmv_vc=jnp.asarray(
+                np.concatenate(
+                    [rmv_vc, np.zeros((batch_rmvs - nr, self.D), np.int32)], axis=0
+                )[None]
+            ),
+        )
+        return ops, na, nr
+
+    # -- introspection -----------------------------------------------------
+
+    def backlog(self, replica: int) -> int:
+        return int(self._lib.ccrdt_host_backlog(self._h, replica))
+
+    def stats(self):
+        out = np.zeros(3, np.int64)
+        self._lib.ccrdt_host_stats(
+            self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        )
+        return {"submitted": int(out[0]), "delivered": int(out[1]),
+                "pending": int(out[2])}

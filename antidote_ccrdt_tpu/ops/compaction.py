@@ -52,6 +52,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .dense_table import NEG_INF
+from .segment import prefix_rank as _prefix_rank, segment_starts as _segment_starts
 
 # Op kinds for the dense topk_rmv log. DEAD marks padding on input and
 # deleted slots on output (the reference's {noop}).
@@ -81,27 +82,6 @@ class TopkRmvLog:
     dc: jax.Array  # i32[L]
     ts: jax.Array  # i32[L]
     vc: jax.Array  # i32[L, D]
-
-
-def _segment_starts(*keys: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """For sorted key columns: (first-in-group flag, index of group start per
-    row, segment id per row)."""
-    n = keys[0].shape[0]
-    idx = jnp.arange(n, dtype=jnp.int32)
-    first = jnp.zeros(n, dtype=bool)
-    for k in keys:
-        first = first | (k != jnp.roll(k, 1, axis=0))
-    first = first.at[0].set(True)
-    start = lax.cummax(jnp.where(first, idx, 0))
-    seg = jnp.cumsum(first.astype(jnp.int32)) - 1
-    return first, start, seg
-
-
-def _prefix_rank(flag: jax.Array, start: jax.Array) -> jax.Array:
-    """Rank of each True `flag` row among the True rows of its segment
-    (segments given by per-row group-start indices)."""
-    excl = jnp.cumsum(flag.astype(jnp.int32)) - flag.astype(jnp.int32)
-    return excl - jnp.take(excl, start)
 
 
 def _compress(live: jax.Array, rows: Tuple[jax.Array, ...]):
@@ -134,9 +114,11 @@ def compact_topk_rmv_log(log: TopkRmvLog, m_keep: int = 4):
         -log.score,
         -log.ts,
         log.dc,  # exact duplicates must land adjacent for the dedup pass
+        log.kind,  # ...and among duplicates the observable add sorts first,
+        # so dedup drops the add_r copy, not the add (:255-259)
     )
-    payload = (log.kind, log.score, log.ts, jnp.arange(L, dtype=jnp.int32))
-    sorted_all = lax.sort(sort_keys + payload, num_keys=6)
+    payload = (log.score, log.ts, jnp.arange(L, dtype=jnp.int32))
+    sorted_all = lax.sort(sort_keys + payload, num_keys=7)
     key_s, id_s, _, _, _, dc_s, kind_s, score_s, ts_s, row_s = sorted_all
     vc_s = jnp.take(log.vc, row_s, axis=0)
     dead_s = kind_s == KIND_DEAD

@@ -1,32 +1,50 @@
 """Sort/segment primitives shared by the dense CRDT kernels.
 
 Everything here is shaped for XLA on TPU: multi-key lexicographic sorts via
-``lax.sort(num_keys=...)``, ranks within sorted groups via cumulative max —
-no data-dependent shapes, no scatter conflicts.
+``lax.sort(num_keys=...)``, group boundaries / ranks via roll-compare and
+cumulative max — no data-dependent shapes, no scatter conflicts.
 """
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 
-def group_rank(group_keys: Sequence[jax.Array]) -> jax.Array:
-    """Rank of each element within its group, for *already sorted* inputs.
+def segment_starts(
+    *keys: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Group structure of *already sorted* 1-D key columns.
 
-    `group_keys` are 1-D arrays that jointly identify the group (e.g. (key,
-    id)); elements of one group must be contiguous. Returns int32 ranks
-    0,1,2,... restarting at each group boundary.
+    Elements of one group (equal on every key) must be contiguous. Returns
+    ``(first, start, seg)``: per-row first-in-group flag, index of the
+    group's first row, and dense segment id (0, 1, 2, ... — usable as
+    ``segment_sum``/``segment_max`` ids with ``num_segments=len``).
     """
-    n = group_keys[0].shape[0]
+    n = keys[0].shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)
-    first = jnp.zeros(n, dtype=bool).at[0].set(True)
-    for k in group_keys:
-        first = first | (k != jnp.roll(k, 1))
+    first = jnp.zeros(n, dtype=bool)
+    for k in keys:
+        first = first | (k != jnp.roll(k, 1, axis=0))
     first = first.at[0].set(True)
-    # Position of each element's group start: running max of start indices.
     start = lax.cummax(jnp.where(first, idx, 0))
-    return idx - start
+    seg = jnp.cumsum(first.astype(jnp.int32)) - 1
+    return first, start, seg
+
+
+def prefix_rank(flag: jax.Array, start: jax.Array) -> jax.Array:
+    """Rank of each True `flag` row among the True rows of its segment
+    (segments given by per-row group-start indices from segment_starts)."""
+    excl = jnp.cumsum(flag.astype(jnp.int32)) - flag.astype(jnp.int32)
+    return excl - jnp.take(excl, start)
+
+
+def group_rank(group_keys: Sequence[jax.Array]) -> jax.Array:
+    """Rank of each element within its group, for *already sorted* inputs:
+    int32 ranks 0,1,2,... restarting at each group boundary."""
+    n = group_keys[0].shape[0]
+    _, start, _ = segment_starts(*group_keys)
+    return jnp.arange(n, dtype=jnp.int32) - start

@@ -1,0 +1,216 @@
+// Native host runtime: op-log store + causal delivery scheduler + batcher.
+//
+// The reference library delegates replication to its host (Antidote): the
+// host logs effect ops, ships them between DCs, and delivers them to every
+// replica causally, exactly once (SURVEY.md §1 — the contract the library
+// leans on but does not implement). This is that host, rebuilt as a native
+// component: the Python/JAX side hands it effect ops and drains dense
+// batches; everything between — per-origin append-only logs, vector-clock
+// dependency tracking, per-replica causal delivery cursors, struct-of-array
+// batch building — runs in C++ so the op pipeline never bottlenecks on the
+// Python interpreter between TPU dispatches.
+//
+// Model
+// -----
+// * D replicas, each also a DC (multi-master geo-replication).
+// * submit(origin, op): stamps the op with the origin's lamport time and a
+//   per-origin sequence number, snapshots the origin's delivered-vc as the
+//   op's causal dependency, appends to the origin's log. O(1) amortized.
+// * drain(replica, max_n): delivers ops to `replica` in causal order —
+//   op (origin, seq) is deliverable iff seq is the next undelivered from
+//   origin AND dep_vc <= replica.delivered_vc componentwise. Fills caller
+//   provided SoA buffers (the dense op-batch layout) and returns the count.
+//   Exactly-once by construction (cursor per (replica, origin)).
+// * Origins deliver their own ops through drain like everyone else: an
+//   op's dep_vc equals the origin's delivered snapshot, so it is
+//   immediately deliverable at its origin — no special case.
+//
+// Single-threaded by design: one host instance per pipeline thread (the
+// Erlang reference serializes through gen_server mailboxes; here the
+// batching amortizes instead).
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct OpRecord {
+  int32_t kind;   // type-specific; by convention 0/1 add-ish, 2/3 rmv-ish
+  int32_t key;    // CRDT instance index
+  int32_t id;     // element / player / token id
+  int32_t score;  // add score | wordcount count | average value
+  int32_t aux;    // second payload (average n, etc.)
+  int32_t dc;     // origin DC
+  int32_t ts;     // origin lamport stamp
+  // followed in the flat log by dep_vc[D] then payload vc[D]
+};
+
+constexpr int kFixed = 7;  // int32 fields before the two vcs
+
+class Host {
+ public:
+  explicit Host(int n_dcs)
+      : d_(n_dcs),
+        stride_(kFixed + 2 * n_dcs),
+        logs_(n_dcs),
+        lamport_(n_dcs, 0),
+        delivered_(n_dcs, std::vector<int64_t>(n_dcs, 0)),
+        submitted_(0),
+        delivered_total_(0) {}
+
+  int32_t Submit(int origin, int32_t kind, int32_t key, int32_t id,
+                 int32_t score, int32_t aux, const int32_t* vc) {
+    int32_t ts = ++lamport_[origin];
+    auto& log = logs_[origin];
+    size_t base = log.size();
+    log.resize(base + stride_);
+    int32_t* rec = log.data() + base;
+    rec[0] = kind;
+    rec[1] = key;
+    rec[2] = id;
+    rec[3] = score;
+    rec[4] = aux;
+    rec[5] = origin;
+    rec[6] = ts;
+    // Causal dependency: everything the origin has delivered so far.
+    for (int i = 0; i < d_; ++i)
+      rec[kFixed + i] = static_cast<int32_t>(delivered_[origin][i]);
+    int32_t* pvc = rec + kFixed + d_;
+    if (vc) {
+      std::memcpy(pvc, vc, sizeof(int32_t) * d_);
+    } else {
+      std::memset(pvc, 0, sizeof(int32_t) * d_);
+    }
+    ++submitted_;
+    return ts;
+  }
+
+  // Deliver up to max_n causally-ready ops for `replica` into SoA buffers.
+  // out_vc is [max_n, D] row-major. Returns the number delivered.
+  int Drain(int replica, int max_n, int32_t* out_kind, int32_t* out_key,
+            int32_t* out_id, int32_t* out_score, int32_t* out_aux,
+            int32_t* out_dc, int32_t* out_ts, int32_t* out_vc) {
+    auto& seen = delivered_[replica];
+    int n = 0;
+    bool progressed = true;
+    while (n < max_n && progressed) {
+      progressed = false;
+      for (int origin = 0; origin < d_ && n < max_n; ++origin) {
+        // Deliver as many consecutive ready ops from this origin as fit.
+        while (n < max_n) {
+          int64_t next = seen[origin];  // 0-based index of next op
+          if (static_cast<size_t>(next) * stride_ >= logs_[origin].size())
+            break;
+          const int32_t* rec = logs_[origin].data() + next * stride_;
+          const int32_t* dep = rec + kFixed;
+          bool ready = true;
+          for (int i = 0; i < d_; ++i) {
+            if (static_cast<int64_t>(dep[i]) > seen[i]) {
+              ready = false;
+              break;
+            }
+          }
+          if (!ready) break;
+          out_kind[n] = rec[0];
+          out_key[n] = rec[1];
+          out_id[n] = rec[2];
+          out_score[n] = rec[3];
+          out_aux[n] = rec[4];
+          out_dc[n] = rec[5];
+          out_ts[n] = rec[6];
+          std::memcpy(out_vc + static_cast<size_t>(n) * d_, rec + kFixed + d_,
+                      sizeof(int32_t) * d_);
+          ++n;
+          ++seen[origin];
+          ++delivered_total_;
+          // Delivering an add advances the replica's lamport view so later
+          // local stamps dominate everything it has seen.
+          if (rec[6] > lamport_[replica]) lamport_[replica] = rec[6];
+          progressed = true;
+        }
+      }
+    }
+    return n;
+  }
+
+  int64_t Backlog(int replica) const {
+    int64_t pending = 0;
+    for (int origin = 0; origin < d_; ++origin) {
+      int64_t total = static_cast<int64_t>(logs_[origin].size() / stride_);
+      pending += total - delivered_[replica][origin];
+    }
+    return pending;
+  }
+
+  void Stats(int64_t* out) const {
+    out[0] = submitted_;
+    out[1] = delivered_total_;
+    int64_t pending = 0;
+    for (int r = 0; r < d_; ++r) pending += Backlog(r);
+    out[2] = pending;
+  }
+
+  int n_dcs() const { return d_; }
+
+ private:
+  int d_;
+  int stride_;
+  std::vector<std::vector<int32_t>> logs_;     // per-origin flat op log
+  std::vector<int32_t> lamport_;               // per-DC lamport clock
+  std::vector<std::vector<int64_t>> delivered_;  // [replica][origin] counts
+  int64_t submitted_;
+  int64_t delivered_total_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ccrdt_host_new(int n_dcs) {
+  if (n_dcs <= 0) return nullptr;
+  return new Host(n_dcs);
+}
+
+void ccrdt_host_free(void* h) { delete static_cast<Host*>(h); }
+
+int32_t ccrdt_host_submit(void* h, int origin, int32_t kind, int32_t key,
+                          int32_t id, int32_t score, int32_t aux,
+                          const int32_t* vc) {
+  return static_cast<Host*>(h)->Submit(origin, kind, key, id, score, aux, vc);
+}
+
+// Batched submit: arrays of length n; vcs is [n, D] row-major or null.
+// out_ts (length n) receives the lamport stamps; may be null.
+void ccrdt_host_submit_batch(void* h, int origin, int n, const int32_t* kinds,
+                             const int32_t* keys, const int32_t* ids,
+                             const int32_t* scores, const int32_t* auxs,
+                             const int32_t* vcs, int32_t* out_ts) {
+  Host* host = static_cast<Host*>(h);
+  int d = host->n_dcs();
+  for (int i = 0; i < n; ++i) {
+    const int32_t* vc = vcs ? vcs + static_cast<size_t>(i) * d : nullptr;
+    int32_t ts = host->Submit(origin, kinds[i], keys[i], ids[i], scores[i],
+                              auxs ? auxs[i] : 0, vc);
+    if (out_ts) out_ts[i] = ts;
+  }
+}
+
+int ccrdt_host_drain(void* h, int replica, int max_n, int32_t* out_kind,
+                     int32_t* out_key, int32_t* out_id, int32_t* out_score,
+                     int32_t* out_aux, int32_t* out_dc, int32_t* out_ts,
+                     int32_t* out_vc) {
+  return static_cast<Host*>(h)->Drain(replica, max_n, out_kind, out_key,
+                                      out_id, out_score, out_aux, out_dc,
+                                      out_ts, out_vc);
+}
+
+int64_t ccrdt_host_backlog(void* h, int replica) {
+  return static_cast<Host*>(h)->Backlog(replica);
+}
+
+void ccrdt_host_stats(void* h, int64_t* out3) {
+  static_cast<Host*>(h)->Stats(out3);
+}
+
+}  // extern "C"

@@ -1,0 +1,198 @@
+"""ETF codec + reference-wire state conversion tests.
+
+Golden byte vectors are hand-assembled from the ETF spec (the distribution
+protocol's external term format) and match OTP's term_to_binary output for
+the flatmap/small-atom-utf8 era (OTP >= 26 defaults).
+"""
+
+import pytest
+
+from antidote_ccrdt_tpu.core import etf, wire
+from antidote_ccrdt_tpu.core.etf import Atom
+from antidote_ccrdt_tpu.core.behaviour import registry
+from antidote_ccrdt_tpu.core.clock import make_contexts
+
+GOLDEN = [
+    # term_to_binary({3, 2})
+    ((3, 2), bytes([131, 104, 2, 97, 3, 97, 2])),
+    # term_to_binary(#{}) / #{1 => 2}
+    ({}, bytes([131, 116, 0, 0, 0, 0])),
+    ({1: 2}, bytes([131, 116, 0, 0, 0, 1, 97, 1, 97, 2])),
+    # term_to_binary(-1), term_to_binary(1000)
+    (-1, bytes([131, 98, 255, 255, 255, 255])),
+    (1000, bytes([131, 98, 0, 0, 3, 232])),
+    # term_to_binary(<<"hi">>)
+    (b"hi", bytes([131, 109, 0, 0, 0, 2, 104, 105])),
+    # lists of bytes are STRING_EXT; other lists are LIST_EXT
+    ([1, 2, 3], bytes([131, 107, 0, 3, 1, 2, 3])),
+    ([1000], bytes([131, 108, 0, 0, 0, 1, 98, 0, 0, 3, 232, 106])),
+    ([], bytes([131, 106])),
+    # term_to_binary(1.5)
+    (1.5, bytes([131, 70, 63, 248, 0, 0, 0, 0, 0, 0])),
+    # term_to_binary(1 bsl 64)
+    (1 << 64, bytes([131, 110, 9, 0, 0, 0, 0, 0, 0, 0, 0, 0, 1])),
+    # term_to_binary(nil) — SMALL_ATOM_UTF8_EXT
+    (Atom("nil"), bytes([131, 119, 3, 110, 105, 108])),
+    (True, bytes([131, 119, 4]) + b"true"),
+    (False, bytes([131, 119, 5]) + b"false"),
+    # topk:new(100) state: {#{}, 100}
+    ((({}), 100), bytes([131, 104, 2, 116, 0, 0, 0, 0, 97, 100])),
+]
+
+
+@pytest.mark.parametrize("term,blob", GOLDEN, ids=[repr(t)[:40] for t, _ in GOLDEN])
+def test_golden_encode(term, blob):
+    assert etf.encode(term) == blob
+
+
+@pytest.mark.parametrize("term,blob", GOLDEN, ids=[repr(t)[:40] for t, _ in GOLDEN])
+def test_golden_decode(term, blob):
+    assert etf.decode(blob) == term
+
+
+def test_decode_legacy_atom_ext():
+    # ATOM_EXT (100) with 2-byte length — what older OTP emits.
+    assert etf.decode(bytes([131, 100, 0, 3]) + b"nil") == Atom("nil")
+    assert etf.decode(bytes([131, 100, 0, 4]) + b"true") is True
+
+
+def test_compressed_roundtrip():
+    term = {i: list(range(20)) for i in range(50)}
+    blob = etf.encode(term, compressed=True)
+    assert blob[1] == etf.COMPRESSED
+    assert etf.decode(blob) == term
+
+
+def test_roundtrip_nested():
+    term = (
+        {Atom("a"): (1, -5, 1 << 80), b"bin": [1.25, [], [300, (True, False)]]},
+        Atom("x"),
+        [],
+    )
+    assert etf.decode(etf.encode(term)) == term
+
+
+def test_map_key_order_is_erlang_term_order():
+    # number < atom < tuple < binary — OTP flatmap serialization order.
+    blob = etf.encode({b"bin": 1, Atom("a"): 2, 5: 3, (1, 2): 4})
+    # decode preserves insertion order of the encoded stream
+    keys = list(etf.decode(blob).keys())
+    assert keys == [5, Atom("a"), (1, 2), b"bin"]
+
+
+def test_malformed_inputs_raise_valueerror():
+    with pytest.raises(ValueError):
+        etf.decode(bytes([131]))  # truncated after magic
+    with pytest.raises(ValueError):
+        etf.decode(b"")
+    with pytest.raises(ValueError):
+        etf.decode(bytes([130, 97, 1]))  # bad magic
+    with pytest.raises(ValueError):
+        etf.decode(etf.encode((1, 2)) + b"junk")  # trailing bytes
+    z = etf.encode({i: i for i in range(64)}, compressed=True)
+    assert z[1] == etf.COMPRESSED
+    with pytest.raises(ValueError):
+        etf.decode(z + b"junk")  # trailing bytes after zlib stream
+
+
+def test_map_key_with_charlist_inside_tuple():
+    # #{{"ab", 5} => 1}: STRING_EXT inside a tuple key must still hash.
+    blob = bytes([131, 116, 0, 0, 0, 1, 104, 2, 107, 0, 2, 97, 98, 97, 5, 97, 1])
+    assert etf.decode(blob) == {((97, 98), 5): 1}
+
+
+def test_bool_atom_sort_order():
+    # atom term order: 'apple' < 'true'
+    blob = etf.encode({True: 1, Atom("apple"): 2})
+    assert list(etf.decode(blob).keys()) == [Atom("apple"), True]
+
+
+def test_gb_sets_roundtrip_matches_from_ordset():
+    # gb_sets:from_ordset([1,2,3]) = {3, {2, {1,nil,nil}, {3,nil,nil}}}
+    nil = Atom("nil")
+    assert etf.gb_set_from_list([3, 1, 2]) == (3, (2, (1, nil, nil), (3, nil, nil)))
+    assert etf.gb_set_to_list(etf.gb_set_from_list(range(100))) == list(range(100))
+    assert etf.gb_set_to_list((0, nil)) == []
+
+
+def test_sets_v1_record_decode():
+    # A sets:new() (v1) record with two elements placed structurally:
+    # {set, Size, N, MaxN, BSo, ESo, Con, Empty, Segs}.
+    empty_seg = tuple([[] for _ in range(16)])
+    seg = tuple([[10] if i == 0 else ([20] if i == 3 else []) for i in range(16)])
+    rec = (Atom("set"), 2, 16, 16, 8, 80, 48, empty_seg, (seg,))
+    assert sorted(etf.set_to_list(rec)) == [10, 20]
+    # v2 map form
+    assert sorted(etf.set_to_list({10: [], 20: []})) == [10, 20]
+    assert etf.set_from_list([10, 20]) == {10: [], 20: []}
+
+
+# --- wire: state round-trips over every type ------------------------------
+
+
+def _run_ops(name, ops, new_args=()):
+    crdt = registry.scalar(name)
+    (ctx,) = make_contexts(1)
+    state = crdt.new(*new_args)
+    for op in ops:
+        eff = crdt.downstream(op, state, ctx)
+        if eff is not None:
+            state, extras = crdt.update(eff, state)
+            for e in extras:
+                state, _ = crdt.update(e, state)
+    return crdt, state
+
+
+CASES = [
+    ("average", [("add", 5), ("add", (10, 2))], ()),
+    ("topk", [("add", (1, 42)), ("add", (2, 7)), ("add", (1, 50))], (5,)),
+    (
+        "topk_rmv",
+        [("add", (1, 42)), ("add", (2, 7)), ("rmv", 2), ("add", (3, 99))],
+        (2,),
+    ),
+    ("leaderboard", [("add", (1, 42)), ("add", (2, 7)), ("ban", 2)], (2,)),
+    ("wordcount", [("add", "a b b\nc")], ()),
+    ("worddocumentcount", [("add", "a a b"), ("add", "a c")], ()),
+]
+
+
+@pytest.mark.parametrize("name,ops,new_args", CASES, ids=[c[0] for c in CASES])
+def test_wire_roundtrip(name, ops, new_args):
+    crdt, state = _run_ops(name, ops, new_args)
+    blob = wire.to_reference_binary(name, state)
+    back = wire.from_reference_binary(name, blob)
+    assert crdt.equal(state, back)
+    # full-state equality, not just observable
+    assert wire.state_to_term(name, back) == wire.state_to_term(name, state)
+    # compressed flavour decodes identically
+    blob_z = wire.to_reference_binary(name, state, compressed=True)
+    assert wire.state_to_term(name, wire.from_reference_binary(name, blob_z)) == \
+        wire.state_to_term(name, state)
+
+
+def test_wire_golden_topk_state():
+    # topk state {#{1 => 42}, 10} after one add
+    crdt, state = _run_ops("topk", [("add", (1, 42))], (10,))
+    assert wire.to_reference_binary("topk", state) == bytes(
+        [131, 104, 2, 116, 0, 0, 0, 1, 97, 1, 97, 42, 97, 10]
+    )
+
+
+def test_wire_accepts_beam_style_ids_and_dcids():
+    # A topk_rmv snapshot whose dcid is Antidote-style {atom, int} and whose
+    # ids are binaries — decodes into a usable scalar state.
+    dc = (Atom("replica1"), 0)
+    term = (
+        {b"player": (42, b"player", (dc, 7))},
+        {b"player": etf.gb_set_from_list([(42, b"player", (dc, 7))])},
+        {},
+        {dc: 7},
+        (42, b"player", (dc, 7)),
+        100,
+    )
+    state = wire.state_from_term("topk_rmv", term)
+    crdt = registry.scalar("topk_rmv")
+    assert crdt.value(state) == [(b"player", 42)]
+    # and re-encodes to the same term
+    assert wire.state_to_term("topk_rmv", state) == term

@@ -72,12 +72,32 @@ class AverageScalar:
             return len(p) == 2 and all(isinstance(x, int) for x in p)
         return isinstance(p, int)
 
+    @staticmethod
+    def _fuse(e1: EffectOp, e2: EffectOp):
+        # An n=0 op is a no-op in update (the `average.erl:89` guard), so it
+        # must contribute nothing when fused either — the reference fuses
+        # blindly (`average.erl:127`), silently resurrecting the dead op's
+        # sum; deliberate fix, caught by test_compaction_preserves_state_average.
+        (v1, n1), (v2, n2) = e1[1], e2[1]
+        if n1 == 0:
+            v1 = 0
+        if n2 == 0:
+            v2 = 0
+        return v1 + v2, n1 + n2
+
     def can_compact(self, e1: EffectOp, e2: EffectOp) -> bool:
-        return e1[0] == "add" and e2[0] == "add"
+        if e1[0] != "add" or e2[0] != "add":
+            return False
+        # Refuse fusions whose combined n is 0 while the combined sum is
+        # not: the fused op would hit the n=0 update guard and drop the
+        # sum that sequential application keeps (possible because
+        # is_operation admits negative n).
+        v, n = self._fuse(e1, e2)
+        return n != 0 or v == 0
 
     def compact_ops(self, e1: EffectOp, e2: EffectOp):
-        (v1, n1), (v2, n2) = e1[1], e2[1]
-        return None, ("add", (v1 + v2, n1 + n2))
+        v, n = self._fuse(e1, e2)
+        return None, ("add", (v, n))
 
     def is_replicate_tagged(self, effect: EffectOp) -> bool:
         return False

@@ -1,0 +1,237 @@
+"""Property-based convergence and algebra tests (hypothesis).
+
+SURVEY.md §4 calls for property-based convergence testing the reference
+lacks: random op streams over N simulated DCs with random sync points
+under causal delivery must leave every pair of replicas observably equal.
+On top of convergence, this file checks the algebraic laws the batched
+TPU path depends on:
+
+* dense merge is commutative/associative, and idempotent for JOIN types;
+* dense apply_ops is invariant to op order within a batch;
+* pairwise op compaction preserves final state (the reference's
+  can_compact/compact_ops contract, antidote_ccrdt.erl:55-56);
+* reference-wire serialization round-trips arbitrary reachable states.
+"""
+
+import jax
+import numpy as np
+import pytest
+from hypothesis import HealthCheck, given, settings
+from hypothesis import strategies as st
+
+from antidote_ccrdt_tpu.core import wire
+from antidote_ccrdt_tpu.core.behaviour import registry
+from antidote_ccrdt_tpu.harness.replay import ScalarReplay
+from antidote_ccrdt_tpu.models.topk_rmv_dense import make_dense
+
+from test_topk_rmv_dense import gen_effect_log, pack_ops
+
+SETTINGS = dict(
+    deadline=None, suppress_health_check=[HealthCheck.too_slow, HealthCheck.data_too_large]
+)
+
+
+# --- op-stream strategies -------------------------------------------------
+
+ids = st.integers(0, 14)
+scores = st.integers(1, 99)
+
+
+def stream(n_replicas, op_strategy, max_size=60):
+    """[(origin, prepare_op)] with interspersed sync markers (origin=-1)."""
+    item = st.one_of(
+        st.tuples(st.integers(0, n_replicas - 1), op_strategy),
+        st.just((-1, None)),
+    )
+    return st.lists(item, max_size=max_size)
+
+
+topk_rmv_ops = st.one_of(
+    st.tuples(st.just("add"), st.tuples(ids, scores)),
+    st.tuples(st.just("rmv"), ids),
+)
+leaderboard_ops = st.one_of(
+    st.tuples(st.just("add"), st.tuples(ids, scores)),
+    st.tuples(st.just("ban"), ids),
+)
+topk_ops = st.tuples(st.just("add"), st.tuples(ids, scores))
+average_ops = st.one_of(
+    st.tuples(st.just("add"), st.integers(-50, 50)),
+    st.tuples(st.just("add"), st.tuples(st.integers(-50, 50), st.integers(0, 5))),
+)
+word_ops = st.tuples(
+    st.just("add"),
+    st.lists(st.sampled_from(["a", "b", "cc", "dd"]), max_size=6).map(" ".join),
+)
+
+
+def run_stream(name, new_args, items, n_replicas=3):
+    crdt = registry.scalar(name)
+    rp = ScalarReplay(crdt, n_replicas, new_args=new_args)
+    for origin, op in items:
+        if origin < 0:
+            rp.sync()
+        else:
+            rp.submit(origin, op)
+    rp.sync()
+    return crdt, rp
+
+
+CONVERGENCE_CASES = [
+    ("topk_rmv", (3,), topk_rmv_ops),
+    ("leaderboard", (3,), leaderboard_ops),
+    ("topk", (4,), topk_ops),
+    ("average", (), average_ops),
+    ("wordcount", (), word_ops),
+    ("worddocumentcount", (), word_ops),
+]
+
+
+@pytest.mark.parametrize("name,new_args,ops", CONVERGENCE_CASES, ids=[c[0] for c in CONVERGENCE_CASES])
+def test_convergence_random_interleavings(name, new_args, ops):
+    @settings(max_examples=60, **SETTINGS)
+    @given(items=stream(3, ops))
+    def prop(items):
+        crdt, rp = run_stream(name, new_args, items)
+        assert rp.converged(), (name, rp.values())
+
+    prop()
+
+
+@settings(max_examples=40, **SETTINGS)
+@given(items=stream(4, topk_rmv_ops, max_size=80))
+def test_topk_rmv_four_dc_convergence_and_wire(items):
+    crdt, rp = run_stream("topk_rmv", (2,), items, n_replicas=4)
+    assert rp.converged()
+    for s in rp.states:
+        blob = wire.to_reference_binary("topk_rmv", s)
+        back = wire.from_reference_binary("topk_rmv", blob)
+        assert wire.state_to_term("topk_rmv", back) == wire.state_to_term("topk_rmv", s)
+
+
+@settings(max_examples=40, **SETTINGS)
+@given(items=stream(3, leaderboard_ops))
+def test_leaderboard_wire_roundtrip_reachable_states(items):
+    crdt, rp = run_stream("leaderboard", (3,), items)
+    for s in rp.states:
+        blob = wire.to_reference_binary("leaderboard", s)
+        assert crdt.equal(s, wire.from_reference_binary("leaderboard", blob))
+
+
+# --- compaction soundness -------------------------------------------------
+
+
+def _apply_seq(crdt, state, effects):
+    for e in effects:
+        if e is None:
+            continue
+        state, extras = crdt.update(e, state)
+        for x in extras:
+            state, _ = crdt.update(x, state)
+    return state
+
+
+@settings(max_examples=80, **SETTINGS)
+@given(
+    ops=st.lists(st.tuples(st.integers(0, 2), topk_rmv_ops), min_size=2, max_size=12),
+    i=st.integers(0, 10),
+    j=st.integers(0, 11),
+)
+def test_compaction_preserves_state_topk_rmv(ops, i, j):
+    """Compacting any compactible pair in an effect log must not change the
+    state the log folds to (same-origin logs: compaction happens inside one
+    DC's op log before shipping)."""
+    crdt = registry.scalar("topk_rmv")
+    rng = np.random.default_rng(0)
+    _, log = gen_effect_log(rng, len(ops), n_ids=6, n_dcs=3, size=3, rmv_frac=0.3)
+    if len(log) < 2:
+        return
+    i, j = i % len(log), j % len(log)
+    if i == j:
+        return
+    i, j = min(i, j), max(i, j)
+    if not crdt.can_compact(log[i], log[j]):
+        return
+    c1, c2 = crdt.compact_ops(log[i], log[j])
+    compacted = list(log)
+    compacted[i], compacted[j] = c1, c2
+    a = _apply_seq(crdt, crdt.new(3), log)
+    b = _apply_seq(crdt, crdt.new(3), compacted)
+    assert crdt.equal(a, b)
+    assert crdt.value(a) == crdt.value(b) or set(crdt.value(a)) == set(crdt.value(b))
+
+
+@settings(max_examples=60, **SETTINGS)
+@given(
+    vals=st.lists(
+        st.tuples(st.integers(-20, 20), st.integers(0, 4)), min_size=2, max_size=6
+    )
+)
+def test_compaction_preserves_state_average(vals):
+    crdt = registry.scalar("average")
+    log = [("add", v) for v in vals]
+    while True:
+        for i in range(len(log)):
+            hit = False
+            for j in range(i + 1, len(log)):
+                if log[i] and log[j] and crdt.can_compact(log[i], log[j]):
+                    log[i], log[j] = crdt.compact_ops(log[i], log[j])
+                    hit = True
+                    break
+            if hit:
+                break
+        else:
+            break
+    expect_sum = sum(v for v, n in vals if n > 0)
+    expect_n = sum(n for _, n in vals)
+    state = _apply_seq(crdt, crdt.new(), log)
+    assert state == (expect_sum, expect_n)
+
+
+# --- dense algebra laws ---------------------------------------------------
+
+_D = make_dense(n_ids=16, n_dcs=3, size=4, slots_per_id=3)
+_apply = jax.jit(_D.apply_ops)
+_merge = jax.jit(_D.merge)
+
+
+def _state_from_log(log):
+    s = _D.init(n_replicas=1, n_keys=1)
+    out, _ = _apply(s, pack_ops(log, n_dcs=3, add_pad=24, rmv_pad=8))
+    return out
+
+
+def _obs(state):
+    return set(map(tuple, _D.value(state)[0][0]))
+
+
+@settings(max_examples=15, **SETTINGS)
+@given(seed=st.integers(0, 10_000), n=st.integers(2, 18))
+def test_dense_merge_laws(seed, n):
+    rng = np.random.default_rng(seed)
+    _, log = gen_effect_log(rng, n, n_ids=16, n_dcs=3, size=4, rmv_frac=0.3)
+    cut1, cut2 = len(log) // 3, 2 * len(log) // 3
+    a = _state_from_log(log[:cut1])
+    b = _state_from_log(log[cut1:cut2])
+    c = _state_from_log(log[cut2:])
+    ab = _merge(a, b)
+    # commutative + associative + idempotent (JOIN lattice)
+    assert _obs(ab) == _obs(_merge(b, a))
+    assert _obs(_merge(ab, c)) == _obs(_merge(a, _merge(b, c)))
+    assert _obs(_merge(ab, ab)) == _obs(ab)
+
+
+@settings(max_examples=15, **SETTINGS)
+@given(seed=st.integers(0, 10_000), n=st.integers(2, 16))
+def test_dense_batch_order_invariance(seed, n):
+    """Applying a permuted effect batch yields the same observable — the
+    property that makes one-dispatch batching sound (SURVEY.md §7 hard
+    part (a))."""
+    rng = np.random.default_rng(seed)
+    _, log = gen_effect_log(rng, n, n_ids=16, n_dcs=3, size=4, rmv_frac=0.3)
+    if not log:
+        return
+    perm = list(rng.permutation(len(log)))
+    a = _state_from_log(log)
+    b = _state_from_log([log[p] for p in perm])
+    assert _obs(a) == _obs(b)

@@ -122,6 +122,22 @@ def _dec(buf: io.BytesIO) -> Any:
     raise ValueError(f"bad tag {tag}")
 
 
+def encode_term(obj: Any) -> bytes:
+    """Bare canonical encoding of one python value (no snapshot header) —
+    the framing used by op-log journals and the bridge wire protocol."""
+    out = io.BytesIO()
+    _enc(obj, out)
+    return out.getvalue()
+
+
+def decode_term(data: bytes) -> Any:
+    buf = io.BytesIO(data)
+    obj = _dec(buf)
+    if buf.read(1):
+        raise ValueError("trailing bytes after encoded term")
+    return obj
+
+
 def _header(kind: int, name: str) -> bytes:
     nb = name.encode("utf-8")
     return MAGIC + bytes([VERSION, kind, len(nb)]) + nb

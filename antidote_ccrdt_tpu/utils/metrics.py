@@ -1,0 +1,120 @@
+"""Metrics + profiling: the observability layer the reference lacks.
+
+SURVEY.md §5 records the reference has no instrumentation at all; the
+BASELINE metrics (merges/sec, p50 merge latency) therefore need first-class
+counters here. Design: process-local, lock-free-enough registries of
+counters and latency recorders, plus thin hooks into the JAX profiler for
+TPU timeline traces.
+
+Usage:
+
+    m = Metrics()
+    with m.timer("sync"):
+        rp.sync()
+    m.count("ops_applied", rp.ops_applied)
+    m.summary()                       # {"sync": {"p50_ms": ...}, ...}
+
+    with device_trace("apply_ops"):   # shows up in the TPU profiler timeline
+        state, _ = D.apply_ops(state, ops)
+
+    with profile("/tmp/trace"):       # full XLA/TPU trace for one region
+        run_benchmark()
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+
+class LatencyRecorder:
+    """Append-only duration series with percentile summaries."""
+
+    __slots__ = ("samples",)
+
+    def __init__(self) -> None:
+        self.samples: List[float] = []
+
+    def record(self, seconds: float) -> None:
+        self.samples.append(seconds)
+
+    def summary(self) -> Dict[str, float]:
+        if not self.samples:
+            return {"n": 0}
+        a = np.asarray(self.samples)
+        return {
+            "n": int(a.size),
+            "mean_ms": float(a.mean() * 1e3),
+            "p50_ms": float(np.percentile(a, 50) * 1e3),
+            "p90_ms": float(np.percentile(a, 90) * 1e3),
+            "p99_ms": float(np.percentile(a, 99) * 1e3),
+            "total_s": float(a.sum()),
+        }
+
+
+class Metrics:
+    """Named counters + latency recorders. One instance per harness run."""
+
+    def __init__(self) -> None:
+        self.counters: Dict[str, float] = {}
+        self.latencies: Dict[str, LatencyRecorder] = {}
+        self._t0 = time.perf_counter()
+
+    def count(self, name: str, delta: float = 1.0) -> None:
+        self.counters[name] = self.counters.get(name, 0.0) + delta
+
+    def set(self, name: str, value: float) -> None:
+        self.counters[name] = value
+
+    @contextlib.contextmanager
+    def timer(self, name: str) -> Iterator[None]:
+        rec = self.latencies.setdefault(name, LatencyRecorder())
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            rec.record(time.perf_counter() - t0)
+
+    def rate(self, counter: str, timer: Optional[str] = None) -> float:
+        """counter / (timer's total seconds, or wall time since creation)."""
+        n = self.counters.get(counter, 0.0)
+        if timer is not None:
+            total = sum(self.latencies[timer].samples) if timer in self.latencies else 0.0
+        else:
+            total = time.perf_counter() - self._t0
+        return n / total if total > 0 else 0.0
+
+    def summary(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = dict(self.counters)
+        for name, rec in self.latencies.items():
+            out[name] = rec.summary()
+        return out
+
+
+# --- JAX profiler hooks ---------------------------------------------------
+
+
+@contextlib.contextmanager
+def device_trace(name: str) -> Iterator[None]:
+    """Annotate a region so it appears on the device timeline in profiler
+    traces (no-op cost when no trace is being captured)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@contextlib.contextmanager
+def profile(log_dir: str) -> Iterator[None]:
+    """Capture a full JAX/XLA profiler trace (TensorBoard format) for the
+    enclosed region."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

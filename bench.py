@@ -20,7 +20,6 @@ Prints exactly ONE JSON line.
 
 import json
 import os
-import statistics
 import sys
 import time
 
@@ -45,15 +44,17 @@ def bench_dense(R, I, D_DCS, K, M, B, Br, rounds):
     state, _ = D.apply_ops(state, batches[1])
     jax.block_until_ready(state.slot_ts)
 
-    times = []
+    from antidote_ccrdt_tpu.utils.metrics import Metrics, device_trace
+
+    m = Metrics()
     for i in range(rounds):
-        t0 = time.perf_counter()
-        state, _ = D.apply_ops(state, batches[2 + i])
-        jax.block_until_ready(state.slot_ts)
-        times.append(time.perf_counter() - t0)
-    ops_per_round = R * (B + Br)
-    apply_rate = ops_per_round * rounds / sum(times)
-    p50_ms = statistics.median(times) * 1e3
+        with m.timer("round"), device_trace("apply_ops_round"):
+            state, _ = D.apply_ops(state, batches[2 + i])
+            jax.block_until_ready(state.slot_ts)
+        m.count("ops", R * (B + Br))
+    apply_rate = m.rate("ops", "round")
+    lat = m.latencies["round"].summary()
+    p50_ms, p99_ms = lat["p50_ms"], lat["p99_ms"]
 
     # Batched replica-state merge: all R pairwise merges in ONE dispatch
     # (state row r joined with row (r+1) mod R) — the literal north-star
@@ -75,7 +76,7 @@ def bench_dense(R, I, D_DCS, K, M, B, Br, rounds):
     jax.block_until_ready(merged.slot_ts)
     state_merges_per_sec = MERGE_REPS * R / (time.perf_counter() - t0)
 
-    return apply_rate, p50_ms, state_merges_per_sec
+    return apply_rate, p50_ms, p99_ms, state_merges_per_sec
 
 
 def bench_scalar_baseline(R, I, D_DCS, K, n_ops):
@@ -118,7 +119,7 @@ def main():
         R, I, B, Br, rounds, base_ops = 32, 100_000, 4096, 256, 10, 20_000
     D_DCS, K, M = R, 100, 4  # every simulated replica is a DC: vc width = R
 
-    apply_rate, p50_ms, state_merge_rate = bench_dense(
+    apply_rate, p50_ms, p99_ms, state_merge_rate = bench_dense(
         R, I, D_DCS, K, M, B, Br, rounds
     )
     baseline_rate = bench_scalar_baseline(R, I, D_DCS, K, base_ops)
@@ -131,6 +132,7 @@ def main():
                 "unit": "merges/sec",
                 "vs_baseline": round(apply_rate / baseline_rate, 2),
                 "p50_round_latency_ms": round(p50_ms, 2),
+                "p99_round_latency_ms": round(p99_ms, 2),
                 "replica_state_merges_per_sec": round(state_merge_rate, 1),
                 "baseline_cpu_merges_per_sec": round(baseline_rate),
                 "backend": backend,

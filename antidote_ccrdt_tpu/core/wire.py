@@ -41,7 +41,17 @@ def _id_to_term(x: Any) -> Any:
 
 
 def _id_from_term(x: Any) -> Any:
-    return x  # ids stay opaque; bytes keys are valid Python dict keys
+    # Erlang has no string type — str ids encode as utf-8 binaries, so
+    # utf-8 binaries decode back to str (non-utf-8 binaries stay bytes).
+    # This makes state round-trips identity for str-keyed states and
+    # term-level identity for BEAM snapshots (b"x" normalizes to "x" in
+    # Python but re-encodes to the same binary).
+    if isinstance(x, bytes):
+        try:
+            return x.decode("utf-8")
+        except UnicodeDecodeError:
+            return x
+    return x
 
 
 def _elem_to_term(e: Any) -> Any:

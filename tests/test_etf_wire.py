@@ -193,6 +193,16 @@ def test_wire_accepts_beam_style_ids_and_dcids():
     )
     state = wire.state_from_term("topk_rmv", term)
     crdt = registry.scalar("topk_rmv")
-    assert crdt.value(state) == [(b"player", 42)]
-    # and re-encodes to the same term
+    # utf-8 binary ids normalize to str in Python...
+    assert crdt.value(state) == [("player", 42)]
+    # ...but re-encode to the identical BEAM term
     assert wire.state_to_term("topk_rmv", state) == term
+
+
+def test_wire_str_ids_roundtrip_identity():
+    crdt, state = _run_ops("topk", [("add", ("player", 42))], (5,))
+    back = wire.from_reference_binary("topk", wire.to_reference_binary("topk", state))
+    assert back == state  # str keys survive, not mutated to bytes
+    # non-utf8 binary ids stay bytes
+    raw = wire.state_from_term("topk", ({b"\xff\xfe": 1}, 5))
+    assert list(raw.entries) == [b"\xff\xfe"]

@@ -65,10 +65,25 @@ SMALL_ATOM_UTF8_EXT = 119
 
 
 class Atom(str):
-    """An Erlang atom. ``Atom('nil') != 'nil'`` only by type, so converters
-    must check ``isinstance(x, Atom)`` before treating strings as atoms."""
+    """An Erlang atom. Equality and hashing are type-strict: ``Atom('x') !=
+    'x'`` and the two can coexist as distinct dict keys, mirroring how the
+    atom ``x`` and the binary ``<<"x">>`` are distinct Erlang terms (ids
+    decode utf-8 binaries to plain str, so without this a state keyed by
+    both would silently merge)."""
 
     __slots__ = ()
+
+    def __eq__(self, other: Any) -> bool:
+        if isinstance(other, Atom):
+            return str.__eq__(self, other)
+        return NotImplemented if not isinstance(other, str) else False
+
+    def __ne__(self, other: Any) -> bool:
+        eq = self.__eq__(other)
+        return NotImplemented if eq is NotImplemented else not eq
+
+    def __hash__(self) -> int:
+        return hash(("\x00erlang-atom", str(self)))
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         return f"Atom({str.__repr__(self)})"

@@ -143,10 +143,7 @@ def _wordcount_to_term(state: Dict[str, int]) -> Any:
 
 
 def _wordcount_from_term(term: Any) -> Any:
-    out = {}
-    for k, v in term.items():
-        out[k.decode("utf-8") if isinstance(k, bytes) else k] = int(v)
-    return out
+    return {_id_from_term(k): int(v) for k, v in term.items()}
 
 
 _TO = {

@@ -1,0 +1,316 @@
+"""Pallas TPU kernels for the topk_rmv hot paths.
+
+SURVEY.md §7 step 6 reserves pallas for ops where XLA falls short. The two
+candidates below were built and differentially verified; v5e measurements
+(bench shapes: [32, 1, 100k] instances, W=8 slots, D=32 DCs) decided what
+the dense model actually dispatches to:
+
+* **Slot sorting** (`sort_slots_pallas`) — the join step of
+  `apply_ops`/`merge` sorts W<=8-wide slot groups best-first per
+  (replica, key, id) row: a fixed-size compare-exchange network (Batcher
+  odd-even mergesort) where each comparator is a handful of VPU selects.
+  Measured 19.5ms with XLA-side transposes and 71.6ms with in-VMEM
+  transposes vs 14.6ms for XLA's variadic `lax.sort` — narrow-array
+  sublane<->lane relayouts dominate, so **XLA remains the default**; the
+  kernel is kept as verified infrastructure (it wins when data already
+  lives in a [W, N] layout).
+
+* **Tombstone row scatter-max** (`scatter_max_rows_pallas`) —
+  `rmv_vc.at[rows].max(upd)` over the [T, D] tombstone table, where XLA's
+  scatter costs ~35ms for 8k rows. The BlockSpec-pipelined version is
+  rejected by Mosaic (last-two-dims tiling rule vs narrow D=32 minor dim)
+  and a manual-DMA variant deadlocked on v5e, so the TPU path is **not
+  wired into the hot path**; the kernel is interpret-verified and the
+  design note that matters survives in `combine_duplicate_rows`: rewriting
+  every duplicate row to carry its run's total makes all writes
+  idempotent-to-final, defusing read-modify-write races in any pipelined
+  scatter. Updates must be >= 0 (vc timestamps).
+
+The big measured win for the hot path was algorithmic, not a kernel: see
+`_filter_slots`'s select-scan note in `models/topk_rmv_dense.py` (~400ms ->
+0.03ms by avoiding XLA's pathological narrow-index gather).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = jnp.iinfo(jnp.int32).min + 1
+
+
+# --- comparator network ---------------------------------------------------
+
+
+def oddeven_network(n: int) -> List[Tuple[int, int]]:
+    """Batcher odd-even mergesort comparator pairs for `n` inputs.
+
+    Generated for the next power of two; pairs touching virtual inputs
+    >= n are dropped, which is sound because missing inputs rank strictly
+    last (empty slots hold (NEG_INF, ts=0)) and a descending
+    compare-exchange never moves a minimal element up."""
+    m = 1
+    while m < n:
+        m *= 2
+    pairs: List[Tuple[int, int]] = []
+
+    def merge(lo: int, cnt: int, r: int) -> None:
+        step = r * 2
+        if step < cnt:
+            merge(lo, cnt, step)
+            merge(lo + r, cnt, step)
+            for i in range(lo + r, lo + cnt - r, step):
+                pairs.append((i, i + r))
+        else:
+            pairs.append((lo, lo + r))
+
+    def sort(lo: int, cnt: int) -> None:
+        if cnt > 1:
+            half = cnt // 2
+            sort(lo, half)
+            sort(lo + half, half)
+            merge(lo, cnt, 1)
+
+    sort(0, m)
+    return [(i, j) for (i, j) in pairs if j < n]
+
+
+def _cmpx_desc(rows, i: int, j: int):
+    """Compare-exchange rows i,j of (score, ts, dc) row-lists so that row i
+    ranks >= row j in (score desc, ts desc, dc asc) order — the `_sort_slots`
+    key order."""
+    s, t, d = rows
+    si, sj = s[i], s[j]
+    ti, tj = t[i], t[j]
+    di, dj = d[i], d[j]
+    swap = (sj > si) | ((sj == si) & ((tj > ti) | ((tj == ti) & (dj < di))))
+    s[i], s[j] = jnp.where(swap, sj, si), jnp.where(swap, si, sj)
+    t[i], t[j] = jnp.where(swap, tj, ti), jnp.where(swap, ti, tj)
+    d[i], d[j] = jnp.where(swap, dj, di), jnp.where(swap, di, dj)
+
+
+def _sort_slots_kernel(W: int, s_ref, d_ref, t_ref, os_ref, od_ref, ot_ref, nl_ref):
+    # Blocks arrive [tile, W]; transpose in VMEM so the W slots live on the
+    # sublane axis and every comparator is a full-width VPU select. This
+    # keeps HBM traffic at exactly read-input + write-output (an XLA-level
+    # pre-transpose would double it).
+    s_t = s_ref[:].T
+    d_t = d_ref[:].T
+    t_t = t_ref[:].T
+    s = [s_t[i, :] for i in range(W)]
+    t = [t_t[i, :] for i in range(W)]
+    d = [d_t[i, :] for i in range(W)]
+    net = oddeven_network(W)
+    for (i, j) in net:
+        _cmpx_desc((s, t, d), i, j)
+    # Adjacent dedup: in a sorted run of identical (score, ts, dc) triples
+    # every element but the first matches its predecessor. Empty (ts=0)
+    # slots are never deduped.
+    empty_s = jnp.full_like(s[0], NEG_INF)
+    zero = jnp.zeros_like(t[0])
+    for i in range(W - 1, 0, -1):
+        dup = (s[i] == s[i - 1]) & (t[i] == t[i - 1]) & (d[i] == d[i - 1]) & (t[i] > 0)
+        s[i] = jnp.where(dup, empty_s, s[i])
+        t[i] = jnp.where(dup, zero, t[i])
+        d[i] = jnp.where(dup, zero, d[i])
+    # Second pass pushes the holes to the end.
+    for (i, j) in net:
+        _cmpx_desc((s, t, d), i, j)
+    n_live = zero
+    for i in range(W):
+        n_live = n_live + (t[i] > 0).astype(jnp.int32)
+    m_keep = os_ref.shape[1]
+    os_ref[:] = jnp.stack(s[:m_keep], axis=0).T
+    od_ref[:] = jnp.stack(d[:m_keep], axis=0).T
+    ot_ref[:] = jnp.stack(t[:m_keep], axis=0).T
+    nl_ref[:] = n_live[:, None]
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4, 5))
+def sort_slots_pallas(score, dc, ts, m_keep: int, interpret: bool = False, tile: int = 2048):
+    """Drop-in for `_sort_slots`: sort best-first, dedup, keep `m_keep`.
+
+    Inputs [..., W] int32; returns (score, dc, ts)[..., :m_keep] and
+    n_live[...] (live count before truncation)."""
+    *lead, W = score.shape
+    N = 1
+    for x in lead:
+        N *= x
+    s2 = score.reshape(N, W)
+    d2 = dc.reshape(N, W)
+    t2 = ts.reshape(N, W)
+    pad = (-N) % tile
+    if pad:
+        s2 = jnp.pad(s2, ((0, pad), (0, 0)), constant_values=NEG_INF)
+        d2 = jnp.pad(d2, ((0, pad), (0, 0)))
+        t2 = jnp.pad(t2, ((0, pad), (0, 0)))
+    Np = N + pad
+    grid = (Np // tile,)
+    blk = lambda w: pl.BlockSpec((tile, w), lambda g: (g, 0))
+    os_, od_, ot_, nl = pl.pallas_call(
+        functools.partial(_sort_slots_kernel, W),
+        grid=grid,
+        in_specs=[blk(W), blk(W), blk(W)],
+        out_specs=[blk(m_keep), blk(m_keep), blk(m_keep), blk(1)],
+        out_shape=[
+            jax.ShapeDtypeStruct((Np, m_keep), jnp.int32),
+            jax.ShapeDtypeStruct((Np, m_keep), jnp.int32),
+            jax.ShapeDtypeStruct((Np, m_keep), jnp.int32),
+            jax.ShapeDtypeStruct((Np, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(s2, d2, t2)
+
+    def back(x, w):
+        return x[:N].reshape(*lead, w)
+
+    return (
+        back(os_, m_keep),
+        back(od_, m_keep),
+        back(ot_, m_keep),
+        nl[:N, 0].reshape(lead),
+    )
+
+
+# --- tombstone row scatter-max --------------------------------------------
+
+
+def _scatter_max_dma_kernel(B: int, idx_ref, tab_ref, upd_ref, out_ref, scratch, rd_sems, wr_sems):
+    """Per-replica row read-modify-write loop with a 2-deep DMA pipeline.
+
+    The table stays in HBM (unblocked); each row is DMA'd into a VMEM
+    scratch slot, maxed with its (VMEM-resident) update, and DMA'd back.
+    Row j+1's read overlaps row j's compute+write. Safe against duplicate
+    rows because updates are idempotent-to-final (elementwise max with the
+    run total) — even a torn concurrent read lands on the correct value."""
+    r = pl.program_id(0)
+
+    def rd(j, slot):
+        return pltpu.make_async_copy(
+            out_ref.at[r, pl.ds(idx_ref[r, j], 1), :], scratch.at[slot], rd_sems.at[slot]
+        )
+
+    def wr(j, slot):
+        return pltpu.make_async_copy(
+            scratch.at[slot], out_ref.at[r, pl.ds(idx_ref[r, j], 1), :], wr_sems.at[slot]
+        )
+
+    rd(0, 0).start()
+
+    def body(j, carry):
+        slot = jax.lax.rem(j, 2)
+
+        @pl.when(j + 1 < B)
+        def _():
+            rd(j + 1, 1 - slot).start()
+
+        rd(j, slot).wait()
+
+        # The write that last used this slot (iteration j-2) must be done
+        # before we overwrite the scratch.
+        @pl.when(j >= 2)
+        def _():
+            wr(j - 2, slot).wait()
+
+        scratch[slot] = jnp.maximum(scratch[slot], upd_ref[0, j][None, :])
+        wr(j, slot).start()
+        return carry
+
+    jax.lax.fori_loop(0, B, body, 0)
+
+    @pl.when(B >= 2)
+    def _():
+        wr(B - 2, jax.lax.rem(B - 2, 2)).wait()
+
+    wr(B - 1, jax.lax.rem(B - 1, 2)).wait()
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def scatter_max_rows_pallas(table, rows, upd, interpret: bool = False):
+    """In-place `table.at[r, rows[r]].max(upd[r])` for non-negative updates.
+
+    table [R, T, D] int32 (donated/aliased), rows [R, B] int32 in [0, T),
+    upd [R, B, D] int32 >= 0. Duplicate rows are allowed ONLY if every
+    duplicate carries the run's total (idempotent-to-final writes — use
+    `combine_duplicate_rows`); otherwise the pipeline's stale
+    read-modify-writes can drop updates."""
+    R, T, D = table.shape
+    _, B = rows.shape
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(R,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),  # table (aliased, HBM)
+            pl.BlockSpec((1, B, D), lambda r, idx: (r, 0, 0)),  # updates
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((2, 1, D), jnp.int32),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_scatter_max_dma_kernel, B),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((R, T, D), jnp.int32),
+        input_output_aliases={1: 0},
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        interpret=interpret,
+    )(rows, table, upd)
+
+
+def combine_duplicate_rows(rows, upd, n_rows: int):
+    """Pre-pass for `scatter_max_rows_pallas`: make every write
+    *idempotent-to-final*.
+
+    Per replica, sort updates by row and give **each** entry of a duplicate
+    run the run's total max (forward + backward segmented scans). Then any
+    write order — including stale read-modify-writes from the kernel's
+    software pipeline racing on a revisited row — lands on the correct
+    final value, because max(anything_stale, total) == max(original,
+    total). Padding (negative row) maps to row 0 carrying row 0's own
+    total (or zero if row 0 is untouched), which is likewise idempotent.
+
+    rows [R, B] int32 (negative = padding), upd [R, B, D] int32 >= 0.
+    """
+    R, B = rows.shape
+    valid = rows >= 0
+    key = jnp.where(valid, rows, jnp.int32(n_rows))  # padding sorts last
+    order = jnp.argsort(key, axis=1)
+    key_s = jnp.take_along_axis(key, order, axis=1)
+    upd_s = jnp.take_along_axis(upd, order[..., None], axis=1)
+
+    def seg(a, b):
+        ka, va = a
+        kb, vb = b
+        same = (ka == kb)[..., None]
+        return (kb, jnp.where(same, jnp.maximum(va, vb), vb))
+
+    def seg_scan(keys, vals, reverse):
+        kt = jnp.moveaxis(keys, 1, 0)
+        vt = jnp.moveaxis(vals, 1, 0)
+        if reverse:
+            kt, vt = kt[::-1], vt[::-1]
+        _, out = jax.lax.associative_scan(seg, (kt, vt), axis=0)
+        if reverse:
+            out = out[::-1]
+        return jnp.moveaxis(out, 0, 1)
+
+    fwd = seg_scan(key_s, upd_s, reverse=False)
+    bwd = seg_scan(key_s, upd_s, reverse=True)
+    total = jnp.maximum(fwd, bwd)  # run total at every element
+
+    pad = key_s >= n_rows
+    # Row 0's total (if updated) sits at sorted position 0.
+    row0_total = jnp.where(
+        (key_s[:, :1] == 0)[..., None], total[:, :1, :], 0
+    )
+    rows_out = jnp.where(pad, 0, key_s)
+    upd_out = jnp.where(pad[..., None], row0_total, total)
+    return rows_out, upd_out

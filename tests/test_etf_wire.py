@@ -199,6 +199,19 @@ def test_wire_accepts_beam_style_ids_and_dcids():
     assert wire.state_to_term("topk_rmv", state) == term
 
 
+def test_atom_is_type_strict():
+    assert Atom("x") != "x"
+    assert "x" != Atom("x")
+    assert Atom("x") == Atom("x")
+    assert hash(Atom("x")) != hash("x")
+    # atom x and binary <<"x">> coexist as distinct map keys end to end
+    term = ({Atom("x"): 1, b"x": 2}, 5)
+    state = wire.state_from_term("topk", term)
+    assert len(state.entries) == 2
+    assert wire.state_to_term("topk", state) == term
+    assert etf.decode(etf.encode({Atom("x"): 1, b"x": 2})) == {Atom("x"): 1, b"x": 2}
+
+
 def test_wire_str_ids_roundtrip_identity():
     crdt, state = _run_ops("topk", [("add", ("player", 42))], (5,))
     back = wire.from_reference_binary("topk", wire.to_reference_binary("topk", state))

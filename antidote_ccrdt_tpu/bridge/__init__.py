@@ -1,0 +1,2 @@
+from .client import BridgeClient  # noqa: F401
+from .server import BridgeServer  # noqa: F401

@@ -1,0 +1,105 @@
+"""Blocking bridge client (the shape an erlport/gen_tcp client takes)."""
+
+from __future__ import annotations
+
+import socket
+from typing import Any, List, Optional, Tuple
+
+from ..core.etf import Atom
+from . import protocol as P
+
+
+class BridgeError(RuntimeError):
+    pass
+
+
+class BridgeClient:
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._buf = bytearray()
+        self._req = 0
+
+    def close(self) -> None:
+        self._sock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def call(self, op: Any) -> Any:
+        self._req += 1
+        self._sock.sendall(P.pack_frame(P.call(self._req, op)))
+        while True:
+            for term in P.unpack_frames(self._buf):
+                req_id, ok, payload = P.parse_reply(term)
+                if req_id != self._req:
+                    raise BridgeError(f"reply for {req_id}, expected {self._req}")
+                if not ok:
+                    raise BridgeError(payload.decode("utf-8", "replace"))
+                return payload
+            chunk = self._sock.recv(1 << 16)
+            if not chunk:
+                raise BridgeError("connection closed")
+            self._buf += chunk
+
+    # -- scalar surface ----------------------------------------------------
+
+    def new(self, type_name: str, *args: Any) -> Any:
+        return self.call((Atom("new"), Atom(type_name), list(args)))
+
+    def from_binary(self, type_name: str, blob: bytes) -> Any:
+        return self.call((Atom("from_binary"), Atom(type_name), blob))
+
+    def downstream(self, handle: Any, op: Tuple[str, Any], dc: Any, ts: int) -> Any:
+        return self.call((Atom("downstream"), handle, P.op_to_term(op), dc, ts))
+
+    def update(self, handle: Any, effect_term: Any) -> List[Any]:
+        return self.call((Atom("update"), handle, effect_term))
+
+    def value(self, handle: Any) -> Any:
+        return self.call((Atom("value"), handle))
+
+    def to_binary(self, handle: Any) -> bytes:
+        return self.call((Atom("to_binary"), handle))
+
+    def equal(self, h1: Any, h2: Any) -> bool:
+        return self.call((Atom("equal"), h1, h2))
+
+    def compact(self, handle: Any, effect_terms: List[Any]) -> List[Any]:
+        return self.call((Atom("compact"), handle, effect_terms))
+
+    def free(self, handle: Any) -> None:
+        self.call((Atom("free"), handle))
+
+    # -- dense grid surface ------------------------------------------------
+
+    def grid_new(self, name: str, **params: int) -> None:
+        self.call(
+            (
+                Atom("grid_new"),
+                name.encode(),
+                Atom("topk_rmv"),
+                {Atom(k): v for k, v in params.items()},
+            )
+        )
+
+    def grid_apply(self, name: str, per_replica_ops: List[List[Any]]) -> int:
+        return self.call((Atom("grid_apply"), name.encode(), per_replica_ops))
+
+    def grid_merge_all(self, name: str) -> None:
+        self.call((Atom("grid_merge_all"), name.encode()))
+
+    def grid_observe(self, name: str, replica: int = 0, key: int = 0):
+        return self.call((Atom("grid_observe"), name.encode(), replica, key))
+
+
+def add(key: int, id_: Any, score: int, dc: int, ts: int):
+    """Grid add op term."""
+    return (Atom("add"), key, id_, score, dc, ts)
+
+
+def rmv(key: int, id_: Any, vc: dict):
+    """Grid removal op term; vc maps dc -> ts."""
+    return (Atom("rmv"), key, id_, [(d, t) for d, t in sorted(vc.items())])

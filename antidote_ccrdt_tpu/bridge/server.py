@@ -1,0 +1,313 @@
+"""Bridge server: a persistent CCRDT worker a BEAM-shaped host can drive.
+
+Stands in for the reference's host integration surface (the Antidote side
+of the behaviour contract, SURVEY.md §1): a threaded TCP server speaking
+`{packet, 4}` + ETF (see `protocol`), holding
+
+* **scalar instances** — handle -> (type, state); the full callback
+  surface (downstream/update/value/compact/to_binary/...) over the wire,
+  states interchangeable with reference `term_to_binary` snapshots; and
+* **dense grids** — named [n_replicas, n_keys] dense states on the JAX
+  backend (TPU when available); op batches are packed to the dense op
+  structs, applied in one dispatch, and replicas fold with the lattice
+  merge — the north-star `batch_merge` exposed to a host.
+
+Concurrency: one OS thread per connection; a global lock serializes state
+mutation (the JAX dispatch itself releases the GIL; the lock keeps
+handle/grid maps consistent).
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..core import wire
+from ..core.behaviour import registry
+from ..core.etf import Atom
+from . import protocol as P
+
+
+# Term <-> op conversion lives in protocol.py (shared with the client).
+from .protocol import op_from_term, op_to_term, py_to_term, term_to_py
+
+_from_term = term_to_py
+_to_term = py_to_term
+
+
+# --- dense grids ----------------------------------------------------------
+
+
+class _Grid:
+    """A named dense topk_rmv grid on the JAX backend."""
+
+    def __init__(self, params: Dict[Any, Any]):
+        from ..models.topk_rmv_dense import make_dense
+
+        def geti(key, default):
+            return int(params.get(Atom(key), default))
+
+        self.R = geti("n_replicas", 2)
+        self.NK = geti("n_keys", 1)
+        self.dense = make_dense(
+            n_ids=geti("n_ids", 1024),
+            n_dcs=geti("n_dcs", self.R),
+            size=geti("size", 100),
+            slots_per_id=geti("slots_per_id", 4),
+        )
+        self.state = self.dense.init(n_replicas=self.R, n_keys=self.NK)
+
+    def apply(self, per_replica_ops) -> int:
+        import jax.numpy as jnp
+
+        from ..models.topk_rmv_dense import TopkRmvOps
+
+        if len(per_replica_ops) != self.R:
+            raise ValueError(f"expected {self.R} replica op lists")
+        D = self.dense.D
+        for ops in per_replica_ops:
+            for op in ops:
+                if op[0] not in (Atom("add"), Atom("rmv")):
+                    raise ValueError(f"unknown grid op tag: {op[0]!r}")
+        adds = [[op for op in ops if op[0] == Atom("add")] for ops in per_replica_ops]
+        rmvs = [[op for op in ops if op[0] == Atom("rmv")] for ops in per_replica_ops]
+        B = max(1, max(len(a) for a in adds))
+        Br = max(1, max(len(r) for r in rmvs))
+        a = np.zeros((self.R, B, 5), np.int32)  # key,id,score,dc,ts (ts=0 pad)
+        r_key = np.zeros((self.R, Br), np.int32)
+        r_id = np.full((self.R, Br), -1, np.int32)
+        r_vc = np.zeros((self.R, Br, D), np.int32)
+        for ri, ops in enumerate(adds):
+            for j, (_, key, id_, score, dc, ts) in enumerate(ops):
+                if not 0 <= dc < D:
+                    # An out-of-range add dc would create an element no
+                    # tombstone can ever dominate (the filter's select-scan
+                    # never matches it) — reject rather than immortalize.
+                    raise ValueError(f"dc {dc} out of range")
+                a[ri, j] = (key, id_, score, dc, ts)
+        for ri, ops in enumerate(rmvs):
+            for j, (_, key, id_, vc_list) in enumerate(ops):
+                r_key[ri, j] = key
+                r_id[ri, j] = id_
+                for dc, ts in vc_list:
+                    if not 0 <= dc < D:
+                        raise ValueError(f"dc {dc} out of range")
+                    r_vc[ri, j, dc] = ts
+        ops_batch = TopkRmvOps(
+            add_key=jnp.asarray(a[:, :, 0]),
+            add_id=jnp.asarray(a[:, :, 1]),
+            add_score=jnp.asarray(a[:, :, 2]),
+            add_dc=jnp.asarray(a[:, :, 3]),
+            add_ts=jnp.asarray(a[:, :, 4]),
+            rmv_key=jnp.asarray(r_key),
+            rmv_id=jnp.asarray(r_id),
+            rmv_vc=jnp.asarray(r_vc),
+        )
+        self.state, extras = self.dense.apply_ops(self.state, ops_batch)
+        return int(np.asarray(extras.dominated).sum())
+
+    def merge_all(self) -> None:
+        """Fold all replica rows with the lattice join and broadcast the
+        result back — the one-dispatch inter-DC reconciliation."""
+        import jax
+        import jax.numpy as jnp
+
+        state = self.state
+        r = self.R
+        while r > 1:
+            half = r // 2
+            top = jax.tree.map(lambda x: x[:half], state)
+            bot = jax.tree.map(lambda x: x[half : 2 * half], state)
+            merged = self.dense.merge(top, bot)
+            if r % 2:
+                odd = jax.tree.map(lambda x: x[2 * half : r], state)
+                merged = jax.tree.map(
+                    lambda m, o: jnp.concatenate([m, o], axis=0), merged, odd
+                )
+            state = merged
+            r = half + (r % 2)
+        self.state = jax.tree.map(
+            lambda x: jnp.broadcast_to(x[:1], (self.R,) + x.shape[1:]), state
+        )
+
+    def observe(self, replica: int, key: int):
+        import jax
+
+        if not (0 <= replica < self.R and 0 <= key < self.NK):
+            raise ValueError(f"observe ({replica}, {key}) out of range")
+        # Slice to the one requested cell before the observe sort — a full
+        # dense.value() would sort and host-transfer the whole [R, NK] grid
+        # (and hold the server lock while doing it).
+        cell = jax.tree.map(lambda x: x[replica : replica + 1, key : key + 1], self.state)
+        return [(_to_term(i), s) for (i, s) in self.dense.value(cell)[0][0]]
+
+
+# --- server ---------------------------------------------------------------
+
+
+class BridgeServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._handles: Dict[Any, Tuple[str, Any]] = {}
+        self._grids: Dict[Any, _Grid] = {}
+        self._next = 0
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                buf = bytearray()
+                while True:
+                    try:
+                        chunk = self.request.recv(1 << 16)
+                    except OSError:
+                        return
+                    if not chunk:
+                        return
+                    buf += chunk
+                    for term in P.unpack_frames(buf):
+                        self.request.sendall(P.pack_frame(outer._dispatch(term)))
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = Server((host, port), Handler)
+        self.address = self._srv.server_address
+        self._thread = threading.Thread(target=self._srv.serve_forever, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _dispatch(self, term: Any) -> Any:
+        if not (isinstance(term, tuple) and len(term) == 3 and term[0] == P.A_CALL):
+            return P.reply_error(-1, f"bad request: {term!r}")
+        _, req_id, op = term
+        try:
+            with self._lock:
+                return P.reply_ok(req_id, self._exec(op))
+        except Exception as e:  # noqa: BLE001 - all errors go to the client
+            return P.reply_error(req_id, f"{type(e).__name__}: {e}")
+
+    def _new_handle(self) -> int:
+        self._next += 1
+        return self._next
+
+    def _state(self, handle: Any) -> Tuple[str, Any]:
+        if handle not in self._handles:
+            raise KeyError(f"no such handle: {handle!r}")
+        return self._handles[handle]
+
+    def _exec(self, op: Any) -> Any:
+        tag = str(op[0])
+        if tag == "new":
+            _, type_atom, args = op
+            name = str(type_atom)
+            crdt = registry.scalar(name)
+            h = self._new_handle()
+            self._handles[h] = (name, crdt.new(*_from_term(args)))
+            return h
+        if tag == "from_binary":
+            _, type_atom, blob = op
+            name = str(type_atom)
+            h = self._new_handle()
+            self._handles[h] = (name, wire.from_reference_binary(name, blob))
+            return h
+        if tag == "downstream":
+            _, h, op_term, dc, ts = op
+            name, state = self._state(h)
+            crdt = registry.scalar(name)
+            ctx = _FixedCtx(dc_id=_from_term(dc), ts=int(ts))
+            eff = crdt.downstream(op_from_term(op_term), state, ctx)
+            return op_to_term(eff)
+        if tag == "update":
+            _, h, eff_term = op
+            name, state = self._state(h)
+            crdt = registry.scalar(name)
+            state, extras = crdt.update(op_from_term(eff_term), state)
+            self._handles[h] = (name, state)
+            return [op_to_term(e) for e in extras]
+        if tag == "value":
+            _, h = op
+            name, state = self._state(h)
+            return _to_term(registry.scalar(name).value(state))
+        if tag == "to_binary":
+            _, h = op
+            name, state = self._state(h)
+            return wire.to_reference_binary(name, state)
+        if tag == "equal":
+            _, h1, h2 = op
+            n1, s1 = self._state(h1)
+            n2, s2 = self._state(h2)
+            return n1 == n2 and registry.scalar(n1).equal(s1, s2)
+        if tag == "compact":
+            _, h, effects = op
+            name, _ = self._state(h)
+            crdt = registry.scalar(name)
+            log = [op_from_term(e) for e in effects]
+            changed = True
+            while changed:
+                changed = False
+                for i in range(len(log)):
+                    if log[i] is None:
+                        continue
+                    for j in range(i + 1, len(log)):
+                        if log[j] is None:
+                            continue
+                        if crdt.can_compact(log[i], log[j]):
+                            log[i], log[j] = crdt.compact_ops(log[i], log[j])
+                            changed = True
+                            break
+                    if changed:
+                        break
+            return [op_to_term(e) for e in log if e is not None]
+        if tag == "free":
+            _, h = op
+            self._handles.pop(h, None)
+            return True
+        if tag == "grid_new":
+            _, gname, type_atom, params = op
+            if str(type_atom) != "topk_rmv":
+                raise ValueError("dense grids support topk_rmv")
+            self._grids[gname] = _Grid(params)
+            return True
+        if tag == "grid_apply":
+            _, gname, per_replica = op
+            return self._grids[gname].apply(per_replica)
+        if tag == "grid_merge_all":
+            _, gname = op
+            self._grids[gname].merge_all()
+            return True
+        if tag == "grid_observe":
+            _, gname, replica, key = op
+            return self._grids[gname].observe(int(replica), int(key))
+        raise ValueError(f"unknown op: {tag}")
+
+
+class _FixedCtx:
+    """ReplicaContext stand-in with caller-provided (dc, ts) — over the
+    bridge the host supplies both, mirroring how Antidote owns the clock
+    (topk_rmv.erl:104-105)."""
+
+    def __init__(self, dc_id, ts: int):
+        self.dc_id = dc_id
+        self._ts = ts
+
+    def stamp(self):
+        return (self.dc_id, self._ts)

@@ -139,7 +139,7 @@ def _leaderboard_from_term(term: Any) -> Any:
 
 
 def _wordcount_to_term(state: Dict[str, int]) -> Any:
-    return {k.encode("utf-8") if isinstance(k, str) else k: v for k, v in state.items()}
+    return {_id_to_term(k): v for k, v in state.items()}
 
 
 def _wordcount_from_term(term: Any) -> Any:

@@ -41,7 +41,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-NEG_INF = jnp.iinfo(jnp.int32).min + 1
+from . import dense_table
+
+# Python int (not a jnp scalar): pallas kernels may not capture traced
+# constants, and pad values must be static anyway. int() keeps the value
+# coupled to the XLA reference path's sentinel.
+NEG_INF = int(dense_table.NEG_INF)
 
 
 # --- comparator network ---------------------------------------------------
@@ -205,18 +210,23 @@ def _scatter_max_dma_kernel(B: int, idx_ref, tab_ref, upd_ref, out_ref, scratch,
     def body(j, carry):
         slot = jax.lax.rem(j, 2)
 
+        # The write that last used slot 1-slot (iteration j-1) must land
+        # before the next read overwrites that scratch buffer — otherwise
+        # row idx[j-1] could be clobbered with row idx[j+1]'s raw contents
+        # (cross-row corruption the idempotence argument does not cover).
+        @pl.when((j + 1 < B) & (j >= 1))
+        def _():
+            wr(j - 1, 1 - slot).wait()
+
         @pl.when(j + 1 < B)
         def _():
             rd(j + 1, 1 - slot).start()
 
         rd(j, slot).wait()
 
-        # The write that last used this slot (iteration j-2) must be done
-        # before we overwrite the scratch.
-        @pl.when(j >= 2)
-        def _():
-            wr(j - 2, slot).wait()
-
+        # scratch[slot]'s previous write (iteration j-2) needs no wait here:
+        # it was already waited at iteration j-1's top (same slot algebra),
+        # and waiting the same DMA semaphore twice would hang.
         scratch[slot] = jnp.maximum(scratch[slot], upd_ref[0, j][None, :])
         wr(j, slot).start()
         return carry

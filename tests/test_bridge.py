@@ -1,0 +1,169 @@
+"""Bridge server/client: the BEAM-shaped host integration surface.
+
+Runs a real TCP server in-process and drives it through the client — and
+once through raw `{packet, 4}` + ETF bytes, proving an Erlang gen_tcp
+client needs nothing Python-specific."""
+
+import socket
+import struct
+
+import pytest
+
+from antidote_ccrdt_tpu.bridge import BridgeClient, BridgeServer
+from antidote_ccrdt_tpu.bridge.client import add, rmv
+from antidote_ccrdt_tpu.bridge import protocol as P
+from antidote_ccrdt_tpu.core import etf, wire
+from antidote_ccrdt_tpu.core.etf import Atom
+from antidote_ccrdt_tpu.core.behaviour import registry
+from antidote_ccrdt_tpu.core.clock import make_contexts
+
+
+@pytest.fixture(scope="module")
+def server():
+    with BridgeServer() as srv:
+        yield srv
+
+
+@pytest.fixture()
+def client(server):
+    with BridgeClient(*server.address) as c:
+        yield c
+
+
+def test_scalar_topk_rmv_over_bridge(client):
+    h = client.new("topk_rmv", 2)
+    eff = client.downstream(h, ("add", (1, 50)), dc=0, ts=1)
+    assert eff[0] == Atom("add")
+    extras = client.update(h, eff)
+    assert extras == []
+    eff2 = client.downstream(h, ("add", (2, 40)), dc=0, ts=2)
+    client.update(h, eff2)
+    assert sorted(client.value(h)) == [(1, 50), (2, 40)]
+    # removal generates no promotion here; re-add of removed id bounces rmv
+    effr = client.downstream(h, ("rmv", 1), dc=0, ts=3)
+    client.update(h, effr)
+    assert client.value(h) == [(2, 40)]
+    eff3 = client.downstream(h, ("add", (1, 45)), dc=0, ts=1)  # stale ts
+    # dominated add: server returns the re-broadcast rmv as an extra
+    if eff3 != Atom("nil"):
+        extras = client.update(h, eff3)
+        assert any(x[0] == Atom("rmv") for x in extras)
+
+
+def test_snapshot_interop_with_local_state(client):
+    # Build state locally, ship via reference binary, continue remotely.
+    crdt = registry.scalar("leaderboard")
+    (ctx,) = make_contexts(1)
+    s = crdt.new(3)
+    for op in [("add", (1, 10)), ("add", (2, 20)), ("ban", 1)]:
+        e = crdt.downstream(op, s, ctx)
+        if e:
+            s, ex = crdt.update(e, s)
+            for x in ex:
+                s, _ = crdt.update(x, s)
+    h = client.from_binary("leaderboard", wire.to_reference_binary("leaderboard", s))
+    assert dict(client.value(h)) == {2: 20}
+    blob = client.to_binary(h)
+    assert crdt.equal(wire.from_reference_binary("leaderboard", blob), s)
+
+
+def test_equal_and_free(client):
+    h1 = client.new("average")
+    h2 = client.new("average")
+    assert client.equal(h1, h2)
+    client.update(h1, (Atom("add"), (10, 1)))
+    assert not client.equal(h1, h2)
+    client.free(h1)
+    with pytest.raises(Exception, match="no such handle"):
+        client.value(h1)
+
+
+def test_compact_over_bridge(client):
+    h = client.new("average")
+    effs = [(Atom("add"), (3, 1)), (Atom("add"), (5, 2)), (Atom("add"), (2, 1))]
+    out = client.compact(h, effs)
+    assert out == [(Atom("add"), (10, 4))]
+
+
+def test_error_reply(client):
+    with pytest.raises(Exception, match="unknown op"):
+        client.call((Atom("bogus"), 1))
+    with pytest.raises(Exception, match="KeyError"):
+        client.call((Atom("value"), 99999))
+
+
+def test_dense_grid_over_bridge(client):
+    client.grid_new("g1", n_replicas=2, n_keys=1, n_ids=64, n_dcs=2, size=4)
+    dominated = client.grid_apply(
+        "g1",
+        [
+            [add(0, 1, 50, 0, 1), add(0, 2, 40, 0, 2)],
+            [add(0, 3, 30, 1, 1), rmv(0, 2, {0: 9})],
+        ],
+    )
+    assert dominated == 0
+    # pre-merge: replica 0 doesn't know id 3 or the removal
+    assert dict(client.grid_observe("g1", 0)) == {1: 50, 2: 40}
+    client.grid_merge_all("g1")
+    merged0 = dict(client.grid_observe("g1", 0))
+    merged1 = dict(client.grid_observe("g1", 1))
+    assert merged0 == merged1 == {1: 50, 3: 30}  # id 2 removed by tombstone
+
+
+def test_grid_rejects_bad_ops(client):
+    client.grid_new("gv", n_replicas=1, n_keys=1, n_ids=8, n_dcs=2, size=2)
+    with pytest.raises(Exception, match="unknown grid op tag"):
+        client.grid_apply("gv", [[(Atom("remove"), 0, 1, [])]])
+    with pytest.raises(Exception, match="dc 5 out of range"):
+        client.grid_apply("gv", [[add(0, 1, 10, 5, 1)]])
+    with pytest.raises(Exception, match="out of range"):
+        client.grid_observe("gv", 3, 0)
+
+
+def test_wordcount_atom_key_roundtrip():
+    # the to-side must keep Atom keys distinct from equal-text binaries
+    term = {Atom("x"): 1, b"x": 2}
+    state = wire.state_from_term("wordcount", term)
+    assert len(state) == 2
+    assert wire.state_to_term("wordcount", state) == term
+
+
+def test_raw_packet4_etf_client(server):
+    """Drive the server with hand-built frames: what gen_tcp sends."""
+    with socket.create_connection(server.address, timeout=10) as sk:
+        def rpc(req_id, op):
+            payload = etf.encode((Atom("call"), req_id, op))
+            sk.sendall(struct.pack(">I", len(payload)) + payload)
+            hdr = sk.recv(4, socket.MSG_WAITALL)
+            (n,) = struct.unpack(">I", hdr)
+            data = b""
+            while len(data) < n:
+                data += sk.recv(n - len(data))
+            return etf.decode(data)
+
+        r = rpc(1, (Atom("new"), Atom("wordcount"), []))
+        assert r[0] == Atom("reply") and r[1] == 1 and r[2][0] == Atom("ok")
+        h = r[2][1]
+        r = rpc(2, (Atom("update"), h, (Atom("add"), b"hello hello world")))
+        assert r[2][0] == Atom("ok")
+        r = rpc(3, (Atom("value"), h))
+        assert r[2] == (Atom("ok"), {b"hello": 2, b"world": 1})
+
+
+def test_pipelined_requests(server):
+    """Multiple in-flight requests on one connection resolve by req id."""
+    with socket.create_connection(server.address, timeout=10) as sk:
+        frames = b""
+        for i, op in [(7, (Atom("new"), Atom("average"), [])), (8, (Atom("new"), Atom("average"), []))]:
+            payload = etf.encode((Atom("call"), i, op))
+            frames += struct.pack(">I", len(payload)) + payload
+        sk.sendall(frames)
+        buf = bytearray()
+        got = {}
+        while len(got) < 2:
+            buf += sk.recv(1 << 16)
+            for term in P.unpack_frames(buf):
+                rid, ok, res = P.parse_reply(term)
+                got[rid] = (ok, res)
+        assert set(got) == {7, 8}
+        assert all(ok for ok, _ in got.values())

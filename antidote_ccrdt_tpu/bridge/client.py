@@ -18,8 +18,10 @@ class BridgeClient:
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._buf = bytearray()
         self._req = 0
+        self._closed = False
 
     def close(self) -> None:
+        self._closed = True
         self._sock.close()
 
     def __enter__(self):
@@ -29,20 +31,39 @@ class BridgeClient:
         self.close()
 
     def call(self, op: Any) -> Any:
+        if self._closed:
+            raise BridgeError("client is closed")
         self._req += 1
-        self._sock.sendall(P.pack_frame(P.call(self._req, op)))
-        while True:
-            for term in P.unpack_frames(self._buf):
-                req_id, ok, payload = P.parse_reply(term)
-                if req_id != self._req:
-                    raise BridgeError(f"reply for {req_id}, expected {self._req}")
-                if not ok:
-                    raise BridgeError(payload.decode("utf-8", "replace"))
-                return payload
-            chunk = self._sock.recv(1 << 16)
-            if not chunk:
-                raise BridgeError("connection closed")
-            self._buf += chunk
+        try:
+            self._sock.sendall(P.pack_frame(P.call(self._req, op)))
+            while True:
+                for term in P.unpack_frames(self._buf):
+                    req_id, ok, payload = P.parse_reply(term)
+                    if req_id < self._req:
+                        # Late reply to an earlier (timed-out) request;
+                        # discard and keep waiting for ours.
+                        continue
+                    if req_id > self._req:
+                        self.close()
+                        raise BridgeError(
+                            f"reply for {req_id}, expected {self._req}"
+                        )
+                    if not ok:
+                        # Server-reported error: the stream is still in
+                        # sync, the client stays usable.
+                        raise BridgeError(payload.decode("utf-8", "replace"))
+                    return payload
+                chunk = self._sock.recv(1 << 16)
+                if not chunk:
+                    self.close()
+                    raise BridgeError("connection closed")
+                self._buf += chunk
+        except OSError:
+            # A timeout (or any transport failure) leaves the reply stream
+            # unsynchronized with request ids — poison the client so the
+            # caller reconnects instead of reading a stale reply.
+            self.close()
+            raise
 
     # -- scalar surface ----------------------------------------------------
 

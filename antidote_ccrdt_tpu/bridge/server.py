@@ -45,15 +45,17 @@ _to_term = py_to_term
 class _Grid:
     """A named dense topk_rmv grid on the JAX backend."""
 
-    def __init__(self, params: Dict[Any, Any]):
-        from ..models.topk_rmv_dense import make_dense
-
+    def __init__(self, type_name: str, params: Dict[Any, Any]):
         def geti(key, default):
             return int(params.get(Atom(key), default))
 
         self.R = geti("n_replicas", 2)
         self.NK = geti("n_keys", 1)
-        self.dense = make_dense(
+        # Constructed through the registry's dense-factory surface — the
+        # same path any embedder uses; only the op packing below is
+        # topk_rmv-specific.
+        self.dense = registry.make_dense(
+            type_name,
             n_ids=geti("n_ids", 1024),
             n_dcs=geti("n_dcs", self.R),
             size=geti("size", 100),
@@ -81,6 +83,7 @@ class _Grid:
         r_key = np.zeros((self.R, Br), np.int32)
         r_id = np.full((self.R, Br), -1, np.int32)
         r_vc = np.zeros((self.R, Br, D), np.int32)
+        I, NK = self.dense.I, self.NK
         for ri, ops in enumerate(adds):
             for j, (_, key, id_, score, dc, ts) in enumerate(ops):
                 if not 0 <= dc < D:
@@ -88,9 +91,17 @@ class _Grid:
                     # tombstone can ever dominate (the filter's select-scan
                     # never matches it) — reject rather than immortalize.
                     raise ValueError(f"dc {dc} out of range")
+                if not (0 <= key < NK and 0 <= id_ < I):
+                    # The dense kernels index with clamping gathers /
+                    # mode='drop' scatters: an out-of-range id would read the
+                    # wrong element's tombstones and then be silently
+                    # discarded — reject at the boundary instead.
+                    raise ValueError(f"add (key={key}, id={id_}) out of range")
                 a[ri, j] = (key, id_, score, dc, ts)
         for ri, ops in enumerate(rmvs):
             for j, (_, key, id_, vc_list) in enumerate(ops):
+                if not (0 <= key < NK and 0 <= id_ < I):
+                    raise ValueError(f"rmv (key={key}, id={id_}) out of range")
                 r_key[ri, j] = key
                 r_id[ri, j] = id_
                 for dc, ts in vc_list:
@@ -285,7 +296,7 @@ class BridgeServer:
             _, gname, type_atom, params = op
             if str(type_atom) != "topk_rmv":
                 raise ValueError("dense grids support topk_rmv")
-            self._grids[gname] = _Grid(params)
+            self._grids[gname] = _Grid(str(type_atom), params)
             return True
         if tag == "grid_apply":
             _, gname, per_replica = op

@@ -182,6 +182,7 @@ class Registry:
     def __init__(self) -> None:
         self._scalar: dict[str, ScalarCCRDT] = {}
         self._dense: dict[str, DenseCCRDT] = {}
+        self._dense_factory: dict[str, Any] = {}
         self._extra_ops: set[str] = set()
 
     def register(
@@ -189,12 +190,15 @@ class Registry:
         name: str,
         scalar: Optional[ScalarCCRDT] = None,
         dense: Optional[DenseCCRDT] = None,
+        dense_factory: Optional[Any] = None,
         generates_extra_operations: bool = False,
     ) -> None:
         if scalar is not None:
             self._scalar[name] = scalar
         if dense is not None:
             self._dense[name] = dense
+        if dense_factory is not None:
+            self._dense_factory[name] = dense_factory
         if generates_extra_operations:
             self._extra_ops.add(name)
 
@@ -210,11 +214,16 @@ class Registry:
     def dense(self, name: str) -> DenseCCRDT:
         return self._dense[name]
 
+    def make_dense(self, name: str, **params: Any) -> DenseCCRDT:
+        """Construct a dense engine with explicit capacities (the rebuild of
+        ``new/1,2`` per-instance parameters, SURVEY.md §5 config row)."""
+        return self._dense_factory[name](**params)
+
     def scalar_types(self) -> Iterable[str]:
         return self._scalar.keys()
 
     def dense_types(self) -> Iterable[str]:
-        return self._dense.keys()
+        return set(self._dense) | set(self._dense_factory)
 
 
 registry = Registry()

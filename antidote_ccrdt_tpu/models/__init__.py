@@ -1,1 +1,1 @@
-from . import average, topk, topk_rmv, leaderboard, wordcount  # noqa: F401
+from . import average, topk, topk_rmv, topk_rmv_dense, leaderboard, wordcount  # noqa: F401

@@ -175,4 +175,9 @@ class AverageDense:
         return jnp.where(state.num == 0, 0.0, state.sum / jnp.maximum(state.num, 1))
 
 
-registry.register("average", scalar=AverageScalar(), dense=AverageDense())
+registry.register(
+    "average",
+    scalar=AverageScalar(),
+    dense=AverageDense(),
+    dense_factory=AverageDense,
+)

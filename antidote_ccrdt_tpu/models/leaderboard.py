@@ -367,3 +367,6 @@ class LeaderboardDense:
 
 def make_dense(n_players: int, size: int = 100) -> LeaderboardDense:
     return LeaderboardDense(n_players=n_players, size=size)
+
+
+registry.register("leaderboard", dense_factory=make_dense)

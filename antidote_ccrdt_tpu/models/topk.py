@@ -227,3 +227,6 @@ class TopkDense:
 
 def make_dense(n_ids: int, size: int = 100) -> TopkDense:
     return TopkDense(n_ids=n_ids, size=size)
+
+
+registry.register("topk", dense_factory=make_dense)

@@ -239,3 +239,9 @@ class WordcountDense:
 
 def make_dense(n_buckets: int) -> WordcountDense:
     return WordcountDense(n_buckets=n_buckets)
+
+
+# Both wordcount variants share the dense kernel; the per-document dedup of
+# worddocumentcount happens at encode time (VocabEncoder per_document).
+registry.register("wordcount", dense_factory=make_dense)
+registry.register("worddocumentcount", dense_factory=make_dense)

@@ -116,8 +116,19 @@ def test_grid_rejects_bad_ops(client):
         client.grid_apply("gv", [[(Atom("remove"), 0, 1, [])]])
     with pytest.raises(Exception, match="dc 5 out of range"):
         client.grid_apply("gv", [[add(0, 1, 10, 5, 1)]])
+    # id/key beyond the dense capacities would alias into clamped gathers /
+    # silently-dropped scatters — must be rejected at the boundary.
+    with pytest.raises(Exception, match="out of range"):
+        client.grid_apply("gv", [[add(0, 999, 10, 0, 1)]])
+    with pytest.raises(Exception, match="out of range"):
+        client.grid_apply("gv", [[add(7, 1, 10, 0, 1)]])
+    with pytest.raises(Exception, match="out of range"):
+        client.grid_apply("gv", [[rmv(0, 999, {0: 1})]])
     with pytest.raises(Exception, match="out of range"):
         client.grid_observe("gv", 3, 0)
+    # Server-reported errors keep the stream in sync: client stays usable.
+    assert client.grid_apply("gv", [[add(0, 1, 10, 0, 1)]]) == 0
+    assert dict(client.grid_observe("gv", 0)) == {1: 10}
 
 
 def test_wordcount_atom_key_roundtrip():

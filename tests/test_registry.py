@@ -1,0 +1,66 @@
+"""Registry parity: is_type / generates_extra_operations / dense factories.
+
+Mirrors ``antidote_ccrdt.erl``: the type whitelist (:28-35), ``is_type/1``
+(:61-62) and ``generates_extra_operations/1`` (:37-40, :64-65) — extended
+with the dense (TPU) level, which every type must also expose.
+"""
+
+import jax.numpy as jnp
+import pytest
+
+import antidote_ccrdt_tpu as ccrdt
+from antidote_ccrdt_tpu.core.behaviour import registry
+
+ALL_TYPES = [
+    "average",
+    "topk",
+    "topk_rmv",
+    "leaderboard",
+    "wordcount",
+    "worddocumentcount",
+]
+
+DENSE_PARAMS = {
+    "average": {},
+    "topk": {"n_ids": 64, "size": 8},
+    "topk_rmv": {"n_ids": 64, "n_dcs": 4, "size": 8, "slots_per_id": 2},
+    "leaderboard": {"n_players": 64, "size": 8},
+    "wordcount": {"n_buckets": 128},
+    "worddocumentcount": {"n_buckets": 128},
+}
+
+
+def test_is_type_whitelist():
+    for name in ALL_TYPES:
+        assert ccrdt.is_type(name)
+    assert not ccrdt.is_type("riak_dt_gcounter")
+    assert not ccrdt.is_type(None)
+    assert not ccrdt.is_type(("topk",))
+
+
+def test_generates_extra_operations():
+    # antidote_ccrdt.erl:37-40: exactly topk_rmv and leaderboard.
+    assert ccrdt.generates_extra_operations("topk_rmv")
+    assert ccrdt.generates_extra_operations("leaderboard")
+    for name in ("average", "topk", "wordcount", "worddocumentcount"):
+        assert not ccrdt.generates_extra_operations(name)
+    assert not ccrdt.generates_extra_operations("nope")
+
+
+@pytest.mark.parametrize("name", ALL_TYPES)
+def test_every_type_has_scalar_and_dense(name):
+    scalar = registry.scalar(name)
+    assert scalar.type_name == name
+    dense = registry.make_dense(name, **DENSE_PARAMS[name])
+    assert hasattr(dense, "merge_kind")
+    state = dense.init(n_replicas=2, n_keys=1)
+    # Fresh states must merge to a fresh state under the declared algebra.
+    merged = dense.merge(state, state)
+    for leaf_a, leaf_b in zip(
+        __import__("jax").tree.leaves(state), __import__("jax").tree.leaves(merged)
+    ):
+        assert leaf_a.shape == leaf_b.shape
+
+
+def test_dense_types_lists_all():
+    assert set(ALL_TYPES) <= set(registry.dense_types())

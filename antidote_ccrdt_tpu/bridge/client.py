@@ -13,6 +13,11 @@ class BridgeError(RuntimeError):
     pass
 
 
+class _ServerError(Exception):
+    """Internal: an error the *server* reported over an in-sync stream —
+    re-raised as BridgeError without poisoning the connection."""
+
+
 class BridgeClient:
     def __init__(self, host: str, port: int, timeout: float = 30.0):
         self._sock = socket.create_connection((host, port), timeout=timeout)
@@ -39,29 +44,26 @@ class BridgeClient:
             while True:
                 for term in P.unpack_frames(self._buf):
                     req_id, ok, payload = P.parse_reply(term)
-                    if req_id < self._req:
-                        # Late reply to an earlier (timed-out) request;
-                        # discard and keep waiting for ours.
-                        continue
-                    if req_id > self._req:
-                        self.close()
+                    if req_id != self._req:
                         raise BridgeError(
                             f"reply for {req_id}, expected {self._req}"
                         )
                     if not ok:
                         # Server-reported error: the stream is still in
                         # sync, the client stays usable.
-                        raise BridgeError(payload.decode("utf-8", "replace"))
+                        raise _ServerError(payload.decode("utf-8", "replace"))
                     return payload
                 chunk = self._sock.recv(1 << 16)
                 if not chunk:
-                    self.close()
                     raise BridgeError("connection closed")
                 self._buf += chunk
-        except OSError:
-            # A timeout (or any transport failure) leaves the reply stream
-            # unsynchronized with request ids — poison the client so the
-            # caller reconnects instead of reading a stale reply.
+        except _ServerError as e:
+            raise BridgeError(str(e)) from None
+        except Exception:
+            # Anything else — timeout, transport failure, corrupt or
+            # oversized frame, desynced request id — leaves the reply
+            # stream unusable: poison the client so the caller reconnects
+            # instead of parsing leftover bytes as the next reply.
             self.close()
             raise
 

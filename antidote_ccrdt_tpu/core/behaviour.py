@@ -203,7 +203,11 @@ class Registry:
             self._extra_ops.add(name)
 
     def is_type(self, name: Any) -> bool:
-        return isinstance(name, str) and (name in self._scalar or name in self._dense)
+        return isinstance(name, str) and (
+            name in self._scalar
+            or name in self._dense
+            or name in self._dense_factory
+        )
 
     def generates_extra_operations(self, name: Any) -> bool:
         return self.is_type(name) and name in self._extra_ops

@@ -1,0 +1,163 @@
+"""Batched multi-DC replay over the dense (TPU) engines.
+
+`ScalarReplay` (replay.py) ships individual effect ops between replicas —
+the faithful rebuild of the reference's op-based pipeline, which *requires*
+the host's causal exactly-once delivery (SURVEY.md §1). `DenseReplay` is
+the TPU-native counterpart at batch granularity: every replica (simulated
+DC) applies its own op batch in one vectorized dispatch across all
+replicas, and reconciliation is a *state-level* exchange whose protocol
+depends on the type's declared merge algebra (`MergeKind`):
+
+* **JOIN** (topk, topk_rmv, leaderboard): replica rows are full states in a
+  join-semilattice; `sync` folds all rows with the CRDT join and broadcasts
+  the result back. Because the join is idempotent, the exchange tolerates
+  duplicated and reordered contributions by construction — the property the
+  op-based pipeline must *assume* from its host, demonstrated here as a
+  fault-model test surface (`sync(contributors=...)`).
+
+* **MONOID** (average, wordcount, worddocumentcount): replica rows are
+  *deltas* accumulated since the last sync (the reference relies on the
+  host applying each op exactly once, SURVEY.md §1; summing full states
+  would double-count). `sync` all-reduces the deltas onto a shared
+  converged base and resets them — exactly-once by construction, and a
+  duplicated contribution measurably corrupts the result (the dual test
+  surface).
+
+On hardware the fold in `sync` is the intra-chip stand-in for the mesh
+collective: `parallel.dist.lattice_all_reduce` runs the same combiner over
+the 'dc' mesh axis (see __graft_entry__.dryrun_multichip).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.behaviour import DenseCCRDT, MergeKind
+from ..utils.metrics import Metrics
+
+
+def _rows(state: Any, idx) -> Any:
+    return jax.tree.map(lambda x: x[idx], state)
+
+
+def _fold_rows(dense: DenseCCRDT, state: Any, contributors: Sequence[int]) -> Any:
+    """Fold the given replica rows (with repetition allowed) with the CRDT
+    merge. `merge` is batched over the leading replica axis, so the tree
+    reduction halves the whole stack at once: log2(n) dispatches total."""
+    idx = np.asarray(list(contributors), dtype=np.int32)
+    acc = _rows(state, idx)  # [C, ...]
+    n = len(idx)
+    while n > 1:
+        half = n // 2
+        merged = dense.merge(_rows(acc, slice(0, half)), _rows(acc, slice(half, 2 * half)))
+        if n % 2:
+            merged = jax.tree.map(
+                lambda m, t: jnp.concatenate([m, t], axis=0),
+                merged,
+                _rows(acc, slice(2 * half, n)),
+            )
+        acc = merged
+        n = half + n % 2
+    return acc
+
+
+def _broadcast_rows(folded: Any, n: int) -> Any:
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(x[:1], (n,) + x.shape[1:]), folded
+    )
+
+
+class DenseReplay:
+    """Round-based multi-DC pipeline over a dense engine.
+
+    state layout: [n_replicas, n_keys, ...] — replica r's row is DC r.
+    """
+
+    def __init__(
+        self,
+        dense: DenseCCRDT,
+        n_replicas: int,
+        n_keys: int = 1,
+        metrics: Optional[Metrics] = None,
+    ):
+        self.dense = dense
+        self.n = n_replicas
+        self.nk = n_keys
+        self.metrics = metrics if metrics is not None else Metrics()
+        if dense.merge_kind == MergeKind.MONOID:
+            # base: the converged state as of the last sync (one row,
+            # broadcast on read); rows of `state` are per-replica deltas.
+            self.base = _rows(dense.init(n_replicas=1, n_keys=n_keys), slice(0, 1))
+        else:
+            self.base = None
+        self.state = dense.init(n_replicas=n_replicas, n_keys=n_keys)
+        self.extras_log: List[Any] = []
+
+    # -- local application -------------------------------------------------
+
+    def apply(self, ops: Any) -> Any:
+        """Apply one op batch (replica r's ops in row r) locally at every
+        replica — a single vectorized dispatch; collects generated extras
+        (promotions / rmv re-broadcasts) for the types that emit them."""
+        with self.metrics.timer("apply"):
+            self.state, extras = self.dense.apply_ops(self.state, ops)
+        if extras is not None:
+            self.extras_log.append(extras)
+        self.metrics.count("rounds")
+        return extras
+
+    # -- reconciliation ----------------------------------------------------
+
+    def sync(self, contributors: Optional[Sequence[int]] = None) -> None:
+        """Inter-DC reconciliation.
+
+        `contributors` is the delivery fault surface: the list of replica
+        rows whose contribution reaches the exchange (default: each exactly
+        once). Duplicates model duplicated delivery, omissions model loss.
+        JOIN types absorb duplicates (idempotent join); MONOID types
+        double-count them — mirroring which guarantees each pipeline needs.
+        """
+        if contributors is None:
+            contributors = range(self.n)
+        contributors = list(contributors)
+        with self.metrics.timer("sync"):
+            if self.dense.merge_kind == MergeKind.JOIN:
+                folded = _fold_rows(self.dense, self.state, contributors)
+                self.state = _broadcast_rows(folded, self.n)
+            else:
+                summed = _fold_rows(self.dense, self.state, contributors)
+                self.base = self.dense.merge(self.base, summed)
+                self.state = self.dense.init(n_replicas=self.n, n_keys=self.nk)
+        self.metrics.count("syncs")
+
+    # -- observation -------------------------------------------------------
+
+    def full_state(self) -> Any:
+        """Per-replica effective state: deltas on top of the shared base
+        for MONOID types, the replica rows themselves for JOIN types."""
+        if self.base is None:
+            return self.state
+        return self.dense.merge(_broadcast_rows(self.base, self.n), self.state)
+
+    def observe(self) -> Any:
+        return self.dense.observe(self.full_state())
+
+    def converged(self, atol: float = 0.0) -> bool:
+        """All replicas report the same observable (bitwise by default;
+        atol > 0 allows absolute float slack, with no relative component —
+        a silent rtol would mask exactly the small divergences the fault
+        tests exist to catch)."""
+        obs = self.observe()
+        leaves = obs if isinstance(obs, (tuple, list)) else (obs,)
+        for leaf in jax.tree.leaves(tuple(leaves)):
+            arr = np.asarray(leaf)
+            if atol > 0.0 and arr.dtype.kind == "f":
+                if not np.allclose(arr, arr[:1], rtol=0.0, atol=atol):
+                    return False
+            elif not (arr == arr[:1]).all():
+                return False
+        return True

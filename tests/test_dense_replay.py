@@ -1,0 +1,151 @@
+"""DenseReplay: the batched multi-DC pipeline over the dense engines.
+
+Checks the two reconciliation protocols (JOIN broadcast-fold, MONOID
+delta exchange), convergence after sync, and the delivery fault model:
+duplicated contributions are harmless exactly for JOIN types — the dense
+counterpart of test_harness.py's op-level fault tests.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from antidote_ccrdt_tpu.harness.dense_replay import DenseReplay
+from antidote_ccrdt_tpu.harness.opgen import TopkRmvEffectGen, Workload
+from antidote_ccrdt_tpu.models import average as av
+from antidote_ccrdt_tpu.models import leaderboard as lb
+from antidote_ccrdt_tpu.models import topk_rmv_dense as tkr
+
+
+def _avg_ops(R, NK, rng, B=8):
+    key = rng.integers(0, NK, (R, B)).astype(np.int32)
+    val = rng.integers(-50, 100, (R, B)).astype(np.int32)
+    cnt = np.ones((R, B), np.int32)
+    return av.AverageOps(
+        key=jnp.asarray(key), value=jnp.asarray(val), count=jnp.asarray(cnt)
+    ), key, val
+
+
+def test_average_delta_exchange_matches_global_mean():
+    R, NK, rounds = 4, 6, 3
+    rng = np.random.default_rng(0)
+    replay = DenseReplay(av.AverageDense(), n_replicas=R, n_keys=NK)
+    all_sum, all_cnt = np.zeros(NK), np.zeros(NK)
+    for _ in range(rounds):
+        ops, key, val = _avg_ops(R, NK, rng)
+        np.add.at(all_sum, key.ravel(), val.ravel())
+        np.add.at(all_cnt, key.ravel(), 1)
+        replay.apply(ops)
+        replay.sync()
+    assert replay.converged()
+    obs = np.asarray(replay.observe())  # [R, NK]
+    expected = np.where(all_cnt == 0, 0.0, all_sum / np.maximum(all_cnt, 1))
+    np.testing.assert_allclose(obs[0], expected, rtol=1e-6)
+
+
+def test_monoid_duplicate_contribution_double_counts():
+    """Exactly-once is load-bearing for MONOID types: a duplicated delta
+    shifts the converged sum (the dense dual of
+    test_harness.test_duplication_breaks_monoid_types)."""
+    R, NK = 3, 4
+    rng = np.random.default_rng(1)
+    honest = DenseReplay(av.AverageDense(), n_replicas=R, n_keys=NK)
+    faulty = DenseReplay(av.AverageDense(), n_replicas=R, n_keys=NK)
+    ops, _, _ = _avg_ops(R, NK, rng)
+    honest.apply(ops)
+    faulty.apply(ops)
+    honest.sync()
+    faulty.sync(contributors=[0, 0, 1, 2])  # replica 0 delivered twice
+    # Both still *converge* (every replica agrees) ...
+    assert honest.converged() and faulty.converged()
+    # ... but the faulty exchange double-counted replica 0's delta.
+    assert not np.allclose(
+        np.asarray(honest.observe()), np.asarray(faulty.observe())
+    )
+
+
+def test_join_duplicate_contribution_harmless():
+    """The lattice join absorbs duplicated delivery (idempotence) — the
+    guarantee the op-based pipeline has to *assume* from its host."""
+    R = 4
+    wl = Workload(n_replicas=R, n_ids=64, seed=3)
+    D = tkr.make_dense(n_ids=64, n_dcs=R, size=4, slots_per_id=4)
+    honest = DenseReplay(D, n_replicas=R)
+    faulty = DenseReplay(D, n_replicas=R)
+    gen = TopkRmvEffectGen(wl)
+    for _ in range(2):
+        batch = gen.next_batch(16, 2)
+        honest.apply(batch)
+        faulty.apply(batch)
+    honest.sync()
+    faulty.sync(contributors=[0, 1, 1, 2, 3, 3, 3])
+    assert honest.converged() and faulty.converged()
+    h, f = honest.observe(), faulty.observe()
+    for a, b in zip(h, f):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_topk_rmv_rounds_converge():
+    R, rounds = 8, 3
+    wl = Workload(n_replicas=R, n_ids=256, seed=5)
+    D = tkr.make_dense(n_ids=256, n_dcs=R, size=8, slots_per_id=4)
+    replay = DenseReplay(D, n_replicas=R)
+    gen = TopkRmvEffectGen(wl)
+    for _ in range(rounds):
+        replay.apply(gen.next_batch(32, 4))
+        assert not replay.converged() or rounds == 0  # pre-sync rows differ
+        replay.sync()
+        assert replay.converged()
+    obs = replay.observe()
+    assert bool(np.asarray(obs.valid)[:, :, 0].all())
+
+
+def test_leaderboard_ban_wins_through_sync():
+    R, P, K = 3, 16, 3
+    D = lb.make_dense(n_players=P, size=K)
+    replay = DenseReplay(D, n_replicas=R)
+
+    def ops(add_rows, ban_rows):
+        B = max(len(a) for a in add_rows)
+        Bb = max(max(len(b) for b in ban_rows), 1)
+        add = np.zeros((R, B, 3), np.int32)
+        add_valid = np.zeros((R, B), bool)
+        ban = np.zeros((R, Bb, 2), np.int32)
+        ban_valid = np.zeros((R, Bb), bool)
+        for r, rows in enumerate(add_rows):
+            for j, (pid, score) in enumerate(rows):
+                add[r, j] = (0, pid, score)
+                add_valid[r, j] = True
+        for r, rows in enumerate(ban_rows):
+            for j, pid in enumerate(rows):
+                ban[r, j] = (0, pid)
+                ban_valid[r, j] = True
+        return lb.LeaderboardOps(
+            add_key=jnp.asarray(add[:, :, 0]),
+            add_id=jnp.asarray(add[:, :, 1]),
+            add_score=jnp.asarray(add[:, :, 2]),
+            add_valid=jnp.asarray(add_valid),
+            ban_key=jnp.asarray(ban[:, :, 0]),
+            ban_id=jnp.asarray(ban[:, :, 1]),
+            ban_valid=jnp.asarray(ban_valid),
+        )
+
+    # Round 1: replica 0 adds players 1..4; replica 2 bans player 3.
+    replay.apply(
+        ops(
+            [[(1, 10), (2, 20), (3, 30), (4, 40)], [], []],
+            [[], [], [3]],
+        )
+    )
+    replay.sync()
+    assert replay.converged()
+    ids, scores, valid = replay.observe()
+    ids0 = np.asarray(ids)[0, 0][np.asarray(valid)[0, 0]].tolist()
+    assert 3 not in ids0  # ban wins regardless of delivery order
+    assert set(ids0) == {4, 2, 1}
+    # Round 2: re-add of the banned player at any score never resurfaces.
+    replay.apply(ops([[], [(3, 99)], []], [[], [], []]))
+    replay.sync()
+    ids, scores, valid = replay.observe()
+    ids0 = np.asarray(ids)[0, 0][np.asarray(valid)[0, 0]].tolist()
+    assert 3 not in ids0

@@ -91,7 +91,7 @@ class DenseReplay:
         if dense.merge_kind == MergeKind.MONOID:
             # base: the converged state as of the last sync (one row,
             # broadcast on read); rows of `state` are per-replica deltas.
-            self.base = _rows(dense.init(n_replicas=1, n_keys=n_keys), slice(0, 1))
+            self.base = dense.init(n_replicas=1, n_keys=n_keys)
         else:
             self.base = None
         self.state = dense.init(n_replicas=n_replicas, n_keys=n_keys)
@@ -125,7 +125,13 @@ class DenseReplay:
             contributors = range(self.n)
         contributors = list(contributors)
         with self.metrics.timer("sync"):
-            if self.dense.merge_kind == MergeKind.JOIN:
+            if not contributors:
+                # Total loss: nothing reaches the exchange. JOIN replicas
+                # learn nothing and keep their local state; MONOID replicas
+                # have shipped (and lost) their deltas — base unchanged.
+                if self.dense.merge_kind == MergeKind.MONOID:
+                    self.state = self.dense.init(n_replicas=self.n, n_keys=self.nk)
+            elif self.dense.merge_kind == MergeKind.JOIN:
                 folded = _fold_rows(self.dense, self.state, contributors)
                 self.state = _broadcast_rows(folded, self.n)
             else:

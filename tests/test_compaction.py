@@ -16,14 +16,20 @@ from antidote_ccrdt_tpu.models.average import AverageScalar  # noqa: E402
 from antidote_ccrdt_tpu.models.topk import TopkScalar  # noqa: E402
 from antidote_ccrdt_tpu.models.topk_rmv import TopkRmvScalar  # noqa: E402
 from antidote_ccrdt_tpu.models.wordcount import WordcountScalar  # noqa: E402
+from antidote_ccrdt_tpu.models.leaderboard import LeaderboardScalar  # noqa: E402
 from antidote_ccrdt_tpu.ops.compaction import (  # noqa: E402
     KIND_ADD,
     KIND_ADD_R,
     KIND_DEAD,
+    KIND_LB_ADD,
+    KIND_LB_ADD_R,
+    KIND_LB_BAN,
+    KIND_LB_DEAD,
     KIND_RMV,
     KIND_RMV_R,
     TopkRmvLog,
     compact_average_log,
+    compact_leaderboard_log,
     compact_topk_log,
     compact_topk_rmv_log,
     compact_wordcount_log,
@@ -266,3 +272,81 @@ class TestSimpleTypeCompaction:
                 if int(k[j]) == nk:
                     got[int(t[j])] = int(c[j])
             assert ref == got
+
+
+class TestLeaderboardCompaction:
+    @staticmethod
+    def _replay(kind, id_, score, n, board_size=4):
+        """Replay rows [0, n) of a leaderboard log through the scalar type."""
+        S = LeaderboardScalar()
+        state = S.new(board_size)
+        names = {KIND_LB_ADD: "add", KIND_LB_ADD_R: "add_r"}
+        for j in range(n):
+            k = int(kind[j])
+            if k == KIND_LB_DEAD:
+                continue
+            if k == KIND_LB_BAN:
+                eff = ("ban", int(id_[j]))
+            else:
+                eff = (names[k], (int(id_[j]), int(score[j])))
+            state, _extras = S.update(eff, state)
+        return S, state
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_observable_equal_after_compaction(self, seed):
+        rng = np.random.default_rng(seed)
+        L, P = 96, 10
+        kind = np.where(
+            rng.random(L) < 0.2,
+            KIND_LB_BAN,
+            np.where(rng.random(L) < 0.3, KIND_LB_ADD_R, KIND_LB_ADD),
+        ).astype(np.int32)
+        kind[rng.random(L) < 0.1] = KIND_LB_DEAD  # padding
+        key = np.zeros(L, np.int32)
+        id_ = rng.integers(0, P, L).astype(np.int32)
+        score = rng.integers(1, 1000, L).astype(np.int32)
+        ko, keyo, ido, so, n_live = compact_leaderboard_log(
+            jnp.asarray(kind), jnp.asarray(key), jnp.asarray(id_), jnp.asarray(score)
+        )
+        n_in = int(np.sum(kind != KIND_LB_DEAD))
+        assert int(n_live) < n_in  # it actually compacts
+        S, ref = self._replay(kind, id_, score, L)
+        _, got = self._replay(np.asarray(ko), np.asarray(ido), np.asarray(so), int(n_live))
+        assert S.equal(ref, got)
+
+    def test_add_add_keeps_max(self):
+        kind = jnp.asarray([KIND_LB_ADD_R, KIND_LB_ADD, KIND_LB_ADD], jnp.int32)
+        key = jnp.zeros(3, jnp.int32)
+        id_ = jnp.asarray([5, 5, 5], jnp.int32)
+        score = jnp.asarray([70, 90, 40], jnp.int32)
+        ko, _, ido, so, n_live = compact_leaderboard_log(kind, key, id_, score)
+        assert int(n_live) == 1
+        assert (int(ko[0]), int(ido[0]), int(so[0])) == (KIND_LB_ADD, 5, 90)
+
+    def test_ban_deletes_all_adds_either_order(self):
+        # Pairwise (leaderboard.erl:201) only deletes adds *before* the ban;
+        # whole-log closure drops adds after it too (bans are permanent, and
+        # the ban rides the same log — replay-equivalent, strictly smaller).
+        kind = jnp.asarray(
+            [KIND_LB_ADD, KIND_LB_BAN, KIND_LB_ADD, KIND_LB_BAN], jnp.int32
+        )
+        key = jnp.zeros(4, jnp.int32)
+        id_ = jnp.asarray([3, 3, 3, 3], jnp.int32)
+        score = jnp.asarray([10, 0, 99, 0], jnp.int32)
+        ko, _, ido, _, n_live = compact_leaderboard_log(kind, key, id_, score)
+        assert int(n_live) == 1  # bans dedupe, adds die
+        assert int(ko[0]) == KIND_LB_BAN and int(ido[0]) == 3
+
+    def test_idempotent(self):
+        rng = np.random.default_rng(9)
+        L = 64
+        kind = rng.integers(0, 3, L).astype(np.int32)
+        key = rng.integers(0, 2, L).astype(np.int32)
+        id_ = rng.integers(0, 8, L).astype(np.int32)
+        score = rng.integers(1, 100, L).astype(np.int32)
+        args = tuple(jnp.asarray(x) for x in (kind, key, id_, score))
+        k1, key1, i1, s1, n1 = compact_leaderboard_log(*args)
+        k2, _, i2, s2, n2 = compact_leaderboard_log(k1, key1, i1, s1)
+        assert int(n1) == int(n2)
+        np.testing.assert_array_equal(np.asarray(k1), np.asarray(k2))
+        np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))

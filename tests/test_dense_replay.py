@@ -64,6 +64,32 @@ def test_monoid_duplicate_contribution_double_counts():
     )
 
 
+def test_total_loss_sync():
+    """sync(contributors=[]) models total delivery loss: JOIN replicas keep
+    local state; MONOID replicas lose their in-flight deltas, base intact."""
+    R, NK = 3, 4
+    rng = np.random.default_rng(2)
+    rp = DenseReplay(av.AverageDense(), n_replicas=R, n_keys=NK)
+    ops, _, _ = _avg_ops(R, NK, rng)
+    rp.apply(ops)
+    rp.sync()  # converge once
+    base_obs = np.asarray(rp.observe()).copy()
+    ops2, _, _ = _avg_ops(R, NK, rng)
+    rp.apply(ops2)
+    rp.sync(contributors=[])  # round 2 deltas all lost in flight
+    np.testing.assert_array_equal(np.asarray(rp.observe()), base_obs)
+    assert rp.converged()
+
+    D = tkr.make_dense(n_ids=32, n_dcs=R, size=4, slots_per_id=2)
+    jp = DenseReplay(D, n_replicas=R)
+    gen = TopkRmvEffectGen(Workload(n_replicas=R, n_ids=32, seed=4))
+    jp.apply(gen.next_batch(8, 1))
+    jp.sync(contributors=[])  # JOIN: nothing learned, local state kept
+    assert not jp.converged()  # rows still differ (their own local adds)
+    jp.sync()
+    assert jp.converged()
+
+
 def test_join_duplicate_contribution_harmless():
     """The lattice join absorbs duplicated delivery (idempotence) — the
     guarantee the op-based pipeline has to *assume* from its host."""

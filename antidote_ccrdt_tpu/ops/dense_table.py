@@ -16,6 +16,61 @@ from jax import lax
 NEG_INF = jnp.int32(-(2**31 - 1))
 
 
+def scatter_max_rows_mxu(
+    table: jax.Array, rows: jax.Array, upd: jax.Array
+) -> jax.Array:
+    """``table.at[rows].max(upd)`` for non-negative i32 updates, computed on
+    the MXU instead of XLA's scatter.
+
+    XLA lowers scatter to a serialized per-row read-modify-write loop —
+    measured ~29ms for 256 rows x 32 lanes into [100k, 32] on v5e (honest
+    device timing; `block_until_ready` does not block on tunneled devices,
+    so earlier sub-ms figures were dispatch-only). The same update as a
+    one-hot matmul runs ~4.5x faster and rides the MXU:
+
+    1. sort updates by row; per-column suffix-max gives each duplicate run's
+       head the run total (vc entries merge by per-DC max);
+    2. non-head duplicates are pointed at an out-of-range row, so each table
+       row receives at most ONE update and the matmul's sum == that value;
+    3. exactness: i32 values split as ``v = hi*2**12 + lo`` (hi < 2**19,
+       lo < 2**12); with ``Precision.HIGHEST`` each f32 product/sum is exact
+       below 2**24, and the pieces reassemble exactly in i32.
+
+    table [T, D] i32 >= 0, rows [Br] i32 (values >= T are dropped),
+    upd [Br, D] i32 >= 0. Returns the updated [T, D] table.
+    """
+    T = table.shape[0]
+    order = jnp.argsort(rows)
+    r_s = jnp.take_along_axis(rows, order, axis=0)
+    u_s = jnp.take_along_axis(upd, order[:, None], axis=0)
+
+    def comb(a, b):
+        (ka, va), (kb, vb) = a, b
+        same = (ka == kb)[..., None]
+        return (kb, jnp.where(same, jnp.maximum(va, vb), vb))
+
+    _, suf = lax.associative_scan(comb, (r_s[::-1], u_s[::-1]), axis=0)
+    total = suf[::-1]  # run max from each position to its run's end
+    is_head = jnp.concatenate(
+        [jnp.ones((1,), bool), r_s[1:] != r_s[:-1]]
+    )
+    head_rows = jnp.where(is_head, r_s, T)  # non-heads never match the iota
+
+    onehot = (
+        head_rows[:, None] == jnp.arange(T, dtype=jnp.int32)[None, :]
+    ).astype(jnp.float32)  # [Br, T]
+    hi = (total >> 12).astype(jnp.float32)
+    lo = (total & 0xFFF).astype(jnp.float32)
+
+    def mm(u):
+        return lax.dot_general(
+            onehot, u, (((0,), (0,)), ((), ())), precision=lax.Precision.HIGHEST
+        ).astype(jnp.int32)  # [T, D]
+
+    delta = (mm(hi) << 12) | mm(lo)
+    return jnp.maximum(table, delta)
+
+
 def masked_topk(scores: jax.Array, k: int):
     """(ids, scores, valid) of the top-k entries of a [..., P] score table;
     NEG_INF marks absent entries."""

@@ -1,34 +1,34 @@
 """Pallas TPU kernels for the topk_rmv hot paths.
 
 SURVEY.md §7 step 6 reserves pallas for ops where XLA falls short. The two
-candidates below were built and differentially verified; v5e measurements
-(bench shapes: [32, 1, 100k] instances, W=8 slots, D=32 DCs) decided what
-the dense model actually dispatches to:
+candidates below were built and differentially verified. Honest v5e device
+timings (host-readback-synced, scan-amortized dispatch — see
+benchmarks/profile_topk_rmv_pieces.py for why `block_until_ready`-based
+numbers on this backend are phantoms) decided what the dense model
+actually dispatches to:
 
 * **Slot sorting** (`sort_slots_pallas`) — the join step of
   `apply_ops`/`merge` sorts W<=8-wide slot groups best-first per
   (replica, key, id) row: a fixed-size compare-exchange network (Batcher
   odd-even mergesort) where each comparator is a handful of VPU selects.
-  Measured 19.5ms with XLA-side transposes and 71.6ms with in-VMEM
-  transposes vs 14.6ms for XLA's variadic `lax.sort` — narrow-array
-  sublane<->lane relayouts dominate, so **XLA remains the default**; the
-  kernel is kept as verified infrastructure (it wins when data already
-  lives in a [W, N] layout).
+  Honest timing at [32, 1, 100k, 8]: ~42ms vs ~11ms for XLA's variadic
+  `lax.sort` — narrow-array sublane<->lane relayouts dominate, so **XLA
+  remains the default**; the kernel is kept as verified infrastructure
+  (it wins when data already lives in a [W, N] layout). It also fails the
+  tunnel's remote compile when nested inside `lax.scan` (HTTP 500).
 
-* **Tombstone row scatter-max** (`scatter_max_rows_pallas`) —
-  `rmv_vc.at[rows].max(upd)` over the [T, D] tombstone table, where XLA's
-  scatter costs ~35ms for 8k rows. The BlockSpec-pipelined version is
-  rejected by Mosaic (last-two-dims tiling rule vs narrow D=32 minor dim)
-  and a manual-DMA variant deadlocked on v5e, so the TPU path is **not
-  wired into the hot path**; the kernel is interpret-verified and the
-  design note that matters survives in `combine_duplicate_rows`: rewriting
-  every duplicate row to carry its run's total makes all writes
-  idempotent-to-final, defusing read-modify-write races in any pipelined
-  scatter. Updates must be >= 0 (vc timestamps).
-
-The big measured win for the hot path was algorithmic, not a kernel: see
-`_filter_slots`'s select-scan note in `models/topk_rmv_dense.py` (~400ms ->
-0.03ms by avoiding XLA's pathological narrow-index gather).
+* **Tombstone row scatter-max** (`scatter_max_rows_pallas`) — the
+  BlockSpec-pipelined version is rejected by Mosaic (last-two-dims tiling
+  rule vs narrow D=32 minor dim) and a manual-DMA variant deadlocked on
+  v5e, so the TPU path is **not wired into the hot path**; the kernel is
+  interpret-verified, and the design note that matters survives in
+  `combine_duplicate_rows`: rewriting every duplicate row to carry its
+  run's total makes all writes idempotent-to-final, defusing
+  read-modify-write races in any pipelined scatter. The production
+  replacement for XLA's serialized scatter (honest cost ~29ms for 256
+  rows x 32 lanes into [100k, 32]) is the dedup + one-hot MXU matmul in
+  `ops.dense_table.scatter_max_rows_mxu` (~6.5ms), which also sidesteps
+  the race entirely.
 """
 
 from __future__ import annotations

@@ -1,0 +1,184 @@
+"""Honest (device_get-synced) per-piece costs of the topk_rmv apply round.
+
+Measurement rules learned the hard way on the tunneled TPU backend:
+
+1. `jax.block_until_ready` does NOT block — it returns while the device is
+   still executing, so naive timings measure dispatch (~0.03ms) or queue
+   backpressure, not compute. Every timing must end with a real
+   device->host readback (`sync` below).
+2. Each per-dispatch round trip costs 10-30ms, so pieces must be timed as
+   many iterations inside ONE jit (lax.scan).
+3. The scanned iterations must consume *distinct per-iteration inputs* and
+   thread a carry through the piece — otherwise XLA hoists the
+   loop-invariant work out of the scan and the loop measures nothing.
+4. Big arrays must arrive as arguments/carries, never closures: closed-over
+   device arrays are serialized into the remote-compile request as
+   constants (HTTP 413 past ~100MB).
+
+Reference numbers (v5e, R=32, I=100k, D=32, M=4, B=4096, Br=256) that
+drove the kernel choices in models/topk_rmv_dense.py are recorded in that
+module's `_apply_one_replica` docstring."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from antidote_ccrdt_tpu.harness.opgen import TopkRmvEffectGen, Workload
+from antidote_ccrdt_tpu.models.topk_rmv_dense import (
+    NEG_INF, _filter_slots, _sort_adds, _sort_slots, make_dense,
+)
+from antidote_ccrdt_tpu.ops.segment import group_rank
+
+R, NK, I, D_DCS, K, M, B, Br, REPS = 32, 1, 100_000, 32, 100, 4, 4096, 256, 20
+D = make_dense(n_ids=I, n_dcs=D_DCS, size=K, slots_per_id=M)
+state = D.init(n_replicas=R, n_keys=1)
+gen = TopkRmvEffectGen(Workload(n_replicas=R, n_ids=I, zipf_a=1.2, score_max=100_000, seed=7))
+warm = gen.next_batch(B, Br)
+state, _ = D.apply_ops(state, warm)
+batch_seq = [gen.next_batch(B, Br) for _ in range(REPS)]
+stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *batch_seq)
+warm_seq = jax.tree.map(lambda x: x, stacked)  # same shapes for warmup
+
+
+def sync(x):
+    return np.asarray(jax.tree.leaves(x)[0].ravel()[0])
+
+
+def true_time(name, step_fn, carry_init):
+    """step_fn(carry, ops) -> carry. ops leaves have [R, ...] shapes."""
+
+    @jax.jit
+    def run(c, seq):
+        def body(c, ops):
+            return step_fn(c, ops), ()
+        out, _ = lax.scan(body, c, seq)
+        return out
+
+    sync(run(carry_init, stacked))
+    t0 = time.perf_counter()
+    out = run(carry_init, stacked)
+    sync(out)
+    print(f"{name:52s} {(time.perf_counter() - t0) / REPS * 1e3:9.2f} ms")
+    return out
+
+
+st = state
+
+# 1 rmv scatter: XLA scatter vs matmul (within full-state carry shapes)
+def rmv_scatter(c, ops):
+    def one(t, rk, ri, u):
+        rkk = jnp.where(ri >= 0, rk, NK)
+        return t.at[rkk, ri].max(u, mode="drop")
+    return jax.vmap(one)(c, ops.rmv_key, ops.rmv_id, ops.rmv_vc)
+
+true_time("1a rmv tombstone XLA scatter", rmv_scatter, st.rmv_vc)
+
+# 2 vc one-hot (tiny)
+def vc_onehot(c, ops):
+    def one(v, k, d, t, valid):
+        slot = k * D_DCS + d
+        hit = slot[:, None] == jnp.arange(NK * D_DCS, dtype=slot.dtype)[None, :]
+        contrib = jnp.where(hit & valid[:, None], t[:, None], 0)
+        return jnp.maximum(v, jnp.max(contrib, axis=0).reshape(NK, D_DCS))
+    return jax.vmap(one)(c, ops.add_key, ops.add_dc, ops.add_ts, ops.add_ts > 0)
+
+true_time("2 vc one-hot", vc_onehot, st.vc)
+
+# 3 whole-table filter (carry slot_score; dc/ts as captured consts via carry tuple)
+def filt(c, ops):
+    score, dc, ts, rmv = c
+    rmv2 = rmv_scatter(rmv, ops)
+    s, d, t = _filter_slots(score, dc, ts, rmv2)
+    return (s, d, t, rmv2)
+
+true_time("3 filter_slots (incl 1a cost)", filt,
+          (st.slot_score, st.slot_dc, st.slot_ts, st.rmv_vc))
+
+# 4 dominated row gather (table rides the carry to avoid const upload)
+def domg(c, ops):
+    tab, acc = c
+    def one(t, k, i, d, ts):
+        row = t.reshape(NK * I, D_DCS)[k * I + i]
+        dom = jnp.take_along_axis(row, d[:, None], axis=-1)[:, 0]
+        return dom >= ts
+    dom = jax.vmap(one)(tab, ops.add_key, ops.add_id, ops.add_dc, ops.add_ts)
+    return (tab, jnp.maximum(acc, dom.sum(-1, keepdims=True).astype(jnp.int32)))
+
+true_time("4 dominated row-gather (B rows)", domg,
+          (st.rmv_vc, jnp.zeros((R, 1), jnp.int32)))
+
+# 5 sort adds (two 7-operand sorts + rank)
+def sortadds(c, ops):
+    def one(akey, aid, ascore, ats, adc):
+        (s_key, s_id, _, _), (s_score, s_ts, s_dc) = _sort_adds(akey, aid, ascore, ats, adc)
+        rank = group_rank((s_key, s_id))
+        return rank.sum()
+    return jnp.maximum(c, jax.vmap(one)(ops.add_key, ops.add_id, ops.add_score,
+                                        ops.add_ts, ops.add_dc)[:, None])
+
+true_time("5 sort adds x2 + rank", sortadds, jnp.zeros((R, 1), jnp.int32))
+
+# 6 window + head-row scatter (delta build, minus sort)
+def delta_rows(c, ops):
+    def one(akey, aid, ascore, ats, adc):
+        (s_key, s_id, _, _), (s_score, s_ts, s_dc) = _sort_adds(akey, aid, ascore, ats, adc)
+        rank = group_rank((s_key, s_id))
+        Bn = s_key.shape[0]
+        startp = jnp.arange(Bn, dtype=jnp.int32) - rank
+        in_b = (jnp.arange(Bn, dtype=jnp.int32)[:, None]
+                + jnp.arange(M, dtype=jnp.int32)[None, :]) < Bn
+        same = (jnp.stack([jnp.roll(startp, -j) for j in range(M)], axis=-1)
+                == startp[:, None]) & in_b
+        w = jnp.where(same, jnp.stack([jnp.roll(s_score, -j) for j in range(M)], -1), NEG_INF)
+        is_head = (rank == 0) & (s_key < NK)
+        head_row = jnp.where(is_head, s_key * I + s_id, NK * I)
+        return (jnp.full((NK * I, M), NEG_INF, jnp.int32)
+                .at[head_row].set(w, mode="drop", unique_indices=True)
+                .reshape(NK, I, M))
+    d = jax.vmap(one)(ops.add_key, ops.add_id, ops.add_score, ops.add_ts, ops.add_dc)
+    return jnp.maximum(c, d)
+
+true_time("6 delta: sort+window+ROW scatter (1 field)", delta_rows,
+          jnp.full((R, NK, I, M), NEG_INF, jnp.int32))
+
+# 6b old scalar-scatter delta
+def delta_scalar(c, ops):
+    def one(akey, aid, ascore, ats, adc):
+        (s_key, s_id, _, _), (s_score, s_ts, s_dc) = _sort_adds(akey, aid, ascore, ats, adc)
+        rank = group_rank((s_key, s_id))
+        rank2 = jnp.where(rank < M, rank, M)
+        return (jnp.full((NK, I, M), NEG_INF, jnp.int32)
+                .at[s_key, s_id, rank2].set(s_score, mode="drop"))
+    d = jax.vmap(one)(ops.add_key, ops.add_id, ops.add_score, ops.add_ts, ops.add_dc)
+    return jnp.maximum(c, d)
+
+true_time("6b delta: sort+SCALAR scatter (1 field)", delta_scalar,
+          jnp.full((R, NK, I, M), NEG_INF, jnp.int32))
+
+# 7 join sort
+def join(c, ops):
+    score, dc, ts = c
+    c_s = jnp.concatenate([score, score], axis=-1)
+    c_d = jnp.concatenate([dc, dc], axis=-1)
+    c_t = jnp.concatenate([ts, ts + ops.add_ts[0, 0]], axis=-1)
+    f_s, f_d, f_t, _ = _sort_slots(c_s, c_d, c_t, M)
+    return (f_s, f_d, f_t)
+
+true_time("7 join sort 2M->M", join, (st.slot_score, st.slot_dc, st.slot_ts))
+
+# 8 FULL apply (current code)
+def full(c, ops):
+    s, _ = D.apply_ops(c, ops)
+    return s
+
+true_time("8 FULL apply_ops (current code)", full, st)
+
+# 9 observe (state rides the carry)
+def obs(c, ops):
+    stc, acc = c
+    o = D.observe(stc)
+    return (stc, jnp.maximum(acc, o.scores[..., 0] + ops.add_ts[:, :1] * 0))
+
+out9 = true_time("9 observe (full I sort)", obs, (st, jnp.zeros((R, NK), jnp.int32)))

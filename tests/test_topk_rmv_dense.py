@@ -284,3 +284,44 @@ def test_vc_advances_on_dominated_add():
     st, extras = D.apply_ops(st, pack_ops([("add", (1, 7, (0, 3)))], 2, 4, 2))
     assert bool(extras.dominated[0, 0])
     assert st.vc[0, 0].tolist() == [3, 0]
+
+
+def test_collect_dominated_off_same_state():
+    """collect_dominated=False skips the extras gather but must leave the
+    state path bit-identical (dominated adds die at the join filter)."""
+    D = make_dense(n_ids=8, n_dcs=2, size=3, slots_per_id=2)
+    ops1 = pack_ops(
+        [("rmv", (1, {0: 5})), ("add", (1, 7, (0, 3))), ("add", (2, 9, (1, 1)))],
+        2, 4, 2,
+    )
+    ops2 = pack_ops(
+        [("add", (1, 11, (0, 6))), ("add", (3, 2, (0, 7)))], 2, 4, 2
+    )
+    st_a = st_b = D.init(1, 1)
+    for ops in (ops1, ops2):
+        st_a, ex_a = D.apply_ops(st_a, ops)
+        st_b, ex_b = D.apply_ops(st_b, ops, collect_dominated=False)
+        assert ex_b.dominated is None and ex_b.dominated_vc is None
+        for la, lb in zip(jax.tree.leaves(st_a), jax.tree.leaves(st_b)):
+            assert np.array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_scatter_max_rows_mxu_exact():
+    """The MXU one-hot scatter-max must be bit-exact vs XLA scatter across
+    the full i32 range, with duplicate rows (per-column max) and OOB
+    padding rows dropped."""
+    from antidote_ccrdt_tpu.ops.dense_table import scatter_max_rows_mxu
+
+    rng = np.random.default_rng(0)
+    T, D_, Br = 500, 8, 64
+    table = jnp.asarray(rng.integers(0, 2**31 - 1, (T, D_)).astype(np.int32))
+    rows_np = rng.integers(0, T, Br).astype(np.int32)
+    rows_np[::7] = rows_np[0]  # force duplicate runs
+    rows_np[3] = T  # OOB padding sentinel
+    rows = jnp.asarray(rows_np)
+    upd = jnp.asarray(rng.integers(0, 2**31 - 1, (Br, D_)).astype(np.int32))
+    # boundary values
+    upd = upd.at[0, 0].set(2**31 - 1).at[1, 1].set(0)
+    ref = table.at[rows].max(upd, mode="drop")
+    got = scatter_max_rows_mxu(table, rows, upd)
+    assert np.array_equal(np.asarray(ref), np.asarray(got))

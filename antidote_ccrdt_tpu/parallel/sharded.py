@@ -1,0 +1,334 @@
+"""Key/id-space sharding: scale one huge CRDT instance across a mesh.
+
+SURVEY.md §5 maps the reference's missing "long-context" axis onto CCRDTs:
+the analogous scaling dimension is the *element-id space* of a single huge
+instance (millions of ids in one top-K), sharded across devices the way
+sequence-parallel attention shards tokens. The design mirrors the
+ring/Ulysses bandwidth argument:
+
+* **state** lives sharded: each device owns a contiguous id range of the
+  slot/tombstone tables ([..., I_local, ...]) — the big arrays never move;
+* **ops** are broadcast (they are small); each shard masks the batch to its
+  own id range and applies it locally — no all-to-all of state;
+* **reads** exchange only the top-K *frontier* per shard (K entries, not
+  I_local) via `all_gather` and re-rank globally — the collective payload
+  is O(K * n_shards), the id-space analog of exchanging KV blocks instead
+  of full activations.
+
+`hierarchical_all_reduce` composes the inter-DC reconciliation over a
+two-level (dcn, ici) mesh: lattice all-reduce inside each host over ICI
+first, then across hosts over DCN — the standard hierarchical-collective
+layout for multi-host TPU pods, applied to the CRDT join.
+
+No component in the reference corresponds to this file (its replication is
+single-key op shipping, SURVEY.md §2 "Parallelism" checklist); this is the
+TPU-native capability the rebuild owes in its place.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.topk_rmv_dense import Observed, TopkRmvDense, TopkRmvOps, make_dense
+from .dist import lattice_all_reduce
+
+
+def make_mesh2(n_dcn: int, n_dc: int, n_key: int = 1, devices=None) -> Mesh:
+    """A (dcn, dc, key) mesh: host groups x replica shards x id shards.
+    'dc' collectives ride ICI; 'dcn' crosses the data-center network."""
+    devices = devices if devices is not None else jax.devices()
+    n = n_dcn * n_dc * n_key
+    assert len(devices) >= n, f"need {n} devices, have {len(devices)}"
+    return Mesh(
+        np.asarray(devices[:n]).reshape(n_dcn, n_dc, n_key),
+        ("dcn", "dc", "key"),
+    )
+
+
+def hierarchical_all_reduce(
+    x: Any,
+    merge: Callable[[Any, Any], Any],
+    mesh: Mesh,
+    ici_axis: str = "dc",
+    dcn_axis: str = "dcn",
+):
+    """All-reduce a pytree with the CRDT merge over two mesh levels:
+    ICI-local first (cheap, high-bandwidth), then one exchange per host
+    group over DCN — total DCN traffic is 1/|ici| of a flat all-reduce."""
+    x = lattice_all_reduce(x, ici_axis, merge, mesh.shape[ici_axis])
+    return lattice_all_reduce(x, dcn_axis, merge, mesh.shape[dcn_axis])
+
+
+@dataclasses.dataclass(frozen=True)
+class IdShardedTopkRmv:
+    """One topk_rmv instance whose id space is sharded over a mesh axis.
+
+    `inner` is the per-shard dense engine (n_ids = I_global / n_shards);
+    every state it produces has layout [R, NK, I_local, ...] per shard.
+    The global engine presents:
+
+    * `init()` — sharded fresh state placed on the mesh;
+    * `apply_ops(state, ops)` — ops carry GLOBAL ids; each shard masks to
+      its range and rebases (ops are replicated over 'key', state stays
+      put);
+    * `observe(state)` — per-shard top-K, frontier all_gather over 'key',
+      global re-rank (ids reported global);
+    * `merge_replicas(state)` — the inter-DC join over 'dc' (and 'dcn' if
+      present), run entirely shard-local: the join never crosses id
+      ranges, so id sharding composes with replica merging for free.
+    """
+
+    inner: TopkRmvDense
+    mesh: Mesh
+    n_replicas: int
+    key_axis: str = "key"
+    dc_axis: str = "dc"
+
+    @property
+    def n_shards(self) -> int:
+        return self.mesh.shape[self.key_axis]
+
+    @property
+    def i_global(self) -> int:
+        return self.inner.I * self.n_shards
+
+    def _state_spec(self):
+        """Per-leaf PartitionSpecs. The slot/tombstone tables shard their
+        id axis (axis 2); vc and lossy have no id axis, so the sharded
+        layout gives them an explicit shard axis at position 1 (each
+        shard's vc covers only the adds it saw — the global vc is the max
+        over shards)."""
+        from ..models.topk_rmv_dense import TopkRmvDenseState
+
+        dc, key = self.dc_axis, self.key_axis
+        table = P(dc, None, key)
+        return TopkRmvDenseState(
+            slot_score=table,
+            slot_dc=table,
+            slot_ts=table,
+            rmv_vc=table,
+            vc=P(dc, key),
+            lossy=P(dc, key),
+        )
+
+    def init(self) -> Any:
+        """Sharded fresh state: tables [R, NK, I_global, ...], vc/lossy
+        carry the extra shard axis [R, n_shards, NK, ...]."""
+        R, NSH, NK = self.n_replicas, self.n_shards, 1
+        Dd, I_g, M = self.inner.D, self.i_global, self.inner.M
+        from ..models.topk_rmv_dense import TopkRmvDenseState
+        from ..ops.dense_table import NEG_INF
+
+        state = TopkRmvDenseState(
+            slot_score=jnp.full((R, NK, I_g, M), NEG_INF, jnp.int32),
+            slot_dc=jnp.zeros((R, NK, I_g, M), jnp.int32),
+            slot_ts=jnp.zeros((R, NK, I_g, M), jnp.int32),
+            rmv_vc=jnp.zeros((R, NK, I_g, Dd), jnp.int32),
+            vc=jnp.zeros((R, NSH, NK, Dd), jnp.int32),
+            lossy=jnp.zeros((R, NSH, NK), bool),
+        )
+        specs = self._state_spec()
+        return jax.tree.map(
+            lambda a, s: jax.device_put(a, NamedSharding(self.mesh, s)),
+            state,
+            specs,
+        )
+
+    @staticmethod
+    def _to_local(st):
+        """Inside shard_map: drop vc/lossy's singleton shard axis so the
+        leaves match the inner engine's layout."""
+        from ..models.topk_rmv_dense import TopkRmvDenseState
+
+        return TopkRmvDenseState(
+            slot_score=st.slot_score,
+            slot_dc=st.slot_dc,
+            slot_ts=st.slot_ts,
+            rmv_vc=st.rmv_vc,
+            vc=st.vc[:, 0],
+            lossy=st.lossy[:, 0],
+        )
+
+    @staticmethod
+    def _from_local(st):
+        from ..models.topk_rmv_dense import TopkRmvDenseState
+
+        return TopkRmvDenseState(
+            slot_score=st.slot_score,
+            slot_dc=st.slot_dc,
+            slot_ts=st.slot_ts,
+            rmv_vc=st.rmv_vc,
+            vc=st.vc[:, None],
+            lossy=st.lossy[:, None],
+        )
+
+    # -- sharded application ------------------------------------------------
+
+    def _mask_to_shard(self, ops: TopkRmvOps) -> TopkRmvOps:
+        """Inside shard_map: keep only ops whose GLOBAL id falls in this
+        shard's range, rebased to local ids; foreign ops become padding.
+        Runs on every shard over the full (replicated) op batch — O(B)
+        elementwise work instead of an all-to-all exchange."""
+        I_loc = self.inner.I
+        shard = lax.axis_index(self.key_axis)
+        lo = shard * I_loc
+        a_mine = (ops.add_id >= lo) & (ops.add_id < lo + I_loc)
+        r_mine = (ops.rmv_id >= lo) & (ops.rmv_id < lo + I_loc)
+        return TopkRmvOps(
+            add_key=ops.add_key,
+            add_id=jnp.where(a_mine, ops.add_id - lo, 0),
+            add_score=ops.add_score,
+            add_dc=ops.add_dc,
+            add_ts=jnp.where(a_mine, ops.add_ts, 0),  # 0 = padding
+            rmv_key=ops.rmv_key,
+            rmv_id=jnp.where(r_mine, ops.rmv_id - lo, -1),  # -1 = padding
+            rmv_vc=ops.rmv_vc,
+        )
+
+    def apply_ops(self, state: Any, ops: TopkRmvOps) -> Any:
+        """ops leaves are [R, B] with global ids, replicated over 'key' and
+        sharded over 'dc' like the state's replica axis."""
+        spec_state = self._state_spec()
+        spec_ops = jax.tree.map(lambda _: P(self.dc_axis), ops)
+
+        def local(st, op):
+            op = self._mask_to_shard(op)
+            st2, _ = self.inner.apply_ops(
+                self._to_local(st), op, collect_dominated=False
+            )
+            return self._from_local(st2)
+
+        return jax.jit(
+            shard_map(
+                local,
+                mesh=self.mesh,
+                in_specs=(spec_state, spec_ops),
+                out_specs=spec_state,
+                check_vma=False,
+            )
+        )(state, ops)
+
+    # -- reads: frontier exchange ------------------------------------------
+
+    def observe(self, state: Any) -> Observed:
+        """Global observable top-K: local top-K per shard (payload K, not
+        I_local), all_gather over the id shards, re-rank by the reference
+        cmp order (score desc, id desc, ts desc)."""
+        spec_state = self._state_spec()
+        K = self.inner.K
+        I_loc = self.inner.I
+
+        def local(st):
+            obs = self.inner.observe(self._to_local(st))  # [R_loc, NK, K] local ids
+            shard = lax.axis_index(self.key_axis)
+            gids = jnp.where(obs.valid, obs.ids + shard * I_loc, -1)
+            frontier = Observed(gids, obs.scores, obs.dcs, obs.tss, obs.valid)
+            # [n_shards, R_loc, NK, K] on every shard
+            gathered = jax.tree.map(
+                lambda a: lax.all_gather(a, self.key_axis), frontier
+            )
+            cat = jax.tree.map(
+                lambda a: jnp.moveaxis(a, 0, -2).reshape(
+                    a.shape[1], a.shape[2], -1
+                ),
+                gathered,
+            )  # [R_loc, NK, n_shards*K]
+            ns, ni, nt, dc_f, valid_f = lax.sort(
+                (
+                    jnp.where(cat.valid, -cat.scores, -jnp.int32(-(2**31 - 1))),
+                    -cat.ids,
+                    -cat.tss,
+                    cat.dcs,
+                    cat.valid,
+                ),
+                num_keys=3,
+                dimension=-1,
+            )
+            return Observed(
+                ids=-ni[..., :K],
+                scores=-ns[..., :K],
+                dcs=dc_f[..., :K],
+                tss=-nt[..., :K],
+                valid=valid_f[..., :K],
+            )
+
+        return jax.jit(
+            shard_map(
+                local,
+                mesh=self.mesh,
+                in_specs=(spec_state,),
+                out_specs=P(self.dc_axis, None, None),
+                check_vma=False,
+            )
+        )(state)
+
+    # -- inter-DC reconciliation -------------------------------------------
+
+    def merge_replicas(self, state: Any) -> Any:
+        """Join all replica rows over the 'dc' axis (and 'dcn' when the
+        mesh has one), shard-local in the id dimension: every replica ends
+        up with the converged state for the shard's id range."""
+        spec_state = self._state_spec()
+        has_dcn = "dcn" in self.mesh.shape
+
+        def local(st):
+            st = self._to_local(st)
+
+            def join(a, b):
+                return self.inner.merge(a, b)
+
+            merged = lattice_all_reduce(
+                st, self.dc_axis, join, self.mesh.shape[self.dc_axis]
+            )
+            if has_dcn:
+                merged = lattice_all_reduce(
+                    merged, "dcn", join, self.mesh.shape["dcn"]
+                )
+            return self._from_local(merged)
+
+        return jax.jit(
+            shard_map(
+                local,
+                mesh=self.mesh,
+                in_specs=(spec_state,),
+                out_specs=spec_state,
+                check_vma=False,
+            )
+        )(state)
+
+
+def make_id_sharded_topk_rmv(
+    mesh: Mesh,
+    n_ids_global: int,
+    n_dcs: int,
+    size: int = 100,
+    slots_per_id: int = 4,
+    n_replicas: int = None,
+    key_axis: str = "key",
+    dc_axis: str = "dc",
+) -> IdShardedTopkRmv:
+    n_shards = mesh.shape[key_axis]
+    assert n_ids_global % n_shards == 0, (n_ids_global, n_shards)
+    inner = make_dense(
+        n_ids=n_ids_global // n_shards,
+        n_dcs=n_dcs,
+        size=size,
+        slots_per_id=slots_per_id,
+    )
+    if n_replicas is None:
+        n_replicas = mesh.shape[dc_axis]
+    return IdShardedTopkRmv(
+        inner=inner,
+        mesh=mesh,
+        n_replicas=n_replicas,
+        key_axis=key_axis,
+        dc_axis=dc_axis,
+    )

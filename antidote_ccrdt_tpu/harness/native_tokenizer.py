@@ -1,0 +1,176 @@
+"""Python binding for the native (C++) corpus tokenizer / data-loader.
+
+``native/ccrdt_tokenizer.cpp`` implements the wordcount ingest hot loop —
+tokenize on '\\n'/' ' keeping empties (wordcount.erl:76-85 parity),
+per-document dedup (worddocumentcount.erl:76-86), FNV-1a hashed or exact
+grow-on-demand vocabulary encoding — over whole corpus chunks in one C
+call. Same build-on-demand + ctypes pattern as `native_host`; falls back
+cleanly when the toolchain is unavailable (`available()` is False and the
+pure-Python `VocabEncoder` / `hash_token` path in models/wordcount.py
+remains the ingest).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libccrdt_tokenizer.so")
+
+_lib = None
+_build_error: Optional[str] = None
+
+
+def _ensure_lib():
+    global _lib, _build_error
+    if _lib is not None or _build_error is not None:
+        return _lib
+    try:
+        if not os.path.exists(_LIB_PATH):
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR],
+                check=True,
+                capture_output=True,
+                text=True,
+            )
+        lib = ctypes.CDLL(_LIB_PATH)
+    except (subprocess.CalledProcessError, OSError) as e:
+        _build_error = str(e)
+        return None
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.ccrdt_tok_new.restype = ctypes.c_void_p
+    lib.ccrdt_tok_new.argtypes = [ctypes.c_int32]
+    lib.ccrdt_tok_free.argtypes = [ctypes.c_void_p]
+    lib.ccrdt_tok_encode.restype = ctypes.c_int64
+    lib.ccrdt_tok_encode.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+        ctypes.c_int, i32p, ctypes.c_int64,
+    ]
+    lib.ccrdt_tok_encode_batch.restype = ctypes.c_int64
+    lib.ccrdt_tok_encode_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, i64p, ctypes.c_int,
+        ctypes.c_int, i32p, ctypes.c_int64, i64p,
+    ]
+    lib.ccrdt_tok_vocab_size.restype = ctypes.c_int64
+    lib.ccrdt_tok_vocab_size.argtypes = [ctypes.c_void_p]
+    lib.ccrdt_tok_vocab_dump.restype = ctypes.c_int64
+    lib.ccrdt_tok_vocab_dump.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64
+    ]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _ensure_lib() is not None
+
+
+def build_error() -> Optional[str]:
+    _ensure_lib()
+    return _build_error
+
+
+class NativeTokenizer:
+    """Corpus tokenizer over the C++ library.
+
+    n_buckets > 0: hashed vocabulary (FNV-1a % n_buckets, byte-identical to
+    models/wordcount.py:hash_token). n_buckets == 0: exact vocabulary grown
+    on demand, ids dense in first-appearance order (VocabEncoder parity up
+    to per-document ordering: the native encoder emits deduped tokens in
+    first-appearance rather than sorted order — counts are unaffected).
+    """
+
+    def __init__(self, n_buckets: int = 0):
+        lib = _ensure_lib()
+        if lib is None:
+            raise RuntimeError(f"native tokenizer unavailable: {_build_error}")
+        self._lib = lib
+        self._h = lib.ccrdt_tok_new(n_buckets)
+        self.n_buckets = n_buckets
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.ccrdt_tok_free(h)
+            self._h = None
+
+    def encode_batch(
+        self, docs: Sequence[str], per_document: bool = False
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Tokenize+encode a document batch in one C call.
+
+        Returns (token_ids i32[N], doc_end i64[n_docs]) where document i's
+        tokens span token_ids[doc_end[i-1]:doc_end[i]].
+        """
+        if not docs:
+            return np.zeros(0, np.int32), np.zeros(0, np.int64)
+        blobs = [d.encode("utf-8") for d in docs]
+        offsets = np.zeros(len(blobs) + 1, np.int64)
+        np.cumsum([len(b) for b in blobs], out=offsets[1:])
+        buf = b"".join(blobs)
+        # Worst case one token per byte plus one trailing empty per doc.
+        cap = len(buf) + len(blobs)
+        out = np.empty(cap, np.int32)
+        doc_end = np.empty(len(blobs), np.int64)
+        n = self._lib.ccrdt_tok_encode_batch(
+            self._h,
+            buf,
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(blobs),
+            1 if per_document else 0,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            cap,
+            doc_end.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+        assert n <= cap, (n, cap)  # cap is a proven upper bound
+        return out[:n].copy(), doc_end
+
+    def vocab_size(self) -> int:
+        return int(self._lib.ccrdt_tok_vocab_size(self._h))
+
+    def vocab(self) -> List[str]:
+        """Exact-mode id-ordered token list (hashed mode has no vocab)."""
+        if self.n_buckets > 0:
+            raise ValueError("hashed tokenizer has no materialized vocab")
+        if self.vocab_size() == 0:
+            return []
+        need = self._lib.ccrdt_tok_vocab_dump(self._h, None, 0)
+        buf = ctypes.create_string_buffer(int(need))
+        self._lib.ccrdt_tok_vocab_dump(self._h, buf, need)
+        return buf.raw[:need].decode("utf-8").split("\n")
+
+
+def wordcount_ops_from_docs(
+    docs_per_replica: Sequence[Sequence[str]],
+    n_buckets: int,
+    per_document: bool = False,
+    key: int = 0,
+):
+    """Data-loader: corpus -> dense `WordcountOps` (one padded token batch
+    per replica) through the native tokenizer. The standing replacement for
+    per-document Python encoding on the streaming-corpus benchmark config
+    (BASELINE.md: wordcount, 64 replicas, ragged vocab)."""
+    import jax.numpy as jnp
+
+    from ..models.wordcount import WordcountOps
+
+    tok = NativeTokenizer(n_buckets)
+    encoded = [
+        tok.encode_batch(docs, per_document=per_document)[0]
+        for docs in docs_per_replica
+    ]
+    B = max((len(e) for e in encoded), default=0)
+    R = len(encoded)
+    tokens = np.full((R, B), -1, np.int32)  # -1 = padding
+    for r, e in enumerate(encoded):
+        tokens[r, : len(e)] = e
+    return WordcountOps(
+        key=jnp.full((R, B), key, jnp.int32),
+        token=jnp.asarray(tokens),
+    )

@@ -1,0 +1,181 @@
+// Native corpus tokenizer / data-loader for the wordcount CCRDTs.
+//
+// The reference tokenizes inside update/2: binary:split(Doc, ["\n", " "],
+// [global]) — splitting on '\n' and ' ' and KEEPING empty segments, which
+// it then counts like any word (antidote_ccrdt_wordcount.erl:76-85).
+// worddocumentcount additionally dedupes tokens within one document
+// through a gb_set (antidote_ccrdt_worddocumentcount.erl:76-86).
+//
+// In the TPU pipeline documents are tokenized host-side into int32 token
+// ids and the device only sees id batches (models/wordcount.py). This file
+// moves that host-side hot loop out of Python: a whole corpus chunk
+// (documents concatenated into one buffer + offsets) is tokenized, deduped
+// and encoded in one C call.
+//
+// Two encoding modes, matching the Python encoders exactly:
+//  * hashed  (n_buckets > 0): FNV-1a 32-bit % n_buckets — byte-identical
+//    to models/wordcount.py:hash_token (stable across runs/processes);
+//  * exact   (n_buckets == 0): grow-on-demand token -> dense id vocabulary
+//    (VocabEncoder parity), dumpable for host-side decode.
+//
+// Per-document dedup happens on the token STRING before hashing/encoding
+// (two distinct words colliding in hashed mode still contribute 2 to the
+// shared bucket — same as the Python path).
+
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+inline uint32_t Fnv1a(const char* s, size_t n) {
+  uint32_t h = 2166136261u;
+  for (size_t i = 0; i < n; ++i) {
+    h = (h ^ static_cast<uint8_t>(s[i])) * 16777619u;
+  }
+  return h;
+}
+
+struct StringPiece {
+  const char* data;
+  size_t len;
+  bool operator==(const StringPiece& o) const {
+    return len == o.len && std::memcmp(data, o.data, len) == 0;
+  }
+};
+
+struct PieceHash {
+  size_t operator()(const StringPiece& p) const {
+    return Fnv1a(p.data, p.len);
+  }
+};
+
+class Tokenizer {
+ public:
+  explicit Tokenizer(int32_t n_buckets) : buckets_(n_buckets) {}
+
+  // Tokenize [buf, buf+len): emit one id per token (empties included).
+  // per_document: dedupe token strings within this document first, in
+  // first-appearance order (the gb_set sorts, but counts are order-
+  // independent so first-appearance is equivalent for the CRDT).
+  // Returns the number of ids written (never exceeds cap; the true count
+  // is returned so callers can detect truncation).
+  int64_t Encode(const char* buf, int64_t len, int per_document,
+                 int32_t* out, int64_t cap) {
+    int64_t n_out = 0;
+    seen_.clear();
+    const char* p = buf;
+    const char* end = buf + len;
+    const char* tok = p;
+    for (;; ++p) {
+      if (p == end || *p == '\n' || *p == ' ') {
+        StringPiece piece{tok, static_cast<size_t>(p - tok)};
+        bool emit = true;
+        if (per_document) emit = seen_.insert(piece).second;
+        if (emit) {
+          int32_t id = EncodeToken(piece);
+          if (n_out < cap) out[n_out] = id;
+          ++n_out;
+        }
+        tok = p + 1;
+      }
+      if (p == end) break;
+    }
+    return n_out;
+  }
+
+  int32_t EncodeToken(const StringPiece& piece) {
+    if (buckets_ > 0) {
+      return static_cast<int32_t>(Fnv1a(piece.data, piece.len) %
+                                  static_cast<uint32_t>(buckets_));
+    }
+    auto it = vocab_.find(piece);
+    if (it != vocab_.end()) return it->second;
+    // Own the bytes: the piece points into the caller's buffer.
+    storage_.emplace_back(piece.data, piece.len);
+    const std::string& owned = storage_.back();
+    int32_t id = static_cast<int32_t>(storage_.size()) - 1;
+    vocab_.emplace(StringPiece{owned.data(), owned.size()}, id);
+    return id;
+  }
+
+  int64_t VocabSize() const {
+    return buckets_ > 0 ? buckets_ : static_cast<int64_t>(storage_.size());
+  }
+
+  // Dump the exact-mode vocabulary as id-ordered tokens joined by '\n'
+  // (tokens never contain '\n' or ' ' — they are split on them; the empty
+  // token round-trips as an empty line). Returns the required byte count;
+  // writes at most cap bytes.
+  int64_t VocabDump(char* out, int64_t cap) const {
+    int64_t need = 0;
+    for (size_t i = 0; i < storage_.size(); ++i) {
+      need += static_cast<int64_t>(storage_[i].size()) + (i ? 1 : 0);
+    }
+    if (out == nullptr || cap < need) return need;
+    char* w = out;
+    for (size_t i = 0; i < storage_.size(); ++i) {
+      if (i) *w++ = '\n';
+      std::memcpy(w, storage_[i].data(), storage_[i].size());
+      w += storage_[i].size();
+    }
+    return need;
+  }
+
+ private:
+  int32_t buckets_;
+  // Exact mode: vocabulary keyed by pieces pointing into storage_. A deque
+  // never relocates elements on push_back, so the StringPiece keys stay
+  // valid (a vector<string> would move short SSO strings on growth and
+  // dangle their inline character buffers).
+  std::unordered_map<StringPiece, int32_t, PieceHash> vocab_;
+  std::deque<std::string> storage_;
+  std::unordered_set<StringPiece, PieceHash> seen_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ccrdt_tok_new(int32_t n_buckets) { return new Tokenizer(n_buckets); }
+
+void ccrdt_tok_free(void* t) { delete static_cast<Tokenizer*>(t); }
+
+int64_t ccrdt_tok_encode(void* t, const char* buf, int64_t len,
+                         int per_document, int32_t* out, int64_t cap) {
+  return static_cast<Tokenizer*>(t)->Encode(buf, len, per_document, out, cap);
+}
+
+// Batch ingest: n_docs documents concatenated in `buf`, document i spanning
+// [offsets[i], offsets[i+1]). Token ids append into `out` (capacity `cap`);
+// out_doc_end[i] receives the cumulative token count after document i.
+// Returns the total token count (callers compare with cap for truncation).
+int64_t ccrdt_tok_encode_batch(void* t, const char* buf,
+                               const int64_t* offsets, int n_docs,
+                               int per_document, int32_t* out, int64_t cap,
+                               int64_t* out_doc_end) {
+  Tokenizer* tok = static_cast<Tokenizer*>(t);
+  int64_t total = 0;
+  for (int i = 0; i < n_docs; ++i) {
+    const char* doc = buf + offsets[i];
+    int64_t len = offsets[i + 1] - offsets[i];
+    int64_t room = cap > total ? cap - total : 0;
+    total += tok->Encode(doc, len, per_document, out + total, room);
+    if (out_doc_end) out_doc_end[i] = total;
+  }
+  return total;
+}
+
+int64_t ccrdt_tok_vocab_size(void* t) {
+  return static_cast<Tokenizer*>(t)->VocabSize();
+}
+
+int64_t ccrdt_tok_vocab_dump(void* t, char* out, int64_t cap) {
+  return static_cast<Tokenizer*>(t)->VocabDump(out, cap);
+}
+
+}  // extern "C"

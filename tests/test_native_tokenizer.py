@@ -1,0 +1,113 @@
+"""Native tokenizer: parity with the Python ingest path (which itself
+mirrors wordcount.erl:76-85 / worddocumentcount.erl:76-86 semantics)."""
+
+import collections
+
+import numpy as np
+import pytest
+
+from antidote_ccrdt_tpu.harness import native_tokenizer as nt
+from antidote_ccrdt_tpu.models.wordcount import VocabEncoder, hash_token, tokenize
+
+pytestmark = pytest.mark.skipif(
+    not nt.available(), reason=f"native toolchain unavailable: {nt.build_error()}"
+)
+
+DOCS = [
+    "the quick brown fox",
+    "the  quick\nfox",  # double space + newline -> empty token (parity!)
+    "",  # empty doc -> one empty token
+    "a a a b",
+    "unicode été café café",
+]
+
+
+def test_hashed_matches_python_hash_token():
+    V = 97
+    tok = nt.NativeTokenizer(V)
+    ids, doc_end = tok.encode_batch(DOCS)
+    expect = []
+    for d in DOCS:
+        expect.extend(hash_token(t, V) for t in tokenize(d))
+    assert ids.tolist() == expect
+    assert doc_end.tolist() == list(
+        np.cumsum([len(tokenize(d)) for d in DOCS])
+    )
+
+
+def test_exact_vocab_counts_match_vocab_encoder():
+    tok = nt.NativeTokenizer(0)
+    ids, _ = tok.encode_batch(DOCS)
+    vocab = tok.vocab()
+    assert len(vocab) == tok.vocab_size()
+    native_counts = collections.Counter(vocab[i] for i in ids)
+
+    enc = VocabEncoder()
+    py_ids = []
+    for d in DOCS:
+        py_ids.extend(enc.encode(d))
+    inv = {i: t for t, i in enc.vocab.items()}
+    py_counts = collections.Counter(inv[i] for i in py_ids)
+    assert native_counts == py_counts
+
+
+def test_per_document_dedup_parity():
+    tok = nt.NativeTokenizer(0)
+    ids, doc_end = tok.encode_batch(DOCS, per_document=True)
+    vocab = tok.vocab()
+    prev = 0
+    for d, end in zip(DOCS, doc_end.tolist()):
+        words = [vocab[i] for i in ids[prev:end]]
+        assert sorted(words) == sorted(set(tokenize(d))), d
+        prev = end
+
+
+def test_empty_token_in_vocab_roundtrip():
+    tok = nt.NativeTokenizer(0)
+    ids, _ = tok.encode_batch(["a  b"])  # 'a', '', 'b'
+    vocab = tok.vocab()
+    assert [vocab[i] for i in ids] == ["a", "", "b"]
+
+
+def test_dense_ops_loader_counts():
+    """End-to-end: docs -> native ops -> dense wordcount == scalar counts."""
+    from antidote_ccrdt_tpu.models.wordcount import WordcountScalar, make_dense
+
+    V = 64
+    docs_per_replica = [DOCS[:3], DOCS[3:]]
+    ops = nt.wordcount_ops_from_docs(docs_per_replica, n_buckets=V)
+    D = make_dense(V)
+    st = D.init(n_replicas=2, n_keys=1)
+    st, _ = D.apply_ops(st, ops)
+    merged = np.asarray(st.counts).sum(axis=0)[0]
+
+    S = WordcountScalar()
+    sc = S.new()
+    for d in DOCS:
+        sc, _ = S.update(("add", d), sc)
+    expect = np.zeros(V, np.int64)
+    for w, c in S.value(sc).items():
+        expect[hash_token(w, V)] += c
+    assert merged.tolist() == expect.tolist()
+
+
+def test_vocab_growth_across_batches():
+    """Exact vocab persists across encode_batch calls (streaming corpus),
+    and dangling-reference hazards on vocab growth do not corrupt lookups."""
+    tok = nt.NativeTokenizer(0)
+    rng = np.random.default_rng(0)
+    words = [f"w{i}" for i in range(2000)]
+    seen = {}
+    for chunk in range(20):
+        docs = [
+            " ".join(rng.choice(words, 50)) for _ in range(10)
+        ]
+        ids, _ = tok.encode_batch(docs)
+        vocab = tok.vocab()
+        # global invariant: every id decodes to a token that re-encodes to it
+        for i in set(ids.tolist()):
+            t = vocab[i]
+            if t in seen:
+                assert seen[t] == i
+            seen[t] = i
+    assert tok.vocab_size() == len(set(seen))

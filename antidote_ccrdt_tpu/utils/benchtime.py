@@ -1,0 +1,75 @@
+"""Shared benchmark timing discipline for bench.py / benchmarks/*.
+
+Centralizes the three measurement rules every benchmark in this repo must
+follow (previously duplicated between bench.py and benchmarks/bench_all.py):
+
+1. **Host-readback sync.** On tunneled TPU backends (axon)
+   `jax.block_until_ready` returns while the device is still executing
+   (measured), so every timed region must close with `sync()` — a real
+   device->host transfer of one element.
+2. **Scan-fused windows.** Per-dispatch tunnel overhead is 10-30ms; rounds
+   are stacked into [W, ...] op pytrees and run as one `lax.scan` dispatch
+   per window so the measurement is true device throughput.
+3. **Distinct per-round batches.** Each round in a window gets freshly
+   generated ops, defeating loop-invariant hoisting of the op upload.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Sequence, Tuple
+
+import numpy as np
+
+
+def sync(x):
+    """Force completion via host readback of one leaf element."""
+    import jax
+
+    return np.asarray(jax.tree.leaves(x)[0].ravel()[0])
+
+
+def stack_rounds(batches: Sequence):
+    """Stack per-round op pytrees into one [W, ...] window pytree."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *batches)
+
+
+def windowed(
+    apply_fn: Callable,
+    state,
+    stacked_windows: Sequence,
+    ops_per_round: int,
+) -> Tuple[float, float]:
+    """Time W-round scan-fused windows; returns (ops/sec, ms/round p50).
+
+    `stacked_windows[0]` is the compile+warmup window and is not timed.
+    Per-round latency is window_time / W — a smoothed estimator (individual
+    rounds inside one dispatch cannot be timed without per-round syncs,
+    which would measure tunnel RTT instead of compute).
+    """
+    import jax
+    from jax import lax
+
+    @jax.jit
+    def run(state, stacked):
+        def body(st, ops):
+            return apply_fn(st, ops), ()
+
+        out, _ = lax.scan(body, state, stacked)
+        return out
+
+    W = len(jax.tree.leaves(stacked_windows[0])[0])
+    state = run(state, stacked_windows[0])  # compile + warm
+    sync(state)
+    times = []
+    for stacked in stacked_windows[1:]:
+        t0 = time.perf_counter()
+        state = run(state, stacked)
+        sync(state)
+        times.append((time.perf_counter() - t0) / W)
+    per_round = float(np.percentile(times, 50))
+    total_ops = ops_per_round * W * len(times)
+    return total_ops / (sum(times) * W), per_round * 1e3

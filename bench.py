@@ -32,14 +32,14 @@ import time
 import numpy as np
 
 
-def _sync(x):
-    """Force completion via host readback of one element. On tunneled TPU
-    backends `block_until_ready` does NOT block (measured: it returns while
-    the device is still executing), so every timing here closes with a real
-    device->host transfer."""
-    import jax
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-    return np.asarray(jax.tree.leaves(x)[0].ravel()[0])
+# Shared measurement discipline (host-readback sync, round stacking); see
+# utils/benchtime.py for why block_until_ready is not enough here.
+from antidote_ccrdt_tpu.utils.benchtime import (  # noqa: E402
+    stack_rounds as _stack_rounds,
+    sync as _sync,
+)
 
 
 def bench_dense(R, I, D_DCS, K, M, B, Br, windows, rounds_per_window):
@@ -62,8 +62,7 @@ def bench_dense(R, I, D_DCS, K, M, B, Br, windows, rounds_per_window):
     # amortized and the measurement is true device throughput.
     window_batches = []
     for _ in range(windows + 1):
-        bs = [gen.next_batch(B, Br) for _ in range(W)]
-        window_batches.append(jax.tree.map(lambda *xs: jnp.stack(xs), *bs))
+        window_batches.append(_stack_rounds([gen.next_batch(B, Br) for _ in range(W)]))
 
     @jax.jit
     def run_window(state, stacked):

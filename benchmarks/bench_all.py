@@ -1,0 +1,233 @@
+"""Benchmarks for every BASELINE.md config beyond the north star (bench.py
+covers topk_rmv): average, topk, leaderboard, wordcount, and the
+worddocumentcount streaming-corpus ingest (native tokenizer -> device).
+
+Measurement discipline is shared with bench.py via
+`antidote_ccrdt_tpu.utils.benchtime`: scan-fused multi-round windows (one
+dispatch per window), distinct per-round op batches (defeats loop-invariant
+hoisting), and host-readback syncs (block_until_ready does not block on
+tunneled backends). Prints one JSON line per config.
+
+Run: python benchmarks/bench_all.py
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from antidote_ccrdt_tpu.utils.benchtime import (  # noqa: E402
+    stack_rounds,
+    sync,
+    windowed,
+)
+
+
+def on_cpu() -> bool:
+    import jax
+
+    return jax.default_backend() == "cpu"
+
+
+def sized(tpu, cpu):
+    """Pick config by backend: full sizes on an accelerator, shrunk sizes on
+    CPU so CI / no-accelerator runs still complete (cf. bench.py main)."""
+    return cpu if on_cpu() else tpu
+
+
+def bench_average():
+    import jax.numpy as jnp
+
+    from antidote_ccrdt_tpu.models.average import AverageDense, AverageOps
+
+    R, NK, B, W, NW = sized((2, 1000, 8192, 8, 4), (2, 1000, 1024, 3, 3))
+    D = AverageDense()
+    state = D.init(R, NK)
+    rng = np.random.default_rng(0)
+
+    def batch():
+        return AverageOps(
+            key=jnp.asarray(rng.integers(0, NK, (R, B)).astype(np.int32)),
+            value=jnp.asarray(rng.integers(-100, 100, (R, B)).astype(np.int32)),
+            count=jnp.asarray(rng.integers(1, 3, (R, B)).astype(np.int32)),
+        )
+
+    wins = [stack_rounds([batch() for _ in range(W)]) for _ in range(NW + 1)]
+    rate, p50 = windowed(lambda s, o: D.apply_ops(s, o)[0], state, wins, R * B)
+    return {"metric": f"average adds/sec ({NK} keys x {R} replicas)",
+            "value": round(rate), "unit": "ops/sec", "p50_round_ms": round(p50, 2)}
+
+
+def bench_topk():
+    import jax.numpy as jnp
+
+    from antidote_ccrdt_tpu.models.topk import TopkOps, make_dense
+
+    R, I, B, W, NW = sized((8, 10_000, 8192, 8, 4), (4, 2_000, 1024, 3, 3))
+    D = make_dense(n_ids=I, size=100)
+    state = D.init(R, 1)
+    rng = np.random.default_rng(0)
+
+    def batch():
+        return TopkOps(
+            key=jnp.zeros((R, B), jnp.int32),
+            id=jnp.asarray(rng.integers(0, I, (R, B)).astype(np.int32)),
+            score=jnp.asarray(rng.integers(1, 10**6, (R, B)).astype(np.int32)),
+            valid=jnp.ones((R, B), bool),
+        )
+
+    wins = [stack_rounds([batch() for _ in range(W)]) for _ in range(NW + 1)]
+    rate, p50 = windowed(lambda s, o: D.apply_ops(s, o)[0], state, wins, R * B)
+    return {"metric": f"topk adds/sec ({I//1000}k ids x {R} replicas, K=100)",
+            "value": round(rate), "unit": "ops/sec", "p50_round_ms": round(p50, 2)}
+
+
+def bench_leaderboard():
+    import jax.numpy as jnp
+
+    from antidote_ccrdt_tpu.models.leaderboard import LeaderboardOps, make_dense
+
+    R, P, B, Bb, W, NW = sized(
+        (16, 1_000_000, 8192, 64, 8, 4), (4, 50_000, 1024, 16, 3, 3)
+    )
+    D = make_dense(n_players=P, size=100)
+    state = D.init(R, 1)
+    rng = np.random.default_rng(0)
+
+    def zipf_ids(n):
+        raw = rng.zipf(1.2, size=n)
+        return ((raw - 1) % P).astype(np.int32)
+
+    def batch():
+        return LeaderboardOps(
+            add_key=jnp.zeros((R, B), jnp.int32),
+            add_id=jnp.asarray(np.stack([zipf_ids(B) for _ in range(R)])),
+            add_score=jnp.asarray(rng.integers(1, 10**6, (R, B)).astype(np.int32)),
+            add_valid=jnp.ones((R, B), bool),
+            ban_key=jnp.zeros((R, Bb), jnp.int32),
+            ban_id=jnp.asarray(np.stack([zipf_ids(Bb) for _ in range(R)])),
+            ban_valid=jnp.ones((R, Bb), bool),
+        )
+
+    wins = [stack_rounds([batch() for _ in range(W)]) for _ in range(NW + 1)]
+    rate, p50 = windowed(
+        lambda s, o: D.apply_ops(s, o)[0], state, wins, R * (B + Bb)
+    )
+    players = f"{P//10**6}M" if P >= 10**6 else f"{P//1000}k"
+    return {"metric": f"leaderboard ops/sec ({players} players x {R} replicas, Zipf)",
+            "value": round(rate), "unit": "ops/sec", "p50_round_ms": round(p50, 2)}
+
+
+def bench_wordcount():
+    import jax.numpy as jnp
+
+    from antidote_ccrdt_tpu.models.wordcount import WordcountOps, make_dense
+
+    R, V, B, W, NW = sized((64, 1 << 16, 8192, 8, 4), (8, 1 << 12, 1024, 3, 3))
+    D = make_dense(V)
+    state = D.init(R, 1)
+    rng = np.random.default_rng(0)
+
+    def batch():
+        # Zipf token stream (ragged-vocab stand-in, already hashed)
+        raw = rng.zipf(1.1, size=(R, B))
+        return WordcountOps(
+            key=jnp.zeros((R, B), jnp.int32),
+            token=jnp.asarray(((raw - 1) % V).astype(np.int32)),
+        )
+
+    wins = [stack_rounds([batch() for _ in range(W)]) for _ in range(NW + 1)]
+    rate, p50 = windowed(lambda s, o: D.apply_ops(s, o)[0], state, wins, R * B)
+    return {"metric": f"wordcount tokens/sec ({R} replicas, V={V>>10}k hashed)",
+            "value": round(rate), "unit": "tokens/sec", "p50_round_ms": round(p50, 2)}
+
+
+def bench_worddocumentcount():
+    """Streaming-corpus ingest end to end: raw document strings -> native
+    tokenizer (tokenize, per-document dedup, FNV-1a hash) -> device
+    scatter-add. This is the half of the BASELINE 64-replica config that
+    bench_wordcount's pre-hashed token stream does not exercise."""
+    import jax
+
+    from antidote_ccrdt_tpu.harness import native_tokenizer as nt
+    from antidote_ccrdt_tpu.models.wordcount import hash_token, make_dense
+
+    R, V, DOCS, WORDS = sized((64, 1 << 16, 512, 64), (8, 1 << 12, 32, 16))
+    D = make_dense(V)
+    state = D.init(R, 1)
+    rng = np.random.default_rng(0)
+
+    # Synthetic ragged corpus: Zipf word frequencies, known raw token count.
+    def make_docs():
+        out = []
+        for _ in range(R):
+            ids = (rng.zipf(1.1, size=(DOCS, WORDS)) - 1) % 50_000
+            out.append([" ".join(f"w{t}" for t in row) for row in ids])
+        return out
+
+    docs = make_docs()
+    raw_tokens = R * DOCS * WORDS
+
+    import jax.numpy as jnp
+
+    from antidote_ccrdt_tpu.models.wordcount import WordcountOps, tokenize
+
+    t0 = time.perf_counter()
+    if nt.available():
+        tok = nt.NativeTokenizer(V)
+        enc = [tok.encode_batch(per_r, per_document=True)[0] for per_r in docs]
+        path = "native"
+    else:  # pure-Python fallback (toolchain unavailable)
+        enc = [
+            np.asarray(
+                [hash_token(t, V) for d in per_r for t in set(tokenize(d))],
+                np.int32,
+            )
+            for per_r in docs
+        ]
+        path = "python-fallback"
+    B = max(len(e) for e in enc)
+    tokens_np = np.full((R, B), -1, np.int32)  # -1 = padding
+    for r, e in enumerate(enc):
+        tokens_np[r, : len(e)] = e
+    keys_np = np.zeros((R, B), np.int32)
+    t_encode = time.perf_counter() - t0
+
+    # Fresh jnp.asarray each call so the timed region pays the host->device
+    # upload of the token batch (benchtime rule #3: never reuse resident ops).
+    def mk_ops():
+        return WordcountOps(key=jnp.asarray(keys_np), token=jnp.asarray(tokens_np))
+
+    apply_jit = jax.jit(lambda s, o: D.apply_ops(s, o)[0])
+    state = apply_jit(state, mk_ops())  # compile + warm
+    sync(state)
+    t0 = time.perf_counter()
+    state = apply_jit(state, mk_ops())
+    sync(state)
+    t_apply = time.perf_counter() - t0
+
+    return {
+        "metric": f"worddocumentcount corpus tokens/sec ({R} replicas, "
+                  f"{DOCS} docs/replica, ingest={path})",
+        "value": round(raw_tokens / (t_encode + t_apply)),
+        "unit": "tokens/sec",
+        "encode_ms": round(t_encode * 1e3, 2),
+        "apply_ms": round(t_apply * 1e3, 2),
+    }
+
+
+def main():
+    import jax
+
+    for fn in (bench_average, bench_topk, bench_leaderboard, bench_wordcount,
+               bench_worddocumentcount):
+        out = fn()
+        out["backend"] = jax.default_backend()
+        print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

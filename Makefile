@@ -1,0 +1,47 @@
+# Build layer — the rebuild's counterpart of the reference's rebar3
+# Makefile (reference: Makefile:1-32, rebar.config:1-9).
+#
+# Target parity map:
+#   reference `make compile` (warnings_as_errors)  -> `make compile`
+#   reference `make test`    (rebar3 eunit)        -> `make test`
+#   reference `make cover`   (rebar3 cover)        -> (no coverage tool in
+#       this image; the test tiers in tests/ are the coverage story)
+#   reference `make dialyzer`/xref undefined-call  -> `make xref`
+#       (import-resolution check over every package module)
+# plus targets the reference has no equivalent of:
+#   `make native`  — C++ host runtime + tokenizer (native/)
+#   `make bench`   — north-star benchmark (one JSON line)
+#   `make benchall`— every BASELINE.md config
+
+PY ?= python
+
+.PHONY: all compile test xref native bench benchall dryrun clean
+
+all: compile xref test
+
+compile: native
+	$(PY) -W error::SyntaxWarning -m compileall -q antidote_ccrdt_tpu tests scripts benchmarks bench.py __graft_entry__.py
+
+test:
+	$(PY) -m pytest tests/ -q
+
+# xref: every module in the package must import cleanly (catches undefined
+# imports the way rebar.config:8's xref undefined_function_calls check does).
+xref:
+	$(PY) scripts/xref.py
+
+native:
+	$(MAKE) -C native
+
+bench:
+	$(PY) bench.py
+
+benchall:
+	$(PY) benchmarks/bench_all.py
+
+dryrun:
+	$(PY) __graft_entry__.py
+
+clean:
+	rm -rf native/build
+	find . -name __pycache__ -type d -not -path './.git/*' -exec rm -rf {} +

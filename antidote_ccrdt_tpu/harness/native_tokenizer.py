@@ -19,7 +19,12 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+# Source checkout puts native/ two levels up; installed wheels don't ship
+# it, so CCRDT_NATIVE_DIR lets an installed package point at a built tree.
+_NATIVE_DIR = os.environ.get(
+    "CCRDT_NATIVE_DIR",
+    os.path.join(os.path.dirname(__file__), "..", "..", "native"),
+)
 _LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libccrdt_tokenizer.so")
 
 _lib = None

@@ -1,0 +1,47 @@
+"""xref: import every module in the package and fail on any error.
+
+The rebuild's stand-in for the reference's rebar3 xref undefined-call check
+(rebar.config:8) and its stale-manifest quirk (antidote_ccrdt.app.src:5-7,
+SURVEY.md §2 quirk #5): the module list here is discovered from the tree,
+never hand-maintained, so it cannot rot.
+
+Runs on CPU (no TPU needed) so it works as a pre-commit / CI gate.
+"""
+
+import importlib
+import os
+import pkgutil
+import sys
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import antidote_ccrdt_tpu
+
+    failed = []
+    mods = ["antidote_ccrdt_tpu"]
+    for m in pkgutil.walk_packages(
+        antidote_ccrdt_tpu.__path__, prefix="antidote_ccrdt_tpu."
+    ):
+        mods.append(m.name)
+    for name in mods:
+        try:
+            importlib.import_module(name)
+        except Exception:
+            failed.append(name)
+            traceback.print_exc()
+    print(f"xref: {len(mods)} modules, {len(failed)} failed")
+    if failed:
+        print("FAILED:", ", ".join(failed))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -25,21 +25,27 @@ def scatter_max_rows_mxu(
     XLA lowers scatter to a serialized per-row read-modify-write loop —
     measured ~29ms for 256 rows x 32 lanes into [100k, 32] on v5e (honest
     device timing; `block_until_ready` does not block on tunneled devices,
-    so earlier sub-ms figures were dispatch-only). The same update as a
-    one-hot matmul runs ~4.5x faster and rides the MXU:
+    so earlier sub-ms figures were dispatch-only). The one-hot matmul:
 
     1. sort updates by row; per-column suffix-max gives each duplicate run's
        head the run total (vc entries merge by per-DC max);
     2. non-head duplicates are pointed at an out-of-range row, so each table
        row receives at most ONE update and the matmul's sum == that value;
-    3. exactness: i32 values split as ``v = hi*2**12 + lo`` (hi < 2**19,
-       lo < 2**12); with ``Precision.HIGHEST`` each f32 product/sum is exact
-       below 2**24, and the pieces reassemble exactly in i32.
+    3. exactness: the one-hot is int8 and the values split into five 7-bit
+       planes packed side by side along the output axis, so the whole
+       update is ONE s8 x s8 -> s32 matmul (native MXU int path). Every
+       product is 0/1 x [0,128) and each output cell receives at most one
+       nonzero term (step 2), so s32 accumulation is exact.
+
+    Measured v5e, Br=1024, [100k, 32] table, 32 replicas under vmap:
+    XLA scatter ~31ms; f32 hi/lo matmul pair via Precision.HIGHEST (the
+    previous scheme — compiles to the slow 6-pass f32 path) ~21-27ms;
+    this s8 plane packing ~19ms.
 
     table [T, D] i32 >= 0, rows [Br] i32 (values >= T are dropped),
     upd [Br, D] i32 >= 0. Returns the updated [T, D] table.
     """
-    T = table.shape[0]
+    T, D = table.shape
     order = jnp.argsort(rows)
     r_s = jnp.take_along_axis(rows, order, axis=0)
     u_s = jnp.take_along_axis(upd, order[:, None], axis=0)
@@ -58,16 +64,19 @@ def scatter_max_rows_mxu(
 
     onehot = (
         head_rows[:, None] == jnp.arange(T, dtype=jnp.int32)[None, :]
-    ).astype(jnp.float32)  # [Br, T]
-    hi = (total >> 12).astype(jnp.float32)
-    lo = (total & 0xFFF).astype(jnp.float32)
-
-    def mm(u):
-        return lax.dot_general(
-            onehot, u, (((0,), (0,)), ((), ())), precision=lax.Precision.HIGHEST
-        ).astype(jnp.int32)  # [T, D]
-
-    delta = (mm(hi) << 12) | mm(lo)
+    ).astype(jnp.int8)  # [Br, T]
+    n_planes = 5  # 5 x 7 bits cover the 31 value bits
+    planes = jnp.concatenate(
+        [((total >> (7 * k)) & 0x7F).astype(jnp.int8) for k in range(n_planes)],
+        axis=-1,
+    )  # [Br, n_planes * D]
+    out = lax.dot_general(
+        onehot, planes, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # [T, n_planes * D]
+    delta = jnp.zeros((T, D), jnp.int32)
+    for k in range(n_planes):
+        delta = delta | (out[:, k * D : (k + 1) * D] << (7 * k))
     return jnp.maximum(table, delta)
 
 

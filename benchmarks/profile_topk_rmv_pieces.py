@@ -18,7 +18,11 @@ Measurement rules learned the hard way on the tunneled TPU backend:
 Reference numbers (v5e, R=32, I=100k, D=32, M=4, B=4096, Br=256) that
 drove the kernel choices in models/topk_rmv_dense.py are recorded in that
 module's `_apply_one_replica` docstring."""
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp

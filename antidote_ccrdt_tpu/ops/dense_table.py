@@ -80,15 +80,53 @@ def scatter_max_rows_mxu(
     return jnp.maximum(table, delta)
 
 
+_CHUNK = 2048  # hierarchical-selection chunk width
+
+
 def masked_topk(scores: jax.Array, k: int):
     """(ids, scores, valid) of the top-k entries of a [..., P] score table;
-    NEG_INF marks absent entries."""
-    ids = jnp.broadcast_to(
-        jnp.arange(scores.shape[-1], dtype=jnp.int32), scores.shape
+    NEG_INF marks absent entries. Order: score desc, id desc (both
+    reference cmp tiebreaks, topk.erl:83 / leaderboard.erl:289-294).
+
+    Exact hierarchical selection (cf. TopkRmvDense.observe): the global
+    top-k of a total order is contained in the union of per-chunk top-ks,
+    so each level replaces one huge 2-operand sort with chunked sorts and
+    a candidate re-sort, recursing while the candidate set is still wide
+    (a 1M-player leaderboard runs two levels: 1M -> ~49k -> ~2.4k).
+    Chunk padding carries id -1 and sorts after every real entry; it can
+    only surface once real entries are exhausted, where valid is False.
+    """
+    neg_s = -scores
+    neg_i = jnp.broadcast_to(
+        -jnp.arange(scores.shape[-1], dtype=jnp.int32), scores.shape
     )
-    ns, ni = lax.sort((-scores, -ids), num_keys=2, dimension=-1)
-    top = -ns[..., :k]
-    return (-ni[..., :k], top, top > NEG_INF)
+    # A level shrinks the candidate set to ceil(P/_CHUNK)*k, so it provably
+    # halves only for k <= _CHUNK//2 — beyond that a level can stall (or
+    # even grow) and the loop would hang at trace time; fall through to the
+    # single full sort in that regime.
+    while k <= _CHUNK // 2 and neg_s.shape[-1] > 2 * _CHUNK:
+        P = neg_s.shape[-1]
+        PP = ((P + _CHUNK - 1) // _CHUNK) * _CHUNK
+        pad = [(0, 0)] * (neg_s.ndim - 1) + [(0, PP - P)]
+        # Padding must sort last: -NEG_INF is the largest ascending key;
+        # id -1 gives -id = 1 > any real -id at equal score.
+        neg_s = jnp.pad(neg_s, pad, constant_values=-NEG_INF)
+        neg_i = jnp.pad(neg_i, pad, constant_values=1)
+        G = PP // _CHUNK
+        kk = min(k, _CHUNK)
+        chunked = (*neg_s.shape[:-1], G, _CHUNK)
+        ns, ni = lax.sort(
+            (neg_s.reshape(chunked), neg_i.reshape(chunked)),
+            num_keys=2, dimension=-1,
+        )
+        flat = (*neg_s.shape[:-1], G * kk)
+        neg_s = ns[..., :kk].reshape(flat)
+        neg_i = ni[..., :kk].reshape(flat)
+    ns, ni = lax.sort((neg_s, neg_i), num_keys=2, dimension=-1)
+    kf = min(k, ns.shape[-1])
+    top = -ns[..., :kf]
+    ids = -ni[..., :kf]
+    return ids, top, (top > NEG_INF) & (ids >= 0)
 
 
 def observe_value(observe_fn, state):

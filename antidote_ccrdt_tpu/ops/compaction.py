@@ -49,6 +49,7 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .dense_table import NEG_INF
@@ -62,7 +63,7 @@ KIND_RMV = 2
 KIND_RMV_R = 3
 KIND_DEAD = 4
 
-_BIG = jnp.int32(2**31 - 1)
+_BIG = np.int32(2**31 - 1)  # numpy: no backend init at import
 
 
 @jax.tree_util.register_dataclass

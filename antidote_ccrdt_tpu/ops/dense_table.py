@@ -10,10 +10,14 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
-# Safe "minus infinity" score sentinel: negatable in int32.
-NEG_INF = jnp.int32(-(2**31 - 1))
+# Safe "minus infinity" score sentinel: negatable in int32. A numpy scalar
+# (not jnp) so importing the package never initializes a JAX backend —
+# multi-process setups must be able to import, then configure jax.distributed
+# (parallel/multihost.py) before the first device op.
+NEG_INF = np.int32(-(2**31 - 1))
 
 
 def scatter_max_rows_mxu(

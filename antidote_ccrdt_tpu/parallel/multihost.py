@@ -1,0 +1,191 @@
+"""Multi-host (multi-process) distribution: the cross-host communication
+backend the reference delegates to NCCL/MPI-style infrastructure in other
+systems (SURVEY.md §5 "Distributed communication backend").
+
+One JAX process runs per host; `jax.distributed` (gRPC coordination
+service + cross-host collectives) takes the role NCCL/MPI plays in the
+CUDA world. On TPU pods the collectives ride ICI within a slice and DCN
+across slices; in CI the same compiled programs run over multi-process
+CPU (Gloo) — the tests spawn real separate OS processes
+(tests/test_multihost.py -> scripts/multihost_demo.py).
+
+Layout: the global replica axis factors as (dcn, dc) = (process, local
+device), matching the hierarchical reconciliation in `sharded.py` —
+lattice all-reduce inside each host first (ICI), then across hosts (DCN),
+so the cross-host hop carries one already-locally-merged state per host
+rather than every replica.
+
+The public pieces:
+* `initialize` — one call per process; after it, `jax.devices()` is the
+  global device list and every jitted computation is SPMD across hosts.
+* `global_replica_mesh` — ("dcn", "dc", "key") mesh over all processes.
+* `state_sharding` / `init_global_state` — place [R, NK, ...] pytrees
+  with replicas split (dcn, dc) and instances on key.
+* `ops_from_process_local` — each host contributes its own replicas' op
+  batches (`jax.make_array_from_process_local_data`); nothing global is
+  ever materialized on one host.
+* `hierarchical_reconcile` — the inter-DC merge as a two-level lattice
+  all-reduce under `shard_map`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+
+def initialize(
+    coordinator_address: str,
+    num_processes: int,
+    process_id: int,
+    cpu_devices_per_process: Optional[int] = None,
+) -> None:
+    """Join this process to the distributed runtime. Call before any JAX
+    computation. `cpu_devices_per_process` forces the CPU backend with n
+    virtual devices (the CI/multi-process-CPU rig); leave None on real TPU
+    hosts (device count comes from the topology)."""
+    import jax
+
+    if cpu_devices_per_process is not None:
+        try:
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", cpu_devices_per_process)
+        except RuntimeError as e:
+            raise RuntimeError(
+                "initialize() must run before the first JAX device op — "
+                "import the package, call initialize(), then compute. "
+                "(Package import itself is backend-free by design; some "
+                "other code touched a device first.)"
+            ) from e
+    jax.distributed.initialize(
+        coordinator_address, num_processes=num_processes, process_id=process_id
+    )
+
+
+def global_replica_mesh(n_key: int = 1):
+    """("dcn", "dc", "key") mesh over every device of every process:
+    dcn = process (cross-host hops), dc = local device, key = instance
+    shards carved out of each host's local devices."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    n_proc = max(d.process_index for d in devs) + 1
+    local = len(devs) // n_proc
+    assert local % n_key == 0, (local, n_key)
+    arr = np.array(devs).reshape(n_proc, local // n_key, n_key)
+    return Mesh(arr, ("dcn", "dc", "key"))
+
+
+def state_sharding(mesh):
+    """[R, NK, ...] pytrees: replicas split over (dcn, dc), instances over
+    key."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(("dcn", "dc"), "key"))
+
+
+def init_global_state(init_fn: Callable[[], Any], mesh) -> Any:
+    """Build a sharded global state without materializing it on one host:
+    `init_fn()` produces the full-shape (cheap, zeros) pytree under jit
+    with sharded outputs, so each device only ever holds its shard."""
+    import jax
+
+    sh = state_sharding(mesh)
+    return jax.jit(init_fn, out_shardings=sh)()
+
+
+def ops_from_process_local(local_ops: Any, mesh) -> Any:
+    """Assemble global [R, B, ...] op batches from each process's
+    [R_local, B, ...] contribution. Every process passes the ops for ITS
+    replicas only; the result is a global array whose shards live where
+    they were produced (no cross-host op shipping)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(mesh, P(("dcn", "dc")))
+    return jax.tree.map(
+        lambda a: jax.make_array_from_process_local_data(sh, np.asarray(a)),
+        local_ops,
+    )
+
+
+def hierarchical_reconcile(state: Any, merge: Callable[[Any, Any], Any], mesh):
+    """Inter-DC reconciliation over the (dcn, dc) replica grid: lattice
+    all-reduce with the CRDT join inside each host first (ICI), then
+    across hosts (DCN). After it, every replica holds the global join.
+
+    `merge` combines two single-replica states ([NK, ...] leaves, no
+    replica axis). Requires R == n_dcn * n_dc (one replica per device on
+    the replica grid): with more, co-resident replicas would be vmapped
+    past each other and never merged — rejected loudly here rather than
+    silently under-joining.
+    """
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from .dist import lattice_all_reduce
+
+    n_rep = mesh.shape["dcn"] * mesh.shape["dc"]
+    R = jax.tree.leaves(state)[0].shape[0]
+    if R != n_rep:
+        raise ValueError(
+            f"hierarchical_reconcile needs R == n_dcn*n_dc ({n_rep}), got "
+            f"R={R}: co-resident replicas would never merge"
+        )
+
+    spec = P(("dcn", "dc"), "key")
+    vmerge = jax.vmap(merge)
+
+    def local(st):
+        st = lattice_all_reduce(
+            st, "dc", vmerge, mesh.shape["dc"]
+        )
+        st = lattice_all_reduce(
+            st, "dcn", vmerge, mesh.shape["dcn"]
+        )
+        return st
+
+    return shard_map(
+        local, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
+    )(state)
+
+
+def process_local_shards(x: Any):
+    """The addressable block of a sharded global pytree, as numpy (for
+    assertions / host-side reads on each process). Shards are reassembled
+    by their index slices, so any sharding layout (replica axis, key axis,
+    both) round-trips correctly."""
+    import jax
+
+    def one(a):
+        shards = list(a.addressable_shards)
+        # Local region bounds per dim; missing starts mean unsharded dims.
+        starts = [
+            min((s.index[d].start or 0) for s in shards)
+            for d in range(a.ndim)
+        ]
+        stops = [
+            max(
+                (s.index[d].stop if s.index[d].stop is not None else a.shape[d])
+                for s in shards
+            )
+            for d in range(a.ndim)
+        ]
+        out = np.empty(
+            [hi - lo for lo, hi in zip(starts, stops)], dtype=a.dtype
+        )
+        for s in shards:
+            sel = tuple(
+                slice(
+                    (idx.start or 0) - lo,
+                    (idx.stop if idx.stop is not None else dim) - lo,
+                )
+                for idx, lo, dim in zip(s.index, starts, a.shape)
+            )
+            out[sel] = np.asarray(s.data)
+        return out
+
+    return jax.tree.map(one, x)

@@ -1,0 +1,106 @@
+"""Multi-process convergence demo/check: one OS process per simulated host.
+
+Usage: python scripts/multihost_demo.py <process_id> <num_processes> <port>
+
+Each process owns `local` CPU devices = that many replicas. Every replica
+applies a DIFFERENT deterministic op batch (seeded by global replica id, so
+any process can reconstruct the full workload for the reference check),
+then `hierarchical_reconcile` joins all replicas — inside each host, then
+across hosts over the real cross-process collective backend. Each process
+asserts its local shards' observables equal a single-process reference
+that applied and merged everything, then prints MULTIHOST-OK.
+
+Run under tests/test_multihost.py; also runnable by hand.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+LOCAL_DEVICES = 4
+I, DCS, K, M, B = 256, 8, 8, 2, 64
+
+
+def replica_ops(r: int, n_dcs: int):
+    """Deterministic per-replica op batch (any process can rebuild all)."""
+    import numpy as np
+
+    rng = np.random.default_rng(1000 + r)
+    return dict(
+        add_key=np.zeros((1, B), np.int32),
+        add_id=rng.integers(0, I, (1, B)).astype(np.int32),
+        add_score=rng.integers(1, 10_000, (1, B)).astype(np.int32),
+        add_dc=np.full((1, B), r % n_dcs, np.int32),
+        add_ts=np.arange(1, B + 1, dtype=np.int32).reshape(1, B),
+        rmv_key=np.zeros((1, 4), np.int32),
+        rmv_id=rng.integers(0, I, (1, 4)).astype(np.int32),
+        rmv_vc=rng.integers(0, B // 2, (1, 4, DCS)).astype(np.int32),
+    )
+
+
+def main() -> None:
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    from antidote_ccrdt_tpu.parallel import multihost as mh
+
+    mh.initialize(
+        f"localhost:{port}", nproc, pid, cpu_devices_per_process=LOCAL_DEVICES
+    )
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import TopkRmvOps, make_dense
+
+    R = nproc * LOCAL_DEVICES
+    D = make_dense(n_ids=I, n_dcs=DCS, size=K, slots_per_id=M)
+    mesh = mh.global_replica_mesh()
+    assert mesh.shape == {"dcn": nproc, "dc": LOCAL_DEVICES, "key": 1}, mesh.shape
+
+    state = mh.init_global_state(lambda: D.init(n_replicas=R, n_keys=1), mesh)
+
+    local_rs = range(pid * LOCAL_DEVICES, (pid + 1) * LOCAL_DEVICES)
+    local = [replica_ops(r, DCS) for r in local_rs]
+    stacked = {
+        k: np.concatenate([o[k] for o in local], axis=0) for k in local[0]
+    }
+    ops = TopkRmvOps(**mh.ops_from_process_local(stacked, mesh))
+
+    apply_sharded = jax.jit(
+        lambda st, op: D.apply_ops(st, op, collect_dominated=False)[0],
+        out_shardings=mh.state_sharding(mesh),
+    )
+    state = apply_sharded(state, ops)
+    # D.merge is shape-polymorphic over leading axes, so it serves as the
+    # single-replica combiner under hierarchical_reconcile's vmap.
+    state = mh.hierarchical_reconcile(state, D.merge, mesh)
+
+    mine = mh.process_local_shards(state)
+    obs_mine = jax.device_get(
+        D.observe(jax.tree.map(jnp.asarray, mine))
+    )
+
+    # Single-process reference: apply every replica's ops, fold all merges.
+    ref_state = D.init(n_replicas=R, n_keys=1)
+    all_ops = [replica_ops(r, DCS) for r in range(R)]
+    ref_ops = TopkRmvOps(**{
+        k: jnp.asarray(np.concatenate([o[k] for o in all_ops], axis=0))
+        for k in all_ops[0]
+    })
+    ref_state, _ = D.apply_ops(ref_state, ref_ops, collect_dominated=False)
+    folded = jax.tree.map(lambda a: a[:1], ref_state)
+    for r in range(1, R):
+        folded = D.merge(folded, jax.tree.map(lambda a: a[r : r + 1], ref_state))
+    obs_ref = jax.device_get(D.observe(folded))
+
+    for r in range(LOCAL_DEVICES):
+        assert (obs_mine.valid[r] == obs_ref.valid[0]).all()
+        v = obs_ref.valid[0]
+        assert (obs_mine.ids[r][v] == obs_ref.ids[0][v]).all()
+        assert (obs_mine.scores[r][v] == obs_ref.scores[0][v]).all()
+    print(f"MULTIHOST-OK {pid}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -179,3 +179,80 @@ def wordcount_ops_from_docs(
         key=jnp.full((R, B), key, jnp.int32),
         token=jnp.asarray(tokens),
     )
+
+
+def fnv1a_buckets(words: Sequence[str], n_buckets: int) -> np.ndarray:
+    """Vectorized FNV-1a % n_buckets over a word list, byte-identical to
+    `models.wordcount.hash_token`. Cost is O(|vocab| * max_len) numpy ops
+    — applied to the *vocabulary*, not the corpus, it is negligible."""
+    if not words:
+        return np.zeros(0, np.int32)
+    blobs = [w.encode("utf-8") for w in words]
+    L = max((len(b) for b in blobs), default=0)
+    mat = np.zeros((len(blobs), L), np.uint32)
+    lens = np.asarray([len(b) for b in blobs])
+    for i, b in enumerate(blobs):
+        mat[i, : len(b)] = np.frombuffer(b, np.uint8)
+    h = np.full(len(blobs), 2166136261, np.uint32)
+    for j in range(L):
+        live = j < lens
+        hj = ((h ^ mat[:, j]) * np.uint32(16777619)) & np.uint32(0xFFFFFFFF)
+        h = np.where(live, hj, h)
+    return (h % np.uint32(n_buckets)).astype(np.int32)
+
+
+def worddoc_arrays_from_docs(
+    docs_per_replica: Sequence[Sequence[str]],
+    n_buckets: int,
+    key: int = 0,
+):
+    """Numpy core of `worddoc_ops_from_docs` (the benchmark times the host
+    phase separately, so it needs the arrays before any device upload).
+
+    Encodes in EXACT mode (no host dedup — the tokenizer only splits and
+    ids, cheap on this 1-CPU host): the exact id is the dedup identity
+    `uniq`, so the device dedup is string-level exactly like the scalar
+    reference (two distinct words that hash-collide still count twice in
+    their shared bucket). The exact->bucket map is one vectorized FNV pass
+    over the vocabulary. Returns dict of [R, B] i32 arrays
+    (key/doc/uniq/token); token -1 marks padding."""
+    tok = NativeTokenizer(0)  # exact mode
+    encoded = []
+    for docs in docs_per_replica:
+        toks, doc_end = tok.encode_batch(docs, per_document=False)
+        lengths = np.diff(np.concatenate([[0], doc_end]))
+        encoded.append((toks, np.repeat(np.arange(len(docs)), lengths)))
+    bucket_of = fnv1a_buckets(tok.vocab(), n_buckets)
+    B = max((len(t) for t, _ in encoded), default=0)
+    R = len(encoded)
+    uniq = np.full((R, B), -1, np.int32)
+    tokens = np.full((R, B), -1, np.int32)  # -1 = padding
+    doc_ids = np.zeros((R, B), np.int32)
+    for r, (t, d) in enumerate(encoded):
+        uniq[r, : len(t)] = t
+        tokens[r, : len(t)] = bucket_of[t]
+        doc_ids[r, : len(d)] = d
+    return {
+        "key": np.full((R, B), key, np.int32),
+        "doc": doc_ids,
+        "uniq": uniq,
+        "token": tokens,
+    }
+
+
+def worddoc_ops_from_docs(
+    docs_per_replica: Sequence[Sequence[str]],
+    n_buckets: int,
+    key: int = 0,
+):
+    """Data-loader for `WordcountDense.apply_doc_ops`: raw per-token
+    records with NO host-side dedup; the per-document dedup of
+    worddocumentcount (worddocumentcount.erl:76-86) happens on device as
+    one sort over the batch, on string identity (see
+    `worddoc_arrays_from_docs`)."""
+    import jax.numpy as jnp
+
+    from ..models.wordcount import WordDocOps
+
+    arrs = worddoc_arrays_from_docs(docs_per_replica, n_buckets, key=key)
+    return WordDocOps(**{k: jnp.asarray(v) for k, v in arrs.items()})

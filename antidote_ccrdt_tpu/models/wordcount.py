@@ -130,6 +130,7 @@ import functools  # noqa: E402
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
 
 from ..core.behaviour import MergeKind  # noqa: E402
 
@@ -190,6 +191,26 @@ class WordcountOps:
     token: jax.Array  # i32[R, B]
 
 
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class WordDocOps:
+    """Raw per-token records for device-side per-document dedup
+    (`apply_doc_ops`); token < 0 marks padding. A document's records must
+    not split across batches (dedup is per batch).
+
+    `uniq` is the dedup identity and `token` the count target. They
+    differ in hashed-vocabulary mode: dedup must be on *string* identity
+    (worddocumentcount.erl:76-86 — two distinct words that hash-collide
+    still contribute 2 to the shared bucket), so `uniq` carries the
+    exact-vocabulary id and `token` the hashed bucket. In exact mode they
+    are the same array."""
+
+    key: jax.Array  # i32[R, B]
+    doc: jax.Array  # i32[R, B]
+    uniq: jax.Array  # i32[R, B]  dedup identity (exact-vocab id)
+    token: jax.Array  # i32[R, B]  count target (bucket or exact id)
+
+
 class WordcountDense:
     """Both wordcount variants share this kernel: the per-document dedup of
     worddocumentcount is an encode-time concern (VocabEncoder per_document).
@@ -223,6 +244,39 @@ class WordcountDense:
 
         counts, lost = jax.vmap(per_replica)(
             state.counts, state.lost, ops.key, ops.token
+        )
+        return WordcountDenseState(counts, lost), None
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def apply_doc_ops(self, state: WordcountDenseState, ops: "WordDocOps"):
+        """worddocumentcount ingest with the per-document dedup ON DEVICE
+        (worddocumentcount.erl:76-86 semantics): raw per-token records
+        stream in un-deduped; a sort by (key, doc, uniq) makes duplicates
+        adjacent, only run heads count, and the head's `token` (the
+        hashed bucket in hashed-vocab mode) receives the count. Dedup on
+        `uniq` — string identity — keeps hash-collision semantics equal
+        to the scalar/host paths. Moves the dedup off the host — this box
+        has one CPU, the tokenizer need only split and id — onto the TPU
+        where it is one 4-operand sort over the batch."""
+        NK = state.counts.shape[1]
+
+        def per_replica(counts, lost, key, doc, uniq, token):
+            k = jnp.where(token >= 0, key, NK)
+            ks, ds, us, ts = lax.sort((k, doc, uniq, token), num_keys=3)
+            dup = (
+                (ks == jnp.roll(ks, 1))
+                & (ds == jnp.roll(ds, 1))
+                & (us == jnp.roll(us, 1))
+            )
+            dup = dup.at[0].set(False)
+            ks = jnp.where(dup, NK, ks)  # only run heads count
+            counts = counts.at[ks, ts].add(1, mode="drop")
+            over = jnp.where(ts >= self.V, ks, NK)
+            lost = lost.at[over].add(1, mode="drop")
+            return counts, lost
+
+        counts, lost = jax.vmap(per_replica)(
+            state.counts, state.lost, ops.key, ops.doc, ops.uniq, ops.token
         )
         return WordcountDenseState(counts, lost), None
 

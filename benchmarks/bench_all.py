@@ -209,14 +209,45 @@ def bench_worddocumentcount():
     sync(state)
     t_apply = time.perf_counter() - t0
 
-    return {
+    out = [{
         "metric": f"worddocumentcount corpus tokens/sec ({R} replicas, "
-                  f"{DOCS} docs/replica, ingest={path})",
+                  f"{DOCS} docs/replica, ingest={path}, host dedup)",
         "value": round(raw_tokens / (t_encode + t_apply)),
         "unit": "tokens/sec",
         "encode_ms": round(t_encode * 1e3, 2),
         "apply_ms": round(t_apply * 1e3, 2),
-    }
+    }]
+
+    if nt.available():
+        # Device-side dedup: host only splits and ids (1 CPU here); the
+        # string-identity per-document dedup is one sort on the TPU
+        # (apply_doc_ops).
+        t0 = time.perf_counter()
+        arrs = nt.worddoc_arrays_from_docs(docs, n_buckets=V)
+        t_encode2 = time.perf_counter() - t0
+
+        from antidote_ccrdt_tpu.models.wordcount import WordDocOps
+
+        def mk_ops2():
+            return WordDocOps(**{k: jnp.asarray(v) for k, v in arrs.items()})
+
+        state2 = D.init(R, 1)
+        apply2 = jax.jit(lambda s, o: D.apply_doc_ops(s, o)[0])
+        state2 = apply2(state2, mk_ops2())  # compile + warm
+        sync(state2)
+        t0 = time.perf_counter()
+        state2 = apply2(state2, mk_ops2())
+        sync(state2)
+        t_apply2 = time.perf_counter() - t0
+        out.append({
+            "metric": f"worddocumentcount corpus tokens/sec ({R} replicas, "
+                      f"{DOCS} docs/replica, ingest=native, device dedup)",
+            "value": round(raw_tokens / (t_encode2 + t_apply2)),
+            "unit": "tokens/sec",
+            "encode_ms": round(t_encode2 * 1e3, 2),
+            "apply_ms": round(t_apply2 * 1e3, 2),
+        })
+    return out
 
 
 def main():
@@ -225,8 +256,9 @@ def main():
     for fn in (bench_average, bench_topk, bench_leaderboard, bench_wordcount,
                bench_worddocumentcount):
         out = fn()
-        out["backend"] = jax.default_backend()
-        print(json.dumps(out), flush=True)
+        for rec in out if isinstance(out, list) else [out]:
+            rec["backend"] = jax.default_backend()
+            print(json.dumps(rec), flush=True)
 
 
 if __name__ == "__main__":

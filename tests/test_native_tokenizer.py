@@ -111,3 +111,50 @@ def test_vocab_growth_across_batches():
                 assert seen[t] == i
             seen[t] = i
     assert tok.vocab_size() == len(set(seen))
+
+
+def test_fnv1a_buckets_matches_hash_token():
+    import numpy as np
+
+    from antidote_ccrdt_tpu.harness.native_tokenizer import fnv1a_buckets
+    from antidote_ccrdt_tpu.models.wordcount import hash_token
+
+    rng = np.random.default_rng(0)
+    words = ["", "a", "été", "word-with-longer-text"] + [
+        "w" + str(rng.integers(0, 10**9)) for _ in range(200)
+    ]
+    for V in (7, 1024, 1 << 16):
+        got = fnv1a_buckets(words, V)
+        assert [int(x) for x in got] == [hash_token(w, V) for w in words]
+
+
+def test_device_doc_dedup_counts_hash_collisions_twice():
+    """Two DISTINCT co-occurring words that collide into one bucket must
+    contribute 2 to it (string-identity dedup — worddocumentcount.erl:76-86
+    parity; dedup on hashed ids would wrongly count 1)."""
+    import itertools
+
+    import jax
+    import numpy as np
+    import pytest
+
+    from antidote_ccrdt_tpu.harness import native_tokenizer as nt
+    from antidote_ccrdt_tpu.models.wordcount import hash_token, make_dense
+
+    if not nt.available():
+        pytest.skip("native toolchain unavailable")
+    V = 64
+    pair = None
+    for a, b in itertools.combinations((f"t{i}" for i in range(80)), 2):
+        if hash_token(a, V) == hash_token(b, V):
+            pair = (a, b)
+            break
+    assert pair is not None
+    doc = f"{pair[0]} {pair[1]}"
+    D = make_dense(V)
+    state, _ = D.apply_doc_ops(
+        D.init(1, 1), nt.worddoc_ops_from_docs([[doc]], n_buckets=V)
+    )
+    counts = np.asarray(jax.device_get(state.counts))[0, 0]
+    assert counts[hash_token(pair[0], V)] == 2
+    assert counts.sum() == 2  # exactly the two tokens of the document

@@ -21,6 +21,7 @@ A from-scratch rebuild of the capabilities of the Erlang library
   collective merges riding ICI.
 """
 
+from .core.batch_merge import batch_merge  # noqa: F401
 from .core.behaviour import (  # noqa: F401
     DenseCCRDT,
     MergeKind,

@@ -96,6 +96,15 @@ class BridgeClient:
     def free(self, handle: Any) -> None:
         self.call((Atom("free"), handle))
 
+    def batch_merge(self, type_name: str, items: List[Any]) -> Any:
+        """Join N states (handles and/or `to_binary` blobs) in one batched
+        device pass on the worker; returns a new handle to the merged
+        state — the north-star `batch_merge` entry point. For the MONOID
+        types (average, wordcounts) the inputs' op histories must be
+        disjoint (+ is not idempotent — see core.batch_merge); the JOIN
+        types tolerate arbitrary overlap."""
+        return self.call((Atom("batch_merge"), Atom(type_name), list(items)))
+
     # -- dense grid surface ------------------------------------------------
 
     def grid_new(self, name: str, **params: int) -> None:

@@ -254,6 +254,28 @@ class BridgeServer:
             state, extras = crdt.update(op_from_term(eff_term), state)
             self._handles[h] = (name, state)
             return [op_to_term(e) for e in extras]
+        if tag == "batch_merge":
+            # {batch_merge, Type, [Handle | StateBinary, ...]} -> new handle
+            # holding the join of all inputs (the north-star entry point:
+            # N replica states merged in one batched device pass).
+            _, type_atom, items = op
+            name = str(type_atom)
+            states = []
+            for it in items:
+                if isinstance(it, (bytes, bytearray)):
+                    states.append(wire.from_reference_binary(name, it))
+                else:
+                    item_name, st = self._state(it)
+                    if item_name != name:
+                        raise ValueError(
+                            f"handle {it!r} holds {item_name!r}, not {name!r}"
+                        )
+                    states.append(st)
+            from ..core.batch_merge import batch_merge
+
+            h = self._new_handle()
+            self._handles[h] = (name, batch_merge(name, states))
+            return h
         if tag == "value":
             _, h = op
             name, state = self._state(h)

@@ -1,0 +1,317 @@
+"""`batch_merge`: merge many scalar CRDT states in one batched device pass.
+
+The north-star entry point (BASELINE.json): a host ships N replica states
+(live scalar states or their `to_binary` blobs) to the persistent worker,
+which joins them all on the accelerator and returns one merged state of
+the same scalar shape. State join is the CRDT lattice the dense engines
+already implement:
+
+  average      (s, n) pairs          combine = +   (MONOID — see below)
+  wordcount(s) word -> count         combine = +   (MONOID — see below)
+  topk         id -> best score      join = per-id max, keep top size
+  leaderboard  scores + bans         join = max / or, observable re-derived
+  topk_rmv     full add-wins state   join = slot lattice + vc max
+
+MONOID caveat: the + combiners are NOT idempotent — average and the
+wordcounts require the input states' op histories to be DISJOINT (each op
+reflected in exactly one input: delta/exactly-once semantics, the same
+causal-delivery contract the reference assumes of its host, SURVEY.md §1).
+Overlapping histories double-count. The JOIN types (topk, leaderboard,
+topk_rmv) are idempotent lattices and tolerate arbitrary overlap.
+
+Scalar states key by arbitrary (orderable) Python terms; the converter
+builds the sorted id/dc universes host-side (O(total entries) — the same
+work any serializer pays), lays states out as one [N, ...] dense batch,
+and the device folds the join pairwise in log2(N) batched dispatches.
+Conversion is exact: capacities are sized from the inputs, so the dense
+lossy flag can never set.
+
+Reference anchor: the per-type merge this batches is the state-level
+counterpart of `update/2` convergence (SURVEY.md §1 — op-based states
+that saw op sets A and B join to the state that saw A ∪ B; the tests pin
+exactly that property).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence
+
+import numpy as np
+
+from .behaviour import registry
+
+_I32_MIN, _I32_MAX = -(2**31 - 1), 2**31 - 1
+
+
+def _check_i32(x: int) -> int:
+    # Exclusive lower bound: _I32_MIN is the dense engines' "never seen"
+    # sentinel, so a real score equal to it would silently vanish in the
+    # merged state — reject it loudly instead.
+    if not (_I32_MIN < x <= _I32_MAX):
+        raise ValueError(
+            f"value {x} outside the dense engines' usable int32 range "
+            f"({_I32_MIN} is the absent-entry sentinel)"
+        )
+    return int(x)
+
+
+def _batched_fold(merge, batch: Any):
+    """Fold a [N, ...] state pytree down to [1, ...]: each round merges the
+    first half against the second half in ONE dispatch (log2(N) dispatches
+    total), carrying the odd row."""
+    import jax
+    import jax.numpy as jnp
+
+    n = jax.tree.leaves(batch)[0].shape[0]
+    while n > 1:
+        half = n // 2
+        merged = merge(
+            jax.tree.map(lambda x: x[:half], batch),
+            jax.tree.map(lambda x: x[half : 2 * half], batch),
+        )
+        if n % 2:
+            batch = jax.tree.map(
+                lambda m, t: jnp.concatenate([m, t], axis=0),
+                merged,
+                jax.tree.map(lambda x: x[2 * half :], batch),
+            )
+        else:
+            batch = merged
+        n = (n + 1) // 2
+    return batch
+
+
+def batch_merge(type_name: str, states: Sequence[Any]) -> Any:
+    """Join N scalar states of `type_name` into one. Accepts live scalar
+    states or `to_binary` blobs (mixed is fine); returns a live scalar
+    state (call the type's `to_binary` to ship it back)."""
+    if not states:
+        raise ValueError("batch_merge needs at least one state")
+    eng = registry.scalar(type_name)
+    states = [
+        eng.from_binary(s) if isinstance(s, (bytes, bytearray)) else s
+        for s in states
+    ]
+    if len(states) == 1:
+        return states[0]
+    fn = _MERGERS.get(type_name)
+    if fn is None:
+        raise ValueError(f"no batch_merge for type {type_name!r}")
+    return fn(states)
+
+
+# -- simple monoids --------------------------------------------------------
+
+
+def _merge_average(states):
+    # Two ints per state: host arithmetic (unbounded Python ints — the
+    # scalar average has no i32 range limit, and shipping 2N ints to the
+    # device would be all transfer).
+    return (sum(s for s, _ in states), sum(n for _, n in states))
+
+
+def _merge_wordcount(states):
+    import jax.numpy as jnp
+
+    vocab = sorted({w for st in states for w in st})
+    idx = {w: i for i, w in enumerate(vocab)}
+    # i32 like the dense engine's count tables (x64 is disabled; per-entry
+    # range is checked, totals share the dense path's i32 assumption).
+    table = np.zeros((len(states), len(vocab)), np.int32)
+    for r, st in enumerate(states):
+        for w, c in st.items():
+            table[r, idx[w]] = _check_i32(c)
+    if not vocab:
+        return {}
+    total = np.asarray(jnp.sum(jnp.asarray(table), axis=0))
+    return {w: int(total[i]) for w, i in idx.items() if total[i]}
+
+
+# -- score tables ----------------------------------------------------------
+
+
+def _merge_topk(states):
+    from ..models.topk import TopkState, _join
+
+    size = states[0].size
+    if any(s.size != size for s in states):
+        raise ValueError("cannot merge topk states of different sizes")
+    ids = sorted({i for st in states for i in st.entries})
+    if not ids:
+        return TopkState({}, size)
+    dense = registry.make_dense("topk", n_ids=len(ids), size=size)
+    import jax.numpy as jnp
+
+    from ..models.topk import TopkDenseState
+
+    idx = {w: i for i, w in enumerate(ids)}
+    table = np.full((len(states), 1, len(ids)), _I32_MIN, np.int32)
+    for r, st in enumerate(states):
+        for w, c in st.entries.items():
+            table[r, 0, idx[w]] = _check_i32(c)
+    folded = _batched_fold(
+        dense.merge, TopkDenseState(best_score=jnp.asarray(table))
+    )
+    best = np.asarray(folded.best_score)[0, 0]
+    # _join applies the scalar type's own top-`size` truncation rule.
+    return TopkState(
+        _join({}, ((w, int(best[i])) for w, i in idx.items() if best[i] > _I32_MIN), size),
+        size,
+    )
+
+
+def _merge_leaderboard(states):
+    import jax.numpy as jnp
+
+    from ..models.leaderboard import (
+        LeaderboardDenseState,
+        LeaderboardState,
+        NIL,
+        _min_pair,
+    )
+
+    size = states[0].size
+    if any(s.size != size for s in states):
+        raise ValueError("cannot merge leaderboard states of different sizes")
+    ids = sorted(
+        {i for st in states for i in (*st.observed, *st.masked, *st.bans)}
+    )
+    if not ids:
+        return LeaderboardState({}, {}, frozenset(), NIL, size)
+    dense = registry.make_dense("leaderboard", n_players=len(ids), size=size)
+    idx = {w: i for i, w in enumerate(ids)}
+    score = np.full((len(states), 1, len(ids)), _I32_MIN, np.int32)
+    banned = np.zeros((len(states), 1, len(ids)), bool)
+    for r, st in enumerate(states):
+        for src in (st.observed, st.masked):
+            for w, c in src.items():
+                score[r, 0, idx[w]] = max(score[r, 0, idx[w]], _check_i32(c))
+        for w in st.bans:
+            banned[r, 0, idx[w]] = True
+    folded = _batched_fold(
+        dense.merge,
+        LeaderboardDenseState(
+            best_score=jnp.asarray(score), banned=jnp.asarray(banned)
+        ),
+    )
+    f_score = np.asarray(folded.best_score)[0, 0]
+    f_ban = np.asarray(folded.banned)[0, 0]
+    live = [
+        (w, int(f_score[i]))
+        for w, i in idx.items()
+        if f_score[i] > _I32_MIN and not f_ban[i]
+    ]
+    live.sort(key=lambda p: (p[1], p[0]), reverse=True)
+    observed = dict(live[:size])
+    masked = dict(live[size:])
+    bans = frozenset(w for w, i in idx.items() if f_ban[i])
+    return LeaderboardState(observed, masked, bans, _min_pair(observed), size)
+
+
+# -- topk_rmv (full add-wins state) ----------------------------------------
+
+
+def _merge_topk_rmv(states):
+    import jax.numpy as jnp
+
+    from ..models.topk_rmv import NIL, TopkRmvState, _min_observed
+    from ..models.topk_rmv_dense import TopkRmvDenseState, _sort_slots, make_dense
+
+    size = states[0].size
+    if any(s.size != size for s in states):
+        raise ValueError("cannot merge topk_rmv states of different sizes")
+    ids = sorted({i for st in states for i in (*st.masked, *st.removals)})
+    dcs = sorted(
+        {
+            d
+            for st in states
+            for d in (
+                *st.vc,
+                *(d for vc in st.removals.values() for d in vc),
+                *(e[2][0] for es in st.masked.values() for e in es),
+            )
+        }
+    )
+    if not ids and not dcs:
+        return TopkRmvState({}, {}, {}, {}, NIL, size)
+    U, D = max(len(ids), 1), max(len(dcs), 1)
+    # Exact capacity: the union multiset of live adds per id.
+    union: Dict[Any, set] = {}
+    for st in states:
+        for w, es in st.masked.items():
+            union.setdefault(w, set()).update(es)
+    M = max((len(es) for es in union.values()), default=1)
+    id_idx = {w: i for i, w in enumerate(ids)}
+    dc_idx = {d: i for i, d in enumerate(dcs)}
+
+    N = len(states)
+    slot_score = np.full((N, 1, U, M), _I32_MIN, np.int32)
+    slot_dc = np.zeros((N, 1, U, M), np.int32)
+    slot_ts = np.zeros((N, 1, U, M), np.int32)
+    rmv_vc = np.zeros((N, 1, U, D), np.int32)
+    vc = np.zeros((N, 1, D), np.int32)
+    for r, st in enumerate(states):
+        for w, es in st.masked.items():
+            for j, (s, _i, (d, t)) in enumerate(sorted(es)):
+                slot_score[r, 0, id_idx[w], j] = _check_i32(s)
+                slot_dc[r, 0, id_idx[w], j] = dc_idx[d]
+                slot_ts[r, 0, id_idx[w], j] = _check_i32(t)
+        for w, v in st.removals.items():
+            for d, t in v.items():
+                rmv_vc[r, 0, id_idx[w], dc_idx[d]] = _check_i32(t)
+        for d, t in st.vc.items():
+            vc[r, 0, dc_idx[d]] = _check_i32(t)
+
+    dense = make_dense(n_ids=U, n_dcs=D, size=size, slots_per_id=M)
+    # Canonicalize rows to the slot invariant (sorted desc, dup-free) that
+    # the rank-arithmetic merge requires, then fold.
+    s_, d_, t_, _ = _sort_slots(
+        jnp.asarray(slot_score), jnp.asarray(slot_dc), jnp.asarray(slot_ts), M
+    )
+    batch = TopkRmvDenseState(
+        slot_score=s_, slot_dc=d_, slot_ts=t_,
+        rmv_vc=jnp.asarray(rmv_vc), vc=jnp.asarray(vc),
+        lossy=jnp.zeros((N, 1), bool),
+    )
+    folded = _batched_fold(dense.merge, batch)
+    assert not bool(np.asarray(folded.lossy).any())  # capacity sized exactly
+
+    f_score = np.asarray(folded.slot_score)[0, 0]
+    f_dc = np.asarray(folded.slot_dc)[0, 0]
+    f_ts = np.asarray(folded.slot_ts)[0, 0]
+    f_rmv = np.asarray(folded.rmv_vc)[0, 0]
+    f_vc = np.asarray(folded.vc)[0, 0]
+
+    masked = {}
+    for w, i in id_idx.items():
+        es = frozenset(
+            (int(f_score[i, j]), w, (dcs[f_dc[i, j]], int(f_ts[i, j])))
+            for j in range(M)
+            if f_ts[i, j] > 0
+        )
+        if es:
+            masked[w] = es
+    removals = {}
+    for w, i in id_idx.items():
+        v = {dcs[d]: int(f_rmv[i, d]) for d in range(D) if f_rmv[i, d]}
+        if v:
+            removals[w] = v
+    out_vc = {dcs[d]: int(f_vc[d]) for d in range(D) if f_vc[d]}
+    # Observed: top `size` per-id bests by cmp order (derived, like the
+    # dense engine's observe).
+    bests = [max(es) for es in masked.values()]
+    bests.sort(reverse=True)
+    observed = {e[1]: e for e in bests[:size]}
+    return TopkRmvState(
+        observed, masked, removals, out_vc, _min_observed(observed), size
+    )
+
+
+_MERGERS = {
+    "average": _merge_average,
+    "wordcount": _merge_wordcount,
+    "worddocumentcount": _merge_wordcount,
+    "topk": _merge_topk,
+    "leaderboard": _merge_leaderboard,
+    "topk_rmv": _merge_topk_rmv,
+}

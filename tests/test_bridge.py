@@ -178,3 +178,33 @@ def test_pipelined_requests(server):
                 got[rid] = (ok, res)
         assert set(got) == {7, 8}
         assert all(ok for ok, _ in got.values())
+
+
+def test_batch_merge_over_the_wire(client):
+    """North-star path: N topk_rmv replica states (one live handle, one
+    reference-format binary) joined in one call; result equals a state
+    that saw every op."""
+    eng = registry.scalar("topk_rmv")
+    ctxs = make_contexts(2)
+    sA, sB, s_all = eng.new(4), eng.new(4), eng.new(4)
+    effs = []
+    for j, (i, sc) in enumerate([(1, 50), (2, 90), (3, 70), (4, 60), (5, 80)]):
+        eff = eng.downstream(("add", (i, sc)), s_all, ctxs[j % 2])
+        effs.append(eff)
+        s_all, _ = eng.update(eff, s_all)
+    for eff in effs[::2]:
+        sA, _ = eng.update(eff, sA)
+    for eff in effs[1::2]:
+        sB, _ = eng.update(eff, sB)
+
+    hA = client.from_binary("topk_rmv", wire.to_reference_binary("topk_rmv", sA))
+    blobB = wire.to_reference_binary("topk_rmv", sB)
+    h = client.batch_merge("topk_rmv", [hA, blobB])
+    got = client.value(h)
+    assert sorted(map(tuple, got)) == sorted(eng.value(s_all))
+
+
+def test_batch_merge_rejects_mixed_types(client):
+    h = client.new("average", 0, 0)
+    with pytest.raises(Exception):
+        client.batch_merge("topk", [h])

@@ -11,7 +11,8 @@ A from-scratch rebuild of the capabilities of the Erlang library
 
 * **Dense level** — states as fixed-shape array pytrees with
   ``[n_replicas, n_keys, ...]`` batch axes; ``apply_ops`` / ``merge`` as
-  jitted batched kernels (the north-star ``batch_merge`` entry point).
+  jitted batched kernels, plus the north-star ``batch_merge`` entry point
+  (``core/batch_merge.py``) joining N scalar states in one device pass.
 
 * **Harness** — synthetic multi-DC replay standing in for the Antidote
   host: op generation, causal delivery, convergence checking, fault

@@ -88,9 +88,16 @@ def batch_merge(type_name: str, states: Sequence[Any]) -> Any:
     if not states:
         raise ValueError("batch_merge needs at least one state")
     eng = registry.scalar(type_name)
+
+    def decode(blob):
+        if blob[:1] == b"\x83":  # Erlang term_to_binary (ETF magic)
+            from . import wire
+
+            return wire.from_reference_binary(type_name, bytes(blob))
+        return eng.from_binary(blob)  # framework CCRD snapshot
+
     states = [
-        eng.from_binary(s) if isinstance(s, (bytes, bytearray)) else s
-        for s in states
+        decode(s) if isinstance(s, (bytes, bytearray)) else s for s in states
     ]
     if len(states) == 1:
         return states[0]

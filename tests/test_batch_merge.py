@@ -131,3 +131,19 @@ def test_single_state_identity():
 def test_empty_rejected():
     with pytest.raises(ValueError):
         batch_merge("topk", [])
+
+
+def test_accepts_reference_etf_blobs():
+    """Real Erlang term_to_binary snapshots (ETF, 0x83 magic) decode too —
+    the README's 'live states or term_to_binary blobs' claim, Python path."""
+    from antidote_ccrdt_tpu.core import wire
+
+    eng = registry.scalar("topk")
+    a = _apply_all(eng, eng.new(4), [("add", (1, 10))])
+    b = _apply_all(eng, eng.new(4), [("add", (2, 20))])
+    merged = batch_merge(
+        "topk",
+        [wire.to_reference_binary("topk", a), wire.to_reference_binary("topk", b)],
+    )
+    ref = _apply_all(eng, eng.new(4), [("add", (1, 10)), ("add", (2, 20))])
+    assert eng.equal(merged, ref)

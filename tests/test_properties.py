@@ -235,3 +235,56 @@ def test_dense_batch_order_invariance(seed, n):
     a = _state_from_log(log)
     b = _state_from_log([log[p] for p in perm])
     assert _obs(a) == _obs(b)
+
+
+# --- batch_merge properties -----------------------------------------------
+
+
+@given(
+    data=st.data(),
+    n_states=st.integers(2, 6),
+)
+@settings(max_examples=25, **SETTINGS)
+def test_batch_merge_join_types_tolerate_overlap(data, n_states):
+    """For JOIN types, batch_merge over ANY covering assignment of the op
+    stream (each op delivered to >= 1 state, possibly several) equals the
+    state that saw every op — overlap is absorbed by idempotence."""
+    from antidote_ccrdt_tpu.core.batch_merge import batch_merge
+    from antidote_ccrdt_tpu.core.clock import make_contexts
+
+    name = data.draw(st.sampled_from(["topk", "leaderboard", "topk_rmv"]))
+    eng = registry.scalar(name)
+    ctxs = make_contexts(2)
+    s_all = eng.new(5)
+    n_ops = data.draw(st.integers(1, 30))
+    effects = []
+    for step in range(n_ops):
+        if name == "topk_rmv" and s_all.observed and data.draw(st.booleans()):
+            target = data.draw(st.sampled_from(sorted(s_all.observed)))
+            op = ("rmv", target)
+        elif name == "leaderboard" and data.draw(st.integers(0, 9)) == 0:
+            op = ("ban", data.draw(ids))
+        else:
+            op = ("add", (data.draw(ids), data.draw(scores)))
+        eff = eng.downstream(op, s_all, ctxs[step % 2])
+        if eff is None:
+            continue
+        effects.append(eff)
+        s_all, extras = eng.update(eff, s_all)
+        for e in extras:
+            effects.append(e)
+            s_all, _ = eng.update(e, s_all)
+
+    states = [eng.new(5) for _ in range(n_states)]
+    for eff in effects:
+        # every op lands on at least one state; overlap is free
+        members = [
+            i for i in range(n_states) if data.draw(st.booleans())
+        ] or [data.draw(st.integers(0, n_states - 1))]
+        for i in members:
+            states[i], _ = eng.update(eff, states[i])
+
+    merged = batch_merge(name, states)
+    ref_obs = sorted(map(tuple, eng.value(s_all)))
+    got_obs = sorted(map(tuple, eng.value(merged)))
+    assert got_obs == ref_obs

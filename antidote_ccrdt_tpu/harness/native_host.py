@@ -118,6 +118,10 @@ class NativeHost:
         self._h = lib.ccrdt_host_new(n_dcs)
         if not self._h:
             raise RuntimeError("ccrdt_host_new failed")
+        # Delivered-but-not-yet-batched ops per replica (SoA dicts): the
+        # drain is exactly-once, so overflow from a batch split must be
+        # carried, never dropped or re-requested (see drain_topk_rmv_ops).
+        self._carry: dict = {}
 
     def close(self) -> None:
         if self._h:
@@ -201,28 +205,52 @@ class NativeHost:
         """Drain into a padded single-replica ``TopkRmvOps`` batch (leading
         replica axis of 1 — vmap-ready). Returns (ops, n_adds, n_rmvs).
 
-        Sized so a full drain fits: delivers at most batch_adds + batch_rmvs
-        ops, then stops (backpressure; the rest arrives next drain). Splits
-        adds/rmvs while preserving causal order *within* the batch: the
-        dense kernel applies removals' tombstones and add-domination checks
-        order-independently (lattice join), so the split is safe.
+        Delivers at most batch_adds adds and batch_rmvs rmvs per call
+        (backpressure; the rest arrives next call). The drain itself is
+        exactly-once, so when the drained window's add/rmv split overflows
+        one side, the excess is CARRIED to the next call — never dropped.
+        Both the adds/rmvs split and the carry delay are safe because the
+        dense kernel applies batches as a lattice join: tombstone
+        domination (``ts > vc[dc]``) is order-independent, so delivering a
+        removal before a causally-prior add converges identically.
         """
         import jax.numpy as jnp
 
         from ..models.topk_rmv_dense import TopkRmvOps
 
-        got = self.drain(replica, batch_adds + batch_rmvs)
+        carry = self._carry.pop(replica, None)
+        room = batch_adds + batch_rmvs - (len(carry["kind"]) if carry else 0)
+        got = self.drain(replica, max(room, 0))
+        if carry is not None:
+            got = {
+                k: np.concatenate([carry[k], got[k]], axis=0) for k in got
+            }
         is_add = got["kind"] <= KIND_ADD_R
+        a_idx = np.flatnonzero(is_add)
+        r_idx = np.flatnonzero(~is_add)
+        # A kind with zero capacity can never leave the carry — the
+        # caller's drain loop would livelock on a stuck backlog. Park the
+        # WHOLE window back in the carry (exactly-once: nothing may be
+        # lost) and fail loudly so the caller retries with usable sizes.
+        if (batch_adds == 0 and a_idx.size) or (batch_rmvs == 0 and r_idx.size):
+            self._carry[replica] = {k: v.copy() for k, v in got.items()}
+            raise ValueError(
+                "zero-capacity batch side for ops present in the stream "
+                f"(batch_adds={batch_adds}, batch_rmvs={batch_rmvs}); "
+                "carried ops retained — retry with nonzero capacities"
+            )
+        over = np.concatenate([a_idx[batch_adds:], r_idx[batch_rmvs:]])
+        if len(over):
+            over.sort()  # keep the carried ops in delivery order
+            self._carry[replica] = {k: got[k][over].copy() for k in got}
+            keep = np.ones(len(is_add), bool)
+            keep[over] = False
+            got = {k: got[k][keep] for k in got}
+            is_add = got["kind"] <= KIND_ADD_R
         adds = {k: got[k][is_add] for k in ("key", "id", "score", "dc", "ts")}
         rmvs = {k: got[k][~is_add] for k in ("key", "id")}
         rmv_vc = got["vc"][~is_add]
         na, nr = int(is_add.sum()), int((~is_add).sum())
-        if na > batch_adds or nr > batch_rmvs:
-            # Oversized split: re-run with conservative cap. Rare; the drain
-            # cap already bounds the total.
-            raise ValueError(
-                f"drained {na} adds / {nr} rmvs exceed batch {batch_adds}/{batch_rmvs}"
-            )
 
         def pad(a, n, fill):
             out = np.full(n, fill, np.int32)
@@ -248,7 +276,12 @@ class NativeHost:
     # -- introspection -----------------------------------------------------
 
     def backlog(self, replica: int) -> int:
-        return int(self._lib.ccrdt_host_backlog(self._h, replica))
+        """Undelivered-to-batch ops: native causal backlog plus any ops
+        carried over from a previous drain's batch-split overflow."""
+        carry = self._carry.get(replica)
+        return int(self._lib.ccrdt_host_backlog(self._h, replica)) + (
+            len(carry["kind"]) if carry else 0
+        )
 
     def stats(self):
         out = np.zeros(3, np.int64)

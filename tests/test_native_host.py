@@ -138,3 +138,40 @@ def test_end_to_end_dense_convergence():
         assert int(final.ids[0, 0, 0]) == top_id
         assert int(final.scores[0, 0, 0]) == 10_000
         assert h.stats()["pending"] == 0
+
+
+def test_drain_split_overflow_carries_without_loss():
+    """A drained window whose add/rmv split overflows one side must carry
+    the excess to later drains (the drain is exactly-once: raising or
+    dropping would lose ops forever). All ops eventually arrive, each
+    exactly once."""
+    if not nh.available():
+        pytest.skip("native toolchain unavailable")
+    with nh.NativeHost(2) as h:
+        for i in range(50):  # adds only: every drain window is all-adds
+            h.submit(0, nh.KIND_ADD, key=0, id_=i, score=i)
+        seen = []
+        for _ in range(50):
+            ops, na, nr = h.drain_topk_rmv_ops(0, batch_adds=8, batch_rmvs=8)
+            assert na <= 8 and nr == 0
+            ids = [int(x) for x in list(ops.add_id[0])[:na]]
+            seen.extend(ids)
+            if h.backlog(0) == 0:
+                break
+        assert sorted(seen) == list(range(50))
+        assert h.backlog(0) == 0
+
+
+def test_zero_capacity_side_raises_instead_of_livelock():
+    if not nh.available():
+        pytest.skip("native toolchain unavailable")
+    with nh.NativeHost(2) as h:
+        h.submit(0, nh.KIND_ADD, key=0, id_=1, score=5)
+        h.submit(0, nh.KIND_RMV, key=0, id_=1,
+                 vc=np.asarray([1, 0], np.int32))
+        with pytest.raises(ValueError, match="zero-capacity"):
+            for _ in range(5):
+                h.drain_topk_rmv_ops(0, batch_adds=4, batch_rmvs=0)
+        # ops were carried, not lost: a capable drain delivers them
+        ops, na, nr = h.drain_topk_rmv_ops(0, batch_adds=4, batch_rmvs=4)
+        assert (na, nr) == (1, 1) and h.backlog(0) == 0

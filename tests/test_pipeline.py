@@ -1,0 +1,124 @@
+"""Prefetcher / stream_apply: ordering, error propagation, early close,
+and result equivalence with the synchronous loop."""
+
+import threading
+import time
+
+import pytest
+
+from antidote_ccrdt_tpu.harness.pipeline import Prefetcher, stream_apply
+
+
+def test_preserves_order_and_exhausts():
+    assert list(Prefetcher(range(100), depth=3)) == list(range(100))
+
+
+def test_producer_exception_propagates():
+    def gen():
+        yield 1
+        raise RuntimeError("producer boom")
+
+    pf = Prefetcher(gen())
+    assert next(pf) == 1
+    with pytest.raises(RuntimeError, match="producer boom"):
+        next(pf)
+
+
+def test_early_close_joins_thread():
+    produced = []
+
+    def gen():
+        for i in range(10_000):
+            produced.append(i)
+            yield i
+
+    with Prefetcher(gen(), depth=2) as pf:
+        assert next(pf) == 0
+    # closed early: producer stopped far before exhaustion
+    assert len(produced) < 10_000
+
+
+def test_prefetch_runs_ahead():
+    """With depth 2, the producer gets ahead of a slow consumer."""
+    timeline = []
+
+    def gen():
+        for i in range(4):
+            timeline.append(("produced", i))
+            yield i
+
+    pf = Prefetcher(gen(), depth=2)
+    time.sleep(0.2)  # consumer idle; producer should fill the queue
+    assert ("produced", 0) in timeline and ("produced", 1) in timeline
+    assert list(pf) == [0, 1, 2, 3]
+
+
+def test_stream_apply_equals_sync_loop():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import TopkRmvOps, make_dense
+
+    D = make_dense(n_ids=64, n_dcs=2, size=8, slots_per_id=2)
+    rng = np.random.default_rng(0)
+
+    def mk_batch(seed):
+        r = np.random.default_rng(seed)
+        return TopkRmvOps(
+            add_key=jnp.zeros((2, 16), jnp.int32),
+            add_id=jnp.asarray(r.integers(0, 64, (2, 16)).astype(np.int32)),
+            add_score=jnp.asarray(r.integers(1, 500, (2, 16)).astype(np.int32)),
+            add_dc=jnp.asarray(r.integers(0, 2, (2, 16)).astype(np.int32)),
+            add_ts=jnp.asarray(r.integers(1, 100, (2, 16)).astype(np.int32)),
+            rmv_key=jnp.zeros((2, 2), jnp.int32),
+            rmv_id=jnp.asarray(r.integers(0, 64, (2, 2)).astype(np.int32)),
+            rmv_vc=jnp.asarray(r.integers(0, 50, (2, 2, 2)).astype(np.int32)),
+        )
+
+    batches = [mk_batch(i) for i in range(6)]
+    ref = D.init(2, 1)
+    for b in batches:
+        ref, _ = D.apply_ops(ref, b, collect_dominated=False)
+
+    got, n = stream_apply(
+        D,
+        D.init(2, 1),
+        iter(batches),
+        apply_kwargs={"collect_dominated": False},
+    )
+    assert n == 6
+    assert D.equal(got, ref)
+
+
+def test_stream_apply_reconcile_hook():
+    calls = []
+
+    class Eng:
+        def apply_ops(self, state, ops):
+            return state + ops, None
+
+    def rec(state):
+        calls.append(state)
+        return state
+
+    out, n = stream_apply(
+        Eng(), 0, iter([1, 2, 3, 4, 5]), reconcile_every=2, reconcile=rec
+    )
+    assert out == 15 and n == 5
+    assert calls == [3, 10]
+
+
+def test_close_with_depth1_does_not_stall():
+    t0 = time.time()
+    with Prefetcher(iter(range(1000)), depth=1) as pf:
+        assert next(pf) == 0
+    assert time.time() - t0 < 2.0  # no 5s join timeout / leaked thread
+
+
+def test_exhausted_iterator_keeps_raising():
+    pf = Prefetcher(range(3))
+    assert list(pf) == [0, 1, 2]
+    with pytest.raises(StopIteration):
+        next(pf)
+    with pytest.raises(StopIteration):
+        next(pf)

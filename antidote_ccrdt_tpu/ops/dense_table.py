@@ -20,6 +20,34 @@ from jax import lax
 NEG_INF = np.int32(-(2**31 - 1))
 
 
+def dedup_rows_run_max(rows: jax.Array, upd: jax.Array, n_rows: int):
+    """Collapse duplicate scatter rows to run heads carrying the run max.
+
+    Sort updates by row; a reverse segmented max gives every element its
+    run's per-column total; only each run's first element keeps its row
+    index (the rest point at the `n_rows` sentinel, which no consumer
+    matches). Shared prepass of `scatter_max_rows_mxu` and the pallas
+    one-hot tombstone kernel — both need each table row to receive at most
+    one update so a sum-of-products accumulation equals that update.
+
+    rows [Br] i32, upd [Br, D] i32. Returns (head_rows [Br], total [Br, D]).
+    """
+    order = jnp.argsort(rows)
+    r_s = jnp.take_along_axis(rows, order, axis=0)
+    u_s = jnp.take_along_axis(upd, order[:, None], axis=0)
+
+    def comb(a, b):
+        (ka, va), (kb, vb) = a, b
+        same = (ka == kb)[..., None]
+        return (kb, jnp.where(same, jnp.maximum(va, vb), vb))
+
+    _, suf = lax.associative_scan(comb, (r_s[::-1], u_s[::-1]), axis=0)
+    total = suf[::-1]  # run max from each position to its run's end
+    is_head = jnp.concatenate([jnp.ones((1,), bool), r_s[1:] != r_s[:-1]])
+    head_rows = jnp.where(is_head, r_s, n_rows)
+    return head_rows, total
+
+
 def scatter_max_rows_mxu(
     table: jax.Array, rows: jax.Array, upd: jax.Array
 ) -> jax.Array:
@@ -50,26 +78,17 @@ def scatter_max_rows_mxu(
     upd [Br, D] i32 >= 0. Returns the updated [T, D] table.
     """
     T, D = table.shape
-    order = jnp.argsort(rows)
-    r_s = jnp.take_along_axis(rows, order, axis=0)
-    u_s = jnp.take_along_axis(upd, order[:, None], axis=0)
-
-    def comb(a, b):
-        (ka, va), (kb, vb) = a, b
-        same = (ka == kb)[..., None]
-        return (kb, jnp.where(same, jnp.maximum(va, vb), vb))
-
-    _, suf = lax.associative_scan(comb, (r_s[::-1], u_s[::-1]), axis=0)
-    total = suf[::-1]  # run max from each position to its run's end
-    is_head = jnp.concatenate(
-        [jnp.ones((1,), bool), r_s[1:] != r_s[:-1]]
-    )
-    head_rows = jnp.where(is_head, r_s, T)  # non-heads never match the iota
+    head_rows, total = dedup_rows_run_max(rows, upd, T)
 
     onehot = (
         head_rows[:, None] == jnp.arange(T, dtype=jnp.int32)[None, :]
     ).astype(jnp.int8)  # [Br, T]
-    n_planes = 5  # 5 x 7 bits cover the 31 value bits
+    # 5 x 7-bit planes cover the 31 value bits. (A 4 x 8-bit packing with
+    # `& 0xFF` recovery was tried to shrink the [T, n_planes*D] output 20%
+    # — it regressed the apply round 40ms -> 116ms on v5e; the sign-wrapped
+    # planes/masked consumers evidently knock the dot off its fast path.
+    # Keep planes unsigned-in-s8.)
+    n_planes = 5
     planes = jnp.concatenate(
         [((total >> (7 * k)) & 0x7F).astype(jnp.int8) for k in range(n_planes)],
         axis=-1,

@@ -255,10 +255,10 @@ def scatter_max_rows_pallas(table, rows, upd, interpret: bool = False):
         num_scalar_prefetch=1,
         grid=(R,),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.ANY),  # table (aliased, HBM)
+            pl.BlockSpec(memory_space=pl.ANY),  # table (aliased, HBM)
             pl.BlockSpec((1, B, D), lambda r, idx: (r, 0, 0)),  # updates
         ],
-        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
         scratch_shapes=[
             pltpu.VMEM((2, 1, D), jnp.int32),
             pltpu.SemaphoreType.DMA((2,)),
@@ -273,6 +273,119 @@ def scatter_max_rows_pallas(table, rows, upd, interpret: bool = False):
         compiler_params=pltpu.CompilerParams(has_side_effects=True),
         interpret=interpret,
     )(rows, table, upd)
+
+
+# --- tiled one-hot MXU scatter-max ----------------------------------------
+
+
+def _onehot_scatter_kernel(G, n_planes, D, Tt, rows_ref, planes_ref, tab_ref, out_ref):
+    """One (replica, table-tile) step of the fused one-hot scatter-max.
+
+    The [Br, T] one-hot that `ops.dense_table.scatter_max_rows_mxu`
+    materializes in HBM (102MB per replica at Br=1024, T=100k — the
+    dominant cost of the XLA version, ~15ms of the 40ms apply round) is
+    instead generated tile-by-tile in VMEM, transposed, as
+    ``ohT[t, b] = (rows[b] // G == tile_base + t)``: it exists only as an
+    MXU operand and never touches HBM. The table rides in a [T//G, G*D]
+    view so the minor dim is a 128-lane multiple (G=4, D=32) — the layout
+    Mosaic rejected for the raw [T, 32] blocks — and the G-fold row packing
+    also makes the one-hot G^2x smaller ([Br, T/G] vs [Br, T]).
+
+    planes_ref carries the 7-bit value planes pre-spread to the row's
+    G-slot (zero elsewhere), so each output cell still receives at most
+    one nonzero term and s32 accumulation is exact (same argument as
+    `scatter_max_rows_mxu`)."""
+    rows = rows_ref[0, 0]  # [Br] i32, dedup'd run heads; sentinel >= T
+    base = pl.program_id(1) * Tt
+    local = (rows // G) - base  # target packed row, tile-local
+    ohT = (
+        jax.lax.broadcasted_iota(jnp.int32, (Tt, rows.shape[0]), 0)
+        == local[None, :]
+    ).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        ohT,
+        planes_ref[0],
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # [Tt, G * n_planes * D]
+    PD = n_planes * D
+    cols = []
+    for g in range(G):
+        col = jnp.zeros((Tt, D), jnp.int32)
+        for k in range(n_planes):
+            col = col | (acc[:, g * PD + k * D : g * PD + (k + 1) * D] << (7 * k))
+        cols.append(col)
+    out_ref[0] = jnp.maximum(tab_ref[0], jnp.concatenate(cols, axis=-1))
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def scatter_max_rows_onehot_pallas(table, rows, upd, interpret: bool = False):
+    """Batched ``table[r].at[rows[r]].max(upd[r])`` for non-negative i32
+    updates, with the one-hot generated tile-locally in VMEM.
+
+    table [R, T, D] i32 (T % 4 == 0, D a multiple of 32... D=32 tested),
+    rows [R, Br] i32 (>= T or negative = dropped), upd [R, Br, D] i32 >= 0.
+    Duplicate rows allowed (dedup'd to run heads internally, as in
+    `scatter_max_rows_mxu`).
+
+    Status: verified infrastructure, NOT the production path. Honest v5e
+    timings at [32, 100k, 32], Br=1024 (benchmarks/ablate_apply.py +
+    micro_tombstone.py): in isolation ~13.5ms vs ~15.5ms for the XLA
+    one-hot matmul — but composed with the rest of `apply_ops` the round
+    regresses 40ms -> ~103ms, scan-fused AND fully unrolled alike, i.e.
+    the custom call itself defeats XLA's cross-piece scheduling/fusion
+    around it. Until that interaction is understood, the XLA path
+    (`ops.dense_table.scatter_max_rows_mxu`) stays in production."""
+    R, T, D = table.shape
+    _, Br = rows.shape
+    G = 4
+    n_planes = 5
+    assert T % G == 0, (T, G)
+    T4 = T // G
+    # Tile the packed-row axis: multiples of 8 sublanes; cover T4 exactly.
+    Tt = 1000 if T4 % 1000 == 0 else (T4 if T4 <= 4096 else None)
+    if Tt is None:
+        for cand in (2048, 1024, 512, 256, 128, 64, 32, 16, 8):
+            if T4 % cand == 0:
+                Tt = cand
+                break
+        else:
+            # No aligned tiling: fall back to the XLA path.
+            f = jax.vmap(lambda t, r, u: dense_table.scatter_max_rows_mxu(t, r, u))
+            return f(table, rows, upd)
+
+    head_rows, total = jax.vmap(
+        functools.partial(dense_table.dedup_rows_run_max, n_rows=T)
+    )(rows, upd)
+    # 7-bit planes spread to the row's G-slot: [R, Br, G * n_planes * D] s8.
+    g_of = (head_rows % G)[..., None]  # [R, Br, 1]
+    planes = jnp.concatenate(
+        [((total >> (7 * k)) & 0x7F).astype(jnp.int8) for k in range(n_planes)],
+        axis=-1,
+    )  # [R, Br, n_planes*D]
+    gsel = (
+        g_of == jnp.arange(G, dtype=jnp.int32)[None, None, :]
+    )  # [R, Br, G]
+    planes_wide = jnp.where(
+        gsel[..., :, None], planes[..., None, :], jnp.int8(0)
+    ).reshape(R, Br, G * n_planes * D)
+
+    tab4 = table.reshape(R, T4, G * D)
+    out4 = pl.pallas_call(
+        functools.partial(_onehot_scatter_kernel, G, n_planes, D, Tt),
+        grid=(R, T4 // Tt),
+        in_specs=[
+            # rows ride with a unit sublane dim so the block's trailing two
+            # dims (1, Br) equal the array dims (Mosaic's tiling rule).
+            pl.BlockSpec((1, 1, Br), lambda r, t: (r, 0, 0)),
+            pl.BlockSpec((1, Br, G * n_planes * D), lambda r, t: (r, 0, 0)),
+            pl.BlockSpec((1, Tt, G * D), lambda r, t: (r, t, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, Tt, G * D), lambda r, t: (r, t, 0)),
+        out_shape=jax.ShapeDtypeStruct((R, T4, G * D), jnp.int32),
+        interpret=interpret,
+    )(head_rows[:, None, :], planes_wide, tab4)
+    return out4.reshape(R, T, D)
 
 
 def combine_duplicate_rows(rows, upd, n_rows: int):

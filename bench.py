@@ -153,13 +153,15 @@ def main():
     else:
         # W amortizes the fixed per-window cost (host sync readback + op
         # upload, ~75-90ms measured) to a few ms/round without hiding it.
-        # B=16384 (1/16 rmv ratio preserved) amortizes the per-round
-        # full-grid join over 4x more ops than the original 4096 — batch
-        # size is a free engine parameter (BASELINE pins keys/replicas/K,
-        # not batch), and p50/p99 round latency stays reported honestly.
-        # Measured at the kernel state of the previous commit: B=4096 ->
-        # 4.9M merges/s @ 28ms/round; B=16384 -> 14.0M @ 40ms/round.
-        R, I, B, Br, windows, W, base_ops = 32, 100_000, 16384, 1024, 6, 16, 20_000
+        # B (1/16 rmv ratio preserved) amortizes the per-round full-grid
+        # join — batch size is a free engine parameter (BASELINE pins
+        # keys/replicas/K, not batch), and p50/p99 round latency stays
+        # reported honestly. Measured scaling on v5e: B=4096 -> 4.9M
+        # merges/s @ 28ms/round; 16384 -> 14.2M @ 40ms; 32768 -> 18.6M @
+        # 60ms; 65536 -> 22.4M @ 99ms (asymptote ~26M set by the ~1.2us/op
+        # sort+scatter cost). B=32768 is the balanced default: near-peak
+        # throughput without letting round latency run away.
+        R, I, B, Br, windows, W, base_ops = 32, 100_000, 32768, 2048, 6, 10, 20_000
     D_DCS, K, M = R, 100, 4  # every simulated replica is a DC: vc width = R
 
     apply_rate, p50_ms, p99_ms, state_merge_rate = bench_dense(

@@ -1,0 +1,240 @@
+"""Ablation timing of the topk_rmv apply round at NORTH-STAR bench shapes.
+
+Unlike profile_topk_rmv_pieces.py (which times pieces in isolation at
+B=4096), this measures the FULL apply with one piece removed at a time.
+Because XLA fuses across pieces, removal deltas are the honest attribution
+of round time. Shapes are B=16384/Br=1024 — the operating point where the
+kernel-choice attributions recorded in the model docstrings were taken;
+bench.py's default batch has since moved to B=32768/Br=2048, so scale
+attributions accordingly (B-linear pieces roughly double).
+
+Same measurement discipline: scan-fused windows, host-readback sync.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from antidote_ccrdt_tpu.harness.opgen import TopkRmvEffectGen, Workload
+from antidote_ccrdt_tpu.models.topk_rmv_dense import (
+    NEG_INF,
+    TopkRmvDenseState,
+    _join_slots,
+    _sort_adds,
+    make_dense,
+)
+
+R, NK, I, D_DCS, K, M, B, Br, REPS = 32, 1, 100_000, 32, 100, 4, 16384, 1024, 12
+D = make_dense(n_ids=I, n_dcs=D_DCS, size=K, slots_per_id=M)
+state0 = D.init(n_replicas=R, n_keys=1)
+gen = TopkRmvEffectGen(Workload(n_replicas=R, n_ids=I, zipf_a=1.2, score_max=100_000, seed=7))
+warm = gen.next_batch(B, Br)
+state0, _ = D.apply_ops(state0, warm, collect_dominated=False)
+stacked = jax.tree.map(
+    lambda *xs: jnp.stack(xs), *[gen.next_batch(B, Br) for _ in range(REPS)]
+)
+
+
+def sync(x):
+    return np.asarray(jax.tree.leaves(x)[0].ravel()[0])
+
+
+SELECT = sys.argv[1:]  # substring filters; empty = run all
+
+
+def timeit(name, step_fn):
+    if SELECT and not any(s in name for s in SELECT):
+        return None
+
+    @jax.jit
+    def run(c, seq):
+        def body(c, ops):
+            return step_fn(c, ops), ()
+        out, _ = lax.scan(body, c, seq)
+        return out
+
+    sync(run(state0, stacked))
+    t0 = time.perf_counter()
+    out = run(state0, stacked)
+    sync(out)
+    print(f"{name:56s} {(time.perf_counter() - t0) / REPS * 1e3:9.2f} ms")
+    return out
+
+
+def make_variant(
+    tombstones=True, vc_track=True, delta=True, join=True, scatter_fields=3
+):
+    from antidote_ccrdt_tpu.ops.dense_table import scatter_max_rows_mxu
+
+    def one(state, ops):
+        NKl, Il, Ml, Dl = NK, I, M, D_DCS
+        if tombstones:
+            rmv_valid = ops.rmv_id >= 0
+            rrow = jnp.where(rmv_valid, ops.rmv_key * Il + ops.rmv_id, NKl * Il)
+            rmv_vc = scatter_max_rows_mxu(
+                state.rmv_vc.reshape(NKl * Il, Dl), rrow, ops.rmv_vc
+            ).reshape(NKl, Il, Dl)
+        else:
+            rmv_vc = state.rmv_vc
+
+        if vc_track:
+            add_valid = (
+                (ops.add_ts > 0)
+                & (ops.add_key >= 0) & (ops.add_key < NKl)
+                & (ops.add_dc >= 0) & (ops.add_dc < Dl)
+            )
+            slot = ops.add_key * Dl + ops.add_dc
+            hit = slot[:, None] == jnp.arange(NKl * Dl, dtype=slot.dtype)[None, :]
+            contrib = jnp.where(hit & add_valid[:, None], ops.add_ts[:, None], 0)
+            vc = jnp.maximum(state.vc, jnp.max(contrib, axis=0).reshape(NKl, Dl))
+        else:
+            vc = state.vc
+
+        d_score = jnp.full((NKl, Il, Ml), NEG_INF, dtype=jnp.int32)
+        d_dc = jnp.zeros((NKl, Il, Ml), dtype=jnp.int32)
+        d_ts = jnp.zeros((NKl, Il, Ml), dtype=jnp.int32)
+        if delta:
+            sk = jnp.where(ops.add_ts > 0, ops.add_key, NKl)
+            (s_key, s_id, _, _), (s_score, s_ts, s_dc) = _sort_adds(
+                sk, ops.add_id, ops.add_score, ops.add_ts, ops.add_dc
+            )
+            dup = (
+                (s_key == jnp.roll(s_key, 1))
+                & (s_id == jnp.roll(s_id, 1))
+                & (s_score == jnp.roll(s_score, 1))
+                & (s_ts == jnp.roll(s_ts, 1))
+                & (s_dc == jnp.roll(s_dc, 1))
+            )
+            dup = dup.at[0].set(False)
+            live = (s_key < NKl) & ~dup
+            grp_start = (
+                (s_key != jnp.roll(s_key, 1)) | (s_id != jnp.roll(s_id, 1))
+            ).at[0].set(True)
+            c = jnp.cumsum(live.astype(jnp.int32))
+            base = lax.cummax(jnp.where(grp_start, c - live.astype(jnp.int32), -1))
+            rank = c - live.astype(jnp.int32) - base
+            rank = jnp.where(live & (rank < Ml), rank, Ml)
+            sk3 = jnp.where(live, s_key, NKl)
+            if scatter_fields >= 1:
+                d_score = d_score.at[sk3, s_id, rank].set(s_score, mode="drop")
+            if scatter_fields >= 2:
+                d_dc = d_dc.at[sk3, s_id, rank].set(s_dc, mode="drop")
+            if scatter_fields >= 3:
+                d_ts = d_ts.at[sk3, s_id, rank].set(s_ts, mode="drop")
+
+        if join:
+            f_score, f_dc, f_ts, n_live = _join_slots(
+                (state.slot_score, state.slot_dc, state.slot_ts),
+                (d_score, d_dc, d_ts),
+                rmv_vc,
+                Ml,
+            )
+            lossy = state.lossy | jnp.any(n_live > Ml, axis=-1)
+        else:
+            # keep everything live so no piece is dead-code-eliminated
+            f_score = jnp.maximum(state.slot_score, d_score)
+            f_dc = jnp.maximum(state.slot_dc, d_dc)
+            f_ts = jnp.maximum(state.slot_ts, d_ts)
+            lossy = state.lossy
+        return TopkRmvDenseState(f_score, f_dc, f_ts, rmv_vc, vc, lossy)
+
+    def step(st, ops):
+        return jax.vmap(one)(st, ops)
+
+    return step
+
+
+def current(st, ops):
+    s, _ = D.apply_ops(st, ops, collect_dominated=False)
+    return s
+
+
+timeit("FULL apply_ops (current code)", current)
+timeit("variant: full re-impl (sanity, ~= current)", make_variant())
+timeit("  - tombstone MXU scatter", make_variant(tombstones=False))
+timeit("  - vc one-hot tracking", make_variant(vc_track=False))
+timeit("  - delta build entirely (sort+rank+scatter)", make_variant(delta=False))
+timeit("  - 2 of 3 delta scatters", make_variant(scatter_fields=1))
+timeit("  - join (elementwise max instead)", make_variant(join=False))
+
+timeit("tombstones ONLY (XLA path, + slot max)",
+       make_variant(vc_track=False, delta=False, join=False))
+
+
+def make_pallas_tomb():
+    from antidote_ccrdt_tpu.ops.pallas_kernels import scatter_max_rows_onehot_pallas
+
+    def step(state, ops):
+        rmv_valid = ops.rmv_id >= 0
+        rrow = jnp.where(rmv_valid, ops.rmv_key * I + ops.rmv_id, NK * I)
+        R_ = state.rmv_vc.shape[0]
+        rmv_vc = scatter_max_rows_onehot_pallas(
+            state.rmv_vc.reshape(R_, NK * I, D_DCS), rrow, ops.rmv_vc
+        ).reshape(R_, NK, I, D_DCS)
+        f_score = jnp.maximum(state.slot_score, ops.add_score[:, None, :M].reshape(R_, NK, 1, M) * 0 + state.slot_score)
+        return TopkRmvDenseState(f_score, state.slot_dc, state.slot_ts, rmv_vc, state.vc, state.lossy)
+
+    return step
+
+
+timeit("tombstones ONLY (pallas, + slot max)", make_pallas_tomb())
+
+
+def make_hoisted(use_pallas):
+    from antidote_ccrdt_tpu.ops.dense_table import scatter_max_rows_mxu
+    from antidote_ccrdt_tpu.ops.pallas_kernels import scatter_max_rows_onehot_pallas
+
+    def step(state, ops):
+        R_ = state.rmv_vc.shape[0]
+        rmv_valid = ops.rmv_id >= 0
+        rrow = jnp.where(rmv_valid, ops.rmv_key * I + ops.rmv_id, NK * I)
+        tab = state.rmv_vc.reshape(R_, NK * I, D_DCS)
+        if use_pallas:
+            out = scatter_max_rows_onehot_pallas(tab, rrow, ops.rmv_vc)
+        else:
+            out = jax.vmap(scatter_max_rows_mxu)(tab, rrow, ops.rmv_vc)
+        rmv_vc_new = out.reshape(R_, NK, I, D_DCS)
+        return jax.vmap(D._apply_one_replica)(state, ops, rmv_vc_new)
+
+    return step
+
+
+timeit("hoisted XLA tombstones + vmap apply", make_hoisted(False))
+timeit("hoisted PALLAS tombstones + vmap apply", make_hoisted(True))
+
+
+def step_identity_tomb(state, ops):
+    # rmv_vc passed through untouched: isolates the cost of CONSUMING a
+    # materialized table in the join vs a fused producer.
+    return jax.vmap(D._apply_one_replica)(state, ops, state.rmv_vc)
+
+
+timeit("vmap apply, identity (materialized) tombstones", step_identity_tomb)
+
+
+def timeit_unrolled(name, step_fn):
+    if SELECT and not any(s in name for s in SELECT):
+        return None
+
+    @jax.jit
+    def run(c, seq):
+        for i in range(REPS):
+            c = step_fn(c, jax.tree.map(lambda x: x[i], seq))
+        return c
+
+    sync(run(state0, stacked))
+    t0 = time.perf_counter()
+    out = run(state0, stacked)
+    sync(out)
+    print(f"{name:56s} {(time.perf_counter() - t0) / REPS * 1e3:9.2f} ms")
+    return out
+
+
+timeit_unrolled("UNROLLED hoisted PALLAS tombstones + vmap apply", make_hoisted(True))
+timeit_unrolled("UNROLLED full re-impl XLA", make_variant())

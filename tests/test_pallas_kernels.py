@@ -14,6 +14,7 @@ from antidote_ccrdt_tpu.models.topk_rmv_dense import _sort_slots
 from antidote_ccrdt_tpu.ops.pallas_kernels import (
     combine_duplicate_rows,
     oddeven_network,
+    scatter_max_rows_onehot_pallas,
     scatter_max_rows_pallas,
     sort_slots_pallas,
 )
@@ -66,6 +67,43 @@ def test_scatter_max_matches_reference(seed):
     r2, u2 = combine_duplicate_rows(jnp.asarray(rows), jnp.asarray(upd), T)
     got = scatter_max_rows_pallas(jnp.asarray(table), r2, u2, True)
     assert np.array_equal(np.asarray(got), exp)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_onehot_scatter_max_matches_reference(seed):
+    # Tiled one-hot MXU scatter-max (verified infrastructure; the XLA
+    # one-hot matmul remains the production tombstone path — see kernel
+    # docstring for the measured in-situ regression).
+    # T is always a multiple of 4 (the G-fold row packing); duplicates and
+    # sentinel/negative (dropped) rows are exercised.
+    rng = np.random.default_rng(100 + seed)
+    R = int(rng.integers(1, 4))
+    T = 4 * int(rng.integers(1, 20))
+    D = int(rng.integers(1, 40))
+    B = int(rng.integers(1, 50))
+    table = rng.integers(0, 10, (R, T, D)).astype(np.int32)
+    rows = rng.integers(-3, T + 2, (R, B)).astype(np.int32)  # some dropped
+    upd = rng.integers(0, 1 << 20, (R, B, D)).astype(np.int32)
+    exp = table.copy()
+    for r in range(R):
+        for j in range(B):
+            if 0 <= rows[r, j] < T:
+                exp[r, rows[r, j]] = np.maximum(exp[r, rows[r, j]], upd[r, j])
+    got = scatter_max_rows_onehot_pallas(
+        jnp.asarray(table), jnp.asarray(rows), jnp.asarray(upd), True
+    )
+    assert np.array_equal(np.asarray(got), exp), seed
+
+
+def test_onehot_scatter_max_full_value_range():
+    # 31-bit values must survive the 5x7-bit plane decomposition exactly.
+    table = jnp.zeros((1, 8, 3), jnp.int32)
+    upd = jnp.asarray([[[2**31 - 1, 1, 0x55555555 & 0x7FFFFFFF]]], jnp.int32)
+    rows = jnp.asarray([[5]], jnp.int32)
+    got = np.asarray(scatter_max_rows_onehot_pallas(table, rows, upd, True))
+    assert got[0, 5, 0] == 2**31 - 1
+    assert got[0, 5, 1] == 1
+    assert got[0, 5, 2] == 0x55555555 & 0x7FFFFFFF
 
 
 def test_combine_duplicate_rows_idempotent_totals():

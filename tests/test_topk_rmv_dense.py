@@ -325,3 +325,28 @@ def test_scatter_max_rows_mxu_exact():
     ref = table.at[rows].max(upd, mode="drop")
     got = scatter_max_rows_mxu(table, rows, upd)
     assert np.array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_out_of_range_add_fields_dropped_not_aliased():
+    # Regression: kid packing (kid = key*I + id) must not let a malformed
+    # add_id >= I alias into the NEXT key's id range, nor a negative
+    # padding id underflow into key NK-1's range. Both must be dropped
+    # whole, leaving every instance untouched and lossy unset.
+    D = make_dense(n_ids=4, n_dcs=2, size=2, slots_per_id=2)
+    st = D.init(n_replicas=1, n_keys=2)
+    ops = TopkRmvOps(
+        add_key=jnp.asarray([[0, 0, 1, 1]], jnp.int32),
+        add_id=jnp.asarray([[4, -3, 2, 9]], jnp.int32),  # 4,-3,9 invalid
+        add_score=jnp.asarray([[99, 98, 50, 97]], jnp.int32),
+        add_dc=jnp.asarray([[0, 0, 1, 1]], jnp.int32),
+        add_ts=jnp.asarray([[5, 6, 7, 8]], jnp.int32),
+        rmv_key=jnp.asarray([[0]], jnp.int32),
+        rmv_id=jnp.asarray([[-1]], jnp.int32),
+        rmv_vc=jnp.asarray([[[0, 0]]], jnp.int32),
+    )
+    st2, _ = D.apply_ops(st, ops)
+    assert D.value(st2)[0][0] == []          # nothing leaked into key 0
+    assert D.value(st2)[0][1] == [(2, 50)]   # only the valid add landed
+    assert not bool(st2.lossy.any())
+    # vc advances only for valid adds: dc 1 saw ts 7, dc 0 saw nothing.
+    assert st2.vc[0, 1, 1] == 7 and st2.vc[0, 0, 0] == 0

@@ -43,7 +43,7 @@ def bench_average():
 
     from antidote_ccrdt_tpu.models.average import AverageDense, AverageOps
 
-    R, NK, B, W, NW = sized((2, 1000, 8192, 8, 4), (2, 1000, 1024, 3, 3))
+    R, NK, B, W, NW = sized((2, 1000, 1048576, 8, 4), (2, 1000, 1024, 3, 3))
     D = AverageDense()
     state = D.init(R, NK)
     rng = np.random.default_rng(0)
@@ -66,7 +66,7 @@ def bench_topk():
 
     from antidote_ccrdt_tpu.models.topk import TopkOps, make_dense
 
-    R, I, B, W, NW = sized((8, 10_000, 8192, 8, 4), (4, 2_000, 1024, 3, 3))
+    R, I, B, W, NW = sized((8, 10_000, 524288, 8, 4), (4, 2_000, 1024, 3, 3))
     D = make_dense(n_ids=I, size=100)
     state = D.init(R, 1)
     rng = np.random.default_rng(0)
@@ -91,7 +91,7 @@ def bench_leaderboard():
     from antidote_ccrdt_tpu.models.leaderboard import LeaderboardOps, make_dense
 
     R, P, B, Bb, W, NW = sized(
-        (16, 1_000_000, 8192, 64, 8, 4), (4, 50_000, 1024, 16, 3, 3)
+        (16, 1_000_000, 131072, 1024, 8, 4), (4, 50_000, 1024, 16, 3, 3)
     )
     D = make_dense(n_players=P, size=100)
     state = D.init(R, 1)
@@ -126,7 +126,7 @@ def bench_wordcount():
 
     from antidote_ccrdt_tpu.models.wordcount import WordcountOps, make_dense
 
-    R, V, B, W, NW = sized((64, 1 << 16, 8192, 8, 4), (8, 1 << 12, 1024, 3, 3))
+    R, V, B, W, NW = sized((64, 1 << 16, 65536, 8, 4), (8, 1 << 12, 1024, 3, 3))
     D = make_dense(V)
     state = D.init(R, 1)
     rng = np.random.default_rng(0)

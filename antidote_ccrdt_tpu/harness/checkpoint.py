@@ -223,10 +223,21 @@ def save_dense_checkpoint(path: str, name: str, state: Any, step: int = 0) -> No
     os.replace(tmp, path)
 
 
-def load_dense_checkpoint(path: str, like: Any) -> Tuple[int, str, Any]:
-    """Returns (step, name, state) with `state` in the structure of `like`."""
+def load_dense_checkpoint(
+    path: str, like: Any, dense: Any = None
+) -> Tuple[int, str, Any]:
+    """Returns (step, name, state) with `state` in the structure of `like`.
+
+    Pass the dense engine as `dense` to structurally validate the restored
+    state against the engine config (utils.validate.check_state) — a
+    checkpoint written under different capacities (I/M/D/K) otherwise
+    surfaces only as silent wrong answers deep in the kernels."""
     with open(path, "rb") as f:
         data = f.read()
     (step,) = struct.unpack("<Q", data[:8])
     name, state = serial.loads_dense(data[8:], like)
+    if dense is not None:
+        from ..utils.validate import check_state
+
+        check_state(dense, state)
     return step, name, state

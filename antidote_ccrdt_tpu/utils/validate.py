@@ -1,0 +1,139 @@
+"""Jit-boundary shape/dtype validation and silent-drop observability.
+
+SURVEY.md §5's race-detection row: the BEAM reference needs no sanitizers
+because every callback is a pure Erlang function; the dense engine's
+analog is keeping kernels pure and *checking structure at the jit
+boundary*, where host data (wire input, checkpoint restores, generated op
+batches) becomes device arrays. Two failure classes are covered:
+
+* **Structural** (`check_state`, `check_ops`) — wrong dtype, wrong rank,
+  mismatched batch axes, a rmv_vc whose DC width disagrees with the
+  engine config. These raise immediately with a path-qualified message;
+  under jit they are trace-time checks and cost nothing at runtime.
+* **Semantic drops** (`topk_rmv_drop_report`) — the kernels deliberately
+  drop out-of-range/padding ops (convergence-safe, see
+  `TopkRmvDense._apply_one_replica`), which is correct but silent. The
+  report counts per-field violations in one tiny jitted reduction so
+  harnesses/bridges can distinguish "all padding" from "a feed is
+  emitting garbage" and alarm on the latter (wire it to
+  `utils.metrics.Metrics.count`).
+
+The scalar engines need none of this: they validate per-op in Python
+(`is_operation`, explicit ValueError on malformed effects).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def _leaves_with_paths(tree: Any):
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        yield jax.tree_util.keystr(path), leaf
+
+
+def check_tree_dtype(tree: Any, what: str, dtype=jnp.int32) -> None:
+    """Every array leaf of `tree` must have exactly `dtype` (bool leaves
+    are allowed — they are masks, not payloads)."""
+    for path, leaf in _leaves_with_paths(tree):
+        got = jnp.asarray(leaf).dtype
+        if got == jnp.bool_:
+            continue
+        if got != dtype:
+            raise TypeError(
+                f"{what}{path}: dtype {got}, expected {jnp.dtype(dtype).name} "
+                f"(host ints silently upcast to i64 break jit caches and "
+                f"double HBM traffic)"
+            )
+
+
+def check_state(dense: Any, state: Any) -> None:
+    """Structural check of a dense state against its engine config.
+
+    Validates dtype, the shared [R, NK] leading batch axes, and the
+    config-derived trailing dims (I/M/D for topk_rmv-shaped states) by
+    comparing against a freshly built reference structure — so it works
+    for every registered dense engine without per-type code. Use after
+    checkpoint restore or any host-side state surgery."""
+    check_tree_dtype(state, type(state).__name__)
+    leaves = jax.tree_util.tree_leaves(state)
+    if not leaves:
+        raise ValueError("empty state pytree")
+    lead = jnp.asarray(leaves[0]).shape[:2]
+    if len(lead) < 2:
+        raise ValueError(
+            f"state leaves must carry [n_replicas, n_keys, ...] batch axes; "
+            f"got shape {jnp.asarray(leaves[0]).shape}"
+        )
+    ref = dense.init(lead[0], lead[1])
+    got_paths = dict(_leaves_with_paths(state))
+    for path, ref_leaf in _leaves_with_paths(ref):
+        if path not in got_paths:
+            raise ValueError(f"state is missing leaf {path}")
+        got_shape = jnp.asarray(got_paths[path]).shape
+        if got_shape != ref_leaf.shape:
+            raise ValueError(
+                f"state{path}: shape {got_shape}, engine config expects "
+                f"{ref_leaf.shape}"
+            )
+
+
+def check_ops(state_or_replicas: Any, ops: Any) -> None:
+    """Structural check of an op batch: i32 leaves and a consistent
+    leading replica axis matching the state's."""
+    check_tree_dtype(ops, type(ops).__name__)
+    if dataclasses.is_dataclass(state_or_replicas):
+        n_replicas = jax.tree_util.tree_leaves(state_or_replicas)[0].shape[0]
+    else:
+        n_replicas = int(state_or_replicas)
+    for path, leaf in _leaves_with_paths(ops):
+        shape = jnp.asarray(leaf).shape
+        if not shape or shape[0] != n_replicas:
+            raise ValueError(
+                f"ops{path}: leading axis {shape[:1] or '()'} != n_replicas "
+                f"{n_replicas}"
+            )
+
+
+def topk_rmv_drop_report(dense: Any, state: Any, ops: Any) -> Dict[str, int]:
+    """Count ops the kernels will drop, by reason, in one device reduction.
+
+    Padding conventions (add_ts <= 0, rmv_id < 0) are counted separately
+    from genuine range violations, so a monitor can alert on the latter
+    while ignoring the former. Returns plain ints (host-synced)."""
+    NK = jax.tree_util.tree_leaves(state)[0].shape[1]
+    I, D = dense.I, dense.D
+
+    @jax.jit
+    def counts(ops):
+        add_pad = ops.add_ts <= 0
+        bad_key = (ops.add_key < 0) | (ops.add_key >= NK)
+        bad_id = (ops.add_id < 0) | (ops.add_id >= I)
+        bad_dc = (ops.add_dc < 0) | (ops.add_dc >= D)
+        add_bad = ~add_pad & (bad_key | bad_id | bad_dc)
+        rmv_pad = ops.rmv_id < 0
+        rmv_bad = ~rmv_pad & (
+            (ops.rmv_key < 0) | (ops.rmv_key >= NK) | (ops.rmv_id >= I)
+        )
+        return (
+            jnp.sum(add_pad), jnp.sum(add_bad),
+            jnp.sum(~add_pad & bad_key), jnp.sum(~add_pad & bad_id),
+            jnp.sum(~add_pad & bad_dc),
+            jnp.sum(rmv_pad), jnp.sum(rmv_bad),
+        )
+
+    (a_pad, a_bad, a_key, a_id, a_dc, r_pad, r_bad) = counts(ops)
+    return {
+        "add_padding": int(a_pad),
+        "add_dropped_out_of_range": int(a_bad),
+        "add_bad_key": int(a_key),
+        "add_bad_id": int(a_id),
+        "add_bad_dc": int(a_dc),
+        "rmv_padding": int(r_pad),
+        "rmv_dropped_out_of_range": int(r_bad),
+    }

@@ -1,0 +1,110 @@
+"""Jit-boundary validation layer (utils/validate.py): structural checks
+and the silent-drop observability report (SURVEY.md §5 race-detection row).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from antidote_ccrdt_tpu.harness.checkpoint import (
+    load_dense_checkpoint,
+    save_dense_checkpoint,
+)
+from antidote_ccrdt_tpu.models.topk_rmv_dense import TopkRmvOps, make_dense
+from antidote_ccrdt_tpu.utils.validate import (
+    check_ops,
+    check_state,
+    check_tree_dtype,
+    topk_rmv_drop_report,
+)
+
+
+def mk_ops(R=2, B=4, Br=2, D=2, dtype=jnp.int32):
+    z = lambda *s: jnp.zeros(s, dtype)  # noqa: E731
+    return TopkRmvOps(
+        add_key=z(R, B), add_id=z(R, B), add_score=z(R, B),
+        add_dc=z(R, B), add_ts=z(R, B),
+        rmv_key=z(R, Br), rmv_id=jnp.full((R, Br), -1, dtype),
+        rmv_vc=z(R, Br, D),
+    )
+
+
+def test_check_state_accepts_fresh_state():
+    D = make_dense(n_ids=8, n_dcs=2, size=2, slots_per_id=2)
+    check_state(D, D.init(2, 3))  # no raise
+
+
+def test_check_state_rejects_wrong_capacity():
+    D = make_dense(n_ids=8, n_dcs=2, size=2, slots_per_id=2)
+    D2 = make_dense(n_ids=16, n_dcs=2, size=2, slots_per_id=2)
+    with pytest.raises(ValueError, match="shape"):
+        check_state(D2, D.init(2, 1))
+
+
+def test_check_state_rejects_wrong_dtype():
+    D = make_dense(n_ids=8, n_dcs=2, size=2, slots_per_id=2)
+    st = D.init(2, 1)
+    # (int64 would silently stay int32 without jax_enable_x64 — use f32.)
+    bad = dataclasses.replace(st, slot_ts=st.slot_ts.astype(jnp.float32))
+    with pytest.raises(TypeError, match="slot_ts"):
+        check_state(D, bad)
+
+
+def test_check_ops_replica_axis_mismatch():
+    D = make_dense(n_ids=8, n_dcs=2, size=2, slots_per_id=2)
+    st = D.init(3, 1)
+    with pytest.raises(ValueError, match="n_replicas"):
+        check_ops(st, mk_ops(R=2))
+    check_ops(st, mk_ops(R=3))  # no raise
+
+
+def test_drop_report_separates_padding_from_garbage():
+    D = make_dense(n_ids=4, n_dcs=2, size=2, slots_per_id=2)
+    st = D.init(1, 2)
+    ops = TopkRmvOps(
+        add_key=jnp.asarray([[0, 0, 1, 9]], jnp.int32),
+        add_id=jnp.asarray([[1, 7, 2, 0]], jnp.int32),
+        add_score=jnp.asarray([[5, 5, 5, 5]], jnp.int32),
+        add_dc=jnp.asarray([[0, 0, 5, 0]], jnp.int32),
+        add_ts=jnp.asarray([[1, 2, 3, 0]], jnp.int32),  # last = padding
+        rmv_key=jnp.asarray([[0, 3]], jnp.int32),
+        rmv_id=jnp.asarray([[-1, 1]], jnp.int32),  # first = padding
+        rmv_vc=jnp.zeros((1, 2, 2), jnp.int32),
+    )
+    rep = topk_rmv_drop_report(D, st, ops)
+    assert rep["add_padding"] == 1
+    assert rep["add_bad_id"] == 1      # id 7 >= I=4
+    assert rep["add_bad_dc"] == 1      # dc 5 >= D=2
+    assert rep["add_bad_key"] == 0     # key 9 is the padding row
+    assert rep["add_dropped_out_of_range"] == 2
+    assert rep["rmv_padding"] == 1
+    assert rep["rmv_dropped_out_of_range"] == 1  # key 3 >= NK=2
+    # The engine itself drops exactly those and converges:
+    st2, _ = D.apply_ops(st, ops)
+    assert D.value(st2)[0][0] == [(1, 5)]
+
+
+def test_check_tree_dtype_allows_bool_masks():
+    D = make_dense(n_ids=8, n_dcs=2, size=2, slots_per_id=2)
+    check_tree_dtype(D.init(1, 1), "state")  # lossy is bool: allowed
+
+
+def test_checkpoint_restore_validates_against_engine():
+    import tempfile, os
+
+    D = make_dense(n_ids=8, n_dcs=2, size=2, slots_per_id=2)
+    st = D.init(2, 1)
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "ck")
+        save_dense_checkpoint(p, "topk_rmv", st, step=7)
+        step, name, out = load_dense_checkpoint(p, st, dense=D)
+        assert step == 7 and name == "topk_rmv"
+        # Same bytes, different engine config: restore must refuse.
+        D2 = make_dense(n_ids=16, n_dcs=2, size=2, slots_per_id=2)
+        like2 = D2.init(2, 1)
+        with pytest.raises(ValueError):
+            load_dense_checkpoint(p, st, dense=D2)

@@ -1,0 +1,153 @@
+"""Elastic membership: failure detection + join-based gossip anti-entropy.
+
+SURVEY.md §5 marks "failure detection / elastic recovery" absent in the
+reference (delegated to the Antidote host). This module is that tier,
+built the CRDT way rather than the collective way:
+
+* `parallel.multihost` / `parallel.dist` are the FAST path — SPMD
+  collectives over ICI/DCN. Collectives need a fixed, fully-alive world:
+  a dead peer hangs the program, and `jax.distributed` cannot shrink the
+  world without a restart.
+* This module is the FAILURE-TOLERANT path: members exchange whole
+  lattice states through a shared store (filesystem here; the transport
+  is a trivial read/write interface, so object stores or RPC slot in).
+  Because every dense state is a join-semilattice (merge is associative,
+  commutative, idempotent — tests/test_properties.py pins the laws),
+  gossip needs none of the machinery fragile systems need: a stale
+  snapshot merges harmlessly, a duplicated op batch re-applied after
+  recovery dedups in the join, and membership can change between any two
+  sweeps. Recovery is literally "merge the dead member's last published
+  state and keep going".
+
+Pieces:
+* `GossipStore` — publish/fetch member snapshots + mtime heartbeats in a
+  shared directory (atomic rename writes; `harness.checkpoint` format).
+* `alive_members` / `owners` — timeout failure detector + the
+  deterministic replica→member assignment everyone recomputes from the
+  alive set alone (no coordinator, no consensus: ownership only affects
+  WHO applies ops; overlap during a membership transition is safe by
+  idempotence).
+* `sweep` — fold every peer's latest snapshot into the local state with
+  the engine join.
+
+The real-process drill (3 workers, one killed mid-run, survivors detect,
+adopt its replicas, converge to the sequential reference) lives in
+scripts/elastic_demo.py + tests/test_elastic.py.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..harness.checkpoint import load_dense_checkpoint, save_dense_checkpoint
+
+
+class GossipStore:
+    """Shared-directory snapshot exchange with heartbeat files.
+
+    Layout: `<root>/snap-<member>` (latest lattice state, atomic replace)
+    and `<root>/hb-<member>` (empty file; mtime = last heartbeat). One
+    writer per member id; any number of readers."""
+
+    def __init__(self, root: str, member: str):
+        self.root = root
+        self.member = member
+        os.makedirs(root, exist_ok=True)
+        self.heartbeat()
+
+    # -- liveness ----------------------------------------------------------
+
+    def heartbeat(self) -> None:
+        p = os.path.join(self.root, f"hb-{self.member}")
+        with open(p, "a"):
+            os.utime(p, None)
+
+    def members(self) -> List[str]:
+        return sorted(
+            f[3:] for f in os.listdir(self.root) if f.startswith("hb-")
+        )
+
+    def alive_members(self, timeout_s: float) -> List[str]:
+        """Members whose heartbeat is fresher than `timeout_s`. Always
+        includes self (a member never suspects itself)."""
+        now = time.time()
+        out = []
+        for m in self.members():
+            if m == self.member:
+                out.append(m)
+                continue
+            try:
+                age = now - os.path.getmtime(os.path.join(self.root, f"hb-{m}"))
+            except OSError:
+                continue
+            if age <= timeout_s:
+                out.append(m)
+        return sorted(out)
+
+    # -- snapshots ---------------------------------------------------------
+
+    def publish(self, name: str, state: Any, step: int) -> None:
+        """Atomically publish this member's state at `step` (and beat)."""
+        save_dense_checkpoint(
+            os.path.join(self.root, f"snap-{self.member}"), name, state, step
+        )
+        self.heartbeat()
+
+    def fetch(
+        self, member: str, like: Any, dense: Any = None
+    ) -> Optional[Tuple[int, Any]]:
+        """Latest (step, state) published by `member`, or None. ANY decode
+        or validation failure reads as None — torn concurrent writes raise
+        struct.error/BadZipFile (not OSError/ValueError), and a peer
+        publishing under a mismatched engine config must be skipped, not
+        crash the gossip loop: join-based gossip never needs any single
+        fetch to succeed, the next sweep retries."""
+        path = os.path.join(self.root, f"snap-{member}")
+        try:
+            step, _name, state = load_dense_checkpoint(path, like, dense=dense)
+        except Exception:  # noqa: BLE001 — deliberately total, see docstring
+            return None
+        return step, state
+
+    def snapshot_members(self) -> List[str]:
+        return sorted(
+            f[5:]
+            for f in os.listdir(self.root)
+            if f.startswith("snap-") and not f.endswith(".tmp")
+        )
+
+
+def owners(alive: List[str], n_replicas: int) -> Dict[int, str]:
+    """Deterministic replica→member assignment from the alive set alone:
+    replica r belongs to alive[r % len(alive)]. Every member computes this
+    locally; during a membership transition two members may briefly both
+    own a replica and apply the same deterministic op stream — harmless,
+    the join dedups (idempotence is what makes coordination unnecessary)."""
+    alive = sorted(alive)
+    if not alive:
+        return {}
+    return {r: alive[r % len(alive)] for r in range(n_replicas)}
+
+
+def my_replicas(store: GossipStore, n_replicas: int, timeout_s: float) -> List[int]:
+    own = owners(store.alive_members(timeout_s), n_replicas)
+    return [r for r, m in own.items() if m == store.member]
+
+
+def sweep(store: GossipStore, dense: Any, state: Any) -> Tuple[Any, int]:
+    """Fold every peer's latest snapshot into `state` with the engine
+    join. Returns (state, n_merged). Self's snapshot is skipped (already
+    reflected); stale or concurrent publishes are safe by idempotence."""
+    n = 0
+    for m in store.snapshot_members():
+        if m == store.member:
+            continue
+        got = store.fetch(m, state, dense=dense)
+        if got is None:
+            continue
+        _step, peer = got
+        state = dense.merge(state, peer)
+        n += 1
+    return state, n

@@ -25,6 +25,7 @@ The scalar engines need none of this: they validate per-op in Python
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Dict
 
 import jax
@@ -70,7 +71,11 @@ def check_state(dense: Any, state: Any) -> None:
             f"state leaves must carry [n_replicas, n_keys, ...] batch axes; "
             f"got shape {jnp.asarray(leaves[0]).shape}"
         )
-    ref = dense.init(lead[0], lead[1])
+    # eval_shape: the reference structure without allocating it — at
+    # production capacities a real init would transiently double state
+    # memory right when a large checkpoint is being restored. R/NK must
+    # stay static (init builds shape tuples from them), hence the closure.
+    ref = jax.eval_shape(lambda: dense.init(lead[0], lead[1]))
     got_paths = dict(_leaves_with_paths(state))
     for path, ref_leaf in _leaves_with_paths(ref):
         if path not in got_paths:
@@ -83,9 +88,12 @@ def check_state(dense: Any, state: Any) -> None:
             )
 
 
-def check_ops(state_or_replicas: Any, ops: Any) -> None:
-    """Structural check of an op batch: i32 leaves and a consistent
-    leading replica axis matching the state's."""
+def check_ops(state_or_replicas: Any, ops: Any, dense: Any = None) -> None:
+    """Structural check of an op batch: i32 leaves, a consistent leading
+    replica axis matching the state's, and — when the engine is passed —
+    config-derived trailing dims (a rmv_vc whose DC width disagrees with
+    the engine's D would otherwise fail deep inside the tombstone matmul
+    with an opaque shape error)."""
     check_tree_dtype(ops, type(ops).__name__)
     if dataclasses.is_dataclass(state_or_replicas):
         n_replicas = jax.tree_util.tree_leaves(state_or_replicas)[0].shape[0]
@@ -98,16 +106,18 @@ def check_ops(state_or_replicas: Any, ops: Any) -> None:
                 f"ops{path}: leading axis {shape[:1] or '()'} != n_replicas "
                 f"{n_replicas}"
             )
+    if dense is not None and hasattr(ops, "rmv_vc"):
+        got_d = jnp.asarray(ops.rmv_vc).shape[-1]
+        if got_d != dense.D:
+            raise ValueError(
+                f"ops.rmv_vc DC width {got_d} != engine n_dcs {dense.D}"
+            )
 
 
-def topk_rmv_drop_report(dense: Any, state: Any, ops: Any) -> Dict[str, int]:
-    """Count ops the kernels will drop, by reason, in one device reduction.
-
-    Padding conventions (add_ts <= 0, rmv_id < 0) are counted separately
-    from genuine range violations, so a monitor can alert on the latter
-    while ignoring the former. Returns plain ints (host-synced)."""
-    NK = jax.tree_util.tree_leaves(state)[0].shape[1]
-    I, D = dense.I, dense.D
+@functools.lru_cache(maxsize=64)
+def _drop_counts_fn(NK: int, I: int, D: int):
+    """Cached-per-config jitted reduction (a fresh inner @jit would
+    retrace and recompile on every report call)."""
 
     @jax.jit
     def counts(ops):
@@ -127,6 +137,17 @@ def topk_rmv_drop_report(dense: Any, state: Any, ops: Any) -> Dict[str, int]:
             jnp.sum(rmv_pad), jnp.sum(rmv_bad),
         )
 
+    return counts
+
+
+def topk_rmv_drop_report(dense: Any, state: Any, ops: Any) -> Dict[str, int]:
+    """Count ops the kernels will drop, by reason, in one device reduction.
+
+    Padding conventions (add_ts <= 0, rmv_id < 0) are counted separately
+    from genuine range violations, so a monitor can alert on the latter
+    while ignoring the former. Returns plain ints (host-synced)."""
+    NK = jax.tree_util.tree_leaves(state)[0].shape[1]
+    counts = _drop_counts_fn(NK, dense.I, dense.D)
     (a_pad, a_bad, a_key, a_id, a_dc, r_pad, r_bad) = counts(ops)
     return {
         "add_padding": int(a_pad),

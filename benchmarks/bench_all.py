@@ -218,6 +218,15 @@ def bench_worddocumentcount():
         "apply_ms": round(t_apply * 1e3, 2),
     }]
 
+    # NOTE (negative result, measured): chunking this corpus through the
+    # streaming pipeline (harness.pipeline.stream_apply, 8 chunks, depth-2
+    # prefetch) ran 8x SLOWER end to end on the tunneled v5e (~750ms per
+    # chunk vs ~570ms for the whole corpus in one shot): every chunk pays
+    # the tunnel's fixed upload+dispatch round trip (~0.5s), which dwarfs
+    # the encode/apply overlap it buys. Pipelined ingest wins when host
+    # encode and device apply are comparable and dispatch is cheap (see
+    # tests/test_pipeline.py on local backends) — not when a remote
+    # tunnel's RTT dominates. Keep single-shot ingest here.
     if nt.available():
         # Device-side dedup: host only splits and ids (1 CPU here); the
         # string-identity per-document dedup is one sort on the TPU

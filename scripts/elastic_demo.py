@@ -1,0 +1,202 @@
+"""Elastic-recovery drill worker (see parallel/elastic.py).
+
+N workers gossip a dense topk_rmv grid through a shared directory. Each
+step, each worker applies a *deterministic* op batch for the replicas it
+owns under the current alive set, heartbeats, and periodically publishes/
+sweeps. A worker started with --die-at crashes (os._exit) at that step;
+survivors detect the stale heartbeat, adopt its replicas, and — because
+op generation is deterministic and the join is idempotent — simply
+re-apply the adopted replicas' entire op history. Duplicated application
+of steps the victim already published is harmless by construction.
+
+Run one worker:
+    python scripts/elastic_demo.py --root /tmp/g --member w0 --n-members 3
+
+The supervising test (tests/test_elastic.py) launches several and checks
+every survivor converges to the sequential single-process reference.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Demo geometry (shared with the test's reference computation).
+R, NK, I, DCS, K, M, B, Br = 4, 1, 64, 4, 8, 2, 32, 8
+STEPS = 10
+
+
+def make_engine():
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import make_dense
+
+    return make_dense(n_ids=I, n_dcs=DCS, size=K, slots_per_id=M)
+
+
+def gen_step_ops(step: int, owned):
+    """Deterministic [R, ...] op batch for `step`; replicas not in `owned`
+    are masked to padding (add_ts=0 / rmv_id=-1). Any member can generate
+    any replica's stream — the durable op source of the drill."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import TopkRmvOps
+
+    owned = set(owned)
+    a_key = np.zeros((R, B), np.int32)
+    a_id = np.zeros((R, B), np.int32)
+    a_score = np.zeros((R, B), np.int32)
+    a_dc = np.zeros((R, B), np.int32)
+    a_ts = np.zeros((R, B), np.int32)
+    r_key = np.zeros((R, Br), np.int32)
+    r_id = np.full((R, Br), -1, np.int32)
+    r_vc = np.zeros((R, Br, DCS), np.int32)
+    for r in range(R):
+        rng = np.random.default_rng(10_000 * (step + 1) + r)
+        ids = rng.integers(0, I, B)
+        scores = rng.integers(1, 500, B)
+        if r in owned:
+            a_id[r], a_score[r] = ids, scores
+            a_dc[r] = r % DCS
+            a_ts[r] = step * B + np.arange(B) + 1  # unique, monotone
+            r_id[r] = rng.integers(0, I, Br)
+            r_vc[r, :, r % DCS] = rng.integers(1, max(2, step * B + 1), Br)
+    return TopkRmvOps(
+        add_key=jnp.asarray(a_key), add_id=jnp.asarray(a_id),
+        add_score=jnp.asarray(a_score), add_dc=jnp.asarray(a_dc),
+        add_ts=jnp.asarray(a_ts),
+        rmv_key=jnp.asarray(r_key), rmv_id=jnp.asarray(r_id),
+        rmv_vc=jnp.asarray(r_vc),
+    )
+
+
+def fold_rows(dense, state):
+    """Join all replica rows to one converged row (the read-side
+    reconciliation; order-free by the lattice laws)."""
+    import jax
+
+    folded = jax.tree.map(lambda x: x[:1], state)
+    for r in range(1, R):
+        row = jax.tree.map(lambda x: x[r : r + 1], state)
+        folded = dense.merge(folded, row)
+    return folded
+
+
+def observable_digest(dense, state):
+    obs = dense.value(fold_rows(dense, state))[0][0]
+    return sorted((int(i), int(s)) for (i, s) in obs)
+
+
+def reference_digest():
+    """Sequential single-process ground truth: every step, every replica."""
+    dense = make_engine()
+    state = dense.init(R, NK)
+    for step in range(STEPS):
+        state, _ = dense.apply_ops(state, gen_step_ops(step, range(R)))
+    return observable_digest(dense, state)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--root", required=True)
+    ap.add_argument("--member", required=True)
+    ap.add_argument("--n-members", type=int, required=True)
+    ap.add_argument("--die-at", type=int, default=-1)
+    ap.add_argument("--hb-interval", type=float, default=0.05)
+    ap.add_argument("--timeout", type=float, default=0.4)
+    ap.add_argument("--step-sleep", type=float, default=0.15)
+    ap.add_argument("--publish-every", type=int, default=2)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from antidote_ccrdt_tpu.parallel.elastic import (
+        GossipStore,
+        my_replicas,
+        sweep,
+    )
+
+    dense = make_engine()
+    state = dense.init(R, NK)
+    store = GossipStore(args.root, args.member)
+
+    # Background heartbeat: dies with the process, so a crash goes stale.
+    def beat():
+        while True:
+            store.heartbeat()
+            time.sleep(args.hb_interval)
+
+    threading.Thread(target=beat, daemon=True).start()
+
+    # Start barrier: wait until the whole initial membership has joined.
+    while len(store.members()) < args.n_members:
+        time.sleep(0.02)
+
+    owned_prev: set = set()
+    for step in range(STEPS):
+        if step == args.die_at:
+            os._exit(1)  # crash: no cleanup, heartbeat goes stale
+        owned = set(my_replicas(store, R, args.timeout))
+        # Adoption: replicas gained since last step get their FULL history
+        # re-applied — steps the previous owner already published merge in
+        # idempotently, steps it lost in the crash are regenerated.
+        for gained in sorted(owned - owned_prev):
+            for s in range(step):
+                state, _ = dense.apply_ops(
+                    state, gen_step_ops(s, [gained]), collect_dominated=False
+                )
+        owned_prev = owned
+        state, _ = dense.apply_ops(
+            state, gen_step_ops(step, sorted(owned)), collect_dominated=False
+        )
+        if step % args.publish_every == 0:
+            store.publish("topk_rmv", state, step)
+            state, _ = sweep(store, dense, state)
+        time.sleep(args.step_sleep)
+
+    # Final convergence: publish/sweep until every member that ever
+    # published has either published its FINAL state (step >= STEPS) or is
+    # confidently dead. Gating on snapshots rather than instantaneous
+    # liveness means a live peer whose heartbeat thread stalls for one
+    # timeout window is still waited for (its snapshot step says it isn't
+    # done) instead of being dropped mid-convergence; the crashed victim
+    # is exempted by a stale-beyond-doubt heartbeat.
+    store.publish("topk_rmv", state, STEPS)
+    confident_stale = max(1.5 * args.timeout, 0.6)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        state, _ = sweep(store, dense, state)
+        store.publish("topk_rmv", state, STEPS)
+        pending = []
+        alive_now = set(store.alive_members(confident_stale))
+        for m in store.snapshot_members():
+            if m == args.member:
+                continue
+            got = store.fetch(m, state, dense=dense)
+            finished = got is not None and got[0] >= STEPS
+            if not finished and m in alive_now:
+                pending.append(m)
+        if not pending:
+            break
+        time.sleep(0.1)
+    state, _ = sweep(store, dense, state)
+
+    out = {
+        "member": args.member,
+        "alive": store.alive_members(args.timeout),
+        "digest": observable_digest(dense, state),
+    }
+    with open(os.path.join(args.root, f"final-{args.member}.json"), "w") as f:
+        json.dump(out, f)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

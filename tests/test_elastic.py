@@ -1,0 +1,125 @@
+"""Elastic membership tier (parallel/elastic.py): unit tests for the
+failure detector / ownership / gossip pieces, plus the real-process
+recovery drill — three workers, one crashes mid-run, survivors detect it,
+adopt its replicas, and converge to the sequential reference."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import jax
+
+from antidote_ccrdt_tpu.models.topk_rmv_dense import make_dense
+from antidote_ccrdt_tpu.parallel.elastic import (
+    GossipStore,
+    my_replicas,
+    owners,
+    sweep,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEMO = os.path.join(REPO, "scripts", "elastic_demo.py")
+
+
+def test_owners_deterministic_and_total():
+    assert owners(["b", "a"], 4) == {0: "a", 1: "b", 2: "a", 3: "b"}
+    assert owners(["only"], 3) == {0: "only", 1: "only", 2: "only"}
+    assert owners([], 3) == {}
+
+
+def test_failure_detector_and_ownership_shift(tmp_path):
+    a = GossipStore(str(tmp_path), "a")
+    b = GossipStore(str(tmp_path), "b")
+    assert a.alive_members(10.0) == ["a", "b"]
+    assert set(my_replicas(a, 4, 10.0)) == {0, 2}
+    # b goes silent: backdate its heartbeat past the timeout.
+    hb = os.path.join(str(tmp_path), "hb-b")
+    past = time.time() - 60
+    os.utime(hb, (past, past))
+    assert a.alive_members(1.0) == ["a"]
+    assert set(my_replicas(a, 4, 1.0)) == {0, 1, 2, 3}
+    # b still considers itself alive (never self-suspects).
+    assert "b" in b.alive_members(1.0)
+
+
+def test_gossip_sweep_merges_peer_snapshots(tmp_path):
+    D = make_dense(n_ids=16, n_dcs=2, size=4, slots_per_id=2)
+    a = GossipStore(str(tmp_path), "a")
+    b = GossipStore(str(tmp_path), "b")
+    import jax.numpy as jnp
+
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import TopkRmvOps
+
+    def add(state, store_owner_row, id_, score, ts, dc=0):
+        z = lambda *s: jnp.zeros(s, jnp.int32)  # noqa: E731
+        ops = TopkRmvOps(
+            add_key=z(2, 1), add_id=jnp.asarray([[id_]], jnp.int32).repeat(2, 0),
+            add_score=jnp.asarray([[score]], jnp.int32).repeat(2, 0),
+            add_dc=z(2, 1) + dc,
+            add_ts=jnp.asarray(
+                [[ts if r == store_owner_row else 0] for r in range(2)], jnp.int32
+            ),
+            rmv_key=z(2, 1), rmv_id=z(2, 1) - 1, rmv_vc=z(2, 1, 2),
+        )
+        return D.apply_ops(state, ops, collect_dominated=False)[0]
+
+    sa = add(D.init(2, 1), 0, id_=3, score=50, ts=1)
+    sb = add(D.init(2, 1), 1, id_=7, score=90, ts=2)
+    a.publish("topk_rmv", sa, step=1)
+    b.publish("topk_rmv", sb, step=1)
+    merged, n = sweep(a, D, sa)
+    assert n == 1
+    v = D.value(merged)
+    assert v[0][0] == [(3, 50)] and v[1][0] == [(7, 90)]
+    # Idempotence: sweeping the same snapshots again changes nothing.
+    again, _ = sweep(a, D, merged)
+    assert D.equal(again, merged)
+
+
+def test_real_process_crash_recovery(tmp_path):
+    """Three workers; w1 crashes at step 4; w0/w2 must adopt its replicas
+    and both converge to the sequential single-process reference."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = {}
+    for member, extra in (
+        ("w0", []),
+        ("w1", ["--die-at", "4"]),
+        ("w2", []),
+    ):
+        procs[member] = subprocess.Popen(
+            [sys.executable, DEMO, "--root", str(tmp_path), "--member", member,
+             "--n-members", "3", *extra],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True,
+        )
+    outs = {}
+    for member, p in procs.items():
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            pytest.fail(f"worker {member} timed out:\n{out}")
+        outs[member] = out
+    assert procs["w1"].returncode == 1, f"victim should crash:\n{outs['w1']}"
+    for m in ("w0", "w2"):
+        assert procs[m].returncode == 0, f"worker {m} failed:\n{outs[m]}"
+
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import elastic_demo
+
+    ref = [list(t) for t in elastic_demo.reference_digest()]  # JSON: lists
+    assert ref, "reference observable is empty — drill is vacuous"
+    for m in ("w0", "w2"):
+        with open(os.path.join(str(tmp_path), f"final-{m}.json")) as f:
+            got = json.load(f)
+        assert got["digest"] == ref, (
+            f"{m} diverged from the sequential reference\n"
+            f"got:  {got['digest']}\nref: {ref}\nlog:\n{outs[m]}"
+        )
+        assert "w1" not in got["alive"], "crashed member still considered alive"

@@ -350,3 +350,39 @@ def test_out_of_range_add_fields_dropped_not_aliased():
     assert not bool(st2.lossy.any())
     # vc advances only for valid adds: dc 1 saw ts 7, dc 0 saw nothing.
     assert st2.vc[0, 1, 1] == 7 and st2.vc[0, 0, 0] == 0
+
+
+def test_out_of_range_rmv_fields_dropped_not_aliased():
+    # Regression (mirror of the add-path fix): a removal with rmv_id >= I
+    # computes rrow = key*I + id inside the NEXT key's tombstone range and
+    # must be dropped, not write a tombstone against a live element of a
+    # different instance.
+    D = make_dense(n_ids=4, n_dcs=2, size=2, slots_per_id=2)
+    st = D.init(1, 2)
+    # Key 1 holds element id 2, added at dc 0 ts 5.
+    seed = TopkRmvOps(
+        add_key=jnp.asarray([[1]], jnp.int32),
+        add_id=jnp.asarray([[2]], jnp.int32),
+        add_score=jnp.asarray([[50]], jnp.int32),
+        add_dc=jnp.asarray([[0]], jnp.int32),
+        add_ts=jnp.asarray([[5]], jnp.int32),
+        rmv_key=jnp.asarray([[0]], jnp.int32),
+        rmv_id=jnp.asarray([[-1]], jnp.int32),
+        rmv_vc=jnp.zeros((1, 1, 2), jnp.int32),
+    )
+    st, _ = D.apply_ops(st, seed)
+    # Malformed removals: key=0, id=6 -> rrow 6 == (key 1, id 2);
+    # key=9 out of range; both must be dropped whole.
+    bad = TopkRmvOps(
+        add_key=jnp.asarray([[0]], jnp.int32),
+        add_id=jnp.asarray([[0]], jnp.int32),
+        add_score=jnp.asarray([[1]], jnp.int32),
+        add_dc=jnp.asarray([[0]], jnp.int32),
+        add_ts=jnp.asarray([[0]], jnp.int32),  # padding add
+        rmv_key=jnp.asarray([[0, 9]], jnp.int32),
+        rmv_id=jnp.asarray([[6, 1]], jnp.int32),
+        rmv_vc=jnp.full((1, 2, 2), 99, jnp.int32),
+    )
+    st2, _ = D.apply_ops(st, bad)
+    assert D.value(st2)[0][1] == [(2, 50)], "aliased rmv killed another key's element"
+    assert int(st2.rmv_vc.sum()) == 0, "tombstone written for out-of-range removal"

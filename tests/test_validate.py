@@ -105,6 +105,5 @@ def test_checkpoint_restore_validates_against_engine():
         assert step == 7 and name == "topk_rmv"
         # Same bytes, different engine config: restore must refuse.
         D2 = make_dense(n_ids=16, n_dcs=2, size=2, slots_per_id=2)
-        like2 = D2.init(2, 1)
         with pytest.raises(ValueError):
             load_dense_checkpoint(p, st, dense=D2)

@@ -119,6 +119,195 @@ class GossipStore:
         )
 
 
+    # -- delta publishes (delta-state replication, parallel/delta.py) ------
+
+    def snapshot_seq(self, member: str) -> Optional[int]:
+        """Seq/step of `member`'s full snapshot from its 8-byte header —
+        without parsing the (large) payload."""
+        try:
+            with open(os.path.join(self.root, f"snap-{member}"), "rb") as f:
+                hdr = f.read(8)
+            if len(hdr) < 8:
+                return None
+            import struct
+
+            return struct.unpack("<Q", hdr)[0]
+        except OSError:
+            return None
+
+    def publish_delta(self, delta_blob: bytes, seq: int, keep: int = 16) -> None:
+        """Atomically publish a serialized delta at `seq`; prune deltas
+        older than `seq - keep` (receivers that fall off the retained
+        window resync from the full snapshot)."""
+        path = os.path.join(self.root, f"delta-{self.member}-{seq:08d}")
+        tmp = f"{path}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(delta_blob)
+        os.replace(tmp, path)
+        self.heartbeat()
+        for s in self.delta_seqs(self.member):
+            if s <= seq - keep:
+                try:
+                    os.remove(
+                        os.path.join(self.root, f"delta-{self.member}-{s:08d}")
+                    )
+                except OSError:
+                    pass
+
+    def delta_seqs(self, member: str) -> List[int]:
+        pre = f"delta-{member}-"
+        out = []
+        for f in os.listdir(self.root):
+            if f.startswith(pre) and not f.endswith(".tmp"):
+                try:
+                    out.append(int(f[len(pre):]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def fetch_delta(
+        self, member: str, seq: int, like_delta: Any,
+        dense: Any = None, n_rows: int = 0,
+    ) -> Optional[Any]:
+        """Deserialized delta at `seq`, or None (missing/torn/pruned/
+        mis-configured — same total-failure policy as `fetch`). With
+        `dense`/`n_rows`, a structurally-decodable delta from a peer on a
+        DIFFERENT engine config (loads_dense checks only the treedef) is
+        rejected here instead of crashing expand/merge downstream."""
+        from ..core import serial
+
+        path = os.path.join(self.root, f"delta-{member}-{seq:08d}")
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+            _name, delta = serial.loads_dense(data, like_delta)
+            if dense is not None:
+                import numpy as _np
+
+                if (
+                    delta.slot_score.shape[1:] != (dense.M,)
+                    or delta.rmv_vc.shape[1:] != (dense.D,)
+                    or delta.vc.shape[-1] != dense.D
+                ):
+                    return None
+                if n_rows and delta.rows.size and (
+                    int(_np.asarray(delta.rows).min()) < 0
+                    or int(_np.asarray(delta.rows).max()) >= n_rows
+                ):
+                    return None
+        except Exception:  # noqa: BLE001 — see fetch
+            return None
+        return delta
+
+
+class DeltaPublisher:
+    """Publish a member's state as chained deltas with periodic full
+    snapshots (the classic delta-CRDT shipping discipline: deltas for
+    bandwidth, full states as the resync anchor)."""
+
+    def __init__(
+        self, store: GossipStore, dense: Any, name: str = "topk_rmv",
+        full_every: int = 8, keep: int = 16,
+    ):
+        from ..core import serial
+
+        self.store = store
+        self.dense = dense
+        self.name = name
+        self.full_every = full_every
+        self.keep = keep
+        self.seq = -1
+        self._prev: Any = None
+        self._serial = serial
+
+    def publish(self, state: Any) -> Dict[str, Any]:
+        from .delta import state_delta
+
+        self.seq += 1
+        if self._prev is None or self.seq % self.full_every == 0:
+            self.store.publish(self.name, state, self.seq)
+            kind, nbytes = "full", -1
+        else:
+            delta = state_delta(self.dense, self._prev, state)
+            blob = self._serial.dumps_dense(f"{self.name}_delta", delta)
+            self.store.publish_delta(blob, self.seq, keep=self.keep)
+            kind, nbytes = "delta", len(blob)
+        self._prev = state
+        return {"kind": kind, "seq": self.seq, "nbytes": nbytes}
+
+
+def empty_delta(dense: Any):
+    """A shape-valid TopkRmvDelta usable as the `like` treedef target."""
+    import jax.numpy as jnp
+
+    from .delta import TopkRmvDelta
+
+    z = lambda *s: jnp.zeros(s, jnp.int32)  # noqa: E731
+    return TopkRmvDelta(
+        rows=z(0), slot_score=z(0, dense.M), slot_dc=z(0, dense.M),
+        slot_ts=z(0, dense.M), rmv_vc=z(0, dense.D),
+        vc=z(1, 1, dense.D), lossy=jnp.zeros((1, 1), bool),
+    )
+
+
+def sweep_deltas(
+    store: GossipStore, dense: Any, state: Any, cursors: Dict[str, int]
+) -> Tuple[Any, Dict[str, Any]]:
+    """Delta-aware sweep: per peer, chain contiguous deltas from the
+    cursor; on a gap (pruned, torn, or never-seen member) resync from the
+    peer's full snapshot and continue chaining. `cursors` maps member ->
+    highest seq applied and is updated in place. Applying a full snapshot
+    after deltas (or twice) is harmless — everything is a join."""
+    from .delta import apply_delta
+
+    import jax
+
+    like_delta = empty_delta(dense)
+    R, NK = jax.tree_util.tree_leaves(state)[0].shape[:2]
+    n_rows = R * NK * dense.I
+    stats = {"deltas": 0, "fulls": 0, "skipped": 0}
+
+    def chain(member: str, cur: int) -> int:
+        nonlocal state, stats
+        avail = set(store.delta_seqs(member))
+        while cur + 1 in avail:
+            delta = store.fetch_delta(
+                member, cur + 1, like_delta, dense=dense, n_rows=n_rows
+            )
+            if delta is None:
+                break  # torn/mismatched write: retry (or resync) next sweep
+            state = apply_delta(dense, state, delta)
+            stats["deltas"] += 1
+            cur += 1
+        return cur
+
+    # Members with any delta file: strip "delta-" prefix and "-<seq>"
+    # suffix (member names may themselves contain dashes).
+    delta_members = {
+        f[len("delta-"):].rsplit("-", 1)[0]
+        for f in os.listdir(store.root)
+        if f.startswith("delta-") and not f.endswith(".tmp")
+    }
+    for m in sorted(set(store.snapshot_members()) | delta_members):
+        if m == store.member:
+            continue
+        cur = cursors.get(m, -1)
+        cur = chain(m, cur)
+        snap_seq = store.snapshot_seq(m)
+        if snap_seq is not None and snap_seq > cur:
+            got = store.fetch(m, state, dense=dense)
+            if got is None:
+                stats["skipped"] += 1
+            else:
+                _seq, peer = got
+                state = dense.merge(state, peer)
+                stats["fulls"] += 1
+                cur = max(cur, _seq)
+                cur = chain(m, cur)
+        cursors[m] = cur
+    return state, stats
+
+
 def owners(alive: List[str], n_replicas: int) -> Dict[int, str]:
     """Deterministic replica→member assignment from the alive set alone:
     replica r belongs to alive[r % len(alive)]. Every member computes this

@@ -179,8 +179,9 @@ def main() -> None:
         for m in store.snapshot_members():
             if m == args.member:
                 continue
-            got = store.fetch(m, state, dense=dense)
-            finished = got is not None and got[0] >= STEPS
+            # Poll the 8-byte seq header, not the whole (large) snapshot.
+            seq = store.snapshot_seq(m)
+            finished = seq is not None and seq >= STEPS
             if not finished and m in alive_now:
                 pending.append(m)
         if not pending:

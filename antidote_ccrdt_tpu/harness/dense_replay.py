@@ -99,10 +99,27 @@ class DenseReplay:
 
     # -- local application -------------------------------------------------
 
-    def apply(self, ops: Any) -> Any:
+    def apply(self, ops: Any, report_drops: bool = False) -> Any:
         """Apply one op batch (replica r's ops in row r) locally at every
         replica — a single vectorized dispatch; collects generated extras
-        (promotions / rmv re-broadcasts) for the types that emit them."""
+        (promotions / rmv re-broadcasts) for the types that emit them.
+
+        `report_drops` feeds the jit-boundary silent-drop counters
+        (utils.validate.topk_rmv_drop_report) into this replay's metrics,
+        separating padding from genuine out-of-range garbage — wire an
+        alarm on `ops_dropped_out_of_range` to catch a corrupted feed."""
+        if report_drops and hasattr(ops, "rmv_vc"):
+            from ..utils.validate import topk_rmv_drop_report
+
+            rep = topk_rmv_drop_report(self.dense, self.state, ops)
+            self.metrics.count(
+                "ops_dropped_out_of_range",
+                rep["add_dropped_out_of_range"]
+                + rep["rmv_dropped_out_of_range"],
+            )
+            self.metrics.count(
+                "ops_padding", rep["add_padding"] + rep["rmv_padding"]
+            )
         with self.metrics.timer("apply"):
             self.state, extras = self.dense.apply_ops(self.state, ops)
         if extras is not None:

@@ -49,3 +49,26 @@ def test_device_trace_is_cheap_noop_without_capture():
     with device_trace("annotated-region"):
         x = jnp.ones((4,)) + 1
     assert float(x.sum()) == 8.0
+
+
+def test_dense_replay_drop_reporting():
+    import jax.numpy as jnp
+
+    from antidote_ccrdt_tpu.harness.dense_replay import DenseReplay
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import TopkRmvOps, make_dense
+
+    D = make_dense(n_ids=4, n_dcs=2, size=2, slots_per_id=2)
+    rp = DenseReplay(D, n_replicas=1, n_keys=1)
+    ops = TopkRmvOps(
+        add_key=jnp.asarray([[0, 0, 0]], jnp.int32),
+        add_id=jnp.asarray([[1, 9, 2]], jnp.int32),   # 9 out of range
+        add_score=jnp.asarray([[5, 5, 5]], jnp.int32),
+        add_dc=jnp.asarray([[0, 0, 0]], jnp.int32),
+        add_ts=jnp.asarray([[1, 2, 0]], jnp.int32),   # last = padding
+        rmv_key=jnp.asarray([[0]], jnp.int32),
+        rmv_id=jnp.asarray([[-1]], jnp.int32),        # padding
+        rmv_vc=jnp.zeros((1, 1, 2), jnp.int32),
+    )
+    rp.apply(ops, report_drops=True)
+    assert rp.metrics.counters["ops_dropped_out_of_range"] == 1
+    assert rp.metrics.counters["ops_padding"] == 2

@@ -169,3 +169,76 @@ def test_mismatched_config_delta_skipped(tmp_path):
     state_b, stats = sweep_deltas(b, D, state_b, cursors)  # must not raise
     assert stats["deltas"] == 0
     assert D.equal(state_b, D.init(R, NK))
+
+
+from hypothesis import HealthCheck, given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
+
+
+@settings(
+    max_examples=20, deadline=None,
+    suppress_health_check=[HealthCheck.too_slow],
+)
+@given(
+    script=st.lists(
+        st.tuples(st.integers(0, 1), st.sampled_from(["ops", "publish", "sweep"])),
+        min_size=1, max_size=24,
+    ),
+    keep=st.integers(1, 4),
+    full_every=st.integers(2, 6),
+)
+def test_delta_gossip_arbitrary_interleavings(script, keep, full_every):
+    """Protocol soundness under ANY schedule of op application, delta/full
+    publishing (with aggressive pruning), and sweeping: after a final
+    publish + sweep everyone equals the sequential reference."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as root:
+        names = ["a", "b"]
+        stores = [GossipStore(root, n) for n in names]
+        pubs = [
+            DeltaPublisher(s, D, full_every=full_every, keep=keep)
+            for s in stores
+        ]
+        states = [D.init(R, NK) for _ in names]
+        cursors: list = [{}, {}]
+        ref = D.init(R, NK)
+        counters = [0, 0]
+
+        def member_ops(m, k):
+            # Deterministic per (member, k); member m touches row m only.
+            rng = np.random.default_rng(7_000 + 97 * m + k)
+            ops = rand_ops(rng, B=6, Br=2, ts_base=1 + 50 * k)
+            row_mask = (np.arange(R) == m)[:, None]
+            return TopkRmvOps(
+                add_key=ops.add_key,
+                add_id=ops.add_id,
+                add_score=ops.add_score,
+                add_dc=ops.add_dc,
+                add_ts=ops.add_ts * jnp.asarray(row_mask, jnp.int32),
+                rmv_key=ops.rmv_key,
+                rmv_id=jnp.where(jnp.asarray(row_mask), ops.rmv_id, -1),
+                rmv_vc=ops.rmv_vc,
+            )
+
+        for m, action in script:
+            if action == "ops":
+                ops = member_ops(m, counters[m])
+                counters[m] += 1
+                states[m], _ = D.apply_ops(states[m], ops)
+                ref, _ = D.apply_ops(ref, ops)
+            elif action == "publish":
+                pubs[m].publish(states[m])
+            else:
+                states[m], _ = sweep_deltas(stores[m], D, states[m], cursors[m])
+        for m in range(2):
+            pubs[m].publish(states[m])
+        # Everyone must have a full anchor for final convergence (the last
+        # publish may have been a delta the peer's cursor can't reach if
+        # earlier deltas were pruned) — publish full explicitly.
+        for m in range(2):
+            stores[m].publish("topk_rmv", states[m], pubs[m].seq)
+        for m in range(2):
+            states[m], _ = sweep_deltas(stores[m], D, states[m], cursors[m])
+        for m in range(2):
+            assert D.equal(states[m], ref), f"member {m} diverged"

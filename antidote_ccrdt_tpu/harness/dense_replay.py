@@ -44,10 +44,12 @@ def _rows(state: Any, idx) -> Any:
     return jax.tree.map(lambda x: x[idx], state)
 
 
-def _fold_rows(dense: DenseCCRDT, state: Any, contributors: Sequence[int]) -> Any:
+def fold_rows(dense: DenseCCRDT, state: Any, contributors: Sequence[int]) -> Any:
     """Fold the given replica rows (with repetition allowed) with the CRDT
     merge. `merge` is batched over the leading replica axis, so the tree
-    reduction halves the whole stack at once: log2(n) dispatches total."""
+    reduction halves the whole stack at once: log2(n) dispatches total.
+    Public: the read-side reconciliation primitive (elastic_demo, embedders)
+    as well as this replay's sync step."""
     idx = np.asarray(list(contributors), dtype=np.int32)
     acc = _rows(state, idx)  # [C, ...]
     n = len(idx)
@@ -149,10 +151,10 @@ class DenseReplay:
                 if self.dense.merge_kind == MergeKind.MONOID:
                     self.state = self.dense.init(n_replicas=self.n, n_keys=self.nk)
             elif self.dense.merge_kind == MergeKind.JOIN:
-                folded = _fold_rows(self.dense, self.state, contributors)
+                folded = fold_rows(self.dense, self.state, contributors)
                 self.state = _broadcast_rows(folded, self.n)
             else:
-                summed = _fold_rows(self.dense, self.state, contributors)
+                summed = fold_rows(self.dense, self.state, contributors)
                 self.base = self.dense.merge(self.base, summed)
                 self.state = self.dense.init(n_replicas=self.n, n_keys=self.nk)
         self.metrics.count("syncs")

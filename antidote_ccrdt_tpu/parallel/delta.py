@@ -117,6 +117,17 @@ def expand_delta(dense: Any, delta: TopkRmvDelta) -> Any:
     )
 
 
+def empty_delta(dense: Any) -> TopkRmvDelta:
+    """A shape-valid zero-row delta: the `like` treedef target for
+    deserialization (loads_dense checks treedef, not shapes)."""
+    z = lambda *s: jnp.zeros(s, jnp.int32)  # noqa: E731
+    return TopkRmvDelta(
+        rows=z(0), slot_score=z(0, dense.M), slot_dc=z(0, dense.M),
+        slot_ts=z(0, dense.M), rmv_vc=z(0, dense.D),
+        vc=z(1, 1, dense.D), lossy=jnp.zeros((1, 1), bool),
+    )
+
+
 def delta_nbytes(delta: TopkRmvDelta) -> int:
     return sum(
         np.asarray(leaf).nbytes for leaf in jax.tree_util.tree_leaves(delta)

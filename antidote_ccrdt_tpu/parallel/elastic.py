@@ -38,10 +38,14 @@ scripts/elastic_demo.py + tests/test_elastic.py.
 from __future__ import annotations
 
 import os
+import struct
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+import numpy as np
+
 from ..harness.checkpoint import load_dense_checkpoint, save_dense_checkpoint
+from .delta import empty_delta  # noqa: F401 — part of this module's API
 
 
 class GossipStore:
@@ -129,8 +133,6 @@ class GossipStore:
                 hdr = f.read(8)
             if len(hdr) < 8:
                 return None
-            import struct
-
             return struct.unpack("<Q", hdr)[0]
         except OSError:
             return None
@@ -182,8 +184,6 @@ class GossipStore:
                 data = f.read()
             _name, delta = serial.loads_dense(data, like_delta)
             if dense is not None:
-                import numpy as _np
-
                 if (
                     delta.slot_score.shape[1:] != (dense.M,)
                     or delta.rmv_vc.shape[1:] != (dense.D,)
@@ -191,8 +191,8 @@ class GossipStore:
                 ):
                     return None
                 if n_rows and delta.rows.size and (
-                    int(_np.asarray(delta.rows).min()) < 0
-                    or int(_np.asarray(delta.rows).max()) >= n_rows
+                    int(np.asarray(delta.rows).min()) < 0
+                    or int(np.asarray(delta.rows).max()) >= n_rows
                 ):
                     return None
         except Exception:  # noqa: BLE001 — see fetch
@@ -234,20 +234,6 @@ class DeltaPublisher:
             kind, nbytes = "delta", len(blob)
         self._prev = state
         return {"kind": kind, "seq": self.seq, "nbytes": nbytes}
-
-
-def empty_delta(dense: Any):
-    """A shape-valid TopkRmvDelta usable as the `like` treedef target."""
-    import jax.numpy as jnp
-
-    from .delta import TopkRmvDelta
-
-    z = lambda *s: jnp.zeros(s, jnp.int32)  # noqa: E731
-    return TopkRmvDelta(
-        rows=z(0), slot_score=z(0, dense.M), slot_dc=z(0, dense.M),
-        slot_ts=z(0, dense.M), rmv_vc=z(0, dense.D),
-        vc=z(1, 1, dense.D), lossy=jnp.zeros((1, 1), bool),
-    )
 
 
 def sweep_deltas(

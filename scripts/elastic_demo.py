@@ -75,20 +75,10 @@ def gen_step_ops(step: int, owned):
     )
 
 
-def fold_rows(dense, state):
-    """Join all replica rows to one converged row (the read-side
-    reconciliation; order-free by the lattice laws)."""
-    import jax
-
-    folded = jax.tree.map(lambda x: x[:1], state)
-    for r in range(1, R):
-        row = jax.tree.map(lambda x: x[r : r + 1], state)
-        folded = dense.merge(folded, row)
-    return folded
-
-
 def observable_digest(dense, state):
-    obs = dense.value(fold_rows(dense, state))[0][0]
+    from antidote_ccrdt_tpu.harness.dense_replay import fold_rows
+
+    obs = dense.value(fold_rows(dense, state, range(R)))[0][0]
     return sorted((int(i), int(s)) for (i, s) in obs)
 
 

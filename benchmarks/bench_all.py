@@ -259,11 +259,45 @@ def bench_worddocumentcount():
     return out
 
 
+def bench_delta_payload():
+    """Delta-state replication payload at north-star state scale: bytes
+    shipped per gossip publish for one op round, vs the full state
+    (parallel/delta.py — the inter-DC bandwidth lever)."""
+    from antidote_ccrdt_tpu.harness.opgen import TopkRmvEffectGen, Workload
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import make_dense
+    from antidote_ccrdt_tpu.parallel.delta import delta_nbytes, state_delta
+
+    import jax
+
+    R, I, D_DCS, B, Br = sized(
+        (32, 100_000, 32, 2048, 128), (4, 5_000, 4, 256, 16)
+    )
+    D = make_dense(n_ids=I, n_dcs=D_DCS, size=100, slots_per_id=4)
+    gen = TopkRmvEffectGen(
+        Workload(n_replicas=R, n_ids=I, zipf_a=1.2, score_max=100_000, seed=3)
+    )
+    st = D.init(R, 1)
+    st, _ = D.apply_ops(st, gen.next_batch(8192, 512))  # populated baseline
+    prev = st
+    st, _ = D.apply_ops(st, gen.next_batch(B, Br))
+    delta = state_delta(D, prev, st)
+    full = sum(np.asarray(x).nbytes for x in jax.tree.leaves(st))
+    d = delta_nbytes(delta)
+    return {
+        "metric": f"delta-state publish payload ({I//1000}k ids x {R} "
+                  f"replicas, {B}+{Br} op round)",
+        "value": round(full / d, 1),
+        "unit": "x smaller than full-state publish",
+        "delta_mb": round(d / 1e6, 2),
+        "full_mb": round(full / 1e6, 2),
+    }
+
+
 def main():
     import jax
 
     for fn in (bench_average, bench_topk, bench_leaderboard, bench_wordcount,
-               bench_worddocumentcount):
+               bench_delta_payload, bench_worddocumentcount):
         out = fn()
         for rec in out if isinstance(out, list) else [out]:
             rec["backend"] = jax.default_backend()

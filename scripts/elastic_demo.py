@@ -97,6 +97,13 @@ def main() -> None:
     ap.add_argument("--member", required=True)
     ap.add_argument("--n-members", type=int, required=True)
     ap.add_argument("--die-at", type=int, default=-1)
+    ap.add_argument(
+        "--join-late", type=float, default=0.0,
+        help="delay registration this many seconds: the member joins an "
+        "already-running gossip (scale-UP elasticity); it adopts whatever "
+        "replicas the ownership map hands it and catches up by full "
+        "history re-apply + sweep",
+    )
     ap.add_argument("--hb-interval", type=float, default=0.05)
     ap.add_argument("--timeout", type=float, default=0.4)
     ap.add_argument("--step-sleep", type=float, default=0.15)
@@ -115,6 +122,12 @@ def main() -> None:
 
     dense = make_engine()
     state = dense.init(R, NK)
+    if args.join_late > 0:
+        # Late join: compile the engine first (apply a no-op batch), THEN
+        # register — from the fleet's view the member appears and is
+        # immediately productive.
+        state, _ = dense.apply_ops(state, gen_step_ops(0, []))
+        time.sleep(args.join_late)
     store = GossipStore(args.root, args.member)
 
     # Background heartbeat: dies with the process, so a crash goes stale.
@@ -125,15 +138,24 @@ def main() -> None:
 
     threading.Thread(target=beat, daemon=True).start()
 
-    # Start barrier: wait until the whole initial membership has joined.
-    while len(store.members()) < args.n_members:
+    # Start barrier: wait until the whole initial membership has joined
+    # (late joiners skip it — the fleet is already running).
+    while args.join_late == 0 and len(store.members()) < args.n_members:
         time.sleep(0.02)
 
     owned_prev: set = set()
     for step in range(STEPS):
         if step == args.die_at:
             os._exit(1)  # crash: no cleanup, heartbeat goes stale
-        owned = set(my_replicas(store, R, args.timeout))
+        # Ownership only ever GROWS during a run: dropping a replica on a
+        # membership change is unsafe under asymmetric views (member A may
+        # drop r for new owner B before B has even seen the new map — r's
+        # trailing steps would be applied by no one). Keeping it means the
+        # old and new owner briefly both apply r's deterministic stream,
+        # which the join dedups — idempotence is what makes handoff need
+        # no coordination. (A real deployment would shed the old owner's
+        # copy at the next reconciliation barrier.)
+        owned = owned_prev | set(my_replicas(store, R, args.timeout))
         # Adoption: replicas gained since last step get their FULL history
         # re-applied — steps the previous owner already published merge in
         # idempotently, steps it lost in the crash are regenerated.

@@ -123,3 +123,48 @@ def test_real_process_crash_recovery(tmp_path):
             f"got:  {got['digest']}\nref: {ref}\nlog:\n{outs[m]}"
         )
         assert "w1" not in got["alive"], "crashed member still considered alive"
+
+
+def test_real_process_scale_up_late_joiner(tmp_path):
+    """Two founding workers + one that joins ~1s into the run: ownership
+    rebalances onto the joiner, everyone converges to the reference."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = {}
+    for member, extra in (
+        ("w0", []),
+        ("w1", []),
+        ("w2", ["--join-late", "1.0"]),
+    ):
+        procs[member] = subprocess.Popen(
+            [sys.executable, DEMO, "--root", str(tmp_path), "--member", member,
+             "--n-members", "2", *extra],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True,
+        )
+    outs = {}
+    for member, p in procs.items():
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            pytest.fail(f"worker {member} timed out:\n{out}")
+        outs[member] = out
+        assert p.returncode == 0, f"worker {member} failed:\n{out}"
+
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import elastic_demo
+
+    ref = [list(t) for t in elastic_demo.reference_digest()]
+    for m in ("w0", "w1", "w2"):
+        with open(os.path.join(str(tmp_path), f"final-{m}.json")) as f:
+            got = json.load(f)
+        assert got["digest"] == ref, (
+            f"{m} diverged\ngot:  {got['digest']}\nref: {ref}\nlog:\n{outs[m]}"
+        )
+    # The founders must have seen (and waited for) the joiner; the joiner's
+    # own exit-time view may no longer list the founders — they are allowed
+    # to exit as soon as everyone's FINAL state is published.
+    with open(os.path.join(str(tmp_path), "final-w0.json")) as f:
+        assert "w2" in json.load(f)["alive"]

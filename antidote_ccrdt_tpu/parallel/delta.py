@@ -128,7 +128,7 @@ def empty_delta(dense: Any) -> TopkRmvDelta:
     )
 
 
-def delta_nbytes(delta: TopkRmvDelta) -> int:
+def delta_nbytes(delta: Any) -> int:
     return sum(
         np.asarray(leaf).nbytes for leaf in jax.tree_util.tree_leaves(delta)
     )
@@ -137,3 +137,83 @@ def delta_nbytes(delta: TopkRmvDelta) -> int:
 def apply_delta(dense: Any, state: Any, delta: TopkRmvDelta) -> Any:
     """Join a delta into `state` (receiver side)."""
     return dense.merge(state, expand_delta(dense, delta))
+
+
+# --- generic entrywise deltas (topk / leaderboard / wordcount) ------------
+
+
+def _split_leaves(state: Any):
+    """(paths, leaves, table_paths): table leaves are the [R, NK, P] score/
+    count/ban planes (3-D); everything else (lost counters, flags) ships
+    whole — they are O(R*NK), not O(P)."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(state)
+    paths = [jax.tree_util.keystr(p) for p, _ in flat]
+    leaves = [leaf for _, leaf in flat]
+    table = [paths[i] for i, leaf in enumerate(leaves) if leaf.ndim == 3]
+    return paths, leaves, table, treedef
+
+
+def table_delta(dense: Any, prev: Any, cur: Any) -> dict:
+    """Entrywise delta for the table-shaped dense states (topk,
+    leaderboard, wordcount): every 3-D leaf shares the [R, NK, P] plane, a
+    changed-entry index selects the shipped cells.
+
+    Payload semantics follow the engine's merge algebra: JOIN types ship
+    the new VALUES (applied via the idempotent join), MONOID types ship
+    the numeric DIFFERENCE since the last publish (applied via `+` — a
+    monoid delta must not be double-applied, which the chained-seq gossip
+    protocol already guarantees). The delta is a plain dict pytree, so
+    `core.serial.dumps_dense` ships it unchanged."""
+    from ..core.behaviour import MergeKind
+
+    monoid = dense.merge_kind == MergeKind.MONOID
+    paths, prevs, table_paths, _ = _split_leaves(prev)
+    _, curs, _, _ = _split_leaves(cur)
+    by_path = dict(zip(paths, zip(prevs, curs)))
+
+    changed = None
+    for p in table_paths:
+        pv, cv = by_path[p]
+        c = cv != pv
+        changed = c if changed is None else (changed | c)
+    mask = np.asarray(changed).reshape(-1)
+    idx = jnp.asarray(np.nonzero(mask)[0].astype(np.int32))
+
+    out: dict = {"idx": idx, "table": {}, "whole": {}}
+    for p in paths:
+        pv, cv = by_path[p]
+        if p in table_paths:
+            flat_c = cv.reshape(-1)
+            vals = flat_c[idx]
+            if monoid:
+                vals = vals - pv.reshape(-1)[idx]
+            out["table"][p] = vals
+        else:
+            out["whole"][p] = (
+                (cv - pv) if (monoid and jnp.issubdtype(cv.dtype, jnp.integer))
+                else cv
+            )
+    return out
+
+
+def expand_table_delta(dense: Any, like: Any, delta: dict) -> Any:
+    """Lift an entrywise delta onto the identity state (`dense.init` IS
+    the join bottom / monoid zero for every type), so `dense.merge` applies
+    it — same move as `expand_delta`, type-agnostically."""
+    R, NK = jax.tree_util.tree_leaves(like)[0].shape[:2]
+    ident = dense.init(R, NK)
+    paths, id_leaves, table_paths, treedef = _split_leaves(ident)
+    idx = np.asarray(delta["idx"])
+    rebuilt = []
+    for p, leaf in zip(paths, id_leaves):
+        if p in table_paths:
+            flat = np.asarray(leaf).reshape(-1).copy()
+            flat[idx] = np.asarray(delta["table"][p])
+            rebuilt.append(jnp.asarray(flat.reshape(leaf.shape)))
+        else:
+            rebuilt.append(jnp.asarray(delta["whole"][p]))
+    return jax.tree_util.tree_unflatten(treedef, rebuilt)
+
+
+def apply_table_delta(dense: Any, state: Any, delta: dict) -> Any:
+    return dense.merge(state, expand_table_delta(dense, state, delta))

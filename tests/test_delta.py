@@ -242,3 +242,85 @@ def test_delta_gossip_arbitrary_interleavings(script, keep, full_every):
             states[m], _ = sweep_deltas(stores[m], D, states[m], cursors[m])
         for m in range(2):
             assert D.equal(states[m], ref), f"member {m} diverged"
+
+
+# --- generic entrywise deltas (topk / leaderboard / wordcount) ------------
+
+
+from antidote_ccrdt_tpu.parallel.delta import (  # noqa: E402
+    apply_table_delta,
+    expand_table_delta,
+    table_delta,
+)
+
+
+def _leaderboard_pair(seed):
+    from antidote_ccrdt_tpu.models.leaderboard import LeaderboardOps
+    from antidote_ccrdt_tpu.models.leaderboard import make_dense as mk_lb
+
+    rng = np.random.default_rng(seed)
+    Dl = mk_lb(n_players=128, size=4)
+
+    def ops(n, nb):
+        return LeaderboardOps(
+            add_key=jnp.zeros((2, n), jnp.int32),
+            add_id=jnp.asarray(rng.integers(0, 128, (2, n)).astype(np.int32)),
+            add_score=jnp.asarray(rng.integers(1, 500, (2, n)).astype(np.int32)),
+            add_valid=jnp.ones((2, n), bool),
+            ban_key=jnp.zeros((2, nb), jnp.int32),
+            ban_id=jnp.asarray(rng.integers(0, 128, (2, nb)).astype(np.int32)),
+            ban_valid=jnp.ones((2, nb), bool),
+        )
+
+    prev = Dl.init(2, 1)
+    prev, _ = Dl.apply_ops(prev, ops(20, 3))
+    cur, _ = Dl.apply_ops(prev, ops(6, 1))
+    return Dl, prev, cur
+
+
+def _wordcount_pair(seed):
+    from antidote_ccrdt_tpu.models.wordcount import WordcountOps
+    from antidote_ccrdt_tpu.models.wordcount import make_dense as mk_wc
+
+    rng = np.random.default_rng(seed)
+    Dw = mk_wc(256)
+
+    def ops(n):
+        return WordcountOps(
+            key=jnp.zeros((2, n), jnp.int32),
+            token=jnp.asarray(rng.integers(0, 256, (2, n)).astype(np.int32)),
+        )
+
+    prev = Dw.init(2, 1)
+    prev, _ = Dw.apply_ops(prev, ops(40))
+    cur, _ = Dw.apply_ops(prev, ops(10))
+    return Dw, prev, cur
+
+
+@pytest.mark.parametrize("mk", [_leaderboard_pair, _wordcount_pair])
+@pytest.mark.parametrize("seed", range(3))
+def test_table_delta_decomposition_law(mk, seed):
+    # prev (+ or ⊔) expand(delta(prev, cur)) == cur, per the merge algebra.
+    Deng, prev, cur = mk(seed)
+    delta = table_delta(Deng, prev, cur)
+    rejoined = apply_table_delta(Deng, prev, delta)
+    assert states_equal(rejoined, cur)
+
+
+def test_table_delta_join_receiver_equivalence():
+    Dl, prev, cur = _leaderboard_pair(42)
+    Dl2, other, _ = _leaderboard_pair(43)
+    theirs = Dl.merge(other, prev)  # receiver holds >= prev
+    via_delta = apply_table_delta(Dl, theirs, table_delta(Dl, prev, cur))
+    via_full = Dl.merge(theirs, cur)
+    assert states_equal(via_delta, via_full)
+
+
+def test_table_delta_payload_and_wire():
+    Dw, prev, cur = _wordcount_pair(9)
+    delta = table_delta(Dw, prev, cur)
+    full = sum(np.asarray(x).nbytes for x in jax.tree.leaves(cur))
+    assert delta_nbytes(delta) < full / 3
+    blob = serial.dumps_dense("wordcount_delta", delta)
+    _, back = serial.loads_dense(blob, delta)
+    assert states_equal(back, delta)

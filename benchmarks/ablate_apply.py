@@ -238,3 +238,46 @@ def timeit_unrolled(name, step_fn):
 
 timeit_unrolled("UNROLLED hoisted PALLAS tombstones + vmap apply", make_hoisted(True))
 timeit_unrolled("UNROLLED full re-impl XLA", make_variant())
+
+
+def make_flat_scatter_variant():
+    """Delta scatter via flat 1-D indices (kid*M + rank) instead of 2-D."""
+    def one(state, ops):
+        NKl, Il, Ml, Dl = NK, I, M, D_DCS
+        from antidote_ccrdt_tpu.ops.dense_table import scatter_max_rows_mxu
+        rmv_valid = ops.rmv_id >= 0
+        rrow = jnp.where(rmv_valid, ops.rmv_key * Il + ops.rmv_id, NKl * Il)
+        rmv_vc = scatter_max_rows_mxu(
+            state.rmv_vc.reshape(NKl * Il, Dl), rrow, ops.rmv_vc
+        ).reshape(NKl, Il, Dl)
+        add_valid = (ops.add_ts > 0) & (ops.add_key >= 0) & (ops.add_key < NKl)
+        kid = jnp.where(add_valid, ops.add_key * Il + ops.add_id, NKl * Il)
+        s_kid, ns, nt, s_dc = lax.sort(
+            (kid, -ops.add_score, -ops.add_ts, ops.add_dc), num_keys=4)
+        s_score, s_ts = -ns, -nt
+        dup = ((s_kid == jnp.roll(s_kid, 1)) & (s_score == jnp.roll(s_score, 1))
+               & (s_ts == jnp.roll(s_ts, 1)) & (s_dc == jnp.roll(s_dc, 1))).at[0].set(False)
+        live = (s_kid < NKl * Il) & ~dup
+        grp_start = (s_kid != jnp.roll(s_kid, 1)).at[0].set(True)
+        c = jnp.cumsum(live.astype(jnp.int32))
+        base = lax.cummax(jnp.where(grp_start, c - live.astype(jnp.int32), -1))
+        rank = c - live.astype(jnp.int32) - base
+        rank = jnp.where(live & (rank < Ml), rank, Ml)
+        flat = jnp.where(live & (rank < Ml), s_kid * Ml + rank, NKl * Il * Ml)
+        d_score = jnp.full((NKl * Il * Ml,), NEG_INF, dtype=jnp.int32)
+        d_dc = jnp.zeros((NKl * Il * Ml,), dtype=jnp.int32)
+        d_ts = jnp.zeros((NKl * Il * Ml,), dtype=jnp.int32)
+        d_score = d_score.at[flat].set(s_score, mode="drop").reshape(NKl, Il, Ml)
+        d_dc = d_dc.at[flat].set(s_dc, mode="drop").reshape(NKl, Il, Ml)
+        d_ts = d_ts.at[flat].set(s_ts, mode="drop").reshape(NKl, Il, Ml)
+        f_score, f_dc, f_ts, n_live = _join_slots(
+            (state.slot_score, state.slot_dc, state.slot_ts),
+            (d_score, d_dc, d_ts), rmv_vc, Ml)
+        return TopkRmvDenseState(f_score, f_dc, f_ts, rmv_vc, state.vc,
+                                 state.lossy | jnp.any(n_live > Ml, axis=-1))
+    def step(st, ops):
+        return jax.vmap(one)(st, ops)
+    return step
+
+
+timeit("FLAT 1-D delta scatter variant", make_flat_scatter_variant())

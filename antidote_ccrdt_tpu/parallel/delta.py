@@ -217,3 +217,71 @@ def expand_table_delta(dense: Any, like: Any, delta: dict) -> Any:
 
 def apply_table_delta(dense: Any, state: Any, delta: dict) -> Any:
     return dense.merge(state, expand_table_delta(dense, state, delta))
+
+
+# --- engine-generic dispatch (used by the gossip tier) --------------------
+
+
+def _is_topk_rmv_state(state: Any) -> bool:
+    from ..models.topk_rmv_dense import TopkRmvDenseState
+
+    return isinstance(state, TopkRmvDenseState)
+
+
+def make_delta(dense: Any, prev: Any, cur: Any) -> Any:
+    """Engine-generic delta: slot-level for topk_rmv states, entrywise for
+    the flat table engines."""
+    if _is_topk_rmv_state(cur):
+        return state_delta(dense, prev, cur)
+    return table_delta(dense, prev, cur)
+
+
+def apply_any_delta(dense: Any, state: Any, delta: Any) -> Any:
+    if isinstance(delta, TopkRmvDelta):
+        return apply_delta(dense, state, delta)
+    return apply_table_delta(dense, state, delta)
+
+
+def like_delta_for(dense: Any, like_state: Any) -> Any:
+    """Treedef target for deserializing this engine's deltas (shapes are
+    free; loads_dense checks treedef only)."""
+    if _is_topk_rmv_state(like_state):
+        return empty_delta(dense)
+    paths, leaves, table_paths, _ = _split_leaves(like_state)
+    z = jnp.zeros((0,), jnp.int32)
+    return {
+        "idx": z,
+        "table": {p: z for p in table_paths},
+        "whole": {
+            p: leaf for p, leaf in zip(paths, leaves) if p not in table_paths
+        },
+    }
+
+
+def delta_in_bounds(dense: Any, like_state: Any, delta: Any) -> bool:
+    """Config/bounds validation of a decoded peer delta (the gossip fetch
+    guard: a treedef-compatible delta from a differently-configured peer
+    must be rejected before expansion indexes out of range)."""
+    R, NK = jax.tree_util.tree_leaves(like_state)[0].shape[:2]
+    if isinstance(delta, TopkRmvDelta):
+        n_rows = R * NK * dense.I
+        if (
+            delta.slot_score.shape[1:] != (dense.M,)
+            or delta.rmv_vc.shape[1:] != (dense.D,)
+            or delta.vc.shape[-1] != dense.D
+        ):
+            return False
+        rows = np.asarray(delta.rows)
+        return rows.size == 0 or (rows.min() >= 0 and rows.max() < n_rows)
+    paths, leaves, table_paths, _ = _split_leaves(like_state)
+    shapes = dict(zip(paths, (leaf.shape for leaf in leaves)))
+    n_entries = {p: int(np.prod(shapes[p])) for p in table_paths}
+    if set(delta.get("table", {})) != set(table_paths):
+        return False
+    idx = np.asarray(delta["idx"])
+    if idx.size and (idx.min() < 0 or idx.max() >= min(n_entries.values())):
+        return False
+    for p, whole in delta.get("whole", {}).items():
+        if p not in shapes or tuple(np.asarray(whole).shape) != shapes[p]:
+            return False
+    return True

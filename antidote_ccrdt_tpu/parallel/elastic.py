@@ -168,14 +168,13 @@ class GossipStore:
         return sorted(out)
 
     def fetch_delta(
-        self, member: str, seq: int, like_delta: Any,
-        dense: Any = None, n_rows: int = 0,
+        self, member: str, seq: int, like_delta: Any, validate=None
     ) -> Optional[Any]:
         """Deserialized delta at `seq`, or None (missing/torn/pruned/
-        mis-configured — same total-failure policy as `fetch`). With
-        `dense`/`n_rows`, a structurally-decodable delta from a peer on a
-        DIFFERENT engine config (loads_dense checks only the treedef) is
-        rejected here instead of crashing expand/merge downstream."""
+        mis-configured — same total-failure policy as `fetch`). `validate`
+        (delta -> bool) rejects structurally-decodable deltas from a peer
+        on a DIFFERENT engine config (loads_dense checks only the treedef)
+        before expansion can index out of range downstream."""
         from ..core import serial
 
         path = os.path.join(self.root, f"delta-{member}-{seq:08d}")
@@ -183,18 +182,8 @@ class GossipStore:
             with open(path, "rb") as f:
                 data = f.read()
             _name, delta = serial.loads_dense(data, like_delta)
-            if dense is not None:
-                if (
-                    delta.slot_score.shape[1:] != (dense.M,)
-                    or delta.rmv_vc.shape[1:] != (dense.D,)
-                    or delta.vc.shape[-1] != dense.D
-                ):
-                    return None
-                if n_rows and delta.rows.size and (
-                    int(np.asarray(delta.rows).min()) < 0
-                    or int(np.asarray(delta.rows).max()) >= n_rows
-                ):
-                    return None
+            if validate is not None and not validate(delta):
+                return None
         except Exception:  # noqa: BLE001 — see fetch
             return None
         return delta
@@ -203,14 +192,26 @@ class GossipStore:
 class DeltaPublisher:
     """Publish a member's state as chained deltas with periodic full
     snapshots (the classic delta-CRDT shipping discipline: deltas for
-    bandwidth, full states as the resync anchor)."""
+    bandwidth, full states as the resync anchor). Engine-generic via
+    `parallel.delta.make_delta` (slot deltas for topk_rmv, entrywise for
+    the table engines) — but JOIN engines only: gossip resync re-merges
+    full snapshots over already-applied deltas, which is harmless under an
+    idempotent join and double-counts under a monoid `+` (MONOID types
+    ship deltas through their own exactly-once pipeline, DenseReplay)."""
 
     def __init__(
         self, store: GossipStore, dense: Any, name: str = "topk_rmv",
         full_every: int = 8, keep: int = 16,
     ):
         from ..core import serial
+        from ..core.behaviour import MergeKind
 
+        if getattr(dense, "merge_kind", None) == MergeKind.MONOID:
+            raise ValueError(
+                "delta gossip requires an idempotent join; MONOID engines "
+                "would double-count on snapshot resync (use DenseReplay's "
+                "exactly-once delta sync instead)"
+            )
         self.store = store
         self.dense = dense
         self.name = name
@@ -221,14 +222,14 @@ class DeltaPublisher:
         self._serial = serial
 
     def publish(self, state: Any) -> Dict[str, Any]:
-        from .delta import state_delta
+        from .delta import make_delta
 
         self.seq += 1
         if self._prev is None or self.seq % self.full_every == 0:
             self.store.publish(self.name, state, self.seq)
             kind, nbytes = "full", -1
         else:
-            delta = state_delta(self.dense, self._prev, state)
+            delta = make_delta(self.dense, self._prev, state)
             blob = self._serial.dumps_dense(f"{self.name}_delta", delta)
             self.store.publish_delta(blob, self.seq, keep=self.keep)
             kind, nbytes = "delta", len(blob)
@@ -244,13 +245,9 @@ def sweep_deltas(
     peer's full snapshot and continue chaining. `cursors` maps member ->
     highest seq applied and is updated in place. Applying a full snapshot
     after deltas (or twice) is harmless — everything is a join."""
-    from .delta import apply_delta
+    from .delta import apply_any_delta, delta_in_bounds, like_delta_for
 
-    import jax
-
-    like_delta = empty_delta(dense)
-    R, NK = jax.tree_util.tree_leaves(state)[0].shape[:2]
-    n_rows = R * NK * dense.I
+    like_delta = like_delta_for(dense, state)
     stats = {"deltas": 0, "fulls": 0, "skipped": 0}
 
     def chain(member: str, cur: int) -> int:
@@ -258,11 +255,12 @@ def sweep_deltas(
         avail = set(store.delta_seqs(member))
         while cur + 1 in avail:
             delta = store.fetch_delta(
-                member, cur + 1, like_delta, dense=dense, n_rows=n_rows
+                member, cur + 1, like_delta,
+                validate=lambda d: delta_in_bounds(dense, state, d),
             )
             if delta is None:
                 break  # torn/mismatched write: retry (or resync) next sweep
-            state = apply_delta(dense, state, delta)
+            state = apply_any_delta(dense, state, delta)
             stats["deltas"] += 1
             cur += 1
         return cur

@@ -324,3 +324,45 @@ def test_table_delta_payload_and_wire():
     blob = serial.dumps_dense("wordcount_delta", delta)
     _, back = serial.loads_dense(blob, delta)
     assert states_equal(back, delta)
+
+
+def test_delta_gossip_generic_join_engine(tmp_path):
+    # The same chained gossip protocol over a TABLE engine (leaderboard).
+    from antidote_ccrdt_tpu.models.leaderboard import LeaderboardOps
+    from antidote_ccrdt_tpu.models.leaderboard import make_dense as mk_lb
+
+    Dl = mk_lb(n_players=64, size=4)
+    rng = np.random.default_rng(21)
+
+    def ops(n):
+        return LeaderboardOps(
+            add_key=jnp.zeros((2, n), jnp.int32),
+            add_id=jnp.asarray(rng.integers(0, 64, (2, n)).astype(np.int32)),
+            add_score=jnp.asarray(rng.integers(1, 900, (2, n)).astype(np.int32)),
+            add_valid=jnp.ones((2, n), bool),
+            ban_key=jnp.zeros((2, 1), jnp.int32),
+            ban_id=jnp.asarray(rng.integers(0, 64, (2, 1)).astype(np.int32)),
+            ban_valid=jnp.ones((2, 1), bool),
+        )
+
+    a = GossipStore(str(tmp_path), "a")
+    b = GossipStore(str(tmp_path), "b")
+    pub = DeltaPublisher(a, Dl, name="leaderboard", full_every=100)
+    sa = Dl.init(2, 1)
+    sb = Dl.init(2, 1)
+    cursors: dict = {}
+    kinds = []
+    for _ in range(5):
+        sa, _ = Dl.apply_ops(sa, ops(12))
+        kinds.append(pub.publish(sa)["kind"])
+    sb, stats = sweep_deltas(b, Dl, sb, cursors)
+    assert stats["deltas"] == 4 and stats["fulls"] == 1, (stats, kinds)
+    assert states_equal(sb, sa)
+
+
+def test_delta_gossip_rejects_monoid_engine(tmp_path):
+    from antidote_ccrdt_tpu.models.wordcount import make_dense as mk_wc
+
+    store = GossipStore(str(tmp_path), "a")
+    with pytest.raises(ValueError, match="MONOID"):
+        DeltaPublisher(store, mk_wc(64))

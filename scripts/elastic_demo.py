@@ -108,6 +108,11 @@ def main() -> None:
     ap.add_argument("--timeout", type=float, default=0.4)
     ap.add_argument("--step-sleep", type=float, default=0.15)
     ap.add_argument("--publish-every", type=int, default=2)
+    ap.add_argument(
+        "--delta", action="store_true",
+        help="gossip chained deltas (DeltaPublisher) instead of full "
+        "snapshots on every publish",
+    )
     args = ap.parse_args()
 
     import jax
@@ -115,13 +120,29 @@ def main() -> None:
     jax.config.update("jax_platforms", "cpu")
 
     from antidote_ccrdt_tpu.parallel.elastic import (
+        DeltaPublisher,
         GossipStore,
         my_replicas,
         sweep,
+        sweep_deltas,
     )
 
     dense = make_engine()
     state = dense.init(R, NK)
+    pub = None  # set after the store exists when --delta
+    cursors: dict = {}
+
+    def do_publish(store, seq_hint):
+        if pub is not None:
+            pub.publish(state)
+        else:
+            store.publish("topk_rmv", state, seq_hint)
+
+    def do_sweep(store, st):
+        if pub is not None:
+            return sweep_deltas(store, dense, st, cursors)
+        return sweep(store, dense, st)
+
     if args.join_late > 0:
         # Late join: compile the engine first (apply a no-op batch), THEN
         # register — from the fleet's view the member appears and is
@@ -129,6 +150,8 @@ def main() -> None:
         state, _ = dense.apply_ops(state, gen_step_ops(0, []))
         time.sleep(args.join_late)
     store = GossipStore(args.root, args.member)
+    if args.delta:
+        pub = DeltaPublisher(store, dense, full_every=4)
 
     # Background heartbeat: dies with the process, so a crash goes stale.
     def beat():
@@ -169,8 +192,8 @@ def main() -> None:
             state, gen_step_ops(step, sorted(owned)), collect_dominated=False
         )
         if step % args.publish_every == 0:
-            store.publish("topk_rmv", state, step)
-            state, _ = sweep(store, dense, state)
+            do_publish(store, step)
+            state, _ = do_sweep(store, state)
         time.sleep(args.step_sleep)
 
     # Final convergence: publish/sweep until every member that ever

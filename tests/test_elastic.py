@@ -168,3 +168,90 @@ def test_real_process_scale_up_late_joiner(tmp_path):
     # to exit as soon as everyone's FINAL state is published.
     with open(os.path.join(str(tmp_path), "final-w0.json")) as f:
         assert "w2" in json.load(f)["alive"]
+
+
+def test_real_process_crash_recovery_delta_gossip(tmp_path):
+    """The crash drill with --delta: chained delta publishes + full
+    anchors carry the gossip; recovery and convergence must be identical."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = {}
+    for member, extra in (
+        ("w0", ["--delta"]),
+        ("w1", ["--delta", "--die-at", "4"]),
+        ("w2", ["--delta"]),
+    ):
+        procs[member] = subprocess.Popen(
+            [sys.executable, DEMO, "--root", str(tmp_path), "--member", member,
+             "--n-members", "3", *extra],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True,
+        )
+    outs = {}
+    for member, p in procs.items():
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            pytest.fail(f"worker {member} timed out:\n{out}")
+        outs[member] = out
+    assert procs["w1"].returncode == 1
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import elastic_demo
+
+    ref = [list(t) for t in elastic_demo.reference_digest()]
+    for m in ("w0", "w2"):
+        assert procs[m].returncode == 0, f"worker {m} failed:\n{outs[m]}"
+        with open(os.path.join(str(tmp_path), f"final-{m}.json")) as f:
+            got = json.load(f)
+        assert got["digest"] == ref, (
+            f"{m} diverged (delta mode)\ngot: {got['digest']}\nref: {ref}\n"
+            f"log:\n{outs[m]}"
+        )
+    # Delta files were actually exchanged (not just full anchors).
+    assert any(
+        f.startswith("delta-") for f in os.listdir(str(tmp_path))
+    ), os.listdir(str(tmp_path))
+
+
+def test_ownership_grows_covers_every_step_under_view_flaps():
+    """The invariant behind the scale-up fix, modeled as the drill
+    implements it: per-member views may disagree arbitrarily while
+    membership churns, ownership only GROWS, and a member that gains a
+    replica retroactively re-applies its whole history. Then, as soon as
+    views stabilize to a common alive set for the tail of the run, every
+    (replica, step) op has been applied by someone. The drop-on-view-change
+    variant (the original bug) loses trailing steps under asymmetric views
+    even WITH stabilization."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    R_, STEPS_, STABLE_TAIL = 6, 12, 3
+    members = ["a", "b", "c"]
+    full = {(r, s) for r in range(R_) for s in range(STEPS_)}
+    drop_ever_lost = False
+    for _trial in range(200):
+        applied = set()
+        applied_drop = set()
+        for m in members:
+            owned: set = set()
+            for s in range(STEPS_):
+                if s < STEPS_ - STABLE_TAIL:
+                    view = sorted({m} | {x for x in members if rng.random() < 0.7})
+                else:
+                    view = members  # heartbeats settled: common view
+                mine = {r for r in range(R_) if view[r % len(view)] == m}
+                gained = mine - owned
+                owned |= mine  # ownership only grows
+                # retroactive full-history re-apply on gain:
+                applied |= {(r, t) for r in gained for t in range(s)}
+                applied |= {(r, s) for r in owned}
+                applied_drop |= {(r, t) for r in gained for t in range(s)}
+                applied_drop |= {(r, s) for r in mine}  # buggy: drops
+        assert applied == full, "ownership-grows lost coverage"
+        drop_ever_lost = drop_ever_lost or (applied_drop != full)
+    assert drop_ever_lost, (
+        "chaos schedule never exercised the drop-variant hazard — weaken "
+        "the view-flap probability so the test stays meaningful"
+    )

@@ -96,6 +96,27 @@ class BridgeClient:
     def free(self, handle: Any) -> None:
         self.call((Atom("free"), handle))
 
+    # -- registry / per-type predicates ------------------------------------
+
+    def is_type(self, type_name: str) -> bool:
+        return self.call((Atom("is_type"), Atom(type_name)))
+
+    def generates_extra_operations(self, type_name: str) -> bool:
+        return self.call((Atom("generates_extra_operations"), Atom(type_name)))
+
+    def is_operation(self, type_name: str, op: Tuple[str, Any]) -> bool:
+        return self.call((Atom("is_operation"), Atom(type_name), P.op_to_term(op)))
+
+    def require_state_downstream(self, type_name: str, op: Tuple[str, Any]) -> bool:
+        return self.call(
+            (Atom("require_state_downstream"), Atom(type_name), P.op_to_term(op))
+        )
+
+    def is_replicate_tagged(self, type_name: str, effect_term: Any) -> bool:
+        return self.call(
+            (Atom("is_replicate_tagged"), Atom(type_name), effect_term)
+        )
+
     def batch_merge(self, type_name: str, items: List[Any]) -> Any:
         """Join N states (handles and/or `to_binary` blobs) in one batched
         device pass on the worker; returns a new handle to the merged

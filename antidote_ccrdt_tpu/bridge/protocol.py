@@ -21,6 +21,12 @@ pipeline requests. Op shapes (atoms abbreviated as Python `Atom`):
     {equal, H1, H2}                   -> {ok, Bool}
     {compact, Handle, [Effect]}       -> {ok, [Effect]}    whole-log compaction
     {free, Handle}                    -> {ok, true}
+    {batch_merge, Type, [H | Bin]}    -> {ok, Handle}      join N states, one pass
+    {is_type, Type}                   -> {ok, Bool}        registry predicates
+    {generates_extra_operations, Type}-> {ok, Bool}
+    {is_operation, Type, Op}          -> {ok, Bool}        per-type predicates
+    {require_state_downstream, Type, Op} -> {ok, Bool}
+    {is_replicate_tagged, Type, Effect} -> {ok, Bool}
     {grid_new, Grid, Type, Params}    -> {ok, true}        dense grid (TPU)
     {grid_apply, Grid, OpsPerReplica} -> {ok, NDominated}
     {grid_merge_all, Grid}            -> {ok, true}        fold replicas (join)

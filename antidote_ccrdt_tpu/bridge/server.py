@@ -276,6 +276,24 @@ class BridgeServer:
             h = self._new_handle()
             self._handles[h] = (name, batch_merge(name, states))
             return h
+        if tag == "is_type":
+            # Registry predicates (antidote_ccrdt.erl:61-65), so a BEAM
+            # host can interrogate the library without local knowledge.
+            return registry.is_type(str(op[1]))
+        if tag == "generates_extra_operations":
+            return registry.generates_extra_operations(str(op[1]))
+        if tag == "is_operation":
+            _, type_atom, op_term = op
+            crdt = registry.scalar(str(type_atom))
+            return bool(crdt.is_operation(op_from_term(op_term)))
+        if tag == "require_state_downstream":
+            _, type_atom, op_term = op
+            crdt = registry.scalar(str(type_atom))
+            return bool(crdt.require_state_downstream(op_from_term(op_term)))
+        if tag == "is_replicate_tagged":
+            _, type_atom, eff_term = op
+            crdt = registry.scalar(str(type_atom))
+            return bool(crdt.is_replicate_tagged(op_from_term(eff_term)))
         if tag == "value":
             _, h = op
             name, state = self._state(h)

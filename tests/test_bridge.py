@@ -208,3 +208,23 @@ def test_batch_merge_rejects_mixed_types(client):
     h = client.new("average", 0, 0)
     with pytest.raises(Exception):
         client.batch_merge("topk", [h])
+
+
+def test_registry_and_predicates_over_bridge(client):
+    # The registry + predicate callbacks (antidote_ccrdt.erl:37-65) are
+    # interrogable over the wire, so a BEAM host needs no local copy.
+    assert client.is_type("topk_rmv") is True
+    assert client.is_type("nope") is False
+    assert client.generates_extra_operations("topk_rmv") is True
+    assert client.generates_extra_operations("average") is False
+    assert client.is_operation("topk_rmv", ("add", (1, 2))) is True
+    assert client.is_operation("topk_rmv", ("frobnicate", 1)) is False
+    assert client.require_state_downstream("topk_rmv", ("add", (1, 2))) is True
+    assert client.require_state_downstream("average", ("add", 5)) is False
+    # A tagged effect (add_r) is replicate-tagged; a plain add is not.
+    h = client.new("topk_rmv", 1)
+    e1 = client.downstream(h, ("add", (1, 50)), 0, 1)
+    client.update(h, e1)
+    assert client.is_replicate_tagged("topk_rmv", e1) is False
+    e3 = client.downstream(h, ("add", (2, 10)), 0, 3)
+    assert client.is_replicate_tagged("topk_rmv", e3) is True

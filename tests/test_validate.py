@@ -107,3 +107,12 @@ def test_checkpoint_restore_validates_against_engine():
         D2 = make_dense(n_ids=16, n_dcs=2, size=2, slots_per_id=2)
         with pytest.raises(ValueError):
             load_dense_checkpoint(p, st, dense=D2)
+
+
+def test_check_ops_engine_dc_width():
+    D = make_dense(n_ids=8, n_dcs=2, size=2, slots_per_id=2)
+    st = D.init(2, 1)
+    bad = mk_ops(R=2, D=5)  # rmv_vc DC width 5 != engine 2
+    with pytest.raises(ValueError, match="DC width"):
+        check_ops(st, bad, dense=D)
+    check_ops(st, mk_ops(R=2, D=2), dense=D)  # no raise

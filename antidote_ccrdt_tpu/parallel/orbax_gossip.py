@@ -1,0 +1,126 @@
+"""Orbax-backed gossip for mesh-sharded states: the geo-DR tier.
+
+`parallel.elastic.GossipStore` ships host-local npz snapshots — right for
+states that fit one host. A SITE in the multihost layout
+(`parallel.multihost`) holds its state *sharded over a device mesh*; its
+snapshots must be written shard-parallel (each host writes what it owns)
+and restored onto a DIFFERENT site's mesh shape. That is exactly what
+Orbax does (`harness.orbax_ckpt`), so this module is the thin composition:
+
+* publish  = Orbax save of the sharded state under `<root>/<member>/` +
+  the same mtime heartbeat files `GossipStore` uses (one failure
+  detector across both tiers).
+* fetch    = Orbax restore of a PEER's latest step into THIS site's
+  shardings (cross-mesh resharding is Orbax's native move).
+* sweep    = fold every peer's latest snapshot in with the engine join —
+  identical semantics to the host-local tier: stale snapshots, repeated
+  merges, and membership churn are all absorbed by join idempotence.
+
+Cross-site anti-entropy over shared storage is the CRDT-native
+disaster-recovery plane: no cross-site collectives, no coordinator, and a
+site restored from the store is immediately mergeable.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..harness import orbax_ckpt
+from .elastic import GossipStore
+
+
+def available() -> bool:
+    return orbax_ckpt.available()
+
+
+class OrbaxGossip:
+    """Per-member Orbax checkpoint trees + shared heartbeat files.
+
+    Layout: `<root>/hb-<member>` (heartbeats, via GossipStore) and
+    `<root>/ckpt-<member>/<step>/` (Orbax-managed, retention-pruned)."""
+
+    def __init__(self, root: str, member: str, max_to_keep: int = 2):
+        self.root = root
+        self.member = member
+        self._hb = GossipStore(root, member)  # heartbeat + liveness surface
+        self._mgr = orbax_ckpt.DenseCheckpointManager(
+            os.path.join(os.path.abspath(root), f"ckpt-{member}"),
+            max_to_keep=max_to_keep,
+        )
+        self._peer_mgrs: Dict[str, Any] = {}
+
+    # liveness delegates to the shared heartbeat files
+    def heartbeat(self) -> None:
+        self._hb.heartbeat()
+
+    def members(self) -> List[str]:
+        return self._hb.members()
+
+    def alive_members(self, timeout_s: float) -> List[str]:
+        return self._hb.alive_members(timeout_s)
+
+    # -- snapshots ---------------------------------------------------------
+
+    def publish(self, state: Any, step: int) -> None:
+        """Shard-parallel save of this site's (possibly mesh-sharded)
+        state; every host of the site calls this collectively."""
+        self._mgr.save(step, state)
+        self._hb.heartbeat()
+
+    def _peer_mgr(self, member: str) -> Optional[Any]:
+        d = os.path.join(os.path.abspath(self.root), f"ckpt-{member}")
+        if not os.path.isdir(d):
+            return None
+        if member not in self._peer_mgrs:
+            self._peer_mgrs[member] = orbax_ckpt.DenseCheckpointManager(
+                d, max_to_keep=10**6  # reader: never prune a peer's steps
+            )
+        return self._peer_mgrs[member]
+
+    def snapshot_members(self) -> List[str]:
+        return sorted(
+            d[len("ckpt-"):]
+            for d in os.listdir(self.root)
+            if d.startswith("ckpt-")
+            and os.path.isdir(os.path.join(self.root, d))
+        )
+
+    def fetch(self, member: str, like: Any) -> Optional[Tuple[int, Any]]:
+        """Peer's latest snapshot restored INTO `like`'s shardings (this
+        site's mesh) — or None on any failure, same total-failure policy
+        as the host-local tier (the next sweep retries)."""
+        try:
+            mgr = self._peer_mgr(member)
+            if mgr is None:
+                return None
+            step = mgr.latest_step()
+            if step is None:
+                return None
+            return step, mgr.restore(like, step=step)
+        except Exception:  # noqa: BLE001 — deliberately total
+            return None
+
+    def sweep(self, dense: Any, state: Any) -> Tuple[Any, int]:
+        """Join every peer's latest snapshot into `state`."""
+        n = 0
+        for m in self.snapshot_members():
+            if m == self.member:
+                continue
+            got = self.fetch(m, state)
+            if got is None:
+                continue
+            state = dense.merge(state, got[1])
+            n += 1
+        return state, n
+
+    def close(self) -> None:
+        self._mgr.close()
+        for mgr in self._peer_mgrs.values():
+            mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,103 @@
+"""Orbax-backed cross-site gossip (parallel/orbax_gossip.py): two "sites"
+holding the same logical grid under DIFFERENT mesh shardings exchange
+snapshots through the store and converge via the engine join — the
+geo-DR plane for mesh-sharded states."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from antidote_ccrdt_tpu.harness import orbax_ckpt
+from antidote_ccrdt_tpu.models.topk_rmv_dense import TopkRmvOps, make_dense
+from antidote_ccrdt_tpu.parallel.orbax_gossip import OrbaxGossip, available
+
+pytestmark = pytest.mark.skipif(
+    not available(), reason="orbax-checkpoint not installed"
+)
+
+R, NK, I, DCS = 4, 2, 64, 4
+D = make_dense(n_ids=I, n_dcs=DCS, size=8, slots_per_id=2)
+
+
+def site_sharding(dev_slice, axis_dims):
+    mesh = Mesh(np.asarray(dev_slice).reshape(*axis_dims), ("dc", "key"))
+    return NamedSharding(mesh, P("dc", "key"))
+
+
+def place(state, sharding):
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), state)
+
+
+def ops_for(seed, row):
+    rng = np.random.default_rng(seed)
+    B, Br = 24, 6
+    row_mask = (np.arange(R) == row)[:, None]
+    return TopkRmvOps(
+        add_key=jnp.asarray(rng.integers(0, NK, (R, B)).astype(np.int32)),
+        add_id=jnp.asarray(rng.integers(0, I, (R, B)).astype(np.int32)),
+        add_score=jnp.asarray(rng.integers(1, 900, (R, B)).astype(np.int32)),
+        add_dc=jnp.asarray(rng.integers(0, DCS, (R, B)).astype(np.int32)),
+        add_ts=jnp.asarray(
+            (rng.integers(1, 90, (R, B)) * row_mask).astype(np.int32)
+        ),
+        rmv_key=jnp.asarray(rng.integers(0, NK, (R, Br)).astype(np.int32)),
+        rmv_id=jnp.asarray(
+            np.where(row_mask[:, :1].repeat(Br, 1),
+                     rng.integers(0, I, (R, Br)), -1).astype(np.int32)
+        ),
+        rmv_vc=jnp.asarray(rng.integers(0, 40, (R, Br, DCS)).astype(np.int32)),
+    )
+
+
+def test_cross_site_sharded_gossip_converges(tmp_path):
+    devs = jax.devices()
+    assert len(devs) >= 8
+    # Site A: 4x1 mesh over devices 0-3; site B: 2x2 over devices 4-7 —
+    # deliberately different mesh shapes AND device sets.
+    sh_a = site_sharding(devs[:4], (4, 1))
+    sh_b = site_sharding(devs[4:8], (2, 2))
+
+    sa = place(D.init(R, NK), sh_a)
+    sb = place(D.init(R, NK), sh_b)
+    sa, _ = D.apply_ops(sa, ops_for(1, row=0))
+    sb, _ = D.apply_ops(sb, ops_for(2, row=1))
+
+    with OrbaxGossip(str(tmp_path), "site-a") as ga, \
+         OrbaxGossip(str(tmp_path), "site-b") as gb:
+        ga.publish(sa, step=1)
+        gb.publish(sb, step=1)
+        assert set(ga.snapshot_members()) == {"site-a", "site-b"}
+
+        sa2, n_a = ga.sweep(D, sa)
+        sb2, n_b = gb.sweep(D, sb)
+        assert n_a == 1 and n_b == 1
+        # Both sites hold the same observable after one exchange (compare
+        # via host values — the states live on disjoint device sets).
+        assert D.value(sa2) == D.value(sb2)
+        # ...and each site's state still lives in ITS OWN shardings.
+        dev_set = {
+            d for leaf in jax.tree.leaves(sa2) for d in leaf.devices()
+        }
+        assert dev_set <= set(devs[:4]), "site A state left its mesh"
+
+        # Idempotence across repeated exchanges, including a re-publish.
+        ga.publish(sa2, step=2)
+        sb3, _ = gb.sweep(D, sb2)
+        assert D.value(sb3) == D.value(sa2)
+
+
+def test_fetch_failures_are_skipped(tmp_path):
+    sa = D.init(R, NK)
+    with OrbaxGossip(str(tmp_path), "a") as ga:
+        ga.publish(sa, step=0)
+        # Unknown peer and a garbage ckpt dir both read as "nothing yet".
+        assert ga.fetch("ghost", sa) is None
+        import os
+
+        os.makedirs(os.path.join(str(tmp_path), "ckpt-junk", "5"))
+        state2, n = ga.sweep(D, sa)
+        assert n == 0
+        assert D.equal(state2, sa)

@@ -78,6 +78,13 @@ class DenseCheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
 
+    def reload(self) -> None:
+        """Refresh the cached step list. Orbax caches it at construction
+        and updates it only on this manager's own saves — a READER of a
+        directory another process (or manager) writes must reload before
+        `latest_step`/`restore`, or it pins the steps it saw first."""
+        self._mngr.reload()
+
     def all_steps(self):
         return sorted(self._mngr.all_steps())
 

@@ -86,32 +86,59 @@ class OrbaxGossip:
             and os.path.isdir(os.path.join(self.root, d))
         )
 
-    def fetch(self, member: str, like: Any) -> Optional[Tuple[int, Any]]:
-        """Peer's latest snapshot restored INTO `like`'s shardings (this
-        site's mesh) — or None on any failure, same total-failure policy
-        as the host-local tier (the next sweep retries)."""
+    def peer_latest_step(self, member: str) -> Optional[int]:
+        """Peer's newest published step. Orbax managers cache their step
+        list at construction and only refresh it on their OWN saves, so a
+        reader MUST `reload()` before looking — without it, a cached peer
+        manager pins the step it saw first, the owner's retention soon
+        prunes that step, and every later fetch turns into a silent None:
+        gossip stops converging after the first exchange (verified against
+        orbax 0.11.32)."""
         try:
             mgr = self._peer_mgr(member)
             if mgr is None:
                 return None
-            step = mgr.latest_step()
-            if step is None:
-                return None
-            return step, mgr.restore(like, step=step)
+            mgr.reload()
+            return mgr.latest_step()
         except Exception:  # noqa: BLE001 — deliberately total
             return None
 
-    def sweep(self, dense: Any, state: Any) -> Tuple[Any, int]:
-        """Join every peer's latest snapshot into `state`."""
+    def fetch(self, member: str, like: Any) -> Optional[Tuple[int, Any]]:
+        """Peer's latest snapshot restored INTO `like`'s shardings (this
+        site's mesh) — or None on any failure, same total-failure policy
+        as the host-local tier (the next sweep retries)."""
+        step = self.peer_latest_step(member)
+        if step is None:
+            return None
+        try:
+            return step, self._peer_mgr(member).restore(like, step=step)
+        except Exception:  # noqa: BLE001 — deliberately total
+            return None
+
+    def sweep(
+        self, dense: Any, state: Any, cursors: Optional[Dict[str, int]] = None
+    ) -> Tuple[Any, int]:
+        """Join every peer's latest snapshot into `state`. `cursors`
+        (member -> last merged step, updated in place) skips peers whose
+        publish has not advanced — a full cross-mesh restore of a large
+        sharded state is the dominant cost of a sweep and is pure waste
+        when the data is already reflected."""
         n = 0
         for m in self.snapshot_members():
             if m == self.member:
                 continue
+            if cursors is not None:
+                latest = self.peer_latest_step(m)
+                if latest is None or latest <= cursors.get(m, -1):
+                    continue
             got = self.fetch(m, state)
             if got is None:
                 continue
-            state = dense.merge(state, got[1])
+            step, peer = got
+            state = dense.merge(state, peer)
             n += 1
+            if cursors is not None:
+                cursors[m] = step
         return state, n
 
     def close(self) -> None:

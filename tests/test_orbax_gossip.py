@@ -83,10 +83,20 @@ def test_cross_site_sharded_gossip_converges(tmp_path):
         }
         assert dev_set <= set(devs[:4]), "site A state left its mesh"
 
-        # Idempotence across repeated exchanges, including a re-publish.
-        ga.publish(sa2, step=2)
-        sb3, _ = gb.sweep(D, sb2)
-        assert D.value(sb3) == D.value(sa2)
+        # Second exchange must carry NEW data (regression: a reader
+        # manager that never reloads pins the peer's first-seen step and
+        # gossip silently stops converging after one exchange). Apply
+        # fresh ops on site A, re-publish, and require site B to see them.
+        sa3, _ = D.apply_ops(sa2, ops_for(7, row=2))
+        ga.publish(sa3, step=2)
+        cursors: dict = {}
+        sb3, n1 = gb.sweep(D, sb2, cursors)
+        assert n1 == 1
+        assert D.value(sb3) == D.value(sa3)
+        # Cursor-aware sweep skips the not-advanced peer entirely.
+        sb4, n2 = gb.sweep(D, sb3, cursors)
+        assert n2 == 0
+        assert D.value(sb4) == D.value(sb3)
 
 
 def test_fetch_failures_are_skipped(tmp_path):

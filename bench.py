@@ -82,6 +82,28 @@ def bench_dense(R, I, D_DCS, K, M, B, Br, windows, rounds_per_window):
             _sync(state)
         m.count("ops", R * (B + Br) * W)
     apply_rate = m.rate("ops", "window")
+
+    # Secondary: the same apply with extras collection ON (dominated-add
+    # re-broadcast vcs, reference :234-237) — the configuration the replay
+    # harness runs; the delta is the cost of full replication behavior.
+    @jax.jit
+    def run_window_extras(state, stacked):
+        def body(st, ops):
+            st2, extras = D.apply_ops(st, ops, collect_dominated=True)
+            # keep the extras live so the gather isn't dead-code-eliminated
+            return st2, jnp.sum(extras.dominated)
+        out, doms = lax.scan(body, state, stacked)
+        return out, jnp.sum(doms)
+
+    (state_x, _d) = run_window_extras(state, window_batches[0])
+    _sync(state_x)
+    me = Metrics()
+    for w in range(min(2, windows)):
+        with me.timer("window"):
+            out, _d = run_window_extras(state_x, window_batches[1 + w])
+            _sync(out)
+        me.count("ops", R * (B + Br) * W)
+    extras_rate = me.rate("ops", "window")
     # Per-round latency is estimated as window_time / W (individual rounds
     # inside a scan-fused window cannot be timed without per-round host
     # syncs, which would measure tunnel RTT instead of compute). p50/p99
@@ -111,7 +133,7 @@ def bench_dense(R, I, D_DCS, K, M, B, Br, windows, rounds_per_window):
     _sync(merged)
     state_merges_per_sec = MERGE_REPS * R / (time.perf_counter() - t0)
 
-    return apply_rate, p50_ms, p99_ms, state_merges_per_sec
+    return apply_rate, extras_rate, p50_ms, p99_ms, state_merges_per_sec
 
 
 def bench_scalar_baseline(R, I, D_DCS, K, n_ops):
@@ -164,7 +186,7 @@ def main():
         R, I, B, Br, windows, W, base_ops = 32, 100_000, 32768, 2048, 6, 10, 20_000
     D_DCS, K, M = R, 100, 4  # every simulated replica is a DC: vc width = R
 
-    apply_rate, p50_ms, p99_ms, state_merge_rate = bench_dense(
+    apply_rate, extras_rate, p50_ms, p99_ms, state_merge_rate = bench_dense(
         R, I, D_DCS, K, M, B, Br, windows, W
     )
     baseline_rate = bench_scalar_baseline(R, I, D_DCS, K, base_ops)
@@ -178,6 +200,7 @@ def main():
                 "vs_baseline": round(apply_rate / baseline_rate, 2),
                 "p50_round_ms_windowed": round(p50_ms, 2),
                 "p99_round_ms_windowed": round(p99_ms, 2),
+                "merges_per_sec_with_extras": round(extras_rate),
                 "replica_state_merges_per_sec": round(state_merge_rate, 1),
                 "baseline_cpu_merges_per_sec": round(baseline_rate),
                 "batch_per_replica_round": f"{B} adds + {Br} rmvs",

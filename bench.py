@@ -169,7 +169,11 @@ def main():
     import jax
 
     backend = jax.default_backend()
-    if backend == "cpu":
+    if os.environ.get("CCRDT_BENCH_TINY"):
+        # Smoke-test mode (tests/test_bench_smoke.py): exercise the full
+        # path in seconds; the numbers are meaningless.
+        R, I, B, Br, windows, W, base_ops = 2, 256, 32, 8, 2, 2, 200
+    elif backend == "cpu":
         # CI / no-accelerator fallback: shrink so the bench still completes.
         R, I, B, Br, windows, W, base_ops = 8, 10_000, 1024, 64, 3, 3, 5_000
     else:

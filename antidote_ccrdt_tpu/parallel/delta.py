@@ -176,8 +176,13 @@ def table_delta(dense: Any, prev: Any, cur: Any) -> dict:
         pv, cv = by_path[p]
         c = cv != pv
         changed = c if changed is None else (changed | c)
-    mask = np.asarray(changed).reshape(-1)
-    idx = jnp.asarray(np.nonzero(mask)[0].astype(np.int32))
+    if changed is None:
+        # No O(P) table planes (average: the whole state is O(R*NK)) —
+        # everything ships as a "whole" leaf and the index is empty.
+        idx = jnp.zeros((0,), jnp.int32)
+    else:
+        mask = np.asarray(changed).reshape(-1)
+        idx = jnp.asarray(np.nonzero(mask)[0].astype(np.int32))
 
     out: dict = {"idx": idx, "table": {}, "whole": {}}
     for p in paths:

@@ -366,3 +366,28 @@ def test_delta_gossip_rejects_monoid_engine(tmp_path):
     store = GossipStore(str(tmp_path), "a")
     with pytest.raises(ValueError, match="MONOID"):
         DeltaPublisher(store, mk_wc(64))
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_table_delta_average_whole_leaf_monoid(seed):
+    # average has no O(P) table planes — the delta is the (sum, num)
+    # difference shipped whole, applied via the monoid +.
+    from antidote_ccrdt_tpu.models.average import AverageDense, AverageOps
+
+    rng = np.random.default_rng(seed)
+    Da = AverageDense()
+
+    def ops(n):
+        return AverageOps(
+            key=jnp.asarray(rng.integers(0, 3, (2, n)).astype(np.int32)),
+            value=jnp.asarray(rng.integers(-50, 50, (2, n)).astype(np.int32)),
+            count=jnp.asarray(rng.integers(1, 3, (2, n)).astype(np.int32)),
+        )
+
+    prev = Da.init(2, 3)
+    prev, _ = Da.apply_ops(prev, ops(16))
+    cur, _ = Da.apply_ops(prev, ops(8))
+    delta = table_delta(Da, prev, cur)
+    assert np.asarray(delta["idx"]).size == 0
+    rejoined = apply_table_delta(Da, prev, delta)
+    assert states_equal(rejoined, cur)

@@ -270,10 +270,19 @@ def delta_in_bounds(dense: Any, like_state: Any, delta: Any) -> bool:
     R, NK = jax.tree_util.tree_leaves(like_state)[0].shape[:2]
     if isinstance(delta, TopkRmvDelta):
         n_rows = R * NK * dense.I
+        n = int(delta.rows.shape[0]) if delta.rows.ndim == 1 else -1
+        # Full-shape checks, leading dims included: a treedef-compatible
+        # delta from a peer with different R/NK (e.g. n_replicas=1) would
+        # otherwise slip through and jnp-broadcast its rows into every
+        # local replica inside merge.
         if (
-            delta.slot_score.shape[1:] != (dense.M,)
-            or delta.rmv_vc.shape[1:] != (dense.D,)
-            or delta.vc.shape[-1] != dense.D
+            n < 0
+            or tuple(delta.slot_score.shape) != (n, dense.M)
+            or tuple(delta.slot_dc.shape) != (n, dense.M)
+            or tuple(delta.slot_ts.shape) != (n, dense.M)
+            or tuple(delta.rmv_vc.shape) != (n, dense.D)
+            or tuple(delta.vc.shape) != (R, NK, dense.D)
+            or tuple(delta.lossy.shape) != (R, NK)
         ):
             return False
         rows = np.asarray(delta.rows)
@@ -284,8 +293,16 @@ def delta_in_bounds(dense: Any, like_state: Any, delta: Any) -> bool:
     if set(delta.get("table", {})) != set(table_paths):
         return False
     idx = np.asarray(delta["idx"])
+    if idx.ndim != 1 or not np.issubdtype(idx.dtype, np.integer):
+        return False
     if idx.size and (idx.min() < 0 or idx.max() >= min(n_entries.values())):
         return False
+    # Each table payload must carry exactly one (scalar) value per index —
+    # a mismatched length otherwise raises inside expand_table_delta's
+    # fancy assignment on the unguarded sweep path.
+    for p in table_paths:
+        if tuple(np.asarray(delta["table"][p]).shape) != (idx.size,):
+            return False
     for p, whole in delta.get("whole", {}).items():
         if p not in shapes or tuple(np.asarray(whole).shape) != shapes[p]:
             return False

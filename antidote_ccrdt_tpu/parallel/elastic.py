@@ -247,6 +247,7 @@ def sweep_deltas(
     after deltas (or twice) is harmless — everything is a join."""
     from .delta import apply_any_delta, delta_in_bounds, like_delta_for
 
+    _reject_monoid(dense, "sweep_deltas")
     like_delta = like_delta_for(dense, state)
     stats = {"deltas": 0, "fulls": 0, "skipped": 0}
 
@@ -260,7 +261,14 @@ def sweep_deltas(
             )
             if delta is None:
                 break  # torn/mismatched write: retry (or resync) next sweep
-            state = apply_any_delta(dense, state, delta)
+            # Same total-failure policy as fetch/fetch_delta: a decodable-
+            # but-malformed delta that slips past delta_in_bounds must not
+            # crash the gossip loop — break the chain and resync next sweep.
+            try:
+                state = apply_any_delta(dense, state, delta)
+            except Exception:  # noqa: BLE001 — deliberately total
+                stats["skipped"] += 1
+                break
             stats["deltas"] += 1
             cur += 1
         return cur
@@ -284,10 +292,14 @@ def sweep_deltas(
                 stats["skipped"] += 1
             else:
                 _seq, peer = got
-                state = dense.merge(state, peer)
-                stats["fulls"] += 1
-                cur = max(cur, _seq)
-                cur = chain(m, cur)
+                try:
+                    state = dense.merge(state, peer)
+                except Exception:  # noqa: BLE001 — deliberately total
+                    stats["skipped"] += 1
+                else:
+                    stats["fulls"] += 1
+                    cur = max(cur, _seq)
+                    cur = chain(m, cur)
         cursors[m] = cur
     return state, stats
 
@@ -309,10 +321,26 @@ def my_replicas(store: GossipStore, n_replicas: int, timeout_s: float) -> List[i
     return [r for r, m in own.items() if m == store.member]
 
 
+def _reject_monoid(dense: Any, where: str) -> None:
+    """Snapshot gossip re-merges peers' latest snapshots on every sweep —
+    only safe for idempotent joins. MONOID engines (average, wordcount)
+    would silently double-count; mirror DeltaPublisher's constructor
+    guard at every sweep entry point."""
+    from ..core.behaviour import MergeKind
+
+    if getattr(dense, "merge_kind", None) == MergeKind.MONOID:
+        raise ValueError(
+            f"{where} requires an idempotent join; MONOID engines "
+            "double-count on repeated snapshot merges (use DenseReplay's "
+            "exactly-once delta sync instead)"
+        )
+
+
 def sweep(store: GossipStore, dense: Any, state: Any) -> Tuple[Any, int]:
     """Fold every peer's latest snapshot into `state` with the engine
     join. Returns (state, n_merged). Self's snapshot is skipped (already
     reflected); stale or concurrent publishes are safe by idempotence."""
+    _reject_monoid(dense, "sweep")
     n = 0
     for m in store.snapshot_members():
         if m == store.member:

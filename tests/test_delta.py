@@ -391,3 +391,88 @@ def test_table_delta_average_whole_leaf_monoid(seed):
     assert np.asarray(delta["idx"]).size == 0
     rejoined = apply_table_delta(Da, prev, delta)
     assert states_equal(rejoined, cur)
+
+
+# --- ADVICE round-1 hardening: bounds validation + total sweep policy -----
+
+
+def test_replica_dim_mismatch_delta_rejected():
+    # A peer with the same I/M/D but n_replicas=1 produces a delta whose
+    # vc/lossy leading dims differ; before the full-shape check it passed
+    # validation and jnp-broadcast its single replica row into ALL local
+    # replicas inside merge.
+    from antidote_ccrdt_tpu.parallel.delta import delta_in_bounds, state_delta
+
+    rng = np.random.default_rng(17)
+    prev1 = jax.tree.map(lambda x: x[:1], D.init(R, NK))
+    ops1 = jax.tree.map(lambda x: x[:1], rand_ops(rng))
+    cur1, _ = D.apply_ops(prev1, ops1)
+    peer_delta = state_delta(D, prev1, cur1)
+    local = D.init(R, NK)
+    assert not delta_in_bounds(D, local, peer_delta)
+
+
+def test_row_payload_length_mismatch_rejected():
+    from antidote_ccrdt_tpu.parallel.delta import delta_in_bounds, state_delta
+    import dataclasses as dc
+
+    rng = np.random.default_rng(19)
+    prev = D.init(R, NK)
+    cur, _ = D.apply_ops(prev, rand_ops(rng))
+    delta = state_delta(D, prev, cur)
+    assert delta_in_bounds(D, cur, delta)
+    torn = dc.replace(delta, slot_score=delta.slot_score[:-1])
+    assert not delta_in_bounds(D, cur, torn)
+
+
+def test_table_delta_payload_length_mismatch_rejected():
+    from antidote_ccrdt_tpu.parallel.delta import delta_in_bounds
+
+    Dw, prev, cur = _wordcount_pair(23)
+    delta = table_delta(Dw, prev, cur)
+    assert delta_in_bounds(Dw, cur, delta)
+    p = next(iter(delta["table"]))
+    torn = {
+        "idx": delta["idx"],
+        "table": {**delta["table"], p: delta["table"][p][:-1]},
+        "whole": delta["whole"],
+    }
+    assert not delta_in_bounds(Dw, cur, torn)
+
+
+def test_sweep_deltas_survives_apply_failure(tmp_path, monkeypatch):
+    # Total-failure policy: a delta that passes bounds but still explodes
+    # inside apply must be counted skipped, not crash the gossip loop.
+    import antidote_ccrdt_tpu.parallel.delta as delta_mod
+
+    rng = np.random.default_rng(29)
+    a = GossipStore(str(tmp_path), "a")
+    b = GossipStore(str(tmp_path), "b")
+    pub = DeltaPublisher(a, D, full_every=100)
+    st = D.init(R, NK)
+    st, _ = D.apply_ops(st, rand_ops(rng))
+    pub.publish(st)  # full (seq 0)
+    st, _ = D.apply_ops(st, rand_ops(rng, ts_base=100))
+    pub.publish(st)  # delta (seq 1)
+
+    def boom(dense, state, delta):
+        raise RuntimeError("malformed beyond bounds check")
+
+    monkeypatch.setattr(delta_mod, "apply_any_delta", boom)
+    state_b = D.init(R, NK)
+    cursors: dict = {}
+    state_b, stats = sweep_deltas(b, D, state_b, cursors)  # must not raise
+    assert stats["skipped"] >= 1
+    assert cursors["a"] == 0  # chain stopped at the failing delta
+
+
+def test_snapshot_sweep_rejects_monoid_engine(tmp_path):
+    from antidote_ccrdt_tpu.models.wordcount import make_dense as mk_wc
+    from antidote_ccrdt_tpu.parallel.elastic import sweep
+
+    store = GossipStore(str(tmp_path), "a")
+    Dw = mk_wc(64)
+    with pytest.raises(ValueError, match="MONOID"):
+        sweep(store, Dw, Dw.init(1, 1))
+    with pytest.raises(ValueError, match="MONOID"):
+        sweep_deltas(store, Dw, Dw.init(1, 1), {})

@@ -123,7 +123,12 @@ class DenseReplay:
                 "ops_padding", rep["add_padding"] + rep["rmv_padding"]
             )
         with self.metrics.timer("apply"):
-            self.state, extras = self.dense.apply_ops(self.state, ops)
+            # Engines declare their replication-realistic extras mode (e.g.
+            # topk_rmv's id-keyed dominated table instead of the op-aligned
+            # gather that dominates the round — measured numbers in
+            # models/topk_rmv_dense.py apply_ops docstring).
+            kwargs = getattr(self.dense, "replication_extras_kwargs", {})
+            self.state, extras = self.dense.apply_ops(self.state, ops, **kwargs)
         if extras is not None:
             self.extras_log.append(extras)
         self.metrics.count("rounds")

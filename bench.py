@@ -83,27 +83,41 @@ def bench_dense(R, I, D_DCS, K, M, B, Br, windows, rounds_per_window):
         m.count("ops", R * (B + Br) * W)
     apply_rate = m.rate("ops", "window")
 
-    # Secondary: the same apply with extras collection ON (dominated-add
-    # re-broadcast vcs, reference :234-237) — the configuration the replay
-    # harness runs; the delta is the cost of full replication behavior.
-    @jax.jit
-    def run_window_extras(state, stacked):
-        def body(st, ops):
-            st2, extras = D.apply_ops(st, ops, collect_dominated=True)
-            # keep the extras live so the gather isn't dead-code-eliminated
-            return st2, jnp.sum(extras.dominated)
-        out, doms = lax.scan(body, state, stacked)
-        return out, jnp.sum(doms)
+    # Extras collection ON (dominated-add re-broadcast vcs, reference
+    # :234-237) — the configuration the replay harness runs. "table" mode
+    # is the replication path: the id-keyed dominated mask (payload =
+    # state.rmv_vc rows, live as part of the carried state) derived
+    # elementwise from the delta table — no per-op gather. True is the
+    # legacy op-aligned mode whose per-add tombstone gather dominated the
+    # round in round 1 (kept for small-batch surfaces). The summed extras
+    # leaf keeps each mode's collection live against DCE.
+    def extras_runner(mode, pick):
+        @jax.jit
+        def run(state, stacked):
+            def body(st, ops):
+                st2, extras = D.apply_ops(st, ops, collect_dominated=mode)
+                return st2, jnp.sum(pick(extras))
+            out, doms = lax.scan(body, state, stacked)
+            return out, jnp.sum(doms)
+        return run
 
-    (state_x, _d) = run_window_extras(state, window_batches[0])
-    _sync(state_x)
-    me = Metrics()
-    for w in range(min(2, windows)):
-        with me.timer("window"):
-            out, _d = run_window_extras(state_x, window_batches[1 + w])
-            _sync(out)
-        me.count("ops", R * (B + Br) * W)
-    extras_rate = me.rate("ops", "window")
+    def time_extras(run, n_windows):
+        (warm, _d) = run(state, window_batches[0])
+        _sync(warm)
+        me = Metrics()
+        for w in range(n_windows):
+            with me.timer("window"):
+                out, _d = run(warm, window_batches[1 + w])
+                _sync(out)
+            me.count("ops", R * (B + Br) * W)
+        return me.rate("ops", "window")
+
+    extras_rate = time_extras(
+        extras_runner("table", lambda e: e.dominated_tbl), min(2, windows)
+    )
+    extras_ops_rate = time_extras(
+        extras_runner(True, lambda e: e.dominated), 1
+    )
     # Per-round latency is estimated as window_time / W (individual rounds
     # inside a scan-fused window cannot be timed without per-round host
     # syncs, which would measure tunnel RTT instead of compute). p50/p99
@@ -133,7 +147,10 @@ def bench_dense(R, I, D_DCS, K, M, B, Br, windows, rounds_per_window):
     _sync(merged)
     state_merges_per_sec = MERGE_REPS * R / (time.perf_counter() - t0)
 
-    return apply_rate, extras_rate, p50_ms, p99_ms, state_merges_per_sec
+    return (
+        apply_rate, extras_rate, extras_ops_rate, p50_ms, p99_ms,
+        state_merges_per_sec,
+    )
 
 
 def bench_scalar_baseline(R, I, D_DCS, K, n_ops):
@@ -190,9 +207,10 @@ def main():
         R, I, B, Br, windows, W, base_ops = 32, 100_000, 32768, 2048, 6, 10, 20_000
     D_DCS, K, M = R, 100, 4  # every simulated replica is a DC: vc width = R
 
-    apply_rate, extras_rate, p50_ms, p99_ms, state_merge_rate = bench_dense(
-        R, I, D_DCS, K, M, B, Br, windows, W
-    )
+    (
+        apply_rate, extras_rate, extras_ops_rate, p50_ms, p99_ms,
+        state_merge_rate,
+    ) = bench_dense(R, I, D_DCS, K, M, B, Br, windows, W)
     baseline_rate = bench_scalar_baseline(R, I, D_DCS, K, base_ops)
 
     print(
@@ -205,6 +223,7 @@ def main():
                 "p50_round_ms_windowed": round(p50_ms, 2),
                 "p99_round_ms_windowed": round(p99_ms, 2),
                 "merges_per_sec_with_extras": round(extras_rate),
+                "merges_per_sec_with_extras_op_aligned": round(extras_ops_rate),
                 "replica_state_merges_per_sec": round(state_merge_rate, 1),
                 "baseline_cpu_merges_per_sec": round(baseline_rate),
                 "batch_per_replica_round": f"{B} adds + {Br} rmvs",

@@ -200,7 +200,7 @@ def make_hoisted(use_pallas):
         else:
             out = jax.vmap(scatter_max_rows_mxu)(tab, rrow, ops.rmv_vc)
         rmv_vc_new = out.reshape(R_, NK, I, D_DCS)
-        return jax.vmap(D._apply_one_replica)(state, ops, rmv_vc_new)
+        return jax.vmap(D._apply_one_replica)(state, ops, rmv_vc_new)[0]
 
     return step
 
@@ -212,7 +212,7 @@ timeit("hoisted PALLAS tombstones + vmap apply", make_hoisted(True))
 def step_identity_tomb(state, ops):
     # rmv_vc passed through untouched: isolates the cost of CONSUMING a
     # materialized table in the join vs a fused producer.
-    return jax.vmap(D._apply_one_replica)(state, ops, state.rmv_vc)
+    return jax.vmap(D._apply_one_replica)(state, ops, state.rmv_vc)[0]
 
 
 timeit("vmap apply, identity (materialized) tombstones", step_identity_tomb)

@@ -15,11 +15,14 @@ import bench  # noqa: E402
 
 
 def test_bench_dense_tiny():
-    apply_rate, extras_rate, p50, p99, merge_rate = bench.bench_dense(
+    (
+        apply_rate, extras_rate, extras_ops_rate, p50, p99, merge_rate,
+    ) = bench.bench_dense(
         R=2, I=64, D_DCS=2, K=4, M=2, B=16, Br=4, windows=2,
         rounds_per_window=2,
     )
     assert apply_rate > 0 and extras_rate > 0 and merge_rate > 0
+    assert extras_ops_rate > 0
     assert p50 > 0 and p99 >= p50
 
 

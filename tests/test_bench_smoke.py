@@ -16,7 +16,8 @@ import bench  # noqa: E402
 
 def test_bench_dense_tiny():
     (
-        apply_rate, extras_rate, extras_ops_rate, p50, p99, merge_rate,
+        apply_rate, extras_rate, extras_ops_rate, p50, p99,
+        p50_e2e, p99_e2e, overhead, merge_rate, hbm,
     ) = bench.bench_dense(
         R=2, I=64, D_DCS=2, K=4, M=2, B=16, Br=4, windows=2,
         rounds_per_window=2,
@@ -24,6 +25,10 @@ def test_bench_dense_tiny():
     assert apply_rate > 0 and extras_rate > 0 and merge_rate > 0
     assert extras_ops_rate > 0
     assert p50 > 0 and p99 >= p50
+    assert p50_e2e > 0 and p99_e2e >= p50_e2e and overhead > 0
+    assert set(hbm) == {"apply", "replica_state_merge", "observe"}
+    for phase in hbm.values():
+        assert phase["achieved_gb_s"] > 0 and phase["bytes_per_dispatch"] > 0
 
 
 def test_bench_scalar_baseline_tiny():

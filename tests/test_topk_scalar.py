@@ -81,3 +81,48 @@ def test_convergence_is_order_independent():
 def test_binary_roundtrip():
     st, _ = K.update(("add", (1, 10)), K.new(5))
     assert K.from_binary(K.to_binary(st)) == st
+
+
+# --- reference-observable compat engine (decision record: VERDICT r1 #4) --
+
+
+def test_compat_reproduces_reference_quirks():
+    from antidote_ccrdt_tpu.models.topk import TopkScalarCompat
+
+    C = TopkScalarCompat()
+    ctx = None
+    st = C.new()
+    assert st.size == 1000  # new/0 -> 1000 (topk.erl:65-66)
+    st = C.new(100)
+    # "size" is a score THRESHOLD in downstream (topk.erl:164-166)
+    assert C.downstream(("add", (1, 100)), st, ctx) is None
+    eff = C.downstream(("add", (1, 101)), st, ctx)
+    assert eff == ("add", (1, 101))
+    st, _ = C.update(eff, st)
+    # last-wins update, never prunes (topk.erl:157-158): a LOWER score
+    # overwrites (the effect slips through downstream only if > size, but
+    # update itself has no guard — apply directly as a replicated effect)
+    st, _ = C.update(("add", (1, 50)), st)
+    assert st.entries == {1: 50}
+    # grow-only beyond "size": 3 more ids than a real top-1 would keep
+    for i, s in ((2, 300), (3, 200), (4, 250)):
+        st, _ = C.update(("add", (i, s)), st)
+    assert len(st.entries) == 4
+    assert C.value(st)[0] == (2, 300)
+
+
+def test_compat_compaction_last_wins_order_dependent():
+    from antidote_ccrdt_tpu.models.topk import TopkScalarCompat
+
+    C = TopkScalarCompat()
+    # duplicate id: later op's score wins regardless of magnitude
+    assert C.can_compact(("add", (7, 900)), ("add", (7, 5)))
+    _, op = C.compact_ops(("add", (7, 900)), ("add", (7, 5)))
+    assert op == ("add_map", {7: 5})
+    _, op = C.compact_ops(("add_map", {7: 5, 8: 1}), ("add_map", {7: 900}))
+    assert op == ("add_map", {7: 900, 8: 1})
+    # while the rebuilt engine takes max (quirk #4 fix)
+    from antidote_ccrdt_tpu.models.topk import TopkScalar
+
+    _, op = TopkScalar().compact_ops(("add", (7, 900)), ("add", (7, 5)))
+    assert op == ("add_map", {7: 900})

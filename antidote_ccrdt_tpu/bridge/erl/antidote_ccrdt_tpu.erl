@@ -1,0 +1,185 @@
+%% Erlang-side client for the antidote_ccrdt_tpu bridge worker.
+%%
+%% Routes the reference behaviour's invocation surface (the 12 callbacks an
+%% Antidote host drives, upstream src/antidote_ccrdt.erl:47-59) over a
+%% {packet,4} + External-Term-Format TCP connection to the persistent TPU
+%% worker (antidote_ccrdt_tpu/bridge/server.py). An Antidote node loads
+%% this module and calls through it exactly where it would call a local
+%% data-type module; the CRDT states live in the worker, addressed by
+%% integer handles, interchangeable with reference term_to_binary
+%% snapshots via to_binary/from_binary.
+%%
+%% Wire protocol (bridge/protocol.py is the source of truth):
+%%   frame   := u32_be length ++ term_to_binary(Term)   %% = {packet, 4}
+%%   request := {call, ReqId, Op}
+%%   reply   := {reply, ReqId, {ok, Result} | {error, Binary}}
+%%
+%% Every request this module sends is term_to_binary of a plain tuple, so
+%% the byte stream is pinned — tests/test_bridge_erl.py vendors the exact
+%% frames these functions produce and asserts bridge/protocol.py decodes
+%% them (and, where the encoding is canonical, produces identical bytes).
+%%
+%% Also runnable as a smoke-test escript against a live worker:
+%%     escript antidote_ccrdt_tpu.erl [Host [Port]]
+%% (tests/test_bridge_erl.py runs this automatically when escript is on
+%% PATH.)
+
+-module(antidote_ccrdt_tpu).
+
+-export([connect/2, close/1, call/2,
+         new/2, new/3, from_binary/3, downstream/5, update/3, value/2,
+         to_binary/2, equal/3, compact/3, free/2, batch_merge/3,
+         is_type/2, generates_extra_operations/2, is_operation/3,
+         require_state_downstream/3, is_replicate_tagged/3,
+         grid_new/4, grid_apply/3, grid_merge_all/2, grid_observe/4,
+         wire_atoms/0, main/1]).
+
+-define(TIMEOUT, 30000).
+
+%% The protocol atoms plus every effect tag the data types emit — for
+%% reference, and so they are interned at module load. Replies are decoded
+%% with plain binary_to_term/1, NOT [safe]: the worker holds all CRDT
+%% state and sits inside the deployment's trust boundary, and replies can
+%% legitimately carry atoms this VM has never seen (DC ids from foreign
+%% reference snapshots loaded via from_binary/3), which [safe] would
+%% reject with badarg.
+wire_atoms() ->
+    [reply, ok, error, nil, true, false, call,
+     add, add_r, rmv, rmv_r, add_map, add_counts, ban, ban_r, noop].
+
+connect(Host, Port) ->
+    gen_tcp:connect(Host, Port,
+                    [binary, {packet, 4}, {active, false}], ?TIMEOUT).
+
+close(Sock) ->
+    gen_tcp:close(Sock).
+
+%% One request/reply round trip. Request ids are VM-unique so concurrent
+%% processes may share a connection only with external serialization; one
+%% connection per caller is the intended shape (the worker is threaded).
+call(Sock, Op) ->
+    ReqId = erlang:unique_integer([positive, monotonic]),
+    ok = gen_tcp:send(Sock, term_to_binary({call, ReqId, Op})),
+    {ok, Bin} = gen_tcp:recv(Sock, 0, ?TIMEOUT),
+    case binary_to_term(Bin) of
+        {reply, ReqId, {ok, Result}} -> {ok, Result};
+        {reply, ReqId, {error, Msg}} -> {error, Msg};
+        Other -> {error, {bad_reply, Other}}
+    end.
+
+%% -- the callback surface (antidote_ccrdt.erl:47-59 over the wire) -------
+
+new(Sock, Type) ->
+    new(Sock, Type, []).
+
+new(Sock, Type, Args) when is_atom(Type), is_list(Args) ->
+    call(Sock, {new, Type, Args}).
+
+from_binary(Sock, Type, Bin) when is_atom(Type), is_binary(Bin) ->
+    call(Sock, {from_binary, Type, Bin}).
+
+%% DcId/Ts replace the reference's ?DC_META_DATA/?TIME shims: the host
+%% passes its identity and clock explicitly (the library's only
+%% nondeterminism made an argument — see core/clock.py).
+downstream(Sock, Handle, Op, DcId, Ts) ->
+    call(Sock, {downstream, Handle, Op, DcId, Ts}).
+
+update(Sock, Handle, Effect) ->
+    call(Sock, {update, Handle, Effect}).
+
+value(Sock, Handle) ->
+    call(Sock, {value, Handle}).
+
+to_binary(Sock, Handle) ->
+    call(Sock, {to_binary, Handle}).
+
+equal(Sock, H1, H2) ->
+    call(Sock, {equal, H1, H2}).
+
+compact(Sock, Handle, Effects) when is_list(Effects) ->
+    call(Sock, {compact, Handle, Effects}).
+
+free(Sock, Handle) ->
+    call(Sock, {free, Handle}).
+
+%% The north-star entry point: join N states (handles or reference
+%% binaries) in one batched device pass; returns a new handle.
+batch_merge(Sock, Type, Items) when is_atom(Type), is_list(Items) ->
+    call(Sock, {batch_merge, Type, Items}).
+
+%% -- registry / per-type predicates (antidote_ccrdt.erl:61-65) -----------
+
+is_type(Sock, Type) ->
+    call(Sock, {is_type, Type}).
+
+generates_extra_operations(Sock, Type) ->
+    call(Sock, {generates_extra_operations, Type}).
+
+is_operation(Sock, Type, Op) ->
+    call(Sock, {is_operation, Type, Op}).
+
+require_state_downstream(Sock, Type, Op) ->
+    call(Sock, {require_state_downstream, Type, Op}).
+
+is_replicate_tagged(Sock, Type, Effect) ->
+    call(Sock, {is_replicate_tagged, Type, Effect}).
+
+%% -- dense grids (the TPU batch surface) ---------------------------------
+
+%% Params is a map, e.g. #{n_replicas => 2, n_keys => 1, n_ids => 1024,
+%% n_dcs => 2, size => 100, slots_per_id => 4}.
+grid_new(Sock, Grid, Type, Params) when is_map(Params) ->
+    call(Sock, {grid_new, Grid, Type, Params}).
+
+%% OpsPerReplica: one op list per replica row;
+%%   {add, Key, Id, Score, Dc, Ts} | {rmv, Key, Id, [{Dc, Ts}]}.
+grid_apply(Sock, Grid, OpsPerReplica) when is_list(OpsPerReplica) ->
+    call(Sock, {grid_apply, Grid, OpsPerReplica}).
+
+grid_merge_all(Sock, Grid) ->
+    call(Sock, {grid_merge_all, Grid}).
+
+grid_observe(Sock, Grid, Replica, Key) ->
+    call(Sock, {grid_observe, Grid, Replica, Key}).
+
+%% -- escript smoke test ---------------------------------------------------
+
+main(Args) ->
+    Host = case Args of [H | _] -> H; _ -> "127.0.0.1" end,
+    Port = case Args of [_, P | _] -> list_to_integer(P); _ -> 7077 end,
+    {ok, S} = connect(Host, Port),
+    {ok, true} = is_type(S, average),
+    {ok, false} = is_type(S, not_a_type),
+    {ok, true} = generates_extra_operations(S, topk_rmv),
+
+    %% scalar surface: average end to end
+    {ok, H} = new(S, average),
+    {ok, Eff} = downstream(S, H, {add, 5}, {replica1, 0}, 1),
+    {ok, []} = update(S, H, Eff),
+    {ok, Eff2} = downstream(S, H, {add, {15, 2}}, {replica1, 0}, 2),
+    {ok, []} = update(S, H, Eff2),
+    {ok, V} = value(S, H),
+    io:format("average value: ~p~n", [V]),
+
+    %% snapshot round trip + batched join
+    {ok, Bin} = to_binary(S, H),
+    {ok, H2} = from_binary(S, average, Bin),
+    {ok, true} = equal(S, H, H2),
+    {ok, H3} = batch_merge(S, average, [H, Bin]),
+    {ok, V3} = value(S, H3),
+    io:format("batch_merge value: ~p~n", [V3]),
+
+    %% topk_rmv with an extra-op re-broadcast (reference :234-237)
+    {ok, T} = new(S, topk_rmv, [2]),
+    {ok, AddEff} = downstream(S, T, {add, {1, 42}}, {dc1, 0}, 1),
+    {ok, []} = update(S, T, AddEff),
+    {ok, RmvEff} = downstream(S, T, {rmv, 1}, {dc1, 0}, 2),
+    {ok, _} = update(S, T, RmvEff),
+    {ok, Extras} = update(S, T, AddEff),  %% re-deliver dominated add
+    true = Extras =/= [],
+    io:format("topk_rmv re-broadcast extras: ~p~n", [Extras]),
+
+    {ok, true} = free(S, H3),
+    ok = close(S),
+    io:format("bridge smoke OK~n", []),
+    halt(0).

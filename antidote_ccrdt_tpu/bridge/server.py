@@ -12,9 +12,22 @@ of the behaviour contract, SURVEY.md §1): a threaded TCP server speaking
   structs, applied in one dispatch, and replicas fold with the lattice
   merge — the north-star `batch_merge` exposed to a host.
 
-Concurrency: one OS thread per connection; a global lock serializes state
-mutation (the JAX dispatch itself releases the GIL; the lock keeps
-handle/grid maps consistent).
+Concurrency: one OS thread per connection, per-OBJECT locking (round-2;
+round 1 had one global lock, so a ~60ms dense grid dispatch stalled every
+other client):
+
+* every scalar handle and every grid has its own lock, created lazily;
+* ops touching several handles (equal, batch_merge) acquire their locks
+  in sorted order (no deadlock);
+* a short meta lock guards only the handle/grid maps, lock tables and id
+  allocation, and is never held while waiting on an object lock;
+* registry predicates are pure reads and run lock-free.
+
+Scalar states are copy-on-write (every `update` builds a new value), so
+holding an object lock only for the duration of the op keeps readers of
+old state references safe. A long grid dispatch therefore blocks ONLY
+callers of that same grid — pinned by
+`tests/test_bridge.py::test_long_grid_op_does_not_block_scalar_ops`.
 """
 
 from __future__ import annotations
@@ -165,7 +178,11 @@ class BridgeServer:
         self._handles: Dict[Any, Tuple[str, Any]] = {}
         self._grids: Dict[Any, _Grid] = {}
         self._next = 0
-        self._lock = threading.Lock()
+        # Lock order: object locks (handles/grids) outrank _meta; _meta is
+        # only ever taken alone or inside an already-held object lock.
+        self._meta = threading.Lock()
+        self._hlocks: Dict[Any, threading.Lock] = {}
+        self._glocks: Dict[Any, threading.Lock] = {}
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -206,19 +223,80 @@ class BridgeServer:
 
     # -- dispatch ----------------------------------------------------------
 
+    # Which operand positions hold handles that must be locked, per tag.
+    _HANDLE_ARGS = {
+        "downstream": (1,), "update": (1,), "value": (1,), "to_binary": (1,),
+        "compact": (1,), "equal": (1, 2),
+    }
+    _GRID_TAGS = {"grid_apply", "grid_merge_all", "grid_observe"}
+
     def _dispatch(self, term: Any) -> Any:
         if not (isinstance(term, tuple) and len(term) == 3 and term[0] == P.A_CALL):
             return P.reply_error(-1, f"bad request: {term!r}")
         _, req_id, op = term
         try:
-            with self._lock:
-                return P.reply_ok(req_id, self._exec(op))
+            return P.reply_ok(req_id, self._exec_routed(op))
         except Exception as e:  # noqa: BLE001 - all errors go to the client
             return P.reply_error(req_id, f"{type(e).__name__}: {e}")
 
-    def _new_handle(self) -> int:
-        self._next += 1
-        return self._next
+    def _exec_routed(self, op: Any) -> Any:
+        """Acquire exactly the locks the op needs, then run it."""
+        tag = str(op[0])
+        if tag == "free":
+            try:
+                lk = self._handle_lock(op[1])
+            except KeyError:
+                return True  # already freed — free is idempotent
+            with lk:
+                return self._exec(op)
+        if tag in self._HANDLE_ARGS:
+            handles = [op[i] for i in self._HANDLE_ARGS[tag]]
+        elif tag == "batch_merge":
+            # Lock the handle items; inline binaries need no lock.
+            handles = [it for it in op[2] if not isinstance(it, (bytes, bytearray))]
+        elif tag in self._GRID_TAGS:
+            with self._grid_lock(op[1]):
+                return self._exec(op)
+        else:
+            # new / from_binary / grid_new create objects (inserted under
+            # _meta inside _exec); registry predicates are pure reads.
+            return self._exec(op)
+        # repr-sort = one global acquisition order; dedup because an op may
+        # name the same handle twice (equal(h, h)).
+        locks = [
+            self._handle_lock(h)
+            for h in dict.fromkeys(sorted(handles, key=repr))
+        ]
+        for lk in locks:
+            lk.acquire()
+        try:
+            return self._exec(op)
+        finally:
+            for lk in reversed(locks):
+                lk.release()
+
+    def _handle_lock(self, h: Any) -> threading.Lock:
+        with self._meta:
+            if h not in self._handles:
+                raise KeyError(f"no such handle: {h!r}")
+            return self._hlocks.setdefault(h, threading.Lock())
+
+    def _grid_lock(self, g: Any) -> threading.Lock:
+        with self._meta:
+            if g not in self._grids:
+                raise KeyError(f"no such grid: {g!r}")
+            return self._glocks.setdefault(g, threading.Lock())
+
+    def _insert_handle(self, name: str, state: Any) -> int:
+        """Allocate id and insert in one _meta section: every mutation of
+        the handle map goes through _meta (or holds the handle's own lock,
+        for update's write-back), keeping _handle_lock's membership check
+        race-free even without the GIL."""
+        with self._meta:
+            self._next += 1
+            h = self._next
+            self._handles[h] = (name, state)
+            return h
 
     def _state(self, handle: Any) -> Tuple[str, Any]:
         if handle not in self._handles:
@@ -231,15 +309,11 @@ class BridgeServer:
             _, type_atom, args = op
             name = str(type_atom)
             crdt = registry.scalar(name)
-            h = self._new_handle()
-            self._handles[h] = (name, crdt.new(*_from_term(args)))
-            return h
+            return self._insert_handle(name, crdt.new(*_from_term(args)))
         if tag == "from_binary":
             _, type_atom, blob = op
             name = str(type_atom)
-            h = self._new_handle()
-            self._handles[h] = (name, wire.from_reference_binary(name, blob))
-            return h
+            return self._insert_handle(name, wire.from_reference_binary(name, blob))
         if tag == "downstream":
             _, h, op_term, dc, ts = op
             name, state = self._state(h)
@@ -273,9 +347,7 @@ class BridgeServer:
                     states.append(st)
             from ..core.batch_merge import batch_merge
 
-            h = self._new_handle()
-            self._handles[h] = (name, batch_merge(name, states))
-            return h
+            return self._insert_handle(name, batch_merge(name, states))
         if tag == "is_type":
             # Registry predicates (antidote_ccrdt.erl:61-65), so a BEAM
             # host can interrogate the library without local knowledge.
@@ -330,13 +402,17 @@ class BridgeServer:
             return [op_to_term(e) for e in log if e is not None]
         if tag == "free":
             _, h = op
-            self._handles.pop(h, None)
+            with self._meta:
+                self._handles.pop(h, None)
+                self._hlocks.pop(h, None)
             return True
         if tag == "grid_new":
             _, gname, type_atom, params = op
             if str(type_atom) != "topk_rmv":
                 raise ValueError("dense grids support topk_rmv")
-            self._grids[gname] = _Grid(str(type_atom), params)
+            grid = _Grid(str(type_atom), params)  # built outside _meta
+            with self._meta:
+                self._grids[gname] = grid
             return True
         if tag == "grid_apply":
             _, gname, per_replica = op

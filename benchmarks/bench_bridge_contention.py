@@ -1,0 +1,71 @@
+"""Bridge contention benchmark: scalar op throughput while a dense grid
+dispatch is in flight.
+
+Round 1 had one global lock: a north-star-sized grid dispatch (~60ms)
+stalled every client. Round 2 locks per object, so scalar traffic should
+be unaffected by a concurrent grid op. This measures both configurations'
+observable effect: scalar round-trips/sec with (a) an idle server and
+(b) a server continuously running slow grid applies on another
+connection.
+
+Run: python benchmarks/bench_bridge_contention.py  [grid_ms=200]
+"""
+
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from antidote_ccrdt_tpu.bridge import BridgeClient, BridgeServer
+from antidote_ccrdt_tpu.bridge.client import add
+from antidote_ccrdt_tpu.core.etf import Atom
+
+
+def scalar_rate(addr, seconds=2.0):
+    with BridgeClient(*addr) as c:
+        h = c.new("average")
+        n = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < seconds:
+            c.update(h, (Atom("add"), (1, 1)))
+            n += 1
+        return n / (time.perf_counter() - t0)
+
+
+def main():
+    grid_ms = float(sys.argv[1]) if len(sys.argv) > 1 else 200.0
+    with BridgeServer() as srv:
+        with BridgeClient(*srv.address) as setup:
+            setup.grid_new("g", n_replicas=2, n_keys=1, n_ids=256, n_dcs=2)
+        grid = srv._grids[b"g"]
+        orig = grid.apply
+        grid.apply = lambda ops: (time.sleep(grid_ms / 1e3), orig(ops))[1]
+
+        idle = scalar_rate(srv.address)
+
+        stop = threading.Event()
+
+        def grind():
+            with BridgeClient(*srv.address) as c:
+                while not stop.is_set():
+                    c.grid_apply("g", [[add(0, 1, 50, 0, 1)], []])
+
+        th = threading.Thread(target=grind)
+        th.start()
+        time.sleep(0.2)  # let the grinder hold the grid lock
+        contended = scalar_rate(srv.address)
+        stop.set()
+        th.join()
+
+    print(
+        f"scalar round-trips/sec: idle={idle:.0f}  "
+        f"with {grid_ms:.0f}ms grid ops in flight={contended:.0f}  "
+        f"ratio={contended / idle:.2f} (1.0 = no interference; the round-1 "
+        f"global lock gave ~{1e3 / grid_ms:.0f}/sec here)"
+    )
+
+
+if __name__ == "__main__":
+    main()

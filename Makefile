@@ -4,26 +4,45 @@
 # Target parity map:
 #   reference `make compile` (warnings_as_errors)  -> `make compile`
 #   reference `make test`    (rebar3 eunit)        -> `make test`
-#   reference `make cover`   (rebar3 cover)        -> (no coverage tool in
-#       this image; the test tiers in tests/ are the coverage story)
-#   reference `make dialyzer`/xref undefined-call  -> `make xref`
-#       (import-resolution check over every package module)
+#   reference `make cover`   (rebar3 cover)        -> `make cover`
+#       (scripts/cover.py: sys.monitoring line coverage, committed
+#        threshold; runs the full suite, so `all` uses it AS the test run)
+#   reference `make dialyzer`/xref undefined-call  -> `make xref` +
+#       `make typecheck` (scripts/typecheck.py: typeguard import hook over
+#        the python-heavy test subset — dynamic success typing, the
+#        closest dialyzer analog this image supports; no mypy/pyright and
+#        no egress to vendor one)
 # plus targets the reference has no equivalent of:
 #   `make native`  — C++ host runtime + tokenizer (native/)
 #   `make bench`   — north-star benchmark (one JSON line)
 #   `make benchall`— every BASELINE.md config
 
 PY ?= python
+# Measured 91.4% at commit time (multihost.py's real-subprocess drills are
+# invisible to the in-process monitor — see scripts/cover.py); 88 leaves
+# drift headroom while keeping the gate meaningful.
+COVER_THRESHOLD ?= 88
 
-.PHONY: all compile test xref native bench benchall dryrun clean
+.PHONY: all compile test cover typecheck xref native bench benchall dryrun clean
 
-all: compile xref test
+all: compile xref typecheck cover
 
 compile: native
 	$(PY) -W error::SyntaxWarning -m compileall -q antidote_ccrdt_tpu tests scripts benchmarks bench.py __graft_entry__.py
 
 test:
 	$(PY) -m pytest tests/ -q
+
+# Sharded (union of executed-line sets is exact); keeps each pytest run
+# under CI per-command wall-time caps. Shard split: conftest + [a-e] /
+# the rest.
+cover:
+	$(PY) scripts/cover.py --data-out $(CURDIR)/.cover-1.json tests/test_[a-e]*.py -q
+	$(PY) scripts/cover.py --data-out $(CURDIR)/.cover-2.json tests/test_[f-z]*.py -q
+	$(PY) scripts/cover.py --report $(CURDIR)/.cover-1.json $(CURDIR)/.cover-2.json --threshold $(COVER_THRESHOLD)
+
+typecheck:
+	$(PY) scripts/typecheck.py
 
 # xref: every module in the package must import cleanly (catches undefined
 # imports the way rebar.config:8's xref undefined_function_calls check does).

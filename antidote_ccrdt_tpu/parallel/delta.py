@@ -286,7 +286,9 @@ def delta_in_bounds(dense: Any, like_state: Any, delta: Any) -> bool:
         ):
             return False
         rows = np.asarray(delta.rows)
-        return rows.size == 0 or (rows.min() >= 0 and rows.max() < n_rows)
+        return bool(
+            rows.size == 0 or (rows.min() >= 0 and rows.max() < n_rows)
+        )
     paths, leaves, table_paths, _ = _split_leaves(like_state)
     shapes = dict(zip(paths, (leaf.shape for leaf in leaves)))
     n_entries = {p: int(np.prod(shapes[p])) for p in table_paths}

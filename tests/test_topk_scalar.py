@@ -89,8 +89,10 @@ def test_binary_roundtrip():
 def test_compat_reproduces_reference_quirks():
     from antidote_ccrdt_tpu.models.topk import TopkScalarCompat
 
+    from antidote_ccrdt_tpu.core.clock import LogicalClock, ReplicaContext
+
     C = TopkScalarCompat()
-    ctx = None
+    ctx = ReplicaContext(0, LogicalClock())
     st = C.new()
     assert st.size == 1000  # new/0 -> 1000 (topk.erl:65-66)
     st = C.new(100)

@@ -459,3 +459,94 @@ def test_dominated_table_equals_scattered_op_flags(seed):
     assert np.array_equal(np.asarray(ex_tbl.dominated_tbl), expected)
     for la, lb in zip(jax.tree.leaves(st_op), jax.tree.leaves(st_tbl)):
         assert np.array_equal(np.asarray(la), np.asarray(lb))
+
+
+from hypothesis import HealthCheck, given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
+
+
+@settings(
+    max_examples=25, deadline=None,
+    suppress_health_check=[HealthCheck.too_slow],
+)
+@given(data=st.data())
+def test_dominated_table_mode_equivalence_property(data):
+    """Property form of the table/op-aligned equivalence: under ANY
+    non-overflowing batch (<= M adds per (key, id)), the id-keyed table
+    equals the op-aligned flags scattered by (key, id), and the state is
+    bit-identical across all three collect_dominated modes."""
+    R, NK, I, DCS, M = 2, 2, 16, 3, 3
+    D = make_dense(n_ids=I, n_dcs=DCS, size=4, slots_per_id=M)
+    st_ = D.init(R, NK)
+    # seed tombstones
+    n_rmv = data.draw(st.integers(1, 6))
+    rmv_id = data.draw(
+        st.lists(st.integers(0, I - 1), min_size=n_rmv, max_size=n_rmv)
+    )
+    rmv_key = data.draw(
+        st.lists(st.integers(0, NK - 1), min_size=n_rmv, max_size=n_rmv)
+    )
+    vc_flat = data.draw(
+        st.lists(st.integers(0, 30), min_size=n_rmv * DCS, max_size=n_rmv * DCS)
+    )
+    pre = TopkRmvOps(
+        add_key=jnp.zeros((R, 1), jnp.int32),
+        add_id=jnp.zeros((R, 1), jnp.int32),
+        add_score=jnp.zeros((R, 1), jnp.int32),
+        add_dc=jnp.zeros((R, 1), jnp.int32),
+        add_ts=jnp.zeros((R, 1), jnp.int32),
+        rmv_key=jnp.broadcast_to(jnp.asarray(rmv_key, jnp.int32), (R, n_rmv)),
+        rmv_id=jnp.broadcast_to(jnp.asarray(rmv_id, jnp.int32), (R, n_rmv)),
+        rmv_vc=jnp.broadcast_to(
+            jnp.asarray(vc_flat, jnp.int32).reshape(1, n_rmv, DCS), (R, n_rmv, DCS)
+        ),
+    )
+    st_, _ = D.apply_ops(st_, pre, collect_dominated=False)
+    # adds: at most M per (key, id) -> never lossy
+    pairs = data.draw(
+        st.lists(
+            st.tuples(st.integers(0, NK - 1), st.integers(0, I - 1)),
+            min_size=1, max_size=10, unique=True,
+        )
+    )
+    per_pair = data.draw(st.integers(1, M))
+    adds = []
+    for (k, i) in pairs:
+        for j in range(per_pair):
+            adds.append(
+                (k, i,
+                 data.draw(st.integers(1, 50)),       # score
+                 data.draw(st.integers(0, DCS - 1)),  # dc
+                 data.draw(st.integers(1, 40)))       # ts
+            )
+    B = len(adds)
+    arr = np.asarray(adds, np.int32)
+    ops = TopkRmvOps(
+        add_key=jnp.broadcast_to(jnp.asarray(arr[:, 0]), (R, B)),
+        add_id=jnp.broadcast_to(jnp.asarray(arr[:, 1]), (R, B)),
+        add_score=jnp.broadcast_to(jnp.asarray(arr[:, 2]), (R, B)),
+        add_dc=jnp.broadcast_to(jnp.asarray(arr[:, 3]), (R, B)),
+        add_ts=jnp.broadcast_to(jnp.asarray(arr[:, 4]), (R, B)),
+        rmv_key=jnp.zeros((R, 1), jnp.int32),
+        rmv_id=jnp.full((R, 1), -1, jnp.int32),
+        rmv_vc=jnp.zeros((R, 1, DCS), jnp.int32),
+    )
+    st_op, ex_op = D.apply_ops(st_, ops, collect_dominated=True)
+    st_tbl, ex_tbl = D.apply_ops(st_, ops, collect_dominated="table")
+    st_off, _ = D.apply_ops(st_, ops, collect_dominated=False)
+    for a, b, c in zip(
+        jax.tree.leaves(st_op), jax.tree.leaves(st_tbl), jax.tree.leaves(st_off)
+    ):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+        assert np.array_equal(np.asarray(a), np.asarray(c))
+    # duplicate adds dedup (idempotence) and never overflow here, but a
+    # batch CAN still rank >M live adds nowhere (unique pairs, <=M each):
+    assert not bool(st_tbl.lossy.any())
+    expected = np.zeros((R, NK, I), bool)
+    dom = np.asarray(ex_op.dominated)
+    ak, ai = np.asarray(ops.add_key), np.asarray(ops.add_id)
+    for r in range(R):
+        for b_i in range(B):
+            if dom[r, b_i]:
+                expected[r, ak[r, b_i], ai[r, b_i]] = True
+    assert np.array_equal(np.asarray(ex_tbl.dominated_tbl), expected)

@@ -4,6 +4,8 @@ merge lattice laws."""
 
 import numpy as np
 import pytest
+from hypothesis import HealthCheck, given, settings
+from hypothesis import strategies as st
 
 import jax
 import jax.numpy as jnp
@@ -459,10 +461,6 @@ def test_dominated_table_equals_scattered_op_flags(seed):
     assert np.array_equal(np.asarray(ex_tbl.dominated_tbl), expected)
     for la, lb in zip(jax.tree.leaves(st_op), jax.tree.leaves(st_tbl)):
         assert np.array_equal(np.asarray(la), np.asarray(lb))
-
-
-from hypothesis import HealthCheck, given, settings  # noqa: E402
-from hypothesis import strategies as st  # noqa: E402
 
 
 @settings(

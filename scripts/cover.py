@@ -26,11 +26,13 @@ threshold (the committed gate for `make cover` / `make all`). Sharding
 exists because one full-suite run is ~8-10 min and some CI wrappers cap
 per-command wall time; union of line sets is exact, not approximate.
 
-Known blind spot: code that only runs in SUBPROCESSES spawned by tests
-(parallel/multihost.py's real multi-process jax.distributed drills) shows
-0% — the monitor is per-interpreter. The committed threshold accounts for
-it; if more subprocess-only modules appear, teach the children to write
-shard files too.
+Subprocess coverage: the monitor is per-interpreter, so code that only
+runs in test-spawned children (parallel/multihost.py's real multi-process
+jax.distributed drills, the elastic crash/scale-up demos) would be a
+blind spot. The cover run exports CCRDT_COVER_DIR; child entry points
+(scripts/multihost_demo.py, scripts/elastic_demo.py) call
+`install_child_cover()` — a no-op outside cover runs — and dump their own
+executed-line shards there, merged into the parent's data.
 """
 
 import argparse
@@ -63,7 +65,7 @@ def executable_lines(path: str) -> set:
     return lines
 
 
-def run_instrumented(pytest_args):
+def _start_monitor():
     executed: dict = {}
     mon = sys.monitoring
     TOOL = mon.COVERAGE_ID
@@ -79,11 +81,68 @@ def run_instrumented(pytest_args):
     mon.register_callback(TOOL, mon.events.LINE, on_line)
     mon.set_events(TOOL, mon.events.LINE)
 
+    def stop():
+        mon.set_events(TOOL, 0)
+        mon.free_tool_id(TOOL)
+
+    return executed, stop
+
+
+def install_child_cover():
+    """Opt-in coverage for SUBPROCESSES tests spawn (multihost / elastic
+    real-process drills — otherwise a blind spot, see module docstring).
+    No-op unless the parent cover run exported CCRDT_COVER_DIR; dumps a
+    uniquely-named shard file there at interpreter exit."""
+    out_dir = os.environ.get("CCRDT_COVER_DIR")
+    if not out_dir:
+        return
+    if sys.monitoring.get_tool(sys.monitoring.COVERAGE_ID) is not None:
+        # Already inside a monitored interpreter: the parent cover run
+        # imported this entry point in-process (tests do that too) — its
+        # monitor sees these lines directly.
+        return
+    executed, stop = _start_monitor()
+
+    def dump():
+        stop()
+        _dump_shard(executed, os.path.join(out_dir, f"child-{os.getpid()}.json"))
+
+    import atexit
+
+    atexit.register(dump)
+
+
+def _dump_shard(executed, path):
+    with open(path, "w") as f:
+        json.dump({fn: sorted(ls) for fn, ls in executed.items()}, f)
+
+
+def _merge_shard(executed, path):
+    with open(path) as f:
+        for fn, lines in json.load(f).items():
+            executed.setdefault(fn, set()).update(lines)
+
+
+def run_instrumented(pytest_args):
+    import glob
+    import shutil
+    import tempfile
+
+    executed, stop = _start_monitor()
+    child_dir = tempfile.mkdtemp(prefix="ccrdt-cover-children-")
+    os.environ["CCRDT_COVER_DIR"] = child_dir
+
     import pytest  # noqa: E402 — imported under monitoring on purpose
 
     rc = pytest.main(pytest_args)
-    mon.set_events(TOOL, 0)
-    mon.free_tool_id(TOOL)
+    stop()
+    os.environ.pop("CCRDT_COVER_DIR", None)
+    for path in glob.glob(os.path.join(child_dir, "child-*.json")):
+        try:
+            _merge_shard(executed, path)
+        except (OSError, ValueError):
+            pass  # a torn child dump must not fail the gate
+    shutil.rmtree(child_dir, ignore_errors=True)
     return int(rc), executed
 
 
@@ -127,9 +186,7 @@ def main() -> int:
     if args.report:
         executed: dict = {}
         for path in args.report:
-            with open(path) as f:
-                for fn, lines in json.load(f).items():
-                    executed.setdefault(fn, set()).update(lines)
+            _merge_shard(executed, path)
         return report(executed, args.threshold)
 
     rc, executed = run_instrumented(rest or ["tests/", "-q"])
@@ -137,8 +194,7 @@ def main() -> int:
         print(f"cover: pytest failed (rc={rc}); coverage not evaluated")
         return rc
     if args.data_out:
-        with open(args.data_out, "w") as f:
-            json.dump({fn: sorted(ls) for fn, ls in executed.items()}, f)
+        _dump_shard(executed, args.data_out)
         print(f"cover: shard data -> {args.data_out}")
         return 0
     return report(executed, args.threshold)

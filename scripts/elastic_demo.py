@@ -27,6 +27,10 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from scripts.cover import install_child_cover  # noqa: E402
+
+install_child_cover()  # no-op outside `make cover` runs
+
 # Demo geometry (shared with the test's reference computation).
 R, NK, I, DCS, K, M, B, Br = 4, 1, 64, 4, 8, 2, 32, 8
 STEPS = 10

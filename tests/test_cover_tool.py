@@ -1,0 +1,72 @@
+"""The in-repo coverage/type gates (scripts/cover.py): executable-line
+ground truth, shard merge, and subprocess (child) coverage — the gate
+itself must be trustworthy since `make all` enforces its number."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from scripts.cover import executable_lines  # noqa: E402
+
+
+def test_executable_lines_ground_truth(tmp_path):
+    p = tmp_path / "m.py"
+    p.write_text(
+        "x = 1\n"
+        "\n"
+        "def f(a):\n"
+        "    if a:\n"
+        "        return 2\n"
+        "    return 3\n"
+    )
+    lines = executable_lines(str(p))
+    assert {1, 3, 4, 5, 6} <= lines
+    assert 2 not in lines  # blank line is not executable
+
+
+def test_executable_lines_syntax_error_is_empty(tmp_path):
+    p = tmp_path / "bad.py"
+    p.write_text("def f(:\n")
+    assert executable_lines(str(p)) == set()
+
+
+def test_child_cover_dumps_shard(tmp_path):
+    code = (
+        f"import sys; sys.path.insert(0, {REPO!r})\n"
+        "from scripts.cover import install_child_cover\n"
+        "install_child_cover()\n"
+        "from antidote_ccrdt_tpu.models.wordcount import hash_token\n"
+        "hash_token('abc', 8)\n"
+    )
+    env = dict(os.environ, CCRDT_COVER_DIR=str(tmp_path))
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=env,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    shards = list(tmp_path.glob("child-*.json"))
+    assert len(shards) == 1
+    data = json.load(open(shards[0]))
+    wc = [fn for fn in data if fn.endswith("wordcount.py")]
+    assert wc and len(data[wc[0]]) > 5
+
+
+def test_child_cover_noop_without_env(tmp_path):
+    code = (
+        f"import sys; sys.path.insert(0, {REPO!r})\n"
+        "from scripts.cover import install_child_cover\n"
+        "install_child_cover()\n"
+        "print('ok')\n"
+    )
+    env = {k: v for k, v in os.environ.items() if k != "CCRDT_COVER_DIR"}
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=env,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0 and "ok" in proc.stdout
+    assert not list(tmp_path.glob("child-*.json"))

@@ -147,6 +147,15 @@ class BridgeClient:
     def grid_observe(self, name: str, replica: int = 0, key: int = 0):
         return self.call((Atom("grid_observe"), name.encode(), replica, key))
 
+    def grid_to_binary(self, name: str) -> bytes:
+        """Self-contained (geometry + state) snapshot of a dense grid."""
+        return self.call((Atom("grid_to_binary"), name.encode()))
+
+    def grid_from_binary(self, name: str, blob: bytes) -> None:
+        """Rebuild a grid (geometry included in the blob) — the worker
+        restart / site-clone path."""
+        self.call((Atom("grid_from_binary"), name.encode(), blob))
+
 
 def add(key: int, id_: Any, score: int, dc: int, ts: int):
     """Grid add op term."""

@@ -32,6 +32,7 @@
          is_type/2, generates_extra_operations/2, is_operation/3,
          require_state_downstream/3, is_replicate_tagged/3,
          grid_new/4, grid_apply/3, grid_merge_all/2, grid_observe/4,
+         grid_to_binary/2, grid_from_binary/3,
          wire_atoms/0, main/1]).
 
 -define(TIMEOUT, 30000).
@@ -141,6 +142,14 @@ grid_merge_all(Sock, Grid) ->
 
 grid_observe(Sock, Grid, Replica, Key) ->
     call(Sock, {grid_observe, Grid, Replica, Key}).
+
+%% Self-contained snapshot (geometry + state); grid_from_binary/3 rebuilds
+%% the grid on a restarted worker or a clone site.
+grid_to_binary(Sock, Grid) ->
+    call(Sock, {grid_to_binary, Grid}).
+
+grid_from_binary(Sock, Grid, Bin) when is_binary(Bin) ->
+    call(Sock, {grid_from_binary, Grid, Bin}).
 
 %% -- escript smoke test ---------------------------------------------------
 

@@ -62,19 +62,69 @@ class _Grid:
         def geti(key, default):
             return int(params.get(Atom(key), default))
 
+        self.type_name = type_name
         self.R = geti("n_replicas", 2)
         self.NK = geti("n_keys", 1)
+        # Resolved geometry (defaults applied) — embedded in snapshots so
+        # grid_from_binary is self-contained.
+        self.geometry = {
+            "n_replicas": self.R,
+            "n_keys": self.NK,
+            "n_ids": geti("n_ids", 1024),
+            "n_dcs": geti("n_dcs", self.R),
+            "size": geti("size", 100),
+            "slots_per_id": geti("slots_per_id", 4),
+        }
         # Constructed through the registry's dense-factory surface — the
         # same path any embedder uses; only the op packing below is
         # topk_rmv-specific.
         self.dense = registry.make_dense(
             type_name,
-            n_ids=geti("n_ids", 1024),
-            n_dcs=geti("n_dcs", self.R),
-            size=geti("size", 100),
-            slots_per_id=geti("slots_per_id", 4),
+            n_ids=self.geometry["n_ids"],
+            n_dcs=self.geometry["n_dcs"],
+            size=self.geometry["size"],
+            slots_per_id=self.geometry["slots_per_id"],
         )
         self.state = self.dense.init(n_replicas=self.R, n_keys=self.NK)
+
+    def to_binary(self) -> bytes:
+        """Self-contained snapshot: (geometry map, dense-state blob) as an
+        ETF term — a restarted worker (or another site) rebuilds the grid
+        from the blob alone."""
+        from ..core import etf, serial
+
+        geom = {Atom(k): v for k, v in self.geometry.items()}
+        return etf.encode(
+            (geom, serial.dumps_dense(self.type_name, self.state))
+        )
+
+    @classmethod
+    def from_binary(cls, blob: bytes) -> "_Grid":
+        import jax
+
+        from ..core import etf, serial
+
+        term = etf.decode(blob)
+        if not (isinstance(term, tuple) and len(term) == 2):
+            raise ValueError("grid snapshot must be a (geometry, state) pair")
+        geom, state_blob = term
+        grid = cls("topk_rmv", dict(geom))
+        name, state = serial.loads_dense(state_blob, grid.state)
+        if name != grid.type_name:
+            # A different dense type's blob can be treedef-compatible yet
+            # carry foreign merge semantics — reject, don't misinterpret.
+            raise ValueError(
+                f"snapshot holds dense type {name!r}, not {grid.type_name!r}"
+            )
+        for got, like in zip(
+            jax.tree_util.tree_leaves(state), jax.tree_util.tree_leaves(grid.state)
+        ):
+            if got.shape != like.shape:
+                raise ValueError(
+                    f"snapshot leaf shape {got.shape} != geometry {like.shape}"
+                )
+        grid.state = state
+        return grid
 
     def apply(self, per_replica_ops) -> int:
         import jax.numpy as jnp
@@ -228,7 +278,7 @@ class BridgeServer:
         "downstream": (1,), "update": (1,), "value": (1,), "to_binary": (1,),
         "compact": (1,), "equal": (1, 2),
     }
-    _GRID_TAGS = {"grid_apply", "grid_merge_all", "grid_observe"}
+    _GRID_TAGS = {"grid_apply", "grid_merge_all", "grid_observe", "grid_to_binary"}
 
     def _dispatch(self, term: Any) -> Any:
         if not (isinstance(term, tuple) and len(term) == 3 and term[0] == P.A_CALL):
@@ -424,6 +474,27 @@ class BridgeServer:
         if tag == "grid_observe":
             _, gname, replica, key = op
             return self._grids[gname].observe(int(replica), int(key))
+        if tag == "grid_to_binary":
+            _, gname = op
+            return self._grids[gname].to_binary()
+        if tag == "grid_from_binary":
+            _, gname, blob = op
+            grid = _Grid.from_binary(blob)  # built outside _meta
+            # Replacing a LIVE grid must respect its object lock, or a
+            # concurrent acknowledged grid_apply on the old object would
+            # vanish silently.
+            try:
+                lk = self._grid_lock(gname)
+            except KeyError:
+                lk = None
+            if lk is None:
+                with self._meta:
+                    self._grids[gname] = grid
+            else:
+                with lk:
+                    with self._meta:
+                        self._grids[gname] = grid
+            return True
         raise ValueError(f"unknown op: {tag}")
 
 

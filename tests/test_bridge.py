@@ -312,3 +312,33 @@ def test_free_is_idempotent(client):
     client.free(h)  # second free must reply {ok, true}, not an error
     with pytest.raises(Exception, match="no such handle"):
         client.value(h)
+
+
+def test_grid_snapshot_restore_across_servers(server):
+    """Worker-restart story: a dense grid's self-contained snapshot
+    (geometry + state) rebuilds the grid on a DIFFERENT server process
+    with identical observables."""
+    with BridgeClient(*server.address) as c:
+        c.grid_new("g2", n_replicas=2, n_keys=1, n_ids=64, n_dcs=2, size=4)
+        c.grid_apply(
+            "g2",
+            [[add(0, 1, 50, 0, 1), add(0, 2, 40, 0, 2)],
+             [add(0, 3, 30, 1, 1), rmv(0, 2, {0: 9})]],
+        )
+        c.grid_merge_all("g2")
+        before = dict(c.grid_observe("g2", 0))
+        blob = c.grid_to_binary("g2")
+        assert isinstance(blob, bytes) and len(blob) > 100
+    with BridgeServer() as srv2, BridgeClient(*srv2.address) as c2:
+        c2.grid_from_binary("restored", blob)
+        assert dict(c2.grid_observe("restored", 0)) == before
+        # the restored grid is live, not a read-only copy
+        c2.grid_apply("restored", [[add(0, 9, 99, 0, 5)], []])
+        c2.grid_merge_all("restored")
+        assert dict(c2.grid_observe("restored", 0)).get(9) == 99
+
+
+def test_grid_restore_rejects_malformed_blob(server):
+    with BridgeClient(*server.address) as c:
+        with pytest.raises(Exception, match="ValueError|Error"):
+            c.grid_from_binary("bad", b"\x83h\x01a\x01")  # not a pair

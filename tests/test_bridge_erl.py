@@ -126,6 +126,8 @@ REQUESTS = [
      [[(A("add"), 0, 1, 10, 0, 1)], [(A("rmv"), 0, 1, [(0, 1)])]]),
     (A("grid_merge_all"), A("g")),
     (A("grid_observe"), A("g"), 0, 0),
+    (A("grid_to_binary"), A("g")),
+    (A("grid_from_binary"), A("g"), b"\x83h\x02t\x00\x00\x00\x00m\x00\x00\x00\x00"),
 ]
 
 

@@ -480,20 +480,15 @@ class BridgeServer:
         if tag == "grid_from_binary":
             _, gname, blob = op
             grid = _Grid.from_binary(blob)  # built outside _meta
-            # Replacing a LIVE grid must respect its object lock, or a
-            # concurrent acknowledged grid_apply on the old object would
-            # vanish silently.
-            try:
-                lk = self._grid_lock(gname)
-            except KeyError:
-                lk = None
-            if lk is None:
+            # Replacing a grid must hold its object lock, or a concurrent
+            # acknowledged grid_apply on the old object would vanish
+            # silently. Create the lock entry unconditionally — a
+            # not-yet-existing name can be racing a grid_new + apply.
+            with self._meta:
+                lk = self._glocks.setdefault(gname, threading.Lock())
+            with lk:
                 with self._meta:
                     self._grids[gname] = grid
-            else:
-                with lk:
-                    with self._meta:
-                        self._grids[gname] = grid
             return True
         raise ValueError(f"unknown op: {tag}")
 

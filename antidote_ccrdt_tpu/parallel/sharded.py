@@ -28,6 +28,7 @@ TPU-native capability the rebuild owes in its place.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Callable, Sequence, Tuple
 
 import jax
@@ -65,6 +66,26 @@ def hierarchical_all_reduce(
     group over DCN — total DCN traffic is 1/|ici| of a flat all-reduce."""
     x = lattice_all_reduce(x, ici_axis, merge, mesh.shape[ici_axis])
     return lattice_all_reduce(x, dcn_axis, merge, mesh.shape[dcn_axis])
+
+
+def _join_over_mesh_axes(st: Any, merge, mesh: Mesh, dc_axis: str) -> Any:
+    """Inside shard_map: replica join over 'dc' (and 'dcn' when the mesh
+    has one) — shared by every id-sharded engine's merge_replicas."""
+    out = lattice_all_reduce(st, dc_axis, merge, mesh.shape[dc_axis])
+    if "dcn" in mesh.shape:
+        out = lattice_all_reduce(out, "dcn", merge, mesh.shape["dcn"])
+    return out
+
+
+def _gather_frontier(tree: Any, axis: str) -> Any:
+    """Inside shard_map: all_gather each [R, NK, K] frontier leaf over the
+    id-shard axis and flatten the shard axis into the trailing candidate
+    axis -> [R, NK, n_shards*K]. The collective payload is O(K) per shard
+    — the whole point of the frontier-exchange read path."""
+    g = jax.tree.map(lambda a: lax.all_gather(a, axis), tree)
+    return jax.tree.map(
+        lambda a: jnp.moveaxis(a, 0, -2).reshape(a.shape[1], a.shape[2], -1), g
+    )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -193,11 +214,15 @@ class IdShardedTopkRmv:
             rmv_vc=ops.rmv_vc,
         )
 
-    def apply_ops(self, state: Any, ops: TopkRmvOps) -> Any:
-        """ops leaves are [R, B] with global ids, replicated over 'key' and
-        sharded over 'dc' like the state's replica axis."""
+    # Compiled entry points are built once per instance (cached_property
+    # writes through the instance __dict__, which frozen dataclasses keep)
+    # — rebuilding jax.jit(shard_map(closure)) per call would retrace and
+    # recompile every time (jit caches on function identity).
+
+    @functools.cached_property
+    def _apply_compiled(self):
         spec_state = self._state_spec()
-        spec_ops = jax.tree.map(lambda _: P(self.dc_axis), ops)
+        spec_ops = TopkRmvOps(*([P(self.dc_axis)] * 8))
 
         def local(st, op):
             op = self._mask_to_shard(op)
@@ -214,14 +239,17 @@ class IdShardedTopkRmv:
                 out_specs=spec_state,
                 check_vma=False,
             )
-        )(state, ops)
+        )
+
+    def apply_ops(self, state: Any, ops: TopkRmvOps) -> Any:
+        """ops leaves are [R, B] with global ids, replicated over 'key' and
+        sharded over 'dc' like the state's replica axis."""
+        return self._apply_compiled(state, ops)
 
     # -- reads: frontier exchange ------------------------------------------
 
-    def observe(self, state: Any) -> Observed:
-        """Global observable top-K: local top-K per shard (payload K, not
-        I_local), all_gather over the id shards, re-rank by the reference
-        cmp order (score desc, id desc, ts desc)."""
+    @functools.cached_property
+    def _observe_compiled(self):
         spec_state = self._state_spec()
         K = self.inner.K
         I_loc = self.inner.I
@@ -231,16 +259,7 @@ class IdShardedTopkRmv:
             shard = lax.axis_index(self.key_axis)
             gids = jnp.where(obs.valid, obs.ids + shard * I_loc, -1)
             frontier = Observed(gids, obs.scores, obs.dcs, obs.tss, obs.valid)
-            # [n_shards, R_loc, NK, K] on every shard
-            gathered = jax.tree.map(
-                lambda a: lax.all_gather(a, self.key_axis), frontier
-            )
-            cat = jax.tree.map(
-                lambda a: jnp.moveaxis(a, 0, -2).reshape(
-                    a.shape[1], a.shape[2], -1
-                ),
-                gathered,
-            )  # [R_loc, NK, n_shards*K]
+            cat = _gather_frontier(frontier, self.key_axis)  # [R, NK, S*K]
             ns, ni, nt, dc_f, valid_f = lax.sort(
                 (
                     jnp.where(cat.valid, -cat.scores, -jnp.int32(-(2**31 - 1))),
@@ -268,30 +287,24 @@ class IdShardedTopkRmv:
                 out_specs=P(self.dc_axis, None, None),
                 check_vma=False,
             )
-        )(state)
+        )
+
+    def observe(self, state: Any) -> Observed:
+        """Global observable top-K: local top-K per shard (payload K, not
+        I_local), all_gather over the id shards, re-rank by the reference
+        cmp order (score desc, id desc, ts desc)."""
+        return self._observe_compiled(state)
 
     # -- inter-DC reconciliation -------------------------------------------
 
-    def merge_replicas(self, state: Any) -> Any:
-        """Join all replica rows over the 'dc' axis (and 'dcn' when the
-        mesh has one), shard-local in the id dimension: every replica ends
-        up with the converged state for the shard's id range."""
+    @functools.cached_property
+    def _merge_compiled(self):
         spec_state = self._state_spec()
-        has_dcn = "dcn" in self.mesh.shape
 
         def local(st):
-            st = self._to_local(st)
-
-            def join(a, b):
-                return self.inner.merge(a, b)
-
-            merged = lattice_all_reduce(
-                st, self.dc_axis, join, self.mesh.shape[self.dc_axis]
+            merged = _join_over_mesh_axes(
+                self._to_local(st), self.inner.merge, self.mesh, self.dc_axis
             )
-            if has_dcn:
-                merged = lattice_all_reduce(
-                    merged, "dcn", join, self.mesh.shape["dcn"]
-                )
             return self._from_local(merged)
 
         return jax.jit(
@@ -302,7 +315,13 @@ class IdShardedTopkRmv:
                 out_specs=spec_state,
                 check_vma=False,
             )
-        )(state)
+        )
+
+    def merge_replicas(self, state: Any) -> Any:
+        """Join all replica rows over the 'dc' axis (and 'dcn' when the
+        mesh has one), shard-local in the id dimension: every replica ends
+        up with the converged state for the shard's id range."""
+        return self._merge_compiled(state)
 
 
 def make_id_sharded_topk_rmv(
@@ -326,6 +345,190 @@ def make_id_sharded_topk_rmv(
     if n_replicas is None:
         n_replicas = mesh.shape[dc_axis]
     return IdShardedTopkRmv(
+        inner=inner,
+        mesh=mesh,
+        n_replicas=n_replicas,
+        key_axis=key_axis,
+        dc_axis=dc_axis,
+    )
+
+
+# --- player-space-sharded leaderboard -------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class IdShardedLeaderboard:
+    """One leaderboard whose PLAYER space is sharded over a mesh axis —
+    the second instantiation of the long-context-analog design (cf.
+    `IdShardedTopkRmv`): state stays put, ops broadcast + shard-masked,
+    reads exchange only the K-frontier per shard. The leaderboard lattice
+    (per-player max, ban-or — models/leaderboard.py) has no vc/lossy side
+    planes, so the sharded layout is purely the player axis and the
+    replica join (`merge_replicas`) is shard-local elementwise max/or.
+    """
+
+    inner: Any  # LeaderboardDense
+    mesh: Mesh
+    n_replicas: int
+    key_axis: str = "key"
+    dc_axis: str = "dc"
+
+    @property
+    def n_shards(self) -> int:
+        return self.mesh.shape[self.key_axis]
+
+    @property
+    def p_global(self) -> int:
+        return self.inner.P * self.n_shards
+
+    def _state_spec(self):
+        from ..models.leaderboard import LeaderboardDenseState
+
+        table = P(self.dc_axis, None, self.key_axis)
+        return LeaderboardDenseState(best_score=table, banned=table)
+
+    def init(self) -> Any:
+        from ..models.leaderboard import LeaderboardDenseState
+        from ..ops.dense_table import NEG_INF
+
+        R, NK, Pg = self.n_replicas, 1, self.p_global
+        state = LeaderboardDenseState(
+            best_score=jnp.full((R, NK, Pg), NEG_INF, jnp.int32),
+            banned=jnp.zeros((R, NK, Pg), bool),
+        )
+        specs = self._state_spec()
+        return jax.tree.map(
+            lambda a, s: jax.device_put(a, NamedSharding(self.mesh, s)),
+            state,
+            specs,
+        )
+
+    def _mask_to_shard(self, ops: Any) -> Any:
+        from ..models.leaderboard import LeaderboardOps
+
+        P_loc = self.inner.P
+        shard = lax.axis_index(self.key_axis)
+        lo = shard * P_loc
+        a_mine = ops.add_valid & (ops.add_id >= lo) & (ops.add_id < lo + P_loc)
+        b_mine = ops.ban_valid & (ops.ban_id >= lo) & (ops.ban_id < lo + P_loc)
+        return LeaderboardOps(
+            add_key=ops.add_key,
+            add_id=jnp.where(a_mine, ops.add_id - lo, 0),
+            add_score=ops.add_score,
+            add_valid=a_mine,
+            ban_key=ops.ban_key,
+            ban_id=jnp.where(b_mine, ops.ban_id - lo, 0),
+            ban_valid=b_mine,
+        )
+
+    @functools.cached_property
+    def _apply_compiled(self):
+        from ..models.leaderboard import LeaderboardOps
+
+        spec_state = self._state_spec()
+        spec_ops = LeaderboardOps(*([P(self.dc_axis)] * 7))
+
+        def local(st, op):
+            st2, _ = self.inner.apply_ops(st, self._mask_to_shard(op))
+            return st2
+
+        return jax.jit(
+            shard_map(
+                local,
+                mesh=self.mesh,
+                in_specs=(spec_state, spec_ops),
+                out_specs=spec_state,
+                check_vma=False,
+            )
+        )
+
+    def apply_ops(self, state: Any, ops: Any) -> Any:
+        return self._apply_compiled(state, ops)
+
+    @functools.cached_property
+    def _observe_compiled(self):
+        spec_state = self._state_spec()
+        K = self.inner.K
+        P_loc = self.inner.P
+
+        def local(st):
+            ids, scores, valid = self.inner.observe(st)
+            shard = lax.axis_index(self.key_axis)
+            gids = jnp.where(valid, ids + shard * P_loc, -1)
+            cat_i, cat_s, cat_v = _gather_frontier(
+                (gids, scores, valid), self.key_axis
+            )
+            ns, ni, v_f = lax.sort(
+                (
+                    jnp.where(cat_v, -cat_s, jnp.int32(2**31 - 1)),
+                    -cat_i,
+                    cat_v,
+                ),
+                num_keys=2,
+                dimension=-1,
+            )
+            return -ni[..., :K], -ns[..., :K], v_f[..., :K]
+
+        return jax.jit(
+            shard_map(
+                local,
+                mesh=self.mesh,
+                in_specs=(spec_state,),
+                out_specs=(
+                    P(self.dc_axis, None, None),
+                    P(self.dc_axis, None, None),
+                    P(self.dc_axis, None, None),
+                ),
+                check_vma=False,
+            )
+        )
+
+    def observe(self, state: Any):
+        """Global top-K of non-banned players: per-shard masked top-K
+        (payload K, not P_local), frontier all_gather over the player
+        shards, global re-rank by the leaderboard cmp order (score desc,
+        id desc — leaderboard.erl:289-294)."""
+        return self._observe_compiled(state)
+
+    @functools.cached_property
+    def _merge_compiled(self):
+        spec_state = self._state_spec()
+
+        def local(st):
+            return _join_over_mesh_axes(
+                st, self.inner.merge, self.mesh, self.dc_axis
+            )
+
+        return jax.jit(
+            shard_map(
+                local,
+                mesh=self.mesh,
+                in_specs=(spec_state,),
+                out_specs=spec_state,
+                check_vma=False,
+            )
+        )
+
+    def merge_replicas(self, state: Any) -> Any:
+        return self._merge_compiled(state)
+
+
+def make_id_sharded_leaderboard(
+    mesh: Mesh,
+    n_players_global: int,
+    size: int = 100,
+    n_replicas: int = None,
+    key_axis: str = "key",
+    dc_axis: str = "dc",
+) -> IdShardedLeaderboard:
+    from ..models.leaderboard import make_dense as mk_lb
+
+    n_shards = mesh.shape[key_axis]
+    assert n_players_global % n_shards == 0, (n_players_global, n_shards)
+    inner = mk_lb(n_players=n_players_global // n_shards, size=size)
+    if n_replicas is None:
+        n_replicas = mesh.shape[dc_axis]
+    return IdShardedLeaderboard(
         inner=inner,
         mesh=mesh,
         n_replicas=n_replicas,

@@ -165,3 +165,90 @@ def test_hierarchical_all_reduce_matches_flat():
     # every (dcn, dc) member holds the max over both axes for its key shard
     expect = np.asarray(x).max(axis=(0, 1), keepdims=True)
     assert np.array_equal(np.asarray(out), np.broadcast_to(expect, (2, 2, 2)))
+
+
+# --- player-space-sharded leaderboard -------------------------------------
+
+
+def _lb_ops(rng, R=4, B=32, Bb=6, P_GLOBAL=64):
+    from antidote_ccrdt_tpu.models.leaderboard import LeaderboardOps
+
+    return LeaderboardOps(
+        add_key=jnp.zeros((R, B), jnp.int32),
+        add_id=jnp.asarray(rng.integers(0, P_GLOBAL, (R, B)).astype(np.int32)),
+        add_score=jnp.asarray(rng.integers(1, 500, (R, B)).astype(np.int32)),
+        add_valid=jnp.ones((R, B), bool),
+        ban_key=jnp.zeros((R, Bb), jnp.int32),
+        ban_id=jnp.asarray(rng.integers(0, P_GLOBAL, (R, Bb)).astype(np.int32)),
+        ban_valid=jnp.ones((R, Bb), bool),
+    )
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_id_sharded_leaderboard_matches_unsharded(seed):
+    from antidote_ccrdt_tpu.models.leaderboard import make_dense as mk_lb
+    from antidote_ccrdt_tpu.parallel.sharded import make_id_sharded_leaderboard
+
+    rng = np.random.default_rng(seed)
+    mesh = make_mesh2(1, 4, 2)
+    S = make_id_sharded_leaderboard(mesh, n_players_global=64, size=4)
+    st = S.init()
+    Dref = mk_lb(n_players=64, size=4)
+    ref = Dref.init(4, 1)
+    for _ in range(3):
+        ops = _lb_ops(rng)
+        st = S.apply_ops(st, ops)
+        ref, _ = Dref.apply_ops(ref, ops)
+    st = S.merge_replicas(st)
+    folded = jax.tree.map(lambda x: x[:1], ref)
+    for r in range(1, 4):
+        folded = Dref.merge(folded, jax.tree.map(lambda x: x[r:r + 1], ref))
+    ids, scores, valid = S.observe(st)
+    rid, rsc, rva = Dref.observe(folded)
+    for r in range(4):  # every replica converged to the reference
+        assert np.array_equal(
+            np.asarray(jnp.where(valid[r], ids[r], -1)),
+            np.asarray(jnp.where(rva[0], rid[0], -1)),
+        )
+        assert np.array_equal(
+            np.asarray(jnp.where(valid[r], scores[r], 0)),
+            np.asarray(jnp.where(rva[0], rsc[0], 0)),
+        )
+
+
+def test_id_sharded_leaderboard_ban_crosses_shards():
+    """A ban originating at one replica kills the player on every shard's
+    view after merge: ban-wins (leaderboard.erl:21-27) survives sharding."""
+    from antidote_ccrdt_tpu.models.leaderboard import LeaderboardOps
+    from antidote_ccrdt_tpu.parallel.sharded import make_id_sharded_leaderboard
+
+    mesh = make_mesh2(1, 4, 2)
+    S = make_id_sharded_leaderboard(mesh, n_players_global=64, size=4)
+    st = S.init()
+    R = 4
+    # player 40 (second shard's range) gets the best score from replica 0
+    ops = LeaderboardOps(
+        add_key=jnp.zeros((R, 1), jnp.int32),
+        add_id=jnp.full((R, 1), 40, jnp.int32),
+        add_score=jnp.asarray([[500], [400], [300], [200]], jnp.int32),
+        add_valid=jnp.ones((R, 1), bool),
+        ban_key=jnp.zeros((R, 1), jnp.int32),
+        ban_id=jnp.full((R, 1), -1, jnp.int32),
+        ban_valid=jnp.zeros((R, 1), bool),
+    )
+    st = S.apply_ops(st, ops)
+    # replica 3 bans player 40
+    ban = LeaderboardOps(
+        add_key=jnp.zeros((R, 1), jnp.int32),
+        add_id=jnp.zeros((R, 1), jnp.int32),
+        add_score=jnp.zeros((R, 1), jnp.int32),
+        add_valid=jnp.zeros((R, 1), bool),
+        ban_key=jnp.zeros((R, 1), jnp.int32),
+        ban_id=jnp.full((R, 1), 40, jnp.int32),
+        ban_valid=jnp.asarray([[False], [False], [False], [True]]),
+    )
+    st = S.apply_ops(st, ban)
+    st = S.merge_replicas(st)
+    ids, scores, valid = S.observe(st)
+    flat = np.asarray(jnp.where(valid, ids, -1))
+    assert not (flat == 40).any(), "banned player visible after merge"

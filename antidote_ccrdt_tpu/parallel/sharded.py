@@ -353,21 +353,22 @@ def make_id_sharded_topk_rmv(
     )
 
 
-# --- player-space-sharded leaderboard -------------------------------------
+# --- shared skeleton: score-table engines (leaderboard, topk) -------------
 
 
 @dataclasses.dataclass(frozen=True)
-class IdShardedLeaderboard:
-    """One leaderboard whose PLAYER space is sharded over a mesh axis —
-    the second instantiation of the long-context-analog design (cf.
-    `IdShardedTopkRmv`): state stays put, ops broadcast + shard-masked,
-    reads exchange only the K-frontier per shard. The leaderboard lattice
-    (per-player max, ban-or — models/leaderboard.py) has no vc/lossy side
-    planes, so the sharded layout is purely the player axis and the
-    replica join (`merge_replicas`) is shard-local elementwise max/or.
-    """
+class _ShardedScoreTable:
+    """Shared id-space-sharded skeleton for the flat score-table engines
+    (whose dense state is [R, NK, P]-shaped planes and whose observe
+    returns (ids, scores, valid)): per-shard masked application, frontier
+    exchange + (score desc, id desc) re-rank, shard-local replica join.
+    Subclasses supply the state spec/init, the op masker, and the local
+    id-range size. Compiled entry points are built once per instance
+    (cached_property writes through the instance __dict__, which frozen
+    dataclasses keep) — rebuilding jit(shard_map(closure)) per call would
+    retrace and recompile every time."""
 
-    inner: Any  # LeaderboardDense
+    inner: Any
     mesh: Mesh
     n_replicas: int
     key_axis: str = "key"
@@ -377,56 +378,29 @@ class IdShardedLeaderboard:
     def n_shards(self) -> int:
         return self.mesh.shape[self.key_axis]
 
-    @property
-    def p_global(self) -> int:
-        return self.inner.P * self.n_shards
+    def _local_size(self) -> int:
+        raise NotImplementedError
 
     def _state_spec(self):
-        from ..models.leaderboard import LeaderboardDenseState
+        raise NotImplementedError
 
-        table = P(self.dc_axis, None, self.key_axis)
-        return LeaderboardDenseState(best_score=table, banned=table)
+    def _ops_spec(self):
+        raise NotImplementedError
 
-    def init(self) -> Any:
-        from ..models.leaderboard import LeaderboardDenseState
-        from ..ops.dense_table import NEG_INF
+    def _mask_to_shard(self, ops: Any) -> Any:
+        raise NotImplementedError
 
-        R, NK, Pg = self.n_replicas, 1, self.p_global
-        state = LeaderboardDenseState(
-            best_score=jnp.full((R, NK, Pg), NEG_INF, jnp.int32),
-            banned=jnp.zeros((R, NK, Pg), bool),
-        )
-        specs = self._state_spec()
+    def _place(self, state: Any) -> Any:
         return jax.tree.map(
             lambda a, s: jax.device_put(a, NamedSharding(self.mesh, s)),
             state,
-            specs,
-        )
-
-    def _mask_to_shard(self, ops: Any) -> Any:
-        from ..models.leaderboard import LeaderboardOps
-
-        P_loc = self.inner.P
-        shard = lax.axis_index(self.key_axis)
-        lo = shard * P_loc
-        a_mine = ops.add_valid & (ops.add_id >= lo) & (ops.add_id < lo + P_loc)
-        b_mine = ops.ban_valid & (ops.ban_id >= lo) & (ops.ban_id < lo + P_loc)
-        return LeaderboardOps(
-            add_key=ops.add_key,
-            add_id=jnp.where(a_mine, ops.add_id - lo, 0),
-            add_score=ops.add_score,
-            add_valid=a_mine,
-            ban_key=ops.ban_key,
-            ban_id=jnp.where(b_mine, ops.ban_id - lo, 0),
-            ban_valid=b_mine,
+            self._state_spec(),
         )
 
     @functools.cached_property
     def _apply_compiled(self):
-        from ..models.leaderboard import LeaderboardOps
-
         spec_state = self._state_spec()
-        spec_ops = LeaderboardOps(*([P(self.dc_axis)] * 7))
+        spec_ops = self._ops_spec()
 
         def local(st, op):
             st2, _ = self.inner.apply_ops(st, self._mask_to_shard(op))
@@ -449,12 +423,11 @@ class IdShardedLeaderboard:
     def _observe_compiled(self):
         spec_state = self._state_spec()
         K = self.inner.K
-        P_loc = self.inner.P
+        loc = self._local_size()
 
         def local(st):
             ids, scores, valid = self.inner.observe(st)
-            shard = lax.axis_index(self.key_axis)
-            gids = jnp.where(valid, ids + shard * P_loc, -1)
+            gids = jnp.where(valid, ids + lax.axis_index(self.key_axis) * loc, -1)
             cat_i, cat_s, cat_v = _gather_frontier(
                 (gids, scores, valid), self.key_axis
             )
@@ -484,10 +457,10 @@ class IdShardedLeaderboard:
         )
 
     def observe(self, state: Any):
-        """Global top-K of non-banned players: per-shard masked top-K
-        (payload K, not P_local), frontier all_gather over the player
-        shards, global re-rank by the leaderboard cmp order (score desc,
-        id desc — leaderboard.erl:289-294)."""
+        """Global top-K: per-shard masked top-K (payload K, not the local
+        table width), frontier all_gather over the id shards, global
+        re-rank by (score desc, id desc) — the shared cmp order of
+        topk.erl:83 / leaderboard.erl:289-294."""
         return self._observe_compiled(state)
 
     @functools.cached_property
@@ -513,6 +486,67 @@ class IdShardedLeaderboard:
         return self._merge_compiled(state)
 
 
+# --- player-space-sharded leaderboard -------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class IdShardedLeaderboard(_ShardedScoreTable):
+    """One leaderboard whose PLAYER space is sharded over a mesh axis —
+    the second instantiation of the long-context-analog design (cf.
+    `IdShardedTopkRmv`): the lattice (per-player max, ban-or) has no
+    vc/lossy side planes, so the sharded layout is purely the player axis
+    and the replica join is shard-local elementwise max/or. Ban-wins
+    (leaderboard.erl:21-27) survives sharding: bans live on the banned
+    player's shard and mask its frontier contribution."""
+
+    @property
+    def p_global(self) -> int:
+        return self.inner.P * self.n_shards
+
+    def _local_size(self) -> int:
+        return self.inner.P
+
+    def _state_spec(self):
+        from ..models.leaderboard import LeaderboardDenseState
+
+        table = P(self.dc_axis, None, self.key_axis)
+        return LeaderboardDenseState(best_score=table, banned=table)
+
+    def _ops_spec(self):
+        from ..models.leaderboard import LeaderboardOps
+
+        return LeaderboardOps(*([P(self.dc_axis)] * 7))
+
+    def init(self) -> Any:
+        from ..models.leaderboard import LeaderboardDenseState
+        from ..ops.dense_table import NEG_INF
+
+        R, NK, Pg = self.n_replicas, 1, self.p_global
+        return self._place(
+            LeaderboardDenseState(
+                best_score=jnp.full((R, NK, Pg), NEG_INF, jnp.int32),
+                banned=jnp.zeros((R, NK, Pg), bool),
+            )
+        )
+
+    def _mask_to_shard(self, ops: Any) -> Any:
+        from ..models.leaderboard import LeaderboardOps
+
+        P_loc = self.inner.P
+        lo = lax.axis_index(self.key_axis) * P_loc
+        a_mine = ops.add_valid & (ops.add_id >= lo) & (ops.add_id < lo + P_loc)
+        b_mine = ops.ban_valid & (ops.ban_id >= lo) & (ops.ban_id < lo + P_loc)
+        return LeaderboardOps(
+            add_key=ops.add_key,
+            add_id=jnp.where(a_mine, ops.add_id - lo, 0),
+            add_score=ops.add_score,
+            add_valid=a_mine,
+            ban_key=ops.ban_key,
+            ban_id=jnp.where(b_mine, ops.ban_id - lo, 0),
+            ban_valid=b_mine,
+        )
+
+
 def make_id_sharded_leaderboard(
     mesh: Mesh,
     n_players_global: int,
@@ -529,6 +563,82 @@ def make_id_sharded_leaderboard(
     if n_replicas is None:
         n_replicas = mesh.shape[dc_axis]
     return IdShardedLeaderboard(
+        inner=inner,
+        mesh=mesh,
+        n_replicas=n_replicas,
+        key_axis=key_axis,
+        dc_axis=dc_axis,
+    )
+
+
+# --- id-space-sharded topk (bounded score table, no bans) -----------------
+
+
+@dataclasses.dataclass(frozen=True)
+class IdShardedTopk(_ShardedScoreTable):
+    """`topk`'s turn on the shared skeleton: the dense engine is a single
+    best-score table (models/topk.py), i.e. the leaderboard pattern minus
+    the ban plane."""
+
+    @property
+    def i_global(self) -> int:
+        return self.inner.I * self.n_shards
+
+    def _local_size(self) -> int:
+        return self.inner.I
+
+    def _state_spec(self):
+        from ..models.topk import TopkDenseState
+
+        return TopkDenseState(best_score=P(self.dc_axis, None, self.key_axis))
+
+    def _ops_spec(self):
+        from ..models.topk import TopkOps
+
+        return TopkOps(*([P(self.dc_axis)] * 4))
+
+    def init(self) -> Any:
+        from ..models.topk import TopkDenseState
+        from ..ops.dense_table import NEG_INF
+
+        return self._place(
+            TopkDenseState(
+                best_score=jnp.full(
+                    (self.n_replicas, 1, self.i_global), NEG_INF, jnp.int32
+                )
+            )
+        )
+
+    def _mask_to_shard(self, ops: Any) -> Any:
+        from ..models.topk import TopkOps
+
+        I_loc = self.inner.I
+        lo = lax.axis_index(self.key_axis) * I_loc
+        mine = ops.valid & (ops.id >= lo) & (ops.id < lo + I_loc)
+        return TopkOps(
+            key=ops.key,
+            id=jnp.where(mine, ops.id - lo, 0),
+            score=ops.score,
+            valid=mine,
+        )
+
+
+def make_id_sharded_topk(
+    mesh: Mesh,
+    n_ids_global: int,
+    size: int = 100,
+    n_replicas: int = None,
+    key_axis: str = "key",
+    dc_axis: str = "dc",
+) -> IdShardedTopk:
+    from ..models.topk import make_dense as mk_topk
+
+    n_shards = mesh.shape[key_axis]
+    assert n_ids_global % n_shards == 0, (n_ids_global, n_shards)
+    inner = mk_topk(n_ids=n_ids_global // n_shards, size=size)
+    if n_replicas is None:
+        n_replicas = mesh.shape[dc_axis]
+    return IdShardedTopk(
         inner=inner,
         mesh=mesh,
         n_replicas=n_replicas,

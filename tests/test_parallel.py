@@ -252,3 +252,41 @@ def test_id_sharded_leaderboard_ban_crosses_shards():
     ids, scores, valid = S.observe(st)
     flat = np.asarray(jnp.where(valid, ids, -1))
     assert not (flat == 40).any(), "banned player visible after merge"
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_id_sharded_topk_matches_unsharded(seed):
+    from antidote_ccrdt_tpu.models.topk import TopkOps
+    from antidote_ccrdt_tpu.models.topk import make_dense as mk_topk
+    from antidote_ccrdt_tpu.parallel.sharded import make_id_sharded_topk
+
+    rng = np.random.default_rng(seed)
+    mesh = make_mesh2(1, 4, 2)
+    S = make_id_sharded_topk(mesh, n_ids_global=64, size=4)
+    st = S.init()
+    Dref = mk_topk(n_ids=64, size=4)
+    ref = Dref.init(4, 1)
+    for _ in range(3):
+        ops = TopkOps(
+            key=jnp.zeros((4, 24), jnp.int32),
+            id=jnp.asarray(rng.integers(0, 64, (4, 24)).astype(np.int32)),
+            score=jnp.asarray(rng.integers(1, 900, (4, 24)).astype(np.int32)),
+            valid=jnp.ones((4, 24), bool),
+        )
+        st = S.apply_ops(st, ops)
+        ref, _ = Dref.apply_ops(ref, ops)
+    st = S.merge_replicas(st)
+    folded = jax.tree.map(lambda x: x[:1], ref)
+    for r in range(1, 4):
+        folded = Dref.merge(folded, jax.tree.map(lambda x: x[r:r + 1], ref))
+    ids, scores, valid = S.observe(st)
+    rid, rsc, rva = Dref.observe(folded)
+    for r in range(4):
+        assert np.array_equal(
+            np.asarray(jnp.where(valid[r], ids[r], -1)),
+            np.asarray(jnp.where(rva[0], rid[0], -1)),
+        )
+        assert np.array_equal(
+            np.asarray(jnp.where(valid[r], scores[r], 0)),
+            np.asarray(jnp.where(rva[0], rsc[0], 0)),
+        )

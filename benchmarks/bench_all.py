@@ -33,8 +33,14 @@ def on_cpu() -> bool:
 
 
 def sized(tpu, cpu):
-    """Pick config by backend: full sizes on an accelerator, shrunk sizes on
-    CPU so CI / no-accelerator runs still complete (cf. bench.py main)."""
+    """Pick config by backend: full sizes on an accelerator, shrunk sizes
+    on CPU so CI / no-accelerator runs still complete (cf. bench.py main).
+    CCRDT_BENCH_TINY additionally clamps every dimension to <=256 — the
+    smoke-test mode (tests/test_benchall_smoke.py): exercises every
+    config's full path in seconds, numbers meaningless. 256 keeps every
+    table at least as wide as the default board size (100)."""
+    if os.environ.get("CCRDT_BENCH_TINY"):
+        return tuple(min(c, 256) for c in cpu)
     return cpu if on_cpu() else tpu
 
 
@@ -296,11 +302,17 @@ def bench_delta_payload():
 def main():
     import jax
 
+    tiny = bool(os.environ.get("CCRDT_BENCH_TINY"))
     for fn in (bench_average, bench_topk, bench_leaderboard, bench_wordcount,
                bench_delta_payload, bench_worddocumentcount):
         out = fn()
         for rec in out if isinstance(out, list) else [out]:
             rec["backend"] = jax.default_backend()
+            if tiny:
+                # Smoke-mode records must never read as real measurements
+                # (clamped dims also floor the "Nk"-style labels to 0k).
+                rec["tiny"] = True
+                rec["metric"] = "[TINY SMOKE] " + rec["metric"]
             print(json.dumps(rec), flush=True)
 
 

@@ -1,0 +1,39 @@
+"""Smoke the per-type benchmark suite (benchmarks/bench_all.py) end to
+end on the CPU backend: every BASELINE.md per-type config must keep
+producing its JSON record (the driver and BASELINE.md cite these —
+signature rot here corrupts the perf record, not just a test)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_all_emits_every_config():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    # The axon sitecustomize (PYTHONPATH-injected, triggered by
+    # PALLAS_AXON_POOL_IPS) force-registers the TPU platform and ignores
+    # JAX_PLATFORMS — strip it so the subprocess really runs on CPU
+    # (hermetic: no dependency on the tunnel being up).
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = ":".join(
+        p for p in env.get("PYTHONPATH", "").split(":") if "axon" not in p
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    env["CCRDT_BENCH_TINY"] = "1"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "bench_all.py")],
+        capture_output=True, text=True, timeout=560, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    recs = [json.loads(l) for l in out.stdout.splitlines() if l.startswith("{")]
+    metrics = " ".join(r["metric"] for r in recs)
+    for frag in (
+        "average", "topk adds", "leaderboard", "wordcount tokens",
+        "delta-state publish", "worddocumentcount corpus",
+    ):
+        assert frag in metrics, f"missing bench config: {frag}"
+    assert all(r["value"] > 0 for r in recs)

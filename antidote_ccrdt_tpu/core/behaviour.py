@@ -40,7 +40,7 @@ from __future__ import annotations
 import enum
 from typing import Any, Iterable, Optional, Protocol, Sequence, Tuple, runtime_checkable
 
-from .clock import ReplicaContext
+from .clock import ClockContext
 
 # A prepare-side operation submitted by a client, e.g. ("add", (id, score)).
 PrepareOp = Tuple[str, Any]
@@ -88,7 +88,7 @@ class ScalarCCRDT(Protocol):
         ...
 
     def downstream(
-        self, op: PrepareOp, state: Any, ctx: ReplicaContext
+        self, op: PrepareOp, state: Any, ctx: ClockContext
     ) -> Optional[EffectOp]:
         """Turn a prepare op into an effect op at the origin replica.
 

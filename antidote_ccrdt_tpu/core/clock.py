@@ -18,10 +18,21 @@ import dataclasses
 import itertools
 import threading
 import time
-from typing import Callable, Tuple
+from typing import Callable, Protocol, Tuple, runtime_checkable
 
 DcId = int
 Timestamp = int
+
+
+@runtime_checkable
+class ClockContext(Protocol):
+    """What `downstream` actually requires of its context: a fresh
+    (dc, ts) origin stamp. `ReplicaContext` is the standard provider; the
+    bridge supplies `_FixedCtx` (caller-provided dc/ts over the wire —
+    the host owns the clock there, as Antidote does), so the callback
+    annotations use this Protocol, not the concrete class."""
+
+    def stamp(self) -> Tuple[DcId, Timestamp]: ...
 
 
 class LogicalClock:

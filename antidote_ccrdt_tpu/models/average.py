@@ -24,7 +24,7 @@ import numpy as np
 
 from ..core import serial
 from ..core.behaviour import EffectOp, MergeKind, PrepareOp, registry
-from ..core.clock import ReplicaContext
+from ..core.clock import ClockContext
 
 
 class AverageScalar:
@@ -40,7 +40,7 @@ class AverageScalar:
         return s / n
 
     def downstream(
-        self, op: PrepareOp, state: Any, ctx: ReplicaContext
+        self, op: PrepareOp, state: Any, ctx: ClockContext
     ) -> Optional[EffectOp]:
         kind, payload = op
         assert kind == "add"

@@ -23,7 +23,7 @@ from typing import Any, Dict, FrozenSet, NamedTuple, Optional, Tuple
 
 from ..core import serial
 from ..core.behaviour import EffectOp, PrepareOp, registry
-from ..core.clock import ReplicaContext
+from ..core.clock import ClockContext
 
 Pair = Tuple[Any, Any]  # (id, score); (None, None) is the reference's {nil, nil}
 NIL: Pair = (None, None)
@@ -77,7 +77,7 @@ class LeaderboardScalar:
         return sorted(state.observed.items())
 
     def downstream(
-        self, op: PrepareOp, state: LeaderboardState, ctx: ReplicaContext
+        self, op: PrepareOp, state: LeaderboardState, ctx: ClockContext
     ) -> Optional[EffectOp]:
         """leaderboard.erl:94-116 filter cascade."""
         kind, payload = op

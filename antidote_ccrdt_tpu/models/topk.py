@@ -24,7 +24,7 @@ from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 from ..core import serial
 from ..core.behaviour import EffectOp, PrepareOp, registry
-from ..core.clock import ReplicaContext
+from ..core.clock import ClockContext
 
 
 class TopkState(NamedTuple):
@@ -72,7 +72,7 @@ class TopkScalar:
         )
 
     def downstream(
-        self, op: PrepareOp, state: TopkState, ctx: ReplicaContext
+        self, op: PrepareOp, state: TopkState, ctx: ClockContext
     ) -> Optional[EffectOp]:
         kind, payload = op
         assert kind == "add"
@@ -178,7 +178,7 @@ class TopkScalarCompat(TopkScalar):
         return TopkState({}, size)
 
     def downstream(
-        self, op: PrepareOp, state: TopkState, ctx: ReplicaContext
+        self, op: PrepareOp, state: TopkState, ctx: ClockContext
     ) -> Optional[EffectOp]:
         kind, payload = op
         assert kind == "add"

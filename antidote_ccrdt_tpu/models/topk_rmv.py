@@ -28,7 +28,7 @@ from typing import Any, Dict, FrozenSet, NamedTuple, Optional, Tuple
 
 from ..core import serial
 from ..core.behaviour import EffectOp, PrepareOp, registry
-from ..core.clock import ReplicaContext
+from ..core.clock import ClockContext
 
 # (score, id, (dc, ts)) — internal element order, and (None, None, None) nil.
 Elem = Tuple[Any, Any, Any]
@@ -93,7 +93,7 @@ class TopkRmvScalar:
         return [(i, s) for (s, i, _) in state.observed.values()]
 
     def downstream(
-        self, op: PrepareOp, state: TopkRmvState, ctx: ReplicaContext
+        self, op: PrepareOp, state: TopkRmvState, ctx: ClockContext
     ) -> Optional[EffectOp]:
         kind, payload = op
         if kind == "add":

@@ -30,7 +30,7 @@ from typing import Any, Dict, Optional, Tuple
 
 from ..core import serial
 from ..core.behaviour import EffectOp, PrepareOp, registry
-from ..core.clock import ReplicaContext
+from ..core.clock import ClockContext
 
 _SPLIT = re.compile(r"[\n ]")
 
@@ -51,7 +51,7 @@ class _WordcountBase:
         return dict(state)
 
     def downstream(
-        self, op: PrepareOp, state: Any, ctx: ReplicaContext
+        self, op: PrepareOp, state: Any, ctx: ClockContext
     ) -> Optional[EffectOp]:
         kind, payload = op
         assert kind == "add"

@@ -95,6 +95,8 @@ SUBSET = [
     "tests/test_harness.py",
     "tests/test_delta.py",
     "tests/test_batch_merge.py",
+    "tests/test_bridge.py",
+    "tests/test_bridge_erl.py",
 ]
 
 if __name__ == "__main__":

@@ -10,7 +10,28 @@ vars — so the override must go through jax.config, before any backend
 initialization (conftest imports early enough).
 """
 
+import os
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
+
+
+def cpu_subprocess_env(**extra):
+    """Env for a subprocess that must REALLY run on the CPU backend.
+
+    The jax.config workaround above cannot reach a subprocess, and the
+    axon sitecustomize (PYTHONPATH-injected, triggered by
+    PALLAS_AXON_POOL_IPS) force-registers the TPU platform and ignores
+    JAX_PLATFORMS — strip the trigger so the child is hermetic (no
+    dependency on the tunnel being up)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = ":".join(
+        p for p in env.get("PYTHONPATH", "").split(":") if "axon" not in p
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra)
+    return env

@@ -12,18 +12,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_bench_all_emits_every_config():
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
-    # The axon sitecustomize (PYTHONPATH-injected, triggered by
-    # PALLAS_AXON_POOL_IPS) force-registers the TPU platform and ignores
-    # JAX_PLATFORMS — strip it so the subprocess really runs on CPU
-    # (hermetic: no dependency on the tunnel being up).
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["PYTHONPATH"] = ":".join(
-        p for p in env.get("PYTHONPATH", "").split(":") if "axon" not in p
-    )
-    env["JAX_PLATFORMS"] = "cpu"
-    env["CCRDT_BENCH_TINY"] = "1"
+    from conftest import cpu_subprocess_env
+
+    env = cpu_subprocess_env(CCRDT_BENCH_TINY="1")
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "benchmarks", "bench_all.py")],
         capture_output=True, text=True, timeout=560, env=env,

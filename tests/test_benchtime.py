@@ -1,0 +1,59 @@
+"""utils/benchtime.py — the measurement discipline every benchmark leans
+on. If `windowed`/`stack_rounds`/`sync` rot, every recorded perf number
+silently degrades to measuring the wrong thing, so they get their own
+tests (they were previously exercised only by the benchmarks)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from antidote_ccrdt_tpu.utils.benchtime import stack_rounds, sync, windowed
+
+
+def test_stack_rounds_stacks_leading_axis():
+    batches = [
+        {"a": jnp.full((2,), i), "b": jnp.full((3, 4), i)} for i in range(5)
+    ]
+    stacked = stack_rounds(batches)
+    assert stacked["a"].shape == (5, 2)
+    assert stacked["b"].shape == (5, 3, 4)
+    assert np.asarray(stacked["a"])[3, 0] == 3
+
+
+def test_sync_returns_first_leaf_element():
+    tree = {"x": jnp.arange(6).reshape(2, 3) + 10}
+    assert int(sync(tree)) == 10
+
+
+def test_windowed_rate_arithmetic_exact(monkeypatch):
+    """Pin windowed()'s accounting exactly with a deterministic clock:
+    each perf_counter call advances 1s, so every timed window 'takes' 1s.
+    Then rate must be OPS*W per second of window time and p50 must be
+    (1/W) seconds — warmup excluded, per-round division by W correct. A
+    regression that counts the warmup window's ops, mis-divides by W, or
+    drops a timed window changes these exact values."""
+    from antidote_ccrdt_tpu.utils import benchtime
+
+    W, OPS, TIMED = 4, 7, 2
+
+    def apply_fn(st, ops):
+        return st + jnp.sum(ops)
+
+    windows = [
+        stack_rounds([jnp.full((2,), w * 10 + r) for r in range(W)])
+        for w in range(1 + TIMED)
+    ]
+
+    t = {"now": 0.0}
+
+    def fake_clock():
+        t["now"] += 1.0
+        return t["now"]
+
+    monkeypatch.setattr(benchtime.time, "perf_counter", fake_clock)
+    rate, p50_ms = windowed(apply_fn, jnp.zeros(()), windows, ops_per_round=OPS)
+    # each timed window: t0 then t1 -> exactly 1.0s; times = [1/W] * TIMED
+    assert rate == OPS * W * TIMED / (TIMED / W * W)  # = OPS * W
+    assert rate == OPS * W
+    assert p50_ms == 1000.0 / W

@@ -290,3 +290,48 @@ def test_id_sharded_topk_matches_unsharded(seed):
             np.asarray(jnp.where(valid[r], scores[r], 0)),
             np.asarray(jnp.where(rva[0], rsc[0], 0)),
         )
+
+
+# --- dist.py primitives ---------------------------------------------------
+
+
+def test_lattice_all_reduce_non_power_of_two_falls_back():
+    """A 3-wide axis must take the gather-reduce path and still produce
+    the full merge on every shard (with a non-commutative-looking but
+    associative max combiner over pytrees)."""
+    devs = jax.devices()[:6]
+    mesh = jax.sharding.Mesh(np.asarray(devs).reshape(3, 2), ("dc", "key"))
+
+    def local(x):
+        red = lattice_all_reduce(x, "dc", lambda a, b: jax.tree.map(jnp.maximum, a, b), 3)
+        return red
+
+    x = jnp.arange(3 * 2 * 4, dtype=jnp.int32).reshape(3, 2, 4)
+    out = jax.jit(
+        shard_map(
+            local, mesh=mesh, in_specs=P("dc", "key"), out_specs=P("dc", "key"),
+            check_vma=False,
+        )
+    )(x)
+    out = np.asarray(out)
+    expect = np.asarray(jnp.max(x, axis=0))  # every dc row = max over rows
+    for r in range(3):
+        assert np.array_equal(out[r], expect)
+
+
+def test_shard_state_and_ops_placement():
+    from antidote_ccrdt_tpu.parallel.dist import (
+        make_mesh,
+        replica_sharding,
+        shard_ops,
+        shard_state,
+    )
+
+    mesh = make_mesh(n_dc=4, n_key=2)
+    state = {"t": jnp.zeros((4, 2, 8), jnp.int32)}
+    ops = {"a": jnp.zeros((4, 16), jnp.int32)}
+    st = shard_state(state, mesh)
+    op = shard_ops(ops, mesh)
+    assert st["t"].sharding == replica_sharding(mesh)
+    assert st["t"].sharding.spec == P("dc", "key")
+    assert op["a"].sharding.spec == P("dc")

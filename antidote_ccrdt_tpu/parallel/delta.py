@@ -233,17 +233,35 @@ def _is_topk_rmv_state(state: Any) -> bool:
     return isinstance(state, TopkRmvDenseState)
 
 
+def _is_lifted(state: Any) -> bool:
+    from .monoid import LiftedMonoidState
+
+    return isinstance(state, LiftedMonoidState)
+
+
+def _is_monoid_row_delta(delta: Any) -> bool:
+    return isinstance(delta, dict) and "ver" in delta and "leaves" in delta
+
+
 def make_delta(dense: Any, prev: Any, cur: Any) -> Any:
-    """Engine-generic delta: slot-level for topk_rmv states, entrywise for
-    the flat table engines."""
+    """Engine-generic delta: slot-level for topk_rmv states, row-replace
+    for lifted monoid states, entrywise for the flat table engines."""
     if _is_topk_rmv_state(cur):
         return state_delta(dense, prev, cur)
+    if _is_lifted(cur):
+        from .monoid import monoid_row_delta
+
+        return monoid_row_delta(dense, prev, cur)
     return table_delta(dense, prev, cur)
 
 
 def apply_any_delta(dense: Any, state: Any, delta: Any) -> Any:
     if isinstance(delta, TopkRmvDelta):
         return apply_delta(dense, state, delta)
+    if _is_monoid_row_delta(delta):
+        from .monoid import apply_monoid_row_delta
+
+        return apply_monoid_row_delta(dense, state, delta)
     return apply_table_delta(dense, state, delta)
 
 
@@ -252,6 +270,10 @@ def like_delta_for(dense: Any, like_state: Any) -> Any:
     free; loads_dense checks treedef only)."""
     if _is_topk_rmv_state(like_state):
         return empty_delta(dense)
+    if _is_lifted(like_state):
+        from .monoid import like_monoid_delta
+
+        return like_monoid_delta(dense, like_state)
     paths, leaves, table_paths, _ = _split_leaves(like_state)
     z = jnp.zeros((0,), jnp.int32)
     return {
@@ -267,6 +289,12 @@ def delta_in_bounds(dense: Any, like_state: Any, delta: Any) -> bool:
     """Config/bounds validation of a decoded peer delta (the gossip fetch
     guard: a treedef-compatible delta from a differently-configured peer
     must be rejected before expansion indexes out of range)."""
+    if _is_lifted(like_state):
+        from .monoid import monoid_delta_in_bounds
+
+        return _is_monoid_row_delta(delta) and monoid_delta_in_bounds(
+            dense, like_state, delta
+        )
     R, NK = jax.tree_util.tree_leaves(like_state)[0].shape[:2]
     if isinstance(delta, TopkRmvDelta):
         n_rows = R * NK * dense.I

@@ -193,28 +193,30 @@ class DeltaPublisher:
     """Publish a member's state as chained deltas with periodic full
     snapshots (the classic delta-CRDT shipping discipline: deltas for
     bandwidth, full states as the resync anchor). Engine-generic via
-    `parallel.delta.make_delta` (slot deltas for topk_rmv, entrywise for
-    the table engines) — but JOIN engines only: gossip resync re-merges
-    full snapshots over already-applied deltas, which is harmless under an
-    idempotent join and double-counts under a monoid `+` (MONOID types
-    ship deltas through their own exactly-once pipeline, DenseReplay)."""
+    `parallel.delta.make_delta`: slot deltas for topk_rmv, entrywise for
+    the table engines, self-contained row-replace deltas for MONOID
+    engines through the versioned-row lift (`parallel.monoid` — a raw
+    monoid engine is auto-wrapped; states must be `LiftedMonoidState`,
+    enforced at the first publish)."""
 
     def __init__(
-        self, store: GossipStore, dense: Any, name: str = "topk_rmv",
+        self, store: GossipStore, dense: Any, name: Optional[str] = None,
         full_every: int = 8, keep: int = 16,
     ):
         from ..core import serial
         from ..core.behaviour import MergeKind
+        from .monoid import MonoidLift
 
         if getattr(dense, "merge_kind", None) == MergeKind.MONOID:
-            raise ValueError(
-                "delta gossip requires an idempotent join; MONOID engines "
-                "would double-count on snapshot resync (use DenseReplay's "
-                "exactly-once delta sync instead)"
-            )
+            dense = MonoidLift(dense)
         self.store = store
         self.dense = dense
-        self.name = name
+        # The header name is the blob's only persisted type record —
+        # default to the (possibly lifted) engine's own name so on-disk
+        # gossip artifacts identify their engine truthfully.
+        self.name = name if name is not None else getattr(
+            dense, "type_name", "topk_rmv"
+        )
         self.full_every = full_every
         self.keep = keep
         self.seq = -1
@@ -224,6 +226,16 @@ class DeltaPublisher:
     def publish(self, state: Any) -> Dict[str, Any]:
         from .delta import make_delta
 
+        from .monoid import LiftedMonoidState, MonoidLift
+
+        if isinstance(self.dense, MonoidLift) and not isinstance(
+            state, LiftedMonoidState
+        ):
+            raise TypeError(
+                "DeltaPublisher.publish: monoid gossip needs versioned "
+                "rows — build the state with MonoidLift(engine).init(...) "
+                "(parallel/monoid.py)"
+            )
         self.seq += 1
         if self._prev is None or self.seq % self.full_every == 0:
             self.store.publish(self.name, state, self.seq)
@@ -247,7 +259,7 @@ def sweep_deltas(
     after deltas (or twice) is harmless — everything is a join."""
     from .delta import apply_any_delta, delta_in_bounds, like_delta_for
 
-    _reject_monoid(dense, "sweep_deltas")
+    dense, state = _resolve_monoid(dense, state, "sweep_deltas")
     like_delta = like_delta_for(dense, state)
     stats = {"deltas": 0, "fulls": 0, "skipped": 0}
 
@@ -321,26 +333,34 @@ def my_replicas(store: GossipStore, n_replicas: int, timeout_s: float) -> List[i
     return [r for r, m in own.items() if m == store.member]
 
 
-def _reject_monoid(dense: Any, where: str) -> None:
-    """Snapshot gossip re-merges peers' latest snapshots on every sweep —
-    only safe for idempotent joins. MONOID engines (average, wordcount)
-    would silently double-count; mirror DeltaPublisher's constructor
-    guard at every sweep entry point."""
+def _resolve_monoid(dense: Any, state: Any, where: str) -> Tuple[Any, Any]:
+    """Gossip entry points speak the JOIN algebra. MONOID engines enter
+    through the versioned-row lift (`parallel.monoid.MonoidLift`): handed
+    a raw monoid engine, auto-wrap it — but the STATE must already carry
+    row versions (they are real protocol information only the writer can
+    produce), so a raw monoid state is a usage error, not something to
+    paper over."""
     from ..core.behaviour import MergeKind
+    from .monoid import LiftedMonoidState, MonoidLift
 
     if getattr(dense, "merge_kind", None) == MergeKind.MONOID:
-        raise ValueError(
-            f"{where} requires an idempotent join; MONOID engines "
-            "double-count on repeated snapshot merges (use DenseReplay's "
-            "exactly-once delta sync instead)"
+        dense = MonoidLift(dense)
+    if isinstance(dense, MonoidLift) and not isinstance(state, LiftedMonoidState):
+        raise TypeError(
+            f"{where}: monoid gossip needs versioned rows — build the "
+            "state with MonoidLift(engine).init(...) and apply ops "
+            "through the lift (parallel/monoid.py)"
         )
+    return dense, state
 
 
 def sweep(store: GossipStore, dense: Any, state: Any) -> Tuple[Any, int]:
     """Fold every peer's latest snapshot into `state` with the engine
     join. Returns (state, n_merged). Self's snapshot is skipped (already
-    reflected); stale or concurrent publishes are safe by idempotence."""
-    _reject_monoid(dense, "sweep")
+    reflected); stale or concurrent publishes are safe by idempotence
+    (MONOID engines ride the versioned-row lift, where row-replace is
+    the idempotent join — `parallel.monoid`)."""
+    dense, state = _resolve_monoid(dense, state, "sweep")
     n = 0
     for m in store.snapshot_members():
         if m == store.member:

@@ -123,9 +123,9 @@ class OrbaxGossip:
         publish has not advanced — a full cross-mesh restore of a large
         sharded state is the dominant cost of a sweep and is pure waste
         when the data is already reflected."""
-        from .elastic import _reject_monoid
+        from .elastic import _resolve_monoid
 
-        _reject_monoid(dense, "OrbaxGossip.sweep")
+        dense, state = _resolve_monoid(dense, state, "OrbaxGossip.sweep")
         n = 0
         for m in self.snapshot_members():
             if m == self.member:

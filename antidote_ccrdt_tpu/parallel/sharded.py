@@ -330,7 +330,7 @@ def make_id_sharded_topk_rmv(
     n_dcs: int,
     size: int = 100,
     slots_per_id: int = 4,
-    n_replicas: int = None,
+    n_replicas: int | None = None,
     key_axis: str = "key",
     dc_axis: str = "dc",
 ) -> IdShardedTopkRmv:
@@ -551,7 +551,7 @@ def make_id_sharded_leaderboard(
     mesh: Mesh,
     n_players_global: int,
     size: int = 100,
-    n_replicas: int = None,
+    n_replicas: int | None = None,
     key_axis: str = "key",
     dc_axis: str = "dc",
 ) -> IdShardedLeaderboard:
@@ -627,7 +627,7 @@ def make_id_sharded_topk(
     mesh: Mesh,
     n_ids_global: int,
     size: int = 100,
-    n_replicas: int = None,
+    n_replicas: int | None = None,
     key_axis: str = "key",
     dc_axis: str = "dc",
 ) -> IdShardedTopk:

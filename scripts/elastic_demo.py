@@ -1,13 +1,18 @@
 """Elastic-recovery drill worker (see parallel/elastic.py).
 
-N workers gossip a dense topk_rmv grid through a shared directory. Each
+N workers gossip a dense CRDT grid through a shared directory. Each
 step, each worker applies a *deterministic* op batch for the replicas it
 owns under the current alive set, heartbeats, and periodically publishes/
 sweeps. A worker started with --die-at crashes (os._exit) at that step;
 survivors detect the stale heartbeat, adopt its replicas, and — because
-op generation is deterministic and the join is idempotent — simply
-re-apply the adopted replicas' entire op history. Duplicated application
-of steps the victim already published is harmless by construction.
+op generation is deterministic — regenerate the adopted replicas' entire
+op history. Duplicated application of steps the victim already published
+is harmless: for JOIN engines by idempotence of the join, for MONOID
+engines (--type average/wordcount) because the versioned-row lift
+(parallel/monoid.py) replaces rows by version instead of adding them —
+the adopted row is regenerated into the adopter's own contribution state
+(MonoidContributor: writes never land on swept-in peer copies) and its
+version supersedes the victim's published prefix.
 
 Run one worker:
     python scripts/elastic_demo.py --root /tmp/g --member w0 --n-members 3
@@ -33,66 +38,225 @@ install_child_cover()  # no-op outside `make cover` runs
 
 # Demo geometry (shared with the test's reference computation).
 R, NK, I, DCS, K, M, B, Br = 4, 1, 64, 4, 8, 2, 32, 8
+NK_MONOID, V = 2, 32  # monoid drills: 2 keys, 32 wordcount buckets
 STEPS = 10
 
 
-def make_engine():
-    from antidote_ccrdt_tpu.models.topk_rmv_dense import make_dense
+# --- per-type drill adapters ----------------------------------------------
 
-    return make_dense(n_ids=I, n_dcs=DCS, size=K, slots_per_id=M)
+
+class _TopkRmvDrill:
+    """The JOIN flagship: in-place history re-apply on adoption (the join
+    dedups duplicated application — the round-1 drill semantics). JOIN
+    states need no own/gossip split, so the view IS the state."""
+
+    name = publish_name = "topk_rmv"
+
+    def pub_state(self, dense, state):
+        return state
+
+    def set_view(self, dense, state, swept):
+        return swept
+
+    def make_engine(self):
+        from antidote_ccrdt_tpu.models.topk_rmv_dense import make_dense
+
+        return make_dense(n_ids=I, n_dcs=DCS, size=K, slots_per_id=M)
+
+    def init(self, dense):
+        return dense.init(R, NK)
+
+    def gen_ops(self, step: int, owned):
+        """Deterministic [R, ...] op batch for `step`; replicas not in
+        `owned` are masked to padding (add_ts=0 / rmv_id=-1). Any member
+        can generate any replica's stream — the durable op source."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from antidote_ccrdt_tpu.models.topk_rmv_dense import TopkRmvOps
+
+        owned = set(owned)
+        a_key = np.zeros((R, B), np.int32)
+        a_id = np.zeros((R, B), np.int32)
+        a_score = np.zeros((R, B), np.int32)
+        a_dc = np.zeros((R, B), np.int32)
+        a_ts = np.zeros((R, B), np.int32)
+        r_key = np.zeros((R, Br), np.int32)
+        r_id = np.full((R, Br), -1, np.int32)
+        r_vc = np.zeros((R, Br, DCS), np.int32)
+        for r in range(R):
+            rng = np.random.default_rng(10_000 * (step + 1) + r)
+            ids = rng.integers(0, I, B)
+            scores = rng.integers(1, 500, B)
+            if r in owned:
+                a_id[r], a_score[r] = ids, scores
+                a_dc[r] = r % DCS
+                a_ts[r] = step * B + np.arange(B) + 1  # unique, monotone
+                r_id[r] = rng.integers(0, I, Br)
+                r_vc[r, :, r % DCS] = rng.integers(1, max(2, step * B + 1), Br)
+        return TopkRmvOps(
+            add_key=jnp.asarray(a_key), add_id=jnp.asarray(a_id),
+            add_score=jnp.asarray(a_score), add_dc=jnp.asarray(a_dc),
+            add_ts=jnp.asarray(a_ts),
+            rmv_key=jnp.asarray(r_key), rmv_id=jnp.asarray(r_id),
+            rmv_vc=jnp.asarray(r_vc),
+        )
+
+    def apply(self, dense, state, step: int, owned):
+        state, _ = dense.apply_ops(
+            state, self.gen_ops(step, owned), collect_dominated=False
+        )
+        return state
+
+    def adopt(self, dense, state, gained, upto_step: int):
+        for g in sorted(gained):
+            for s in range(upto_step):
+                state = self.apply(dense, state, s, [g])
+        return state
+
+    def digest(self, dense, state):
+        from antidote_ccrdt_tpu.harness.dense_replay import fold_rows
+
+        obs = dense.value(fold_rows(dense, state, range(R)))[0][0]
+        return sorted((int(i), int(s)) for (i, s) in obs)
+
+
+class _MonoidDrill:
+    """Shared machinery for the MONOID types through the versioned-row
+    lift: ops for non-owned rows are padding, versions bump only for
+    owned rows. The drill state is a `MonoidContributor` — ops apply to
+    the member's own contribution rows (never to swept-in peer copies;
+    see parallel/monoid.py for why that would double-count), gossip
+    lands on the peers side, publishes/reads use the merged view."""
+
+    def init(self, lift):
+        from antidote_ccrdt_tpu.parallel.monoid import MonoidContributor
+
+        return MonoidContributor(lift, R, NK_MONOID)
+
+    def apply(self, lift, contrib, step: int, owned):
+        contrib.apply(self.gen_ops(step, owned), owned=sorted(owned))
+        return contrib
+
+    def adopt(self, lift, contrib, gained, upto_step: int):
+        # Regenerate the gained rows' history into `own`, where they are
+        # still identity/ver-0 — the regenerated version supersedes the
+        # victim's published prefix by row-replace.
+        for s in range(upto_step):
+            contrib.apply(self.gen_ops(s, gained), owned=sorted(gained))
+        return contrib
+
+    def pub_state(self, lift, contrib):
+        return contrib.view
+
+    def set_view(self, lift, contrib, swept):
+        contrib.absorb(swept)
+        return contrib
+
+
+class _AverageDrill(_MonoidDrill):
+    name = "average"
+    publish_name = "average_lifted"
+
+    def make_engine(self):
+        from antidote_ccrdt_tpu.models.average import AverageDense
+        from antidote_ccrdt_tpu.parallel.monoid import MonoidLift
+
+        return MonoidLift(AverageDense())
+
+    def gen_ops(self, step: int, owned):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from antidote_ccrdt_tpu.models.average import AverageOps
+
+        owned = set(owned)
+        key = np.zeros((R, B), np.int32)
+        val = np.zeros((R, B), np.int32)
+        cnt = np.zeros((R, B), np.int32)
+        for r in range(R):
+            rng = np.random.default_rng(20_000 * (step + 1) + r)
+            if r in owned:
+                key[r] = rng.integers(0, NK_MONOID, B)
+                val[r] = rng.integers(1, 100, B)
+                cnt[r] = 1  # count==0 is the padding/no-op sentinel
+        return AverageOps(
+            key=jnp.asarray(key), value=jnp.asarray(val), count=jnp.asarray(cnt)
+        )
+
+    def digest(self, lift, contrib):
+        import numpy as np
+
+        tot = lift.total(contrib.view)  # [1, NK_MONOID] sum/num
+        return [
+            [int(x) for x in np.asarray(tot.sum)[0]],
+            [int(x) for x in np.asarray(tot.num)[0]],
+        ]
+
+
+class _WordcountDrill(_MonoidDrill):
+    name = "wordcount"
+    publish_name = "wordcount_lifted"
+
+    def make_engine(self):
+        from antidote_ccrdt_tpu.models.wordcount import WordcountDense
+        from antidote_ccrdt_tpu.parallel.monoid import MonoidLift
+
+        return MonoidLift(WordcountDense(V))
+
+    def gen_ops(self, step: int, owned):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from antidote_ccrdt_tpu.models.wordcount import WordcountOps
+
+        owned = set(owned)
+        key = np.zeros((R, B), np.int32)
+        tok = np.full((R, B), -1, np.int32)  # token<0 is padding
+        for r in range(R):
+            rng = np.random.default_rng(30_000 * (step + 1) + r)
+            if r in owned:
+                key[r] = rng.integers(0, NK_MONOID, B)
+                tok[r] = rng.integers(0, V, B)
+        return WordcountOps(key=jnp.asarray(key), token=jnp.asarray(tok))
+
+    def digest(self, lift, contrib):
+        import numpy as np
+
+        tot = lift.total(contrib.view)  # counts [1, NK, V], lost [1, NK]
+        counts = np.asarray(tot.counts)[0]
+        out = [
+            [k, int(t), int(counts[k, t])]
+            for k in range(NK_MONOID)
+            for t in np.nonzero(counts[k])[0]
+        ]
+        return out + [["lost", int(np.asarray(tot.lost).sum())]]
+
+
+DRILLS = {d.name: d for d in (_TopkRmvDrill(), _AverageDrill(), _WordcountDrill())}
+
+
+# Back-compat shims (tests and docs import these for the flagship drill).
+def make_engine():
+    return DRILLS["topk_rmv"].make_engine()
 
 
 def gen_step_ops(step: int, owned):
-    """Deterministic [R, ...] op batch for `step`; replicas not in `owned`
-    are masked to padding (add_ts=0 / rmv_id=-1). Any member can generate
-    any replica's stream — the durable op source of the drill."""
-    import jax.numpy as jnp
-    import numpy as np
-
-    from antidote_ccrdt_tpu.models.topk_rmv_dense import TopkRmvOps
-
-    owned = set(owned)
-    a_key = np.zeros((R, B), np.int32)
-    a_id = np.zeros((R, B), np.int32)
-    a_score = np.zeros((R, B), np.int32)
-    a_dc = np.zeros((R, B), np.int32)
-    a_ts = np.zeros((R, B), np.int32)
-    r_key = np.zeros((R, Br), np.int32)
-    r_id = np.full((R, Br), -1, np.int32)
-    r_vc = np.zeros((R, Br, DCS), np.int32)
-    for r in range(R):
-        rng = np.random.default_rng(10_000 * (step + 1) + r)
-        ids = rng.integers(0, I, B)
-        scores = rng.integers(1, 500, B)
-        if r in owned:
-            a_id[r], a_score[r] = ids, scores
-            a_dc[r] = r % DCS
-            a_ts[r] = step * B + np.arange(B) + 1  # unique, monotone
-            r_id[r] = rng.integers(0, I, Br)
-            r_vc[r, :, r % DCS] = rng.integers(1, max(2, step * B + 1), Br)
-    return TopkRmvOps(
-        add_key=jnp.asarray(a_key), add_id=jnp.asarray(a_id),
-        add_score=jnp.asarray(a_score), add_dc=jnp.asarray(a_dc),
-        add_ts=jnp.asarray(a_ts),
-        rmv_key=jnp.asarray(r_key), rmv_id=jnp.asarray(r_id),
-        rmv_vc=jnp.asarray(r_vc),
-    )
+    return DRILLS["topk_rmv"].gen_ops(step, owned)
 
 
 def observable_digest(dense, state):
-    from antidote_ccrdt_tpu.harness.dense_replay import fold_rows
-
-    obs = dense.value(fold_rows(dense, state, range(R)))[0][0]
-    return sorted((int(i), int(s)) for (i, s) in obs)
+    return DRILLS["topk_rmv"].digest(dense, state)
 
 
-def reference_digest():
+def reference_digest(type_name: str = "topk_rmv"):
     """Sequential single-process ground truth: every step, every replica."""
-    dense = make_engine()
-    state = dense.init(R, NK)
+    drill = DRILLS[type_name]
+    dense = drill.make_engine()
+    state = drill.init(dense)
     for step in range(STEPS):
-        state, _ = dense.apply_ops(state, gen_step_ops(step, range(R)))
-    return observable_digest(dense, state)
+        state = drill.apply(dense, state, step, range(R))
+    return drill.digest(dense, state)
 
 
 def main() -> None:
@@ -100,6 +264,7 @@ def main() -> None:
     ap.add_argument("--root", required=True)
     ap.add_argument("--member", required=True)
     ap.add_argument("--n-members", type=int, required=True)
+    ap.add_argument("--type", default="topk_rmv", choices=sorted(DRILLS))
     ap.add_argument("--die-at", type=int, default=-1)
     ap.add_argument(
         "--join-late", type=float, default=0.0,
@@ -131,31 +296,36 @@ def main() -> None:
         sweep_deltas,
     )
 
-    dense = make_engine()
-    state = dense.init(R, NK)
+    drill = DRILLS[args.type]
+    dense = drill.make_engine()
+    state = drill.init(dense)
     pub = None  # set after the store exists when --delta
     cursors: dict = {}
 
     def do_publish(store, seq_hint):
+        view = drill.pub_state(dense, state)
         if pub is not None:
-            pub.publish(state)
+            pub.publish(view)
         else:
-            store.publish("topk_rmv", state, seq_hint)
+            store.publish(drill.publish_name, view, seq_hint)
 
     def do_sweep(store, st):
+        view = drill.pub_state(dense, st)
         if pub is not None:
-            return sweep_deltas(store, dense, st, cursors)
-        return sweep(store, dense, st)
+            swept, stats = sweep_deltas(store, dense, view, cursors)
+        else:
+            swept, stats = sweep(store, dense, view)
+        return drill.set_view(dense, st, swept), stats
 
     if args.join_late > 0:
         # Late join: compile the engine first (apply a no-op batch), THEN
         # register — from the fleet's view the member appears and is
         # immediately productive.
-        state, _ = dense.apply_ops(state, gen_step_ops(0, []))
+        state = drill.apply(dense, state, 0, [])
         time.sleep(args.join_late)
     store = GossipStore(args.root, args.member)
     if args.delta:
-        pub = DeltaPublisher(store, dense, full_every=4)
+        pub = DeltaPublisher(store, dense, name=drill.publish_name, full_every=4)
 
     # Background heartbeat: dies with the process, so a crash goes stale.
     def beat():
@@ -179,22 +349,20 @@ def main() -> None:
         # drop r for new owner B before B has even seen the new map — r's
         # trailing steps would be applied by no one). Keeping it means the
         # old and new owner briefly both apply r's deterministic stream,
-        # which the join dedups — idempotence is what makes handoff need
-        # no coordination. (A real deployment would shed the old owner's
-        # copy at the next reconciliation barrier.)
+        # which dedups: JOIN by idempotence, MONOID because identical
+        # streams produce identical (version, content) rows under the
+        # lift. (A real deployment would shed the old owner's copy at the
+        # next reconciliation barrier.)
         owned = owned_prev | set(my_replicas(store, R, args.timeout))
         # Adoption: replicas gained since last step get their FULL history
-        # re-applied — steps the previous owner already published merge in
-        # idempotently, steps it lost in the crash are regenerated.
-        for gained in sorted(owned - owned_prev):
-            for s in range(step):
-                state, _ = dense.apply_ops(
-                    state, gen_step_ops(s, [gained]), collect_dominated=False
-                )
+        # regenerated — steps the previous owner already published merge
+        # in harmlessly (join dedup / version row-replace), steps it lost
+        # in the crash are recreated from the durable op source.
+        gained = owned - owned_prev
+        if gained:
+            state = drill.adopt(dense, state, sorted(gained), step)
         owned_prev = owned
-        state, _ = dense.apply_ops(
-            state, gen_step_ops(step, sorted(owned)), collect_dominated=False
-        )
+        state = drill.apply(dense, state, step, sorted(owned))
         if step % args.publish_every == 0:
             do_publish(store, step)
             state, _ = do_sweep(store, state)
@@ -207,12 +375,13 @@ def main() -> None:
     # timeout window is still waited for (its snapshot step says it isn't
     # done) instead of being dropped mid-convergence; the crashed victim
     # is exempted by a stale-beyond-doubt heartbeat.
-    store.publish("topk_rmv", state, STEPS)
+    store.publish(drill.publish_name, drill.pub_state(dense, state), STEPS)
     confident_stale = max(1.5 * args.timeout, 0.6)
     deadline = time.time() + 10
     while time.time() < deadline:
-        state, _ = sweep(store, dense, state)
-        store.publish("topk_rmv", state, STEPS)
+        swept, _ = sweep(store, dense, drill.pub_state(dense, state))
+        state = drill.set_view(dense, state, swept)
+        store.publish(drill.publish_name, drill.pub_state(dense, state), STEPS)
         pending = []
         alive_now = set(store.alive_members(confident_stale))
         for m in store.snapshot_members():
@@ -226,12 +395,13 @@ def main() -> None:
         if not pending:
             break
         time.sleep(0.1)
-    state, _ = sweep(store, dense, state)
+    swept, _ = sweep(store, dense, drill.pub_state(dense, state))
+    state = drill.set_view(dense, state, swept)
 
     out = {
         "member": args.member,
         "alive": store.alive_members(args.timeout),
-        "digest": observable_digest(dense, state),
+        "digest": drill.digest(dense, state),
     }
     with open(os.path.join(args.root, f"final-{args.member}.json"), "w") as f:
         json.dump(out, f)

@@ -360,12 +360,19 @@ def test_delta_gossip_generic_join_engine(tmp_path):
     assert states_equal(sb, sa)
 
 
-def test_delta_gossip_rejects_monoid_engine(tmp_path):
+def test_delta_gossip_lifts_monoid_engine(tmp_path):
+    """Round 2 refused MONOID engines outright; round 3 auto-wraps them
+    in the versioned-row lift (parallel/monoid.py) — but still rejects a
+    raw (unversioned) monoid state at publish time."""
     from antidote_ccrdt_tpu.models.wordcount import make_dense as mk_wc
+    from antidote_ccrdt_tpu.parallel.monoid import MonoidLift
 
     store = GossipStore(str(tmp_path), "a")
-    with pytest.raises(ValueError, match="MONOID"):
-        DeltaPublisher(store, mk_wc(64))
+    pub = DeltaPublisher(store, mk_wc(64), name="wordcount_lifted")
+    assert isinstance(pub.dense, MonoidLift)
+    with pytest.raises(TypeError, match="MonoidLift"):
+        pub.publish(mk_wc(64).init(2, 1))
+    pub.publish(pub.dense.init(2, 1))  # lifted state sails through
 
 
 @pytest.mark.parametrize("seed", range(2))
@@ -466,13 +473,16 @@ def test_sweep_deltas_survives_apply_failure(tmp_path, monkeypatch):
     assert cursors["a"] == 0  # chain stopped at the failing delta
 
 
-def test_snapshot_sweep_rejects_monoid_engine(tmp_path):
+def test_snapshot_sweep_rejects_raw_monoid_state(tmp_path):
+    """Sweeps auto-lift a raw MONOID engine but a raw state stays a
+    TypeError — versions are required protocol information (the lifted
+    path itself is exercised in tests/test_monoid_lift.py)."""
     from antidote_ccrdt_tpu.models.wordcount import make_dense as mk_wc
     from antidote_ccrdt_tpu.parallel.elastic import sweep
 
     store = GossipStore(str(tmp_path), "a")
     Dw = mk_wc(64)
-    with pytest.raises(ValueError, match="MONOID"):
+    with pytest.raises(TypeError, match="MonoidLift"):
         sweep(store, Dw, Dw.init(1, 1))
-    with pytest.raises(ValueError, match="MONOID"):
+    with pytest.raises(TypeError, match="MonoidLift"):
         sweep_deltas(store, Dw, Dw.init(1, 1), {})

@@ -80,42 +80,50 @@ def test_gossip_sweep_merges_peer_snapshots(tmp_path):
     assert D.equal(again, merged)
 
 
-def test_real_process_crash_recovery(tmp_path):
-    """Three workers; w1 crashes at step 4; w0/w2 must adopt its replicas
-    and both converge to the sequential single-process reference."""
+def _run_drill(tmp_path, spec, n_members, type_name, timeout=180):
+    """Launch drill workers per `spec` [(member, extra_args)], wait, and
+    return ({member: returncode}, {member: output})."""
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env["JAX_PLATFORMS"] = "cpu"
     procs = {}
-    for member, extra in (
-        ("w0", []),
-        ("w1", ["--die-at", "4"]),
-        ("w2", []),
-    ):
+    for member, extra in spec:
         procs[member] = subprocess.Popen(
             [sys.executable, DEMO, "--root", str(tmp_path), "--member", member,
-             "--n-members", "3", *extra],
+             "--n-members", str(n_members), "--type", type_name, *extra],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True,
         )
-    outs = {}
+    rcs, outs = {}, {}
     for member, p in procs.items():
         try:
-            out, _ = p.communicate(timeout=180)
+            out, _ = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             p.kill()
             out, _ = p.communicate()
             pytest.fail(f"worker {member} timed out:\n{out}")
-        outs[member] = out
-    assert procs["w1"].returncode == 1, f"victim should crash:\n{outs['w1']}"
-    for m in ("w0", "w2"):
-        assert procs[m].returncode == 0, f"worker {m} failed:\n{outs[m]}"
+        rcs[member], outs[member] = p.returncode, out
+    return rcs, outs
 
+
+def _drill_reference(type_name):
     sys.path.insert(0, os.path.join(REPO, "scripts"))
     import elastic_demo
 
-    ref = [list(t) for t in elastic_demo.reference_digest()]  # JSON: lists
+    return elastic_demo.reference_digest(type_name)
+
+
+def test_real_process_crash_recovery(tmp_path):
+    """Three workers; w1 crashes at step 4; w0/w2 must adopt its replicas
+    and both converge to the sequential single-process reference."""
+    rcs, outs = _run_drill(
+        tmp_path, (("w0", []), ("w1", ["--die-at", "4"]), ("w2", [])),
+        3, "topk_rmv",
+    )
+    assert rcs["w1"] == 1, f"victim should crash:\n{outs['w1']}"
+    ref = [list(t) for t in _drill_reference("topk_rmv")]  # JSON: lists
     assert ref, "reference observable is empty — drill is vacuous"
     for m in ("w0", "w2"):
+        assert rcs[m] == 0, f"worker {m} failed:\n{outs[m]}"
         with open(os.path.join(str(tmp_path), f"final-{m}.json")) as f:
             got = json.load(f)
         assert got["digest"] == ref, (
@@ -128,36 +136,13 @@ def test_real_process_crash_recovery(tmp_path):
 def test_real_process_scale_up_late_joiner(tmp_path):
     """Two founding workers + one that joins ~1s into the run: ownership
     rebalances onto the joiner, everyone converges to the reference."""
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    procs = {}
-    for member, extra in (
-        ("w0", []),
-        ("w1", []),
-        ("w2", ["--join-late", "1.0"]),
-    ):
-        procs[member] = subprocess.Popen(
-            [sys.executable, DEMO, "--root", str(tmp_path), "--member", member,
-             "--n-members", "2", *extra],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True,
-        )
-    outs = {}
-    for member, p in procs.items():
-        try:
-            out, _ = p.communicate(timeout=180)
-        except subprocess.TimeoutExpired:
-            p.kill()
-            out, _ = p.communicate()
-            pytest.fail(f"worker {member} timed out:\n{out}")
-        outs[member] = out
-        assert p.returncode == 0, f"worker {member} failed:\n{out}"
-
-    sys.path.insert(0, os.path.join(REPO, "scripts"))
-    import elastic_demo
-
-    ref = [list(t) for t in elastic_demo.reference_digest()]
+    rcs, outs = _run_drill(
+        tmp_path, (("w0", []), ("w1", []), ("w2", ["--join-late", "1.0"])),
+        2, "topk_rmv",
+    )
+    ref = [list(t) for t in _drill_reference("topk_rmv")]
     for m in ("w0", "w1", "w2"):
+        assert rcs[m] == 0, f"worker {m} failed:\n{outs[m]}"
         with open(os.path.join(str(tmp_path), f"final-{m}.json")) as f:
             got = json.load(f)
         assert got["digest"] == ref, (
@@ -173,36 +158,16 @@ def test_real_process_scale_up_late_joiner(tmp_path):
 def test_real_process_crash_recovery_delta_gossip(tmp_path):
     """The crash drill with --delta: chained delta publishes + full
     anchors carry the gossip; recovery and convergence must be identical."""
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    procs = {}
-    for member, extra in (
-        ("w0", ["--delta"]),
-        ("w1", ["--delta", "--die-at", "4"]),
-        ("w2", ["--delta"]),
-    ):
-        procs[member] = subprocess.Popen(
-            [sys.executable, DEMO, "--root", str(tmp_path), "--member", member,
-             "--n-members", "3", *extra],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True,
-        )
-    outs = {}
-    for member, p in procs.items():
-        try:
-            out, _ = p.communicate(timeout=180)
-        except subprocess.TimeoutExpired:
-            p.kill()
-            out, _ = p.communicate()
-            pytest.fail(f"worker {member} timed out:\n{out}")
-        outs[member] = out
-    assert procs["w1"].returncode == 1
-    sys.path.insert(0, os.path.join(REPO, "scripts"))
-    import elastic_demo
-
-    ref = [list(t) for t in elastic_demo.reference_digest()]
+    rcs, outs = _run_drill(
+        tmp_path,
+        (("w0", ["--delta"]), ("w1", ["--delta", "--die-at", "4"]),
+         ("w2", ["--delta"])),
+        3, "topk_rmv",
+    )
+    assert rcs["w1"] == 1
+    ref = [list(t) for t in _drill_reference("topk_rmv")]
     for m in ("w0", "w2"):
-        assert procs[m].returncode == 0, f"worker {m} failed:\n{outs[m]}"
+        assert rcs[m] == 0, f"worker {m} failed:\n{outs[m]}"
         with open(os.path.join(str(tmp_path), f"final-{m}.json")) as f:
             got = json.load(f)
         assert got["digest"] == ref, (
@@ -210,6 +175,56 @@ def test_real_process_crash_recovery_delta_gossip(tmp_path):
             f"log:\n{outs[m]}"
         )
     # Delta files were actually exchanged (not just full anchors).
+    assert any(
+        f.startswith("delta-") for f in os.listdir(str(tmp_path))
+    ), os.listdir(str(tmp_path))
+
+
+def test_real_process_crash_recovery_monoid_average(tmp_path):
+    """The MONOID half of the host delivery contract
+    (antidote_ccrdt.erl:47-59 replicates without type distinction):
+    average rides the versioned-row lift through the SAME crash drill the
+    JOIN flagship runs — w1 dies at step 4, survivors adopt its rows by
+    regenerating history into their own contribution state, and converge
+    to the exact sequential totals (any double count is a digest diff)."""
+    rcs, outs = _run_drill(
+        tmp_path,
+        (("w0", []), ("w1", ["--die-at", "4"]), ("w2", [])),
+        3, "average",
+    )
+    assert rcs["w1"] == 1, f"victim should crash:\n{outs['w1']}"
+    ref = _drill_reference("average")
+    for m in ("w0", "w2"):
+        assert rcs[m] == 0, f"worker {m} failed:\n{outs[m]}"
+        with open(os.path.join(str(tmp_path), f"final-{m}.json")) as f:
+            got = json.load(f)
+        assert got["digest"] == ref, (
+            f"{m} diverged (monoid average)\ngot: {got['digest']}\n"
+            f"ref: {ref}\nlog:\n{outs[m]}"
+        )
+        assert "w1" not in got["alive"]
+
+
+def test_real_process_late_joiner_monoid_wordcount_delta(tmp_path):
+    """Scale-up elasticity + row-replace delta gossip for the second
+    MONOID engine: a member joins ~1s in, ownership rebalances onto it,
+    deltas (self-contained whole-row payloads) carry the anti-entropy,
+    and every member converges to the exact sequential counts."""
+    rcs, outs = _run_drill(
+        tmp_path,
+        (("w0", ["--delta"]), ("w1", ["--delta"]),
+         ("w2", ["--join-late", "1.0", "--delta"])),
+        2, "wordcount",
+    )
+    ref = _drill_reference("wordcount")
+    for m in ("w0", "w1", "w2"):
+        assert rcs[m] == 0, f"worker {m} failed:\n{outs[m]}"
+        with open(os.path.join(str(tmp_path), f"final-{m}.json")) as f:
+            got = json.load(f)
+        assert got["digest"] == ref, (
+            f"{m} diverged (monoid wordcount delta)\ngot: {got['digest']}\n"
+            f"ref: {ref}\nlog:\n{outs[m]}"
+        )
     assert any(
         f.startswith("delta-") for f in os.listdir(str(tmp_path))
     ), os.listdir(str(tmp_path))

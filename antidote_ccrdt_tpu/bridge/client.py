@@ -128,12 +128,15 @@ class BridgeClient:
 
     # -- dense grid surface ------------------------------------------------
 
-    def grid_new(self, name: str, **params: int) -> None:
+    def grid_new(self, name: str, type_name: str = "topk_rmv", **params: int) -> None:
+        """Create a dense grid of any registered type (topk_rmv, topk,
+        leaderboard, average, wordcount, worddocumentcount); `params` are
+        the type's geometry keys (see server._GRID_GEOMETRY)."""
         self.call(
             (
                 Atom("grid_new"),
                 name.encode(),
-                Atom("topk_rmv"),
+                Atom(type_name),
                 {Atom(k): v for k, v in params.items()},
             )
         )

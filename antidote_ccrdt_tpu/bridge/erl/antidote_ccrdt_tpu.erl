@@ -132,8 +132,12 @@ is_replicate_tagged(Sock, Type, Effect) ->
 grid_new(Sock, Grid, Type, Params) when is_map(Params) ->
     call(Sock, {grid_new, Grid, Type, Params}).
 
-%% OpsPerReplica: one op list per replica row;
-%%   {add, Key, Id, Score, Dc, Ts} | {rmv, Key, Id, [{Dc, Ts}]}.
+%% OpsPerReplica: one op list per replica row. Op shapes per grid type:
+%%   topk_rmv     {add, Key, Id, Score, Dc, Ts} | {rmv, Key, Id, [{Dc, Ts}]}
+%%   topk         {add, Key, Id, Score}
+%%   leaderboard  {add, Key, Id, Score} | {ban, Key, Id}
+%%   average      {add, Key, Value, Count}
+%%   wordcount / worddocumentcount  {add, Key, TokenId}
 grid_apply(Sock, Grid, OpsPerReplica) when is_list(OpsPerReplica) ->
     call(Sock, {grid_apply, Grid, OpsPerReplica}).
 
@@ -189,6 +193,21 @@ main(Args) ->
     io:format("topk_rmv re-broadcast extras: ~p~n", [Extras]),
 
     {ok, true} = free(S, H3),
+
+    %% dense grids beyond the flagship: a MONOID grid (average) and a
+    %% JOIN grid (leaderboard) batched over the same surface
+    {ok, true} = grid_new(S, ga, average, #{n_replicas => 2, n_keys => 1}),
+    {ok, 0} = grid_apply(S, ga, [[{add, 0, 10, 1}], [{add, 0, 20, 1}]]),
+    {ok, true} = grid_merge_all(S, ga),
+    {ok, {30, 2}} = grid_observe(S, ga, 0, 0),
+    {ok, true} = grid_new(S, gl, leaderboard,
+                          #{n_replicas => 2, n_players => 8, size => 2}),
+    {ok, 0} = grid_apply(S, gl, [[{add, 0, 1, 10}],
+                                 [{ban, 0, 1}, {add, 0, 2, 5}]]),
+    {ok, true} = grid_merge_all(S, gl),
+    {ok, [{2, 5}]} = grid_observe(S, gl, 0, 0),
+    io:format("dense grids (average + leaderboard) OK~n", []),
+
     ok = close(S),
     io:format("bridge smoke OK~n", []),
     halt(0).

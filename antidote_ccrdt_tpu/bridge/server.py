@@ -55,36 +55,53 @@ _to_term = py_to_term
 # --- dense grids ----------------------------------------------------------
 
 
+# Geometry schema per dense type: (key, default) pairs beyond the shared
+# n_replicas/n_keys; a callable default is resolved against the grid
+# (the reference host's per-type parameters, antidote_ccrdt.erl:47-59 —
+# every registered type gets the batch surface, not just the flagship).
+_GRID_GEOMETRY: Dict[str, Tuple[Tuple[str, Any], ...]] = {
+    "topk_rmv": (  # frozen wire format — golden bytes pin it
+        ("n_ids", 1024), ("n_dcs", lambda g: g.R),
+        ("size", 100), ("slots_per_id", 4),
+    ),
+    "topk": (("n_ids", 1024), ("size", 100)),
+    "leaderboard": (("n_players", 1024), ("size", 100)),
+    "average": (),
+    "wordcount": (("n_buckets", 1024),),
+    "worddocumentcount": (("n_buckets", 1024),),
+}
+
+
 class _Grid:
-    """A named dense topk_rmv grid on the JAX backend."""
+    """A named dense CRDT grid on the JAX backend — any registered dense
+    type; op packing and observe shape dispatch per type below."""
 
     def __init__(self, type_name: str, params: Dict[Any, Any]):
         def geti(key, default):
             return int(params.get(Atom(key), default))
 
+        if type_name not in _GRID_GEOMETRY:
+            raise ValueError(
+                f"dense grids support {sorted(_GRID_GEOMETRY)}; "
+                f"got {type_name!r}"
+            )
         self.type_name = type_name
         self.R = geti("n_replicas", 2)
         self.NK = geti("n_keys", 1)
         # Resolved geometry (defaults applied) — embedded in snapshots so
         # grid_from_binary is self-contained.
-        self.geometry = {
-            "n_replicas": self.R,
-            "n_keys": self.NK,
-            "n_ids": geti("n_ids", 1024),
-            "n_dcs": geti("n_dcs", self.R),
-            "size": geti("size", 100),
-            "slots_per_id": geti("slots_per_id", 4),
-        }
+        self.geometry = {"n_replicas": self.R, "n_keys": self.NK}
+        for key, default in _GRID_GEOMETRY[type_name]:
+            self.geometry[key] = geti(
+                key, default(self) if callable(default) else default
+            )
         # Constructed through the registry's dense-factory surface — the
-        # same path any embedder uses; only the op packing below is
-        # topk_rmv-specific.
-        self.dense = registry.make_dense(
-            type_name,
-            n_ids=self.geometry["n_ids"],
-            n_dcs=self.geometry["n_dcs"],
-            size=self.geometry["size"],
-            slots_per_id=self.geometry["slots_per_id"],
-        )
+        # same path any embedder uses.
+        dense_kwargs = {
+            k: v for k, v in self.geometry.items()
+            if k not in ("n_replicas", "n_keys")
+        }
+        self.dense = registry.make_dense(type_name, **dense_kwargs)
         self.state = self.dense.init(n_replicas=self.R, n_keys=self.NK)
 
     def to_binary(self) -> bytes:
@@ -108,7 +125,10 @@ class _Grid:
         if not (isinstance(term, tuple) and len(term) == 2):
             raise ValueError("grid snapshot must be a (geometry, state) pair")
         geom, state_blob = term
-        grid = cls("topk_rmv", dict(geom))
+        # The dense-state blob's own header names the type (dumps_dense),
+        # so the snapshot tuple stays the frozen 2-element layout the
+        # round-2 golden bytes pin while carrying any grid type.
+        grid = cls(serial.peek_name(state_blob), dict(geom))
         name, state = serial.loads_dense(state_blob, grid.state)
         if name != grid.type_name:
             # A different dense type's blob can be treedef-compatible yet
@@ -127,17 +147,35 @@ class _Grid:
         return grid
 
     def apply(self, per_replica_ops) -> int:
+        """Apply one op batch per replica row in one device dispatch.
+
+        Wire op formats (tagged tuples; topk_rmv's is frozen by golden
+        bytes, the rest are the round-3 widening of the grid surface):
+          topk_rmv         {add, Key, Id, Score, Dc, Ts} | {rmv, Key, Id, [{Dc,Ts}]}
+          topk             {add, Key, Id, Score}
+          leaderboard      {add, Key, Id, Score} | {ban, Key, Id}
+          average          {add, Key, Value, Count}
+          wordcount(+doc)  {add, Key, TokenId}   (ids from the host's encoder)
+        Returns the extras count (dominated elements for topk_rmv, 0 for
+        types without extra-op output on this surface)."""
+        if len(per_replica_ops) != self.R:
+            raise ValueError(f"expected {self.R} replica op lists")
+        return getattr(self, f"_apply_{self.type_name}")(per_replica_ops)
+
+    @staticmethod
+    def _check_tags(per_replica_ops, allowed) -> None:
+        for ops in per_replica_ops:
+            for op in ops:
+                if op[0] not in allowed:
+                    raise ValueError(f"unknown grid op tag: {op[0]!r}")
+
+    def _apply_topk_rmv(self, per_replica_ops) -> int:
         import jax.numpy as jnp
 
         from ..models.topk_rmv_dense import TopkRmvOps
 
-        if len(per_replica_ops) != self.R:
-            raise ValueError(f"expected {self.R} replica op lists")
         D = self.dense.D
-        for ops in per_replica_ops:
-            for op in ops:
-                if op[0] not in (Atom("add"), Atom("rmv")):
-                    raise ValueError(f"unknown grid op tag: {op[0]!r}")
+        self._check_tags(per_replica_ops, (Atom("add"), Atom("rmv")))
         adds = [[op for op in ops if op[0] == Atom("add")] for ops in per_replica_ops]
         rmvs = [[op for op in ops if op[0] == Atom("rmv")] for ops in per_replica_ops]
         B = max(1, max(len(a) for a in adds))
@@ -184,11 +222,148 @@ class _Grid:
         self.state, extras = self.dense.apply_ops(self.state, ops_batch)
         return int(np.asarray(extras.dominated).sum())
 
+    def _apply_topk(self, per_replica_ops) -> int:
+        import jax.numpy as jnp
+
+        from ..models.topk import TopkOps
+
+        self._check_tags(per_replica_ops, (Atom("add"),))
+        I, NK = self.dense.I, self.NK
+        B = max(1, max(len(ops) for ops in per_replica_ops))
+        key = np.zeros((self.R, B), np.int32)
+        id_ = np.zeros((self.R, B), np.int32)
+        score = np.zeros((self.R, B), np.int32)
+        valid = np.zeros((self.R, B), bool)
+        for ri, ops in enumerate(per_replica_ops):
+            for j, (_, k, i, s) in enumerate(ops):
+                if not (0 <= k < NK and 0 <= i < I):
+                    raise ValueError(f"add (key={k}, id={i}) out of range")
+                key[ri, j], id_[ri, j], score[ri, j] = k, i, s
+                valid[ri, j] = True
+        self.state, _ = self.dense.apply_ops(
+            self.state,
+            TopkOps(
+                key=jnp.asarray(key), id=jnp.asarray(id_),
+                score=jnp.asarray(score), valid=jnp.asarray(valid),
+            ),
+        )
+        return 0
+
+    def _apply_leaderboard(self, per_replica_ops) -> int:
+        import jax.numpy as jnp
+
+        from ..models.leaderboard import LeaderboardOps
+
+        self._check_tags(per_replica_ops, (Atom("add"), Atom("ban")))
+        P, NK = self.dense.P, self.NK
+        adds = [[op for op in ops if op[0] == Atom("add")] for ops in per_replica_ops]
+        bans = [[op for op in ops if op[0] == Atom("ban")] for ops in per_replica_ops]
+        B = max(1, max(len(a) for a in adds))
+        Bb = max(1, max(len(b) for b in bans))
+        a_key = np.zeros((self.R, B), np.int32)
+        a_id = np.zeros((self.R, B), np.int32)
+        a_score = np.zeros((self.R, B), np.int32)
+        a_valid = np.zeros((self.R, B), bool)
+        b_key = np.zeros((self.R, Bb), np.int32)
+        b_id = np.zeros((self.R, Bb), np.int32)
+        b_valid = np.zeros((self.R, Bb), bool)
+        for ri, ops in enumerate(adds):
+            for j, (_, k, i, s) in enumerate(ops):
+                if not (0 <= k < NK and 0 <= i < P):
+                    raise ValueError(f"add (key={k}, id={i}) out of range")
+                a_key[ri, j], a_id[ri, j], a_score[ri, j] = k, i, s
+                a_valid[ri, j] = True
+        for ri, ops in enumerate(bans):
+            for j, (_, k, i) in enumerate(ops):
+                if not (0 <= k < NK and 0 <= i < P):
+                    raise ValueError(f"ban (key={k}, id={i}) out of range")
+                b_key[ri, j], b_id[ri, j] = k, i
+                b_valid[ri, j] = True
+        self.state, _ = self.dense.apply_ops(
+            self.state,
+            LeaderboardOps(
+                add_key=jnp.asarray(a_key), add_id=jnp.asarray(a_id),
+                add_score=jnp.asarray(a_score), add_valid=jnp.asarray(a_valid),
+                ban_key=jnp.asarray(b_key), ban_id=jnp.asarray(b_id),
+                ban_valid=jnp.asarray(b_valid),
+            ),
+        )
+        return 0
+
+    def _apply_average(self, per_replica_ops) -> int:
+        import jax.numpy as jnp
+
+        from ..models.average import AverageOps
+
+        self._check_tags(per_replica_ops, (Atom("add"),))
+        NK = self.NK
+        B = max(1, max(len(ops) for ops in per_replica_ops))
+        key = np.zeros((self.R, B), np.int32)
+        val = np.zeros((self.R, B), np.int32)
+        cnt = np.zeros((self.R, B), np.int32)
+        for ri, ops in enumerate(per_replica_ops):
+            for j, (_, k, v, c) in enumerate(ops):
+                if not 0 <= k < NK:
+                    raise ValueError(f"add key={k} out of range")
+                if c < 0:
+                    # count==0 is the engine's padding sentinel; a negative
+                    # count has no reference semantics (average.erl:87-89).
+                    raise ValueError(f"add count={c} out of range")
+                key[ri, j], val[ri, j], cnt[ri, j] = k, v, c
+        self.state, _ = self.dense.apply_ops(
+            self.state,
+            AverageOps(
+                key=jnp.asarray(key), value=jnp.asarray(val),
+                count=jnp.asarray(cnt),
+            ),
+        )
+        return 0
+
+    def _apply_wordcount(self, per_replica_ops) -> int:
+        import jax.numpy as jnp
+
+        from ..models.wordcount import WordcountOps
+
+        self._check_tags(per_replica_ops, (Atom("add"),))
+        NK, V = self.NK, self.dense.V
+        B = max(1, max(len(ops) for ops in per_replica_ops))
+        key = np.zeros((self.R, B), np.int32)
+        tok = np.full((self.R, B), -1, np.int32)  # token<0 = padding
+        for ri, ops in enumerate(per_replica_ops):
+            for j, (_, k, t) in enumerate(ops):
+                if not 0 <= k < NK:
+                    raise ValueError(f"add key={k} out of range")
+                if not 0 <= t < V:
+                    # Over-table ids would silently land in the lost
+                    # counter; the wire is the place to be loud.
+                    raise ValueError(f"add token={t} out of range")
+                key[ri, j], tok[ri, j] = k, t
+        self.state, _ = self.dense.apply_ops(
+            self.state,
+            WordcountOps(key=jnp.asarray(key), token=jnp.asarray(tok)),
+        )
+        return 0
+
+    # Shared kernel, own registry entry (dedup is an encode-time concern,
+    # worddocumentcount.erl:76-86). Explicit alias: a future grid type
+    # missing its packer must fail loudly, not fall back.
+    _apply_worddocumentcount = _apply_wordcount
+
     def merge_all(self) -> None:
-        """Fold all replica rows with the lattice join and broadcast the
-        result back — the one-dispatch inter-DC reconciliation."""
+        """One-dispatch inter-DC reconciliation, by merge algebra:
+
+        JOIN — fold all replica rows with the lattice join and broadcast
+        the result back (idempotent: every DC now holds the full join).
+
+        MONOID — per-replica rows are DELTAS (MergeKind docstring), so
+        broadcasting a fold would multiply the total by R on the next
+        fold. Instead the fold lands in row 0 and the other rows reset to
+        the monoid identity: the grid total is preserved, merge_all is
+        idempotent at the total level, and later ops keep accumulating."""
         import jax
         import jax.numpy as jnp
+
+        from ..core.behaviour import MergeKind
 
         state = self.state
         r = self.R
@@ -204,9 +379,20 @@ class _Grid:
                 )
             state = merged
             r = half + (r % 2)
-        self.state = jax.tree.map(
-            lambda x: jnp.broadcast_to(x[:1], (self.R,) + x.shape[1:]), state
-        )
+        if getattr(self.dense, "merge_kind", None) == MergeKind.MONOID:
+            ident = self.dense.init(n_replicas=self.R - 1, n_keys=self.NK)
+            self.state = (
+                state
+                if self.R == 1
+                else jax.tree.map(
+                    lambda total, z: jnp.concatenate([total[:1], z], axis=0),
+                    state, ident,
+                )
+            )
+        else:
+            self.state = jax.tree.map(
+                lambda x: jnp.broadcast_to(x[:1], (self.R,) + x.shape[1:]), state
+            )
 
     def observe(self, replica: int, key: int):
         import jax
@@ -217,6 +403,13 @@ class _Grid:
         # dense.value() would sort and host-transfer the whole [R, NK] grid
         # (and hold the server lock while doing it).
         cell = jax.tree.map(lambda x: x[replica : replica + 1, key : key + 1], self.state)
+        if self.type_name == "average":
+            # {Sum, Num} — lossless; the client derives the float the way
+            # the scalar value/1 does (average.erl:38-42).
+            return (int(cell.sum[0, 0]), int(cell.num[0, 0]))
+        if self.type_name in ("wordcount", "worddocumentcount"):
+            counts = np.asarray(cell.counts)[0, 0]
+            return [(int(t), int(c)) for t, c in enumerate(counts) if c]
         return [(_to_term(i), s) for (i, s) in self.dense.value(cell)[0][0]]
 
 
@@ -336,6 +529,19 @@ class BridgeServer:
             if g not in self._grids:
                 raise KeyError(f"no such grid: {g!r}")
             return self._glocks.setdefault(g, threading.Lock())
+
+    def _replace_grid(self, gname: Any, grid: "_Grid") -> None:
+        """Install/replace a grid under its object lock. Swapping without
+        the lock would let a concurrent in-flight grid_apply's
+        acknowledged write vanish silently; the lock entry is created
+        unconditionally because a not-yet-existing name can be racing a
+        grid_new + apply. Shared by grid_new and grid_from_binary so the
+        replace discipline cannot drift between the two paths."""
+        with self._meta:
+            lk = self._glocks.setdefault(gname, threading.Lock())
+        with lk:
+            with self._meta:
+                self._grids[gname] = grid
 
     def _insert_handle(self, name: str, state: Any) -> int:
         """Allocate id and insert in one _meta section: every mutation of
@@ -458,11 +664,8 @@ class BridgeServer:
             return True
         if tag == "grid_new":
             _, gname, type_atom, params = op
-            if str(type_atom) != "topk_rmv":
-                raise ValueError("dense grids support topk_rmv")
             grid = _Grid(str(type_atom), params)  # built outside _meta
-            with self._meta:
-                self._grids[gname] = grid
+            self._replace_grid(gname, grid)
             return True
         if tag == "grid_apply":
             _, gname, per_replica = op
@@ -480,15 +683,7 @@ class BridgeServer:
         if tag == "grid_from_binary":
             _, gname, blob = op
             grid = _Grid.from_binary(blob)  # built outside _meta
-            # Replacing a grid must hold its object lock, or a concurrent
-            # acknowledged grid_apply on the old object would vanish
-            # silently. Create the lock entry unconditionally — a
-            # not-yet-existing name can be racing a grid_new + apply.
-            with self._meta:
-                lk = self._glocks.setdefault(gname, threading.Lock())
-            with lk:
-                with self._meta:
-                    self._grids[gname] = grid
+            self._replace_grid(gname, grid)
             return True
         raise ValueError(f"unknown op: {tag}")
 

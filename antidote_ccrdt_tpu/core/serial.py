@@ -180,6 +180,14 @@ def dumps_dense(name: str, state: Any) -> bytes:
     return _header(KIND_DENSE, name) + bio.getvalue()
 
 
+def peek_name(data: bytes) -> str:
+    """The type name a dumps_scalar/dumps_dense blob was written under,
+    without decoding the payload — the dispatch key for embedders that
+    store heterogeneous snapshots (e.g. the bridge's grid restore)."""
+    _kind, name, _off = _parse_header(bytes(data))
+    return name
+
+
 def loads_dense(data: bytes, like: Any) -> tuple[str, Any]:
     """Restore a dense state into the structure of `like` (same treedef)."""
     import jax

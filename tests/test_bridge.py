@@ -110,6 +110,109 @@ def test_dense_grid_over_bridge(client):
     assert merged0 == merged1 == {1: 50, 3: 30}  # id 2 removed by tombstone
 
 
+def test_dense_grid_topk_over_bridge(client):
+    client.grid_new("gtk", "topk", n_replicas=2, n_keys=1, n_ids=32, size=2)
+    client.grid_apply("gtk", [
+        [(Atom("add"), 0, 1, 50), (Atom("add"), 0, 2, 40), (Atom("add"), 0, 3, 60)],
+        [(Atom("add"), 0, 4, 99)],
+    ])
+    assert dict(client.grid_observe("gtk", 0)) == {1: 50, 3: 60}
+    client.grid_merge_all("gtk")
+    # K=2 board over the joined table: 99 and 60 win, on every replica.
+    assert dict(client.grid_observe("gtk", 0)) == {3: 60, 4: 99}
+    assert dict(client.grid_observe("gtk", 1)) == {3: 60, 4: 99}
+    with pytest.raises(Exception, match="out of range"):
+        client.grid_apply("gtk", [[(Atom("add"), 0, 999, 1)], []])
+
+
+def test_dense_grid_leaderboard_over_bridge(client):
+    client.grid_new("glb", "leaderboard", n_replicas=2, n_keys=1,
+                    n_players=16, size=3)
+    client.grid_apply("glb", [
+        [(Atom("add"), 0, 1, 10), (Atom("add"), 0, 2, 20)],
+        [(Atom("add"), 0, 3, 30), (Atom("ban"), 0, 2)],
+    ])
+    client.grid_merge_all("glb")
+    # Ban wins over any add (leaderboard.erl:494-499): 2 is out everywhere.
+    assert dict(client.grid_observe("glb", 0)) == {1: 10, 3: 30}
+    assert dict(client.grid_observe("glb", 1)) == {1: 10, 3: 30}
+    with pytest.raises(Exception, match="unknown grid op tag"):
+        client.grid_apply("glb", [[(Atom("rmv"), 0, 1)], []])
+
+
+def test_dense_grid_average_over_bridge(client):
+    client.grid_new("gav", "average", n_replicas=3, n_keys=2)
+    client.grid_apply("gav", [
+        [(Atom("add"), 0, 10, 1), (Atom("add"), 1, 8, 2)],
+        [(Atom("add"), 0, 20, 1)],
+        [],
+    ])
+    assert client.grid_observe("gav", 0, 0) == (10, 1)
+    assert client.grid_observe("gav", 1, 0) == (20, 1)
+    client.grid_merge_all("gav")
+    # MONOID fold: the total lands in row 0, other rows reset to identity
+    # (rows are deltas — broadcasting a fold would R-multiply the total).
+    assert client.grid_observe("gav", 0, 0) == (30, 2)
+    assert client.grid_observe("gav", 0, 1) == (8, 2)
+    assert client.grid_observe("gav", 1, 0) == (0, 0)
+    # Idempotent at the total level: merging again changes nothing.
+    client.grid_merge_all("gav")
+    assert client.grid_observe("gav", 0, 0) == (30, 2)
+    # Accumulation continues after a fold without double counting.
+    client.grid_apply("gav", [[], [(Atom("add"), 0, 5, 1)], []])
+    client.grid_merge_all("gav")
+    assert client.grid_observe("gav", 0, 0) == (35, 3)
+    with pytest.raises(Exception, match="count=-1 out of range"):
+        client.grid_apply("gav", [[(Atom("add"), 0, 1, -1)], [], []])
+
+
+def test_dense_grid_wordcount_over_bridge(client):
+    client.grid_new("gwc", "wordcount", n_replicas=2, n_keys=1, n_buckets=8)
+    client.grid_apply("gwc", [
+        [(Atom("add"), 0, 3), (Atom("add"), 0, 3), (Atom("add"), 0, 5)],
+        [(Atom("add"), 0, 3)],
+    ])
+    assert dict(client.grid_observe("gwc", 0)) == {3: 2, 5: 1}
+    client.grid_merge_all("gwc")
+    assert dict(client.grid_observe("gwc", 0)) == {3: 3, 5: 1}
+    assert client.grid_observe("gwc", 1) == []
+    with pytest.raises(Exception, match="token=9 out of range"):
+        client.grid_apply("gwc", [[(Atom("add"), 0, 9)], []])
+    # worddocumentcount shares the kernel but is its own registered grid
+    # type (dedup is an encode-time concern, worddocumentcount.erl:76-86).
+    client.grid_new("gwd", "worddocumentcount", n_replicas=1, n_keys=1,
+                    n_buckets=4)
+    client.grid_apply("gwd", [[(Atom("add"), 0, 1)]])
+    assert dict(client.grid_observe("gwd", 0)) == {1: 1}
+
+
+def test_dense_grid_snapshot_roundtrip_all_types(client):
+    """grid_to_binary/grid_from_binary for every grid type: the snapshot
+    carries its own type + geometry, the restored grid answers observes."""
+    cases = [
+        ("topk", dict(n_replicas=2, n_keys=1, n_ids=16, size=2),
+         [[(Atom("add"), 0, 1, 7)], []]),
+        ("leaderboard", dict(n_replicas=2, n_keys=1, n_players=8, size=2),
+         [[(Atom("add"), 0, 1, 7)], [(Atom("ban"), 0, 3)]]),
+        ("average", dict(n_replicas=2, n_keys=1),
+         [[(Atom("add"), 0, 6, 2)], []]),
+        ("wordcount", dict(n_replicas=2, n_keys=1, n_buckets=8),
+         [[(Atom("add"), 0, 2)], []]),
+    ]
+    for tname, params, ops in cases:
+        src, dst = f"snap_src_{tname}", f"snap_dst_{tname}"
+        client.grid_new(src, tname, **params)
+        client.grid_apply(src, ops)
+        blob = client.grid_to_binary(src)
+        client.grid_from_binary(dst, blob)
+        assert client.grid_observe(dst, 0) == client.grid_observe(src, 0), tname
+
+
+def test_grid_rejects_unknown_type(client):
+    with pytest.raises(Exception, match="dense grids support"):
+        client.grid_new("gx", "no_such_type", n_replicas=1)
+
+
 def test_grid_rejects_bad_ops(client):
     client.grid_new("gv", n_replicas=1, n_keys=1, n_ids=8, n_dcs=2, size=2)
     with pytest.raises(Exception, match="unknown grid op tag"):

@@ -124,6 +124,19 @@ REQUESTS = [
      {A("n_replicas"): 2, A("n_keys"): 1, A("n_ids"): 64}),
     (A("grid_apply"), A("g"),
      [[(A("add"), 0, 1, 10, 0, 1)], [(A("rmv"), 0, 1, [(0, 1)])]]),
+    # Round-3 widening: every registered dense type gets the grid surface;
+    # one golden request per new op shape.
+    (A("grid_new"), A("ga"), A("average"), {A("n_replicas"): 2}),
+    (A("grid_apply"), A("ga"), [[(A("add"), 0, 10, 1)], []]),
+    (A("grid_new"), A("gw"), A("wordcount"),
+     {A("n_replicas"): 2, A("n_buckets"): 64}),
+    (A("grid_apply"), A("gw"), [[(A("add"), 0, 3)], []]),
+    (A("grid_new"), A("gt"), A("topk"),
+     {A("n_replicas"): 2, A("n_ids"): 64, A("size"): 4}),
+    (A("grid_apply"), A("gt"), [[(A("add"), 0, 1, 10)], []]),
+    (A("grid_new"), A("gl"), A("leaderboard"),
+     {A("n_replicas"): 2, A("n_players"): 64, A("size"): 4}),
+    (A("grid_apply"), A("gl"), [[(A("add"), 0, 1, 10)], [(A("ban"), 0, 1)]]),
     (A("grid_merge_all"), A("g")),
     (A("grid_observe"), A("g"), 0, 0),
     (A("grid_to_binary"), A("g")),
@@ -199,6 +212,23 @@ def test_raw_socket_session_like_an_erlang_client(server, legacy):
         h3 = rt(9, (A("batch_merge"), A("average"), [h, blob]))
         assert rt(10, (A("value"), h3)) == 5.0  # (5+5)/(1+1)
         assert rt(11, (A("free"), h3)) is True
+
+        # Dense grids beyond the flagship, raw bytes end to end: a MONOID
+        # grid (average) and a JOIN grid (leaderboard).
+        assert rt(12, (A("grid_new"), A("ga"), A("average"),
+                       {A("n_replicas"): 2, A("n_keys"): 1})) is True
+        assert rt(13, (A("grid_apply"), A("ga"),
+                       [[(A("add"), 0, 10, 1)], [(A("add"), 0, 20, 1)]])) == 0
+        assert rt(14, (A("grid_merge_all"), A("ga"))) is True
+        assert rt(15, (A("grid_observe"), A("ga"), 0, 0)) == (30, 2)
+        assert rt(16, (A("grid_new"), A("gl"), A("leaderboard"),
+                       {A("n_replicas"): 2, A("n_players"): 8,
+                        A("size"): 2})) is True
+        assert rt(17, (A("grid_apply"), A("gl"),
+                       [[(A("add"), 0, 1, 10)], [(A("ban"), 0, 1),
+                                                 (A("add"), 0, 2, 5)]])) == 0
+        assert rt(18, (A("grid_merge_all"), A("gl"))) is True
+        assert rt(19, (A("grid_observe"), A("gl"), 0, 0)) == [(2, 5)]
 
 
 # --- live escript (only when OTP is present) ------------------------------

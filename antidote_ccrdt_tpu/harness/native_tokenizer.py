@@ -36,14 +36,32 @@ def _ensure_lib():
     if _lib is not None or _build_error is not None:
         return _lib
     try:
-        if not os.path.exists(_LIB_PATH):
+        # Run make whenever the source tree is present — it is a no-op
+        # when the .so is current, and it rebuilds a STALE one (a cached
+        # build from before a symbol was added would otherwise load and
+        # crash the bindings below). A prebuilt .so without sources
+        # (CCRDT_NATIVE_DIR at an installed tree) skips the build.
+        if os.path.exists(os.path.join(_NATIVE_DIR, "Makefile")):
             subprocess.run(
                 ["make", "-C", _NATIVE_DIR],
-                check=True,
+                check=not os.path.exists(_LIB_PATH),
                 capture_output=True,
                 text=True,
             )
         lib = ctypes.CDLL(_LIB_PATH)
+        # Belt and braces: an old library that survived the rebuild (or a
+        # prebuilt one) must fail CLEANLY into the pure-Python fallback,
+        # not AttributeError out of available().
+        for sym in (
+            "ccrdt_tok_new", "ccrdt_tok_free", "ccrdt_tok_encode",
+            "ccrdt_tok_encode_batch", "ccrdt_tok_encode_batch_mt",
+            "ccrdt_tok_vocab_size", "ccrdt_tok_vocab_dump",
+        ):
+            if not hasattr(lib, sym):
+                raise OSError(
+                    f"{_LIB_PATH} is stale: missing {sym} (make clean "
+                    "&& make in native/)"
+                )
     except (subprocess.CalledProcessError, OSError) as e:
         _build_error = str(e)
         return None
@@ -61,6 +79,11 @@ def _ensure_lib():
     lib.ccrdt_tok_encode_batch.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, i64p, ctypes.c_int,
         ctypes.c_int, i32p, ctypes.c_int64, i64p,
+    ]
+    lib.ccrdt_tok_encode_batch_mt.restype = ctypes.c_int64
+    lib.ccrdt_tok_encode_batch_mt.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, i64p, ctypes.c_int,
+        ctypes.c_int, i32p, ctypes.c_int64, i64p, ctypes.c_int,
     ]
     lib.ccrdt_tok_vocab_size.restype = ctypes.c_int64
     lib.ccrdt_tok_vocab_size.argtypes = [ctypes.c_void_p]
@@ -106,9 +129,18 @@ class NativeTokenizer:
             self._h = None
 
     def encode_batch(
-        self, docs: Sequence[str], per_document: bool = False
+        self,
+        docs: Sequence[str],
+        per_document: bool = False,
+        threads: int = 0,
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Tokenize+encode a document batch in one C call.
+
+        `threads`: 0 = hardware thread count, 1 = serial, N = pool of N.
+        Documents are independent, so the pool splits the batch by byte
+        ranges; exact-mode vocabulary ids stay bit-identical to the serial
+        encode (thread-local vocabs folded in document order — see the
+        .cpp header). The C call releases the GIL either way.
 
         Returns (token_ids i32[N], doc_end i64[n_docs]) where document i's
         tokens span token_ids[doc_end[i-1]:doc_end[i]].
@@ -123,7 +155,7 @@ class NativeTokenizer:
         cap = len(buf) + len(blobs)
         out = np.empty(cap, np.int32)
         doc_end = np.empty(len(blobs), np.int64)
-        n = self._lib.ccrdt_tok_encode_batch(
+        n = self._lib.ccrdt_tok_encode_batch_mt(
             self._h,
             buf,
             offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
@@ -132,6 +164,7 @@ class NativeTokenizer:
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             cap,
             doc_end.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            threads,
         )
         assert n <= cap, (n, cap)  # cap is a proven upper bound
         return out[:n].copy(), doc_end
@@ -167,7 +200,7 @@ def wordcount_ops_from_docs(
 
     tok = NativeTokenizer(n_buckets)
     encoded = [
-        tok.encode_batch(docs, per_document=per_document)[0]
+        tok.encode_batch(docs, per_document=per_document, threads=0)[0]
         for docs in docs_per_replica
     ]
     B = max((len(e) for e in encoded), default=0)
@@ -219,7 +252,7 @@ def worddoc_arrays_from_docs(
     tok = NativeTokenizer(0)  # exact mode
     encoded = []
     for docs in docs_per_replica:
-        toks, doc_end = tok.encode_batch(docs, per_document=False)
+        toks, doc_end = tok.encode_batch(docs, per_document=False, threads=0)
         lengths = np.diff(np.concatenate([[0], doc_end]))
         encoded.append((toks, np.repeat(np.arange(len(docs)), lengths)))
     bucket_of = fnv1a_buckets(tok.vocab(), n_buckets)

@@ -645,3 +645,162 @@ def make_id_sharded_topk(
         key_axis=key_axis,
         dc_axis=dc_axis,
     )
+
+
+# --- vocab-space-sharded wordcount (MONOID: psum reconciliation) ----------
+
+
+@dataclasses.dataclass(frozen=True)
+class VocabShardedWordcount:
+    """One wordcount instance whose VOCAB space is sharded over a mesh
+    axis — the MONOID member of the id-space-sharding family (SURVEY §5
+    key-space sharding row). Same data movement as the JOIN engines:
+    the count table never moves, ops are replicated over 'key' and each
+    shard masks the token batch to its bucket range; but reconciliation
+    is a `psum` over 'dc' (replica rows are deltas, MergeKind.MONOID) —
+    no frontier exchange, reads are already local per shard.
+
+    Out-of-global-range tokens are counted in `lost` by shard 0 only
+    (every shard sees every op; without a canonical owner the lost
+    counter would multiply by n_shards). Within-shard overflow cannot
+    happen: the mask rebases tokens into [0, V_local).
+
+    Compiled entry points are built once per instance (cached_property —
+    cf. _ShardedScoreTable's retrace note)."""
+
+    inner: Any  # WordcountDense over V_local buckets
+    mesh: Mesh
+    n_replicas: int
+    key_axis: str = "key"
+    dc_axis: str = "dc"
+
+    @property
+    def n_shards(self) -> int:
+        return self.mesh.shape[self.key_axis]
+
+    @property
+    def v_global(self) -> int:
+        return self.inner.V * self.n_shards
+
+    def _state_spec(self):
+        from ..models.wordcount import WordcountDenseState
+
+        # counts shard their bucket axis; lost gains an explicit shard
+        # axis at position 1 (same move as IdShardedTopkRmv's vc/lossy).
+        return WordcountDenseState(
+            counts=P(self.dc_axis, None, self.key_axis),
+            lost=P(self.dc_axis, self.key_axis),
+        )
+
+    def init(self) -> Any:
+        from ..models.wordcount import WordcountDenseState
+
+        R, NK = self.n_replicas, 1
+        state = WordcountDenseState(
+            counts=jnp.zeros((R, NK, self.v_global), jnp.int32),
+            lost=jnp.zeros((R, self.n_shards, NK), jnp.int32),
+        )
+        return jax.tree.map(
+            lambda a, s: jax.device_put(a, NamedSharding(self.mesh, s)),
+            state,
+            self._state_spec(),
+        )
+
+    def _mask_to_shard(self, ops: Any) -> Any:
+        from ..models.wordcount import WordcountOps
+
+        V_loc = self.inner.V
+        shard = lax.axis_index(self.key_axis)
+        lo = shard * V_loc
+        valid = ops.token >= 0
+        mine = valid & (ops.token >= lo) & (ops.token < lo + V_loc)
+        # Global overflow: only shard 0 counts it (token V_loc lands in
+        # the inner engine's lost path).
+        over = valid & (ops.token >= self.v_global) & (shard == 0)
+        token = jnp.where(mine, ops.token - lo, jnp.where(over, V_loc, -1))
+        return WordcountOps(key=ops.key, token=token)
+
+    @functools.cached_property
+    def _apply_compiled(self):
+        from ..models.wordcount import WordcountDenseState, WordcountOps
+
+        spec_state = self._state_spec()
+        spec_ops = WordcountOps(P(self.dc_axis), P(self.dc_axis))
+
+        def local(st, op):
+            st_l = WordcountDenseState(counts=st.counts, lost=st.lost[:, 0])
+            st2, _ = self.inner.apply_ops(st_l, self._mask_to_shard(op))
+            return WordcountDenseState(
+                counts=st2.counts, lost=st2.lost[:, None]
+            )
+
+        return jax.jit(
+            shard_map(
+                local,
+                mesh=self.mesh,
+                in_specs=(spec_state, spec_ops),
+                out_specs=spec_state,
+                check_vma=False,
+            )
+        )
+
+    def apply_ops(self, state: Any, ops: Any) -> Any:
+        """`ops` carry GLOBAL bucket ids, one batch per replica row,
+        replicated over the vocab shards (they are small; the table is
+        what must not move)."""
+        return self._apply_compiled(state, ops)
+
+    @functools.cached_property
+    def _reduce_compiled(self):
+        from ..models.wordcount import WordcountDenseState
+
+        spec_state = self._state_spec()
+
+        def local(st):
+            # Replica rows are deltas: the reconciled value is their SUM
+            # (psum over 'dc' — the MONOID plane), shard-local in vocab.
+            counts = lax.psum(jnp.sum(st.counts, axis=0), self.dc_axis)
+            lost = lax.psum(jnp.sum(st.lost, axis=0), self.dc_axis)
+            return WordcountDenseState(counts=counts, lost=lost)
+
+        return jax.jit(
+            shard_map(
+                local,
+                mesh=self.mesh,
+                in_specs=(spec_state,),
+                out_specs=WordcountDenseState(
+                    counts=P(None, self.key_axis),
+                    lost=P(self.key_axis),
+                ),
+                check_vma=False,
+            )
+        )
+
+    def global_counts(self, state: Any):
+        """Reconciled global (counts [NK, V_global], lost [n_shards, NK])
+        — counts stay vocab-sharded on the mesh (the read is local per
+        shard); `lost` sums to the global overflow count."""
+        return self._reduce_compiled(state)
+
+
+def make_vocab_sharded_wordcount(
+    mesh: Mesh,
+    n_buckets_global: int,
+    n_replicas: int | None = None,
+    key_axis: str = "key",
+    dc_axis: str = "dc",
+) -> VocabShardedWordcount:
+    from ..models.wordcount import make_dense as mk_wc
+
+    n_shards = mesh.shape[key_axis]
+    assert n_buckets_global % n_shards == 0, (n_buckets_global, n_shards)
+    inner = mk_wc(n_buckets_global // n_shards)
+    if n_replicas is None:
+        n_replicas = mesh.shape[dc_axis]
+    return VocabShardedWordcount(
+        inner=inner,
+        mesh=mesh,
+        n_replicas=n_replicas,
+        key_axis=key_axis,
+        dc_axis=dc_axis,
+    )

@@ -39,6 +39,9 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 # the roofline block is computed against this; on the CPU fallback backend
 # the fraction is not meaningful (the JSON carries the backend name).
 HBM_PEAK_GB_S = 819.0
+# TPU v5e MXU int8 peak (public spec: 394 TOPS/chip; the tombstone one-hot
+# matmul is the s8 x s8 -> s32 native path, ops = 2 * MACs).
+MXU_INT8_PEAK_TOPS = 394.0
 
 # Shared measurement discipline (host-readback sync, round stacking); see
 # utils/benchtime.py for why block_until_ready is not enough here.
@@ -222,9 +225,9 @@ def bench_dense(R, I, D_DCS, K, M, B, Br, windows, rounds_per_window):
     # --- roofline: analytic bytes touched per phase vs HBM peak ----------
     # Minimum-traffic accounting (each array touched once; intermediates
     # assumed fused). This workload is bandwidth-bound only on the
-    # full-state merge; apply is compute-bound (the tombstone one-hot MXU
-    # matmul + the join's M x M cross-compares), so its fraction-of-peak is
-    # expected to be low — reported anyway so the floor claim is checkable.
+    # full-state merge; apply sits above every peak floor — the compute
+    # block below (compute_model) quantifies what actually binds it
+    # (scheduling/serialized small ops, with the measured evidence).
     # These rows are MEAN-based throughputs, so the single measured
     # dispatch RTT per timed call (dispatch_overhead_ms_p50) is subtracted
     # once — valid for means, unlike the tail estimators above.
@@ -258,11 +261,87 @@ def bench_dense(R, I, D_DCS, K, M, B, Br, windows, rounds_per_window):
             "frac_of_peak": round(gbps / HBM_PEAK_GB_S, 4),
         }
 
+    compute = compute_model(
+        R, 1, I, D_DCS, M, B, Br,
+        apply_ms=adj(window_med, W) * 1e3,
+        apply_hbm_bytes=hbm["apply"]["bytes_per_dispatch"],
+    )
+
     return (
         apply_rate, extras_rate, extras_ops_rate, p50_ms, p99_ms,
         p50_e2e_ms, p99_e2e_ms, dispatch_overhead_ms,
-        state_merges_per_sec, hbm,
+        state_merges_per_sec, hbm, compute,
     )
+
+
+def compute_model(R, NK, I, D_DCS, M, B, Br, apply_ms, apply_hbm_bytes):
+    """Analytic compute roofline for the apply phase (VERDICT-r2 task 2):
+    per-piece op counts from the kernel shapes, peak-based floors, and the
+    measured removal-ablation attribution, so "what binds apply" is a
+    number, not a claim.
+
+    Piece models (see models/topk_rmv_dense.py for the kernels):
+    * tombstones — `scatter_max_rows_mxu`: one s8 one-hot [Br, NK*I] x
+      plane matrix [Br, 5*D] matmul per replica; MACs = R*Br*NK*I*5*D.
+    * delta build — a 4-operand/4-key sort over B per replica plus three
+      scalar 2-D scatters; no peak model (bitonic sort networks and XLA's
+      serialized scatter loop are latency-bound, not throughput-bound) —
+      the op counts are reported for scale.
+    * join — elementwise add-wins filter + rank-arithmetic merge over
+      [R, NK, I, 2M] plus a 2M-wide sort per id.
+
+    Measured verdict (round 3, v5e, B=32768/Br=2048 — repro commands in
+    the fields): the round sits ~5-10x above EVERY peak floor, yet three
+    independent restructurings that attack the dominant modeled resource
+    all REGRESS in composition: block-bucketed one-hot (32x fewer MACs)
+    62.6 -> 87.5ms, runtime-adaptive 3-plane packing 62.6 -> 70.1ms
+    (benchmarks/tomb_bucket_probe.py), and the pallas tombstone kernel
+    40 -> 103ms (round 2, benchmarks/ablate_apply.py). The binding
+    constraint is XLA's scheduling/serialization of the fused small-op
+    chain (sorts, scatters, cross-piece fusion), not MXU, VPU, or HBM
+    peak — which the attribution corroborates: removal deltas sum to
+    ~37ms of a ~62ms round; the residual ~25ms is fusion/scheduling that
+    no piece owns."""
+    T = NK * I
+    planes = 5
+    macs = R * Br * T * planes * D_DCS
+    mxu_floor_ms = macs * 2 / (MXU_INT8_PEAK_TOPS * 1e12) * 1e3
+    hbm_floor_ms = apply_hbm_bytes / (HBM_PEAK_GB_S * 1e9) * 1e3
+    floor_ms = max(mxu_floor_ms, hbm_floor_ms)
+    # The ablation attribution is a v5e measurement at the north-star
+    # shapes — attach it only where it applies (not tiny/CPU configs).
+    attribution = (
+        {
+            "tombstones": 14.6, "delta_build": 20.9, "join": 1.2,
+            "residual_fusion": round(62.1 - 14.6 - 20.9 - 1.2, 1),
+            "full_round": 62.1,
+            "repro": "ABLATE_B=32768 ABLATE_BR=2048 python "
+                     "benchmarks/ablate_apply.py",
+        }
+        if (R, I, B, Br) == (32, 100_000, 32768, 2048)
+        else None
+    )
+    return {
+        "apply": {
+            "measured_ms": round(apply_ms, 2),
+            "mxu": {
+                "tombstone_onehot_macs": int(macs),
+                "int8_peak_tops": MXU_INT8_PEAK_TOPS,
+                "floor_ms": round(mxu_floor_ms, 2),
+            },
+            "hbm_floor_ms": round(hbm_floor_ms, 2),
+            "floor_ms": round(floor_ms, 2),
+            "headroom_vs_floor_x": round(apply_ms / max(floor_ms, 1e-9), 1),
+            "sort_elems": int(R * B * 6),
+            "scatter_rows": int(R * B * 3),
+            "join_elementwise_ops": int(R * T * 2 * M * 12),
+            "attribution_ms_r3": attribution,
+            "binding_constraint": (
+                "xla-scheduling/serialized-small-ops; MAC-cutting "
+                "restructurings regress (benchmarks/tomb_bucket_probe.py)"
+            ),
+        },
+    }
 
 
 def bench_scalar_baseline(R, I, D_DCS, K, n_ops):
@@ -322,7 +401,7 @@ def main():
     (
         apply_rate, extras_rate, extras_ops_rate, p50_ms, p99_ms,
         p50_e2e_ms, p99_e2e_ms, dispatch_overhead_ms,
-        state_merge_rate, hbm,
+        state_merge_rate, hbm, compute,
     ) = bench_dense(R, I, D_DCS, K, M, B, Br, windows, W)
     baseline_rate = bench_scalar_baseline(R, I, D_DCS, K, base_ops)
 
@@ -339,6 +418,13 @@ def main():
                 "p99_round_ms_e2e": round(p99_e2e_ms, 2),
                 "dispatch_overhead_ms_p50": round(dispatch_overhead_ms, 2),
                 "hbm": hbm,
+                "compute": compute,
+                # extras_mode disambiguates the two rates below (ADVICE-r2
+                # item 3): "table" is the id-keyed dominated table (the
+                # replication-path default), "op_aligned" the legacy
+                # per-op gather mode — same key names across rounds used
+                # to read a methodology switch as a speedup.
+                "extras_mode": "table",
                 "merges_per_sec_with_extras": round(extras_rate),
                 "merges_per_sec_with_extras_op_aligned": round(extras_ops_rate),
                 "replica_state_merges_per_sec": round(state_merge_rate, 1),

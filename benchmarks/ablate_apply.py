@@ -30,7 +30,13 @@ from antidote_ccrdt_tpu.models.topk_rmv_dense import (
     make_dense,
 )
 
-R, NK, I, D_DCS, K, M, B, Br, REPS = 32, 1, 100_000, 32, 100, 4, 16384, 1024, 12
+R, NK, I, D_DCS, K, M = 32, 1, 100_000, 32, 100, 4
+# Batch shapes overridable from the env: the docstring attributions were
+# taken at B=16384/Br=1024; bench.py's north star is B=32768/Br=2048
+# (ABLATE_B=32768 ABLATE_BR=2048 reproduces the compute-block numbers).
+B = int(os.environ.get("ABLATE_B", 16384))
+Br = int(os.environ.get("ABLATE_BR", 1024))
+REPS = int(os.environ.get("ABLATE_REPS", 12))
 D = make_dense(n_ids=I, n_dcs=D_DCS, size=K, slots_per_id=M)
 state0 = D.init(n_replicas=R, n_keys=1)
 gen = TopkRmvEffectGen(Workload(n_replicas=R, n_ids=I, zipf_a=1.2, score_max=100_000, seed=7))

@@ -1,0 +1,98 @@
+"""Probe: slot-plane layout for the observe/read path (VERDICT-r2 task 4).
+
+`observe` reads slot 0 of the [R, NK, I, M] slot arrays — 1 of M=4 words
+per 16B line, ~4x read amplification by layout (BASELINE.md roofline:
+26.4 GB/s achieved, 3.2% of peak). Candidates measured at north-star
+shapes:
+
+  strided   — production: masked_topk over state.slot_score[..., 0]
+  planes    — slot-0 pre-split into contiguous [R, NK, I] planes (what a
+              plane-split state layout would give observe for free); the
+              split cost itself is measured separately (split_ms) since a
+              real adoption would pay it in apply/merge writes instead
+  planes+ts — contiguous planes for the ts/dc positional gathers too
+
+Also reports the pure traffic floor: 38.4MB useful at 819GB/s = 47us, so
+anything in the ~1ms range is latency/sort-bound, not bandwidth-bound —
+the number that decides whether the layout change can pay at all.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from antidote_ccrdt_tpu.harness.opgen import TopkRmvEffectGen, Workload
+from antidote_ccrdt_tpu.models.topk_rmv_dense import Observed, make_dense
+from antidote_ccrdt_tpu.ops.dense_table import masked_topk
+
+R, NK, I, D_DCS, K, M, REPS = 32, 1, 100_000, 32, 100, 4, 50
+
+D = make_dense(n_ids=I, n_dcs=D_DCS, size=K, slots_per_id=M)
+gen = TopkRmvEffectGen(Workload(n_replicas=R, n_ids=I, zipf_a=1.2, score_max=100_000, seed=7))
+state = D.init(n_replicas=R, n_keys=1)
+state, _ = D.apply_ops(state, gen.next_batch(32768, 2048), collect_dominated=False)
+
+
+def sync(x):
+    return np.asarray(jax.tree.leaves(x)[0].ravel()[0])
+
+
+def timeit(name, fn, *args):
+    @jax.jit
+    def run(*a):
+        def body(c, _):
+            out = fn(*a)
+            # fold the output into the carry so the scan can't hoist it
+            return c + out.scores[0, 0, 0], ()
+        out, _ = lax.scan(body, jnp.int32(0), None, length=REPS)
+        return out
+
+    sync(run(*args))
+    t0 = time.perf_counter()
+    out = run(*args)
+    sync(out)
+    dt = (time.perf_counter() - t0) / REPS * 1e3
+    print(f"{name:32s} {dt:9.3f} ms   ({38.4 / dt:8.1f} GB/s useful)")
+    return dt
+
+
+def observe_planes(score0, dc0, ts0):
+    id_f, score_f, _ = masked_topk(score0, min(K, I))
+    gi = jnp.clip(id_f, 0)
+    ts_f = jnp.take_along_axis(ts0, gi, axis=-1)
+    dc_f = jnp.take_along_axis(dc0, gi, axis=-1)
+    valid = (ts_f > 0) & (id_f >= 0)
+    return Observed(id_f, score_f, dc_f, ts_f, valid)
+
+
+if __name__ == "__main__":
+    timeit("strided (production observe)", D.observe, state)
+    # Pre-materialized contiguous planes (copy cost excluded — a plane
+    # layout would produce them as the natural state).
+    score0 = jnp.copy(state.slot_score[..., 0])
+    dc0 = jnp.copy(state.slot_dc[..., 0])
+    ts0 = jnp.copy(state.slot_ts[..., 0])
+    sync((score0, dc0, ts0))
+    timeit("planes (contiguous slot-0)", observe_planes, score0, dc0, ts0)
+
+    # The split cost a non-plane state would pay per observe instead.
+    @jax.jit
+    def split(st):
+        return (
+            jnp.copy(st.slot_score[..., 0]),
+            jnp.copy(st.slot_dc[..., 0]),
+            jnp.copy(st.slot_ts[..., 0]),
+        )
+
+    sync(split(state))
+    t0 = time.perf_counter()
+    for _ in range(8):
+        out = split(state)
+    sync(out)
+    print(f"{'split cost (3 strided copies)':32s} {(time.perf_counter()-t0)/8*1e3:9.3f} ms")

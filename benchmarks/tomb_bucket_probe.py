@@ -1,0 +1,244 @@
+"""Probe: block-bucketed one-hot tombstone update vs the full one-hot.
+
+VERDICT-r2 task 2 candidate: the production `scatter_max_rows_mxu`
+multiplies a [Br, T] one-hot against T=100k rows to update <= Br=2048 —
+MACs = Br * T * 5D per replica. A two-level decomposition buckets the
+(deduped, sorted) update rows by table block first:
+
+  level 1: route each update into its block bucket [NB, CAP, ...]
+           (NB = T/Bk blocks, CAP slots per block; rank-within-block via
+           the same segmented-rank idiom as the delta build);
+  level 2: expand each bucket onto its block's rows and max into the
+           table — one small batched matmul over planes, contracting CAP
+           instead of Br: MACs = T * CAP * 5D.
+
+MAC ratio vs full: Br / CAP (2048/64 = 32x fewer). CAP overflow (an
+adversarial batch concentrating > CAP distinct removal ids in one
+512-row block) falls back to the full one-hot via lax.cond — both
+branches return the same [T, D] table, typical batches take the fast
+path.
+
+Variants measured INSIDE the full apply at north-star shapes (the pallas
+lesson: isolated wins can compose into regressions):
+  full      — production scatter_max_rows_mxu
+  bucketM   — bucket via small one-hot matmul, expand via planes matmul
+  bucketS   — bucket via scalar 2-D scatters, expand via planes matmul
+
+Honest timing: scan-fused windows + host-readback sync (benchtime).
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from antidote_ccrdt_tpu.harness.opgen import TopkRmvEffectGen, Workload
+from antidote_ccrdt_tpu.models.topk_rmv_dense import make_dense
+from antidote_ccrdt_tpu.ops.dense_table import dedup_rows_run_max
+
+R, NK, I, D_DCS, K, M, B, Br, REPS = 32, 1, 100_000, 32, 100, 4, 32768, 2048, 8
+BK, CAP = 512, 64
+N_PLANES = 5
+
+D = make_dense(n_ids=I, n_dcs=D_DCS, size=K, slots_per_id=M)
+state0 = D.init(n_replicas=R, n_keys=1)
+gen = TopkRmvEffectGen(Workload(n_replicas=R, n_ids=I, zipf_a=1.2, score_max=100_000, seed=7))
+warm = gen.next_batch(B, Br)
+state0, _ = D.apply_ops(state0, warm, collect_dominated=False)
+stacked = jax.tree.map(
+    lambda *xs: jnp.stack(xs), *[gen.next_batch(B, Br) for _ in range(REPS)]
+)
+
+
+def sync(x):
+    return np.asarray(jax.tree.leaves(x)[0].ravel()[0])
+
+
+def bucketed_scatter_max(table, rows, upd, via_matmul):
+    """table.at[rows].max(upd) via block bucketing; exact fallback to the
+    full one-hot when any block overflows CAP."""
+    from antidote_ccrdt_tpu.ops.dense_table import scatter_max_rows_mxu
+
+    T, Dl = table.shape
+    NB = (T + BK - 1) // BK
+    head_rows, total = dedup_rows_run_max(rows, upd, T)  # sorted by row
+    # Compact: stable-sort by block so dedup sentinels (head_rows == T,
+    # block NB) move to the end and same-block heads become contiguous —
+    # otherwise sentinel interludes reset the segmented rank mid-block
+    # and two heads collide on (block, rank).
+    blk0 = jnp.where(head_rows < T, head_rows // BK, NB)
+    order = jnp.argsort(blk0)  # stable: row order preserved within block
+    blk = blk0[order]
+    hr = head_rows[order]
+    total = total[order]
+    valid = hr < T
+    off = jnp.where(valid, hr % BK, BK)
+    grp_start = (blk != jnp.roll(blk, 1)).at[0].set(True)
+    c = jnp.cumsum(valid.astype(jnp.int32))
+    base = lax.cummax(jnp.where(grp_start, c - valid.astype(jnp.int32), -1))
+    rank = c - valid.astype(jnp.int32) - base
+    overflow = jnp.any(valid & (rank >= CAP))
+    slot = jnp.where(valid & (rank < CAP), blk * CAP + rank, NB * CAP)
+    head_rows = hr
+
+    def fast(args):
+        table, head_rows, total = args
+        planes = jnp.stack(
+            [((total >> (7 * k)) & 0x7F).astype(jnp.int8) for k in range(N_PLANES)],
+            axis=-1,
+        ).reshape(Br, N_PLANES * Dl)  # [Br, 5D] (plane-major per lane)
+        if via_matmul:
+            onehot = (
+                slot[:, None] == jnp.arange(NB * CAP, dtype=jnp.int32)[None, :]
+            ).astype(jnp.int8)  # [Br, NB*CAP]
+            val_tbl = lax.dot_general(
+                onehot, planes, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            ).astype(jnp.int8).reshape(NB, CAP, N_PLANES * Dl)
+            # off in [0, BK) needs two s8 planes (BK=512 > 127).
+            off_pl = jnp.stack(
+                [((off + 1) & 0x7F).astype(jnp.int8),
+                 (((off + 1) >> 7) & 0x7F).astype(jnp.int8)], axis=-1
+            )  # [Br, 2]
+            op_out = lax.dot_general(
+                onehot, off_pl, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )  # [NB*CAP, 2]
+            off_tbl = (
+                (op_out[:, 0] | (op_out[:, 1] << 7)) - 1
+            ).reshape(NB, CAP)  # empty -> -1
+        else:
+            val_tbl = (
+                jnp.zeros((NB * CAP + 1, N_PLANES * Dl), jnp.int8)
+                .at[slot].set(planes, mode="drop")[: NB * CAP]
+                .reshape(NB, CAP, N_PLANES * Dl)
+            )
+            off_tbl = (
+                jnp.full((NB * CAP + 1,), -1, jnp.int32)
+                .at[slot].set(off, mode="drop")[: NB * CAP]
+                .reshape(NB, CAP)
+            )
+        # level 2: expand buckets onto block rows (contract CAP on the MXU)
+        onehot2 = (
+            off_tbl[:, :, None] == jnp.arange(BK, dtype=jnp.int32)[None, None, :]
+        ).astype(jnp.int8)  # [NB, CAP, BK]
+        out = lax.dot_general(
+            onehot2, val_tbl,
+            (((1,), (1,)), ((0,), (0,))),  # contract CAP, batch NB
+            preferred_element_type=jnp.int32,
+        )  # [NB, BK, 5D]
+        delta = jnp.zeros((NB * BK, Dl), jnp.int32)
+        flat = out.reshape(NB * BK, N_PLANES, Dl)
+        for k in range(N_PLANES):
+            delta = delta | (flat[:, k, :] << (7 * k))
+        return jnp.maximum(table, delta[:T])
+
+    def slow(args):
+        table, head_rows, total = args
+        return scatter_max_rows_mxu(table, head_rows, total)
+
+    return lax.cond(overflow, slow, fast, (table, head_rows, total))
+
+
+def adaptive_scatter_max(table, rows, upd):
+    """Full one-hot, but with a runtime-adaptive plane count: vc entries
+    (logical-clock timestamps) usually fit 21 bits, so 3 of the 5 planes
+    carry zeros — skip them via lax.cond (same output shape either way).
+    MACs and the s32 out intermediate both scale with plane count."""
+    T, Dl = table.shape
+    head_rows, total = dedup_rows_run_max(rows, upd, T)
+    onehot = (
+        head_rows[:, None] == jnp.arange(T, dtype=jnp.int32)[None, :]
+    ).astype(jnp.int8)
+    fits = jnp.max(total) < (1 << 21)
+
+    def mk(n_planes):
+        def f(_):
+            planes = jnp.concatenate(
+                [((total >> (7 * k)) & 0x7F).astype(jnp.int8)
+                 for k in range(n_planes)], axis=-1,
+            )
+            out = lax.dot_general(
+                onehot, planes, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+            delta = jnp.zeros((T, Dl), jnp.int32)
+            for k in range(n_planes):
+                delta = delta | (out[:, k * Dl : (k + 1) * Dl] << (7 * k))
+            return jnp.maximum(table, delta)
+        return f
+
+    return lax.cond(fits, mk(3), mk(5), None)
+
+
+def make_step(mode):
+    def tombstones(state, ops):
+        Rl, NKl = state.rmv_vc.shape[:2]
+        rmv_valid = (
+            (ops.rmv_id >= 0) & (ops.rmv_id < I)
+            & (ops.rmv_key >= 0) & (ops.rmv_key < NKl)
+        )
+        rrow = jnp.where(rmv_valid, ops.rmv_key * I + ops.rmv_id, NKl * I)
+        table = state.rmv_vc.reshape(Rl, NKl * I, D_DCS)
+        if mode == "none":
+            out = table
+        elif mode == "full":
+            from antidote_ccrdt_tpu.ops.dense_table import scatter_max_rows_mxu
+
+            out = jax.vmap(scatter_max_rows_mxu)(table, rrow, ops.rmv_vc)
+        elif mode == "adaptive":
+            out = jax.vmap(adaptive_scatter_max)(table, rrow, ops.rmv_vc)
+        else:
+            out = jax.vmap(
+                lambda t, r, u: bucketed_scatter_max(t, r, u, mode == "bucketM")
+            )(table, rrow, ops.rmv_vc)
+        return out.reshape(Rl, NKl, I, D_DCS)
+
+    def step(st, ops):
+        import functools
+
+        rmv_vc_new = tombstones(st, ops)
+        new_state, _ = jax.vmap(
+            functools.partial(D._apply_one_replica, want_dominated_tbl=False)
+        )(st, ops, rmv_vc_new)
+        return new_state
+
+    return step
+
+
+def timeit(name, step_fn):
+    @jax.jit
+    def run(c, seq):
+        def body(c, ops):
+            return step_fn(c, ops), ()
+        out, _ = lax.scan(body, c, seq)
+        return out
+
+    sync(run(state0, stacked))
+    t0 = time.perf_counter()
+    out = run(state0, stacked)
+    sync(out)
+    dt = (time.perf_counter() - t0) / REPS * 1e3
+    print(f"{name:40s} {dt:9.2f} ms")
+    return out
+
+
+if __name__ == "__main__":
+    modes = sys.argv[1:] or ["full", "bucketM", "bucketS"]
+    outs = {}
+    for m in modes:
+        outs[m] = timeit(f"apply round, tombstones={m}", make_step(m))
+    # Equivalence: every variant must produce the identical state.
+    if "full" in outs:
+        ref = outs["full"]
+        for m, got in outs.items():
+            same = all(
+                bool(jnp.array_equal(a, b))
+                for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(got))
+            )
+            print(f"state[{m}] == state[full]: {same}")

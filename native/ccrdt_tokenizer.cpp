@@ -20,14 +20,28 @@
 //
 // Per-document dedup happens on the token STRING before hashing/encoding
 // (two distinct words colliding in hashed mode still contribute 2 to the
-// shared bucket — same as the Python path).
+// shared bucket — same as the Python path). The dedup set is a
+// generation-stamped open-addressing scratch table: resetting between
+// documents is one counter bump, no clears, no per-token allocation —
+// the unordered_set it replaced dominated the per-token cost.
+//
+// ccrdt_tok_encode_batch_mt runs the batch across a thread pool
+// (documents are independent). Hashed mode is embarrassingly parallel.
+// Exact mode runs two phases: threads tokenize against the (frozen)
+// global vocabulary, assigning thread-local ids to unseen tokens; a
+// serial remap pass then walks the output in document order and folds
+// the thread-local vocabularies into the global one — so global ids are
+// assigned in first-appearance order, bit-identical to the
+// single-threaded encode. Callers on a 1-CPU host lose nothing: the
+// n_threads <= 1 path is the plain loop.
 
+#include <atomic>
 #include <cstdint>
 #include <cstring>
 #include <deque>
 #include <string>
+#include <thread>
 #include <unordered_map>
-#include <unordered_set>
 #include <vector>
 
 namespace {
@@ -54,6 +68,76 @@ struct PieceHash {
   }
 };
 
+// Per-document string dedup without per-token allocation or clearing:
+// slots carry a generation stamp, so resetting between documents is one
+// counter bump. Linear probing over a power-of-two table kept at <= 50%
+// load, GROWN on demand — sizing by document length would preallocate
+// O(bytes) scratch (a 100MB single-document corpus would reserve GBs)
+// where distinct tokens are what bounds the live set, exactly like the
+// unordered_set this replaced.
+class DedupScratch {
+ public:
+  // Reset for a new document (capacity is retained across documents).
+  void Begin(size_t /*max_tokens_hint*/) {
+    if (gen_.empty()) Alloc(1 << 10);
+    if (++cur_ == 0) {  // generation wrap: one real clear every 2^32 docs
+      std::fill(gen_.begin(), gen_.end(), 0u);
+      cur_ = 1;
+    }
+    count_ = 0;
+  }
+
+  // True if `p` was not yet in this document's set (and inserts it).
+  bool Insert(const StringPiece& p, uint32_t h) {
+    if ((count_ + 1) * 2 > gen_.size()) Grow();
+    size_t i = h & mask_;
+    while (gen_[i] == cur_) {
+      if (keys_[i] == p) return false;
+      i = (i + 1) & mask_;
+    }
+    gen_[i] = cur_;
+    keys_[i] = p;
+    hashes_[i] = h;
+    ++count_;
+    return true;
+  }
+
+ private:
+  void Alloc(size_t n) {
+    gen_.assign(n, 0u);
+    keys_.resize(n);
+    hashes_.resize(n);
+    mask_ = n - 1;
+    cur_ = 1;
+  }
+
+  void Grow() {
+    std::vector<uint32_t> old_gen;
+    old_gen.swap(gen_);
+    std::vector<StringPiece> old_keys;
+    old_keys.swap(keys_);
+    std::vector<uint32_t> old_hashes;
+    old_hashes.swap(hashes_);
+    uint32_t old_cur = cur_;
+    Alloc(old_gen.size() * 2);
+    for (size_t i = 0; i < old_gen.size(); ++i) {
+      if (old_gen[i] != old_cur) continue;  // other documents' leftovers
+      size_t j = old_hashes[i] & mask_;
+      while (gen_[j] == cur_) j = (j + 1) & mask_;
+      gen_[j] = cur_;
+      keys_[j] = old_keys[i];
+      hashes_[j] = old_hashes[i];
+    }
+  }
+
+  std::vector<uint32_t> gen_;
+  std::vector<StringPiece> keys_;
+  std::vector<uint32_t> hashes_;
+  uint32_t cur_ = 0;
+  size_t mask_ = 0;
+  size_t count_ = 0;
+};
+
 class Tokenizer {
  public:
   explicit Tokenizer(int32_t n_buckets) : buckets_(n_buckets) {}
@@ -67,17 +151,18 @@ class Tokenizer {
   int64_t Encode(const char* buf, int64_t len, int per_document,
                  int32_t* out, int64_t cap) {
     int64_t n_out = 0;
-    seen_.clear();
+    if (per_document) scratch_.Begin(static_cast<size_t>(len) + 1);
     const char* p = buf;
     const char* end = buf + len;
     const char* tok = p;
     for (;; ++p) {
       if (p == end || *p == '\n' || *p == ' ') {
         StringPiece piece{tok, static_cast<size_t>(p - tok)};
+        uint32_t h = Fnv1a(piece.data, piece.len);
         bool emit = true;
-        if (per_document) emit = seen_.insert(piece).second;
+        if (per_document) emit = scratch_.Insert(piece, h);
         if (emit) {
-          int32_t id = EncodeToken(piece);
+          int32_t id = EncodeTokenHashed(piece, h);
           if (n_out < cap) out[n_out] = id;
           ++n_out;
         }
@@ -88,10 +173,10 @@ class Tokenizer {
     return n_out;
   }
 
-  int32_t EncodeToken(const StringPiece& piece) {
+  // Encode with the token's FNV already computed (the dedup needed it).
+  int32_t EncodeTokenHashed(const StringPiece& piece, uint32_t h) {
     if (buckets_ > 0) {
-      return static_cast<int32_t>(Fnv1a(piece.data, piece.len) %
-                                  static_cast<uint32_t>(buckets_));
+      return static_cast<int32_t>(h % static_cast<uint32_t>(buckets_));
     }
     auto it = vocab_.find(piece);
     if (it != vocab_.end()) return it->second;
@@ -101,6 +186,10 @@ class Tokenizer {
     int32_t id = static_cast<int32_t>(storage_.size()) - 1;
     vocab_.emplace(StringPiece{owned.data(), owned.size()}, id);
     return id;
+  }
+
+  int32_t EncodeToken(const StringPiece& piece) {
+    return EncodeTokenHashed(piece, Fnv1a(piece.data, piece.len));
   }
 
   int64_t VocabSize() const {
@@ -126,6 +215,32 @@ class Tokenizer {
     return need;
   }
 
+  int32_t buckets() const { return buckets_; }
+
+  // Read-only lookup (safe concurrently while no inserts run).
+  const int32_t* Find(const StringPiece& p) const {
+    auto it = vocab_.find(p);
+    return it == vocab_.end() ? nullptr : &it->second;
+  }
+
+  int64_t EncodeBatch(const char* buf, const int64_t* offsets, int n_docs,
+                      int per_document, int32_t* out, int64_t cap,
+                      int64_t* out_doc_end) {
+    int64_t total = 0;
+    for (int i = 0; i < n_docs; ++i) {
+      const char* doc = buf + offsets[i];
+      int64_t len = offsets[i + 1] - offsets[i];
+      int64_t room = cap > total ? cap - total : 0;
+      total += Encode(doc, len, per_document, out + total, room);
+      if (out_doc_end) out_doc_end[i] = total;
+    }
+    return total;
+  }
+
+  int64_t EncodeBatchMT(const char* buf, const int64_t* offsets, int n_docs,
+                        int per_document, int32_t* out, int64_t cap,
+                        int64_t* out_doc_end, int n_threads);
+
  private:
   int32_t buckets_;
   // Exact mode: vocabulary keyed by pieces pointing into storage_. A deque
@@ -134,8 +249,137 @@ class Tokenizer {
   // dangle their inline character buffers).
   std::unordered_map<StringPiece, int32_t, PieceHash> vocab_;
   std::deque<std::string> storage_;
-  std::unordered_set<StringPiece, PieceHash> seen_;
+  DedupScratch scratch_;
 };
+
+// Per-thread output of the parallel batch encode. Exact-mode unseen
+// tokens get ids encoded as ~local_id (negative — distinguishable from
+// global ids without a second array); `local` owns their bytes.
+struct ThreadShard {
+  std::vector<int32_t> ids;
+  std::vector<int64_t> doc_end;  // cumulative within the shard
+  std::deque<std::string> local;
+  std::unordered_map<StringPiece, int32_t, PieceHash> local_vocab;
+};
+
+int64_t Tokenizer::EncodeBatchMT(const char* buf, const int64_t* offsets,
+                                 int n_docs, int per_document, int32_t* out,
+                                 int64_t cap, int64_t* out_doc_end,
+                                 int n_threads) {
+  if (n_threads <= 0) {
+    unsigned hw = std::thread::hardware_concurrency();
+    n_threads = hw ? static_cast<int>(hw) : 1;
+  }
+  if (n_threads > n_docs) n_threads = n_docs > 0 ? n_docs : 1;
+  if (n_threads <= 1) {
+    return EncodeBatch(buf, offsets, n_docs, per_document, out, cap,
+                       out_doc_end);
+  }
+
+  // Split documents into contiguous ranges of roughly equal byte size so
+  // one long document cannot serialize the pool.
+  std::vector<int> starts(n_threads + 1, n_docs);
+  const int64_t total_bytes = offsets[n_docs] - offsets[0];
+  starts[0] = 0;
+  for (int t = 1; t < n_threads; ++t) {
+    int64_t want = offsets[0] + total_bytes * t / n_threads;
+    int lo = starts[t - 1];
+    int d = lo;
+    while (d < n_docs && offsets[d] < want) ++d;
+    starts[t] = d;
+  }
+  starts[n_threads] = n_docs;
+
+  std::vector<ThreadShard> shards(n_threads);
+  const Tokenizer* self = this;
+  auto work = [&](int t) {
+    ThreadShard& sh = shards[t];
+    DedupScratch scratch;
+    sh.doc_end.reserve(starts[t + 1] - starts[t]);
+    for (int d = starts[t]; d < starts[t + 1]; ++d) {
+      const char* doc = buf + offsets[d];
+      const char* end = buf + offsets[d + 1];
+      if (per_document) {
+        scratch.Begin(static_cast<size_t>(end - doc) + 1);
+      }
+      const char* tok = doc;
+      for (const char* p = doc;; ++p) {
+        if (p == end || *p == '\n' || *p == ' ') {
+          StringPiece piece{tok, static_cast<size_t>(p - tok)};
+          uint32_t h = Fnv1a(piece.data, piece.len);
+          bool emit = true;
+          if (per_document) emit = scratch.Insert(piece, h);
+          if (emit) {
+            int32_t id;
+            if (self->buckets_ > 0) {
+              id = static_cast<int32_t>(
+                  h % static_cast<uint32_t>(self->buckets_));
+            } else if (const int32_t* g = self->Find(piece)) {
+              id = *g;  // global vocab is frozen while threads run
+            } else {
+              auto it = sh.local_vocab.find(piece);
+              if (it != sh.local_vocab.end()) {
+                id = ~it->second;
+              } else {
+                sh.local.emplace_back(piece.data, piece.len);
+                const std::string& owned = sh.local.back();
+                int32_t lid =
+                    static_cast<int32_t>(sh.local.size()) - 1;
+                sh.local_vocab.emplace(
+                    StringPiece{owned.data(), owned.size()}, lid);
+                id = ~lid;
+              }
+            }
+            sh.ids.push_back(id);
+          }
+          tok = p + 1;
+        }
+        if (p == end) break;
+      }
+      sh.doc_end.push_back(static_cast<int64_t>(sh.ids.size()));
+    }
+  };
+  std::vector<std::thread> pool;
+  pool.reserve(n_threads - 1);
+  for (int t = 1; t < n_threads; ++t) pool.emplace_back(work, t);
+  work(0);
+  for (auto& th : pool) th.join();
+
+  // Serial stitch in document order. Exact mode folds thread-local
+  // vocabularies into the global one here, so global ids are assigned in
+  // first-appearance order — identical to the single-threaded encode.
+  int64_t total = 0;
+  for (int t = 0; t < n_threads; ++t) {
+    ThreadShard& sh = shards[t];
+    std::vector<int32_t> remap;  // local id -> global id, -1 = unassigned
+    if (buckets_ <= 0) remap.assign(sh.local.size(), -1);
+    size_t di = 0;
+    for (size_t j = 0; j < sh.ids.size(); ++j) {
+      int32_t id = sh.ids[j];
+      if (id < 0) {
+        int32_t lid = ~id;
+        if (remap[lid] < 0) {
+          const std::string& s = sh.local[lid];
+          remap[lid] = EncodeToken(StringPiece{s.data(), s.size()});
+        }
+        id = remap[lid];
+      }
+      if (total < cap) out[total] = id;
+      ++total;
+      while (di < sh.doc_end.size() &&
+             static_cast<int64_t>(j) + 1 == sh.doc_end[di]) {
+        if (out_doc_end) out_doc_end[starts[t] + di] = total;
+        ++di;
+      }
+    }
+    // Empty documents at the shard tail (or an all-empty shard).
+    while (di < sh.doc_end.size()) {
+      if (out_doc_end) out_doc_end[starts[t] + di] = total;
+      ++di;
+    }
+  }
+  return total;
+}
 
 }  // namespace
 
@@ -158,16 +402,22 @@ int64_t ccrdt_tok_encode_batch(void* t, const char* buf,
                                const int64_t* offsets, int n_docs,
                                int per_document, int32_t* out, int64_t cap,
                                int64_t* out_doc_end) {
-  Tokenizer* tok = static_cast<Tokenizer*>(t);
-  int64_t total = 0;
-  for (int i = 0; i < n_docs; ++i) {
-    const char* doc = buf + offsets[i];
-    int64_t len = offsets[i + 1] - offsets[i];
-    int64_t room = cap > total ? cap - total : 0;
-    total += tok->Encode(doc, len, per_document, out + total, room);
-    if (out_doc_end) out_doc_end[i] = total;
-  }
-  return total;
+  return static_cast<Tokenizer*>(t)->EncodeBatch(buf, offsets, n_docs,
+                                                 per_document, out, cap,
+                                                 out_doc_end);
+}
+
+// Parallel batch ingest (same contract as ccrdt_tok_encode_batch).
+// n_threads <= 0 uses the hardware thread count; output (ids, doc ends,
+// exact-mode vocabulary id assignment) is bit-identical to the serial
+// call for every thread count.
+int64_t ccrdt_tok_encode_batch_mt(void* t, const char* buf,
+                                  const int64_t* offsets, int n_docs,
+                                  int per_document, int32_t* out, int64_t cap,
+                                  int64_t* out_doc_end, int n_threads) {
+  return static_cast<Tokenizer*>(t)->EncodeBatchMT(buf, offsets, n_docs,
+                                                   per_document, out, cap,
+                                                   out_doc_end, n_threads);
 }
 
 int64_t ccrdt_tok_vocab_size(void* t) {

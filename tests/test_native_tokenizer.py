@@ -158,3 +158,58 @@ def test_device_doc_dedup_counts_hash_collisions_twice():
     counts = np.asarray(jax.device_get(state.counts))[0, 0]
     assert counts[hash_token(pair[0], V)] == 2
     assert counts.sum() == 2  # exactly the two tokens of the document
+
+
+def test_mt_encode_bit_identical_across_thread_counts():
+    """Parallel batch encode (ccrdt_tok_encode_batch_mt) must produce the
+    exact ids, doc ends, and exact-mode vocabulary id order of the serial
+    encode at EVERY thread count — the exact-mode remap pass assigns
+    global ids in document-order first appearance, so the thread split is
+    unobservable (see native/ccrdt_tokenizer.cpp header)."""
+    import numpy as np
+    import pytest
+
+    from antidote_ccrdt_tpu.harness import native_tokenizer as nt
+
+    if not nt.available():
+        pytest.skip("native toolchain unavailable")
+    rng = np.random.default_rng(5)
+    docs = [
+        " ".join(f"w{t}" for t in rng.integers(0, 200, rng.integers(0, 30)))
+        for _ in range(97)
+    ]
+    docs += ["", " ", "x"]  # empty docs and empty tokens at shard edges
+    for buckets in (64, 0):
+        for per_doc in (False, True):
+            ref_tok = nt.NativeTokenizer(buckets)
+            ref_ids, ref_de = ref_tok.encode_batch(
+                docs, per_document=per_doc, threads=1
+            )
+            for threads in (2, 3, 8, 200):  # 200 > n_docs: clamped
+                tok = nt.NativeTokenizer(buckets)
+                ids, de = tok.encode_batch(
+                    docs, per_document=per_doc, threads=threads
+                )
+                assert np.array_equal(ids, ref_ids), (buckets, per_doc, threads)
+                assert np.array_equal(de, ref_de), (buckets, per_doc, threads)
+                if buckets == 0:
+                    assert tok.vocab() == ref_tok.vocab(), (per_doc, threads)
+
+
+def test_mt_vocab_reuse_across_calls():
+    """A second MT batch must reuse ids the first one assigned (the global
+    vocabulary is consulted read-only inside the pool, then extended only
+    in the serial remap)."""
+    import numpy as np
+    import pytest
+
+    from antidote_ccrdt_tpu.harness import native_tokenizer as nt
+
+    if not nt.available():
+        pytest.skip("native toolchain unavailable")
+    tok = nt.NativeTokenizer(0)
+    ids1, _ = tok.encode_batch(["a b c", "b d"], threads=4)
+    ids2, _ = tok.encode_batch(["d c b a e", "e a"], threads=4)
+    assert list(ids1) == [0, 1, 2, 1, 3]
+    assert list(ids2) == [3, 2, 1, 0, 4, 4, 0]
+    assert tok.vocab() == ["a", "b", "c", "d", "e"]

@@ -254,7 +254,10 @@ def bench_worddocumentcount():
         "encode_ms": round(t_encode * 1e3, 2),
         "apply_ms": round(t_apply * 1e3, 2),
         "device_ms": round(t_device * 1e3, 2),
-        "upload_ms": round((t_apply - t_device) * 1e3, 2),
+        # Clamped like device_idle_frac below: on a host-attached TPU the
+        # upload is sub-ms and single-shot noise can push the difference
+        # negative, which would also blow up the wire-rate calibration.
+        "upload_ms": round(max(0.0, t_apply - t_device) * 1e3, 2),
         "wire": wire,
         "wire_mb": round(wire_np.nbytes / 1e6, 2),
         "host_tokenizer_tokens_per_sec": round(raw_tokens / t_encode),
@@ -262,8 +265,9 @@ def bench_worddocumentcount():
         # Self-describing record: on a tunneled device this calibrates the
         # wire; host-attached TPUs upload at PCIe rates and the config is
         # host-tokenizer-bound instead (see BASELINE.md ingest note).
-        "wire_mb_per_s": round(
-            wire_np.nbytes / 1e6 / max(t_apply - t_device, 1e-9), 1
+        "wire_mb_per_s": (
+            round(wire_np.nbytes / 1e6 / (t_apply - t_device), 1)
+            if t_apply - t_device > 1e-4 else None  # below noise: no calib
         ),
     }]
 

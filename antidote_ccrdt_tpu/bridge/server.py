@@ -129,13 +129,10 @@ class _Grid:
         # so the snapshot tuple stays the frozen 2-element layout the
         # round-2 golden bytes pin while carrying any grid type.
         grid = cls(serial.peek_name(state_blob), dict(geom))
-        name, state = serial.loads_dense(state_blob, grid.state)
-        if name != grid.type_name:
-            # A different dense type's blob can be treedef-compatible yet
-            # carry foreign merge semantics — reject, don't misinterpret.
-            raise ValueError(
-                f"snapshot holds dense type {name!r}, not {grid.type_name!r}"
-            )
+        # (No name re-check here: loads_dense parses the SAME header
+        # peek_name dispatched on; the guard that does real work is the
+        # shape-vs-geometry validation below.)
+        _name, state = serial.loads_dense(state_blob, grid.state)
         for got, like in zip(
             jax.tree_util.tree_leaves(state), jax.tree_util.tree_leaves(grid.state)
         ):

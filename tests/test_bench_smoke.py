@@ -17,7 +17,7 @@ import bench  # noqa: E402
 def test_bench_dense_tiny():
     (
         apply_rate, extras_rate, extras_ops_rate, p50, p99,
-        p50_e2e, p99_e2e, overhead, merge_rate, hbm,
+        p50_e2e, p99_e2e, overhead, merge_rate, hbm, compute,
     ) = bench.bench_dense(
         R=2, I=64, D_DCS=2, K=4, M=2, B=16, Br=4, windows=2,
         rounds_per_window=2,
@@ -29,6 +29,11 @@ def test_bench_dense_tiny():
     assert set(hbm) == {"apply", "replica_state_merge", "observe"}
     for phase in hbm.values():
         assert phase["achieved_gb_s"] > 0 and phase["bytes_per_dispatch"] > 0
+    ca = compute["apply"]
+    assert ca["measured_ms"] > 0 and ca["floor_ms"] >= ca["hbm_floor_ms"]
+    assert ca["mxu"]["tombstone_onehot_macs"] == 2 * 4 * 64 * 5 * 2
+    # The v5e ablation attribution only attaches at north-star shapes.
+    assert ca["attribution_ms_r3"] is None
 
 
 def test_bench_scalar_baseline_tiny():

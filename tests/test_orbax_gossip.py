@@ -153,7 +153,10 @@ def test_cross_site_monoid_gossip_via_lift(tmp_path):
         for _ in range(2):  # duplicate sweeps: idempotent by row-replace
             swept_b, n_b = gb.sweep(lift, cb.view)
             cb.absorb(swept_b)
-    assert n_a == 1 and n_b in (0, 1)
+            # Cursorless sweeps re-fetch every time — pin that the stale
+            # re-merge path actually executes on the repeat.
+            assert n_b == 1
+    assert n_a == 1
 
     ref = lift.init(R, NK)
     ref, _ = lift.apply_ops(ref, avg_ops([0, 1], 1), owned=[0, 1])

@@ -533,3 +533,52 @@ def test_grid_wire_differential_vs_direct_engines(client, seed):
     for r in range(R):
         for k in range(NK):
             assert client.grid_observe(g, r, k) == vals_ref[r][k]
+
+
+from hypothesis import HealthCheck, given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
+
+
+@settings(
+    max_examples=15, deadline=None,
+    suppress_health_check=[HealthCheck.too_slow],
+)
+@given(
+    script=st.lists(
+        st.one_of(
+            st.tuples(st.just("apply"), st.integers(0, 2), st.integers(0, 1),
+                      st.integers(-20, 40), st.integers(0, 2)),
+            st.tuples(st.just("merge_all")),
+        ),
+        min_size=1, max_size=12,
+    ),
+)
+def test_grid_monoid_merge_all_total_invariant(script):
+    """MONOID grid invariant under ANY interleaving of applies and
+    merge_all calls: the grid-wide total (sum over replica rows — rows
+    are deltas) always equals the exact op sum, and merge_all is
+    idempotent at the total level. Pins the fold-to-row-0 + identity-
+    reset semantics against the R-multiplication bug a naive broadcast
+    would introduce (server.py merge_all docstring)."""
+    from antidote_ccrdt_tpu.bridge.server import _Grid
+
+    grid = _Grid("average", {Atom("n_replicas"): 3, Atom("n_keys"): 2})
+    exact_sum = [0, 0]
+    exact_num = [0, 0]
+    for step in script:
+        if step[0] == "apply":
+            _, replica, key, value, count = step
+            ops = [[] for _ in range(3)]
+            ops[replica] = [(Atom("add"), key, value, count)]
+            grid.apply(ops)
+            if count > 0:
+                exact_sum[key] += value
+                exact_num[key] += count
+        else:
+            grid.merge_all()
+    grid.merge_all()
+    import numpy as np
+
+    sums = np.asarray(grid.state.sum).sum(axis=0)
+    nums = np.asarray(grid.state.num).sum(axis=0)
+    assert list(sums) == exact_sum and list(nums) == exact_num

@@ -315,6 +315,11 @@ def compute_model(R, NK, I, D_DCS, M, B, Br, apply_ms, apply_hbm_bytes):
             "tombstones": 14.6, "delta_build": 20.9, "join": 1.2,
             "residual_fusion": round(62.1 - 14.6 - 20.9 - 1.2, 1),
             "full_round": 62.1,
+            # full_round is the ablation harness's UNADJUSTED per-rep wall
+            # (includes ~RTT/REPS of tunnel overhead), so it reads higher
+            # than measured_ms above (RTT-adjusted). The piece values are
+            # removal DELTAS between equal-overhead runs — RTT-free.
+            "methodology": "removal deltas; full_round unadjusted",
             "repro": "ABLATE_B=32768 ABLATE_BR=2048 python "
                      "benchmarks/ablate_apply.py",
         }

@@ -180,49 +180,52 @@ def test_real_process_crash_recovery_delta_gossip(tmp_path):
     ), os.listdir(str(tmp_path))
 
 
-def test_real_process_crash_recovery_monoid_average(tmp_path):
+@pytest.mark.parametrize("type_name", ["average", "wordcount"])
+def test_real_process_crash_recovery_monoid(tmp_path, type_name):
     """The MONOID half of the host delivery contract
-    (antidote_ccrdt.erl:47-59 replicates without type distinction):
-    average rides the versioned-row lift through the SAME crash drill the
-    JOIN flagship runs — w1 dies at step 4, survivors adopt its rows by
-    regenerating history into their own contribution state, and converge
-    to the exact sequential totals (any double count is a digest diff)."""
+    (antidote_ccrdt.erl:47-59 replicates without type distinction): both
+    monoid types ride the versioned-row lift through the SAME crash drill
+    the JOIN flagship runs — w1 dies at step 4, survivors adopt its rows
+    by regenerating history into their own contribution state, and
+    converge to the exact sequential totals (any double count is a
+    digest diff)."""
     rcs, outs = _run_drill(
         tmp_path,
         (("w0", []), ("w1", ["--die-at", "4"]), ("w2", [])),
-        3, "average",
+        3, type_name,
     )
     assert rcs["w1"] == 1, f"victim should crash:\n{outs['w1']}"
-    ref = _drill_reference("average")
+    ref = _drill_reference(type_name)
     for m in ("w0", "w2"):
         assert rcs[m] == 0, f"worker {m} failed:\n{outs[m]}"
         with open(os.path.join(str(tmp_path), f"final-{m}.json")) as f:
             got = json.load(f)
         assert got["digest"] == ref, (
-            f"{m} diverged (monoid average)\ngot: {got['digest']}\n"
+            f"{m} diverged (monoid {type_name})\ngot: {got['digest']}\n"
             f"ref: {ref}\nlog:\n{outs[m]}"
         )
         assert "w1" not in got["alive"]
 
 
-def test_real_process_late_joiner_monoid_wordcount_delta(tmp_path):
-    """Scale-up elasticity + row-replace delta gossip for the second
-    MONOID engine: a member joins ~1s in, ownership rebalances onto it,
+@pytest.mark.parametrize("type_name", ["average", "wordcount"])
+def test_real_process_late_joiner_monoid_delta(tmp_path, type_name):
+    """Scale-up elasticity + row-replace delta gossip for both MONOID
+    engines: a member joins ~1s in, ownership rebalances onto it,
     deltas (self-contained whole-row payloads) carry the anti-entropy,
     and every member converges to the exact sequential counts."""
     rcs, outs = _run_drill(
         tmp_path,
         (("w0", ["--delta"]), ("w1", ["--delta"]),
          ("w2", ["--join-late", "1.0", "--delta"])),
-        2, "wordcount",
+        2, type_name,
     )
-    ref = _drill_reference("wordcount")
+    ref = _drill_reference(type_name)
     for m in ("w0", "w1", "w2"):
         assert rcs[m] == 0, f"worker {m} failed:\n{outs[m]}"
         with open(os.path.join(str(tmp_path), f"final-{m}.json")) as f:
             got = json.load(f)
         assert got["digest"] == ref, (
-            f"{m} diverged (monoid wordcount delta)\ngot: {got['digest']}\n"
+            f"{m} diverged (monoid {type_name} delta)\ngot: {got['digest']}\n"
             f"ref: {ref}\nlog:\n{outs[m]}"
         )
     assert any(

@@ -138,6 +138,10 @@ grid_new(Sock, Grid, Type, Params) when is_map(Params) ->
 %%   leaderboard  {add, Key, Id, Score} | {ban, Key, Id}
 %%   average      {add, Key, Value, Count}
 %%   wordcount / worddocumentcount  {add, Key, TokenId}
+%%   worddocumentcount also accepts raw per-token records
+%%     {doc_add, Key, DocId, UniqId, TokenId}  (whole batch must be
+%%     doc_add; per-document dedup then runs on device — UniqId is the
+%%     string-identity id, one document's records must stay in one batch)
 grid_apply(Sock, Grid, OpsPerReplica) when is_list(OpsPerReplica) ->
     call(Sock, {grid_apply, Grid, OpsPerReplica}).
 

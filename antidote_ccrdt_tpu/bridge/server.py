@@ -153,6 +153,9 @@ class _Grid:
           leaderboard      {add, Key, Id, Score} | {ban, Key, Id}
           average          {add, Key, Value, Count}
           wordcount(+doc)  {add, Key, TokenId}   (ids from the host's encoder)
+          worddocumentcount also {doc_add, Key, Doc, Uniq, Token} — raw
+                           records, per-document dedup on device; whole
+                           batch must be doc_add (_apply_worddocumentcount)
         Returns the extras count (dominated elements for topk_rmv, 0 for
         types without extra-op output on this surface)."""
         if len(per_replica_ops) != self.R:
@@ -341,10 +344,51 @@ class _Grid:
         )
         return 0
 
-    # Shared kernel, own registry entry (dedup is an encode-time concern,
-    # worddocumentcount.erl:76-86). Explicit alias: a future grid type
-    # missing its packer must fail loudly, not fall back.
-    _apply_worddocumentcount = _apply_wordcount
+    def _apply_worddocumentcount(self, per_replica_ops) -> int:
+        """Two op shapes: {add, Key, Token} (host already deduped — the
+        shared wordcount packer) or {doc_add, Key, Doc, Uniq, Token} (raw
+        per-token records; the per-document dedup runs ON DEVICE as one
+        sort over the batch, worddocumentcount.erl:76-86 semantics via
+        apply_doc_ops — `Uniq` is the string-identity id, so hash-
+        colliding distinct words still count twice in a shared bucket).
+        A batch is one mode or the other: dedup is batch-scoped, and a
+        document's records must not split across grid_apply calls."""
+        import jax.numpy as jnp
+
+        from ..models.wordcount import WordDocOps
+
+        tags = {op[0] for ops in per_replica_ops for op in ops}
+        if Atom("doc_add") not in tags:
+            return self._apply_wordcount(per_replica_ops)
+        if tags != {Atom("doc_add")}:
+            raise ValueError(
+                "grid_apply batch mixes doc_add with other ops; the "
+                "per-document dedup is batch-scoped — send one mode per "
+                "batch"
+            )
+        NK, V = self.NK, self.dense.V
+        B = max(1, max(len(ops) for ops in per_replica_ops))
+        key = np.zeros((self.R, B), np.int32)
+        doc = np.zeros((self.R, B), np.int32)
+        uniq = np.zeros((self.R, B), np.int32)
+        tok = np.full((self.R, B), -1, np.int32)  # token<0 = padding
+        for ri, ops in enumerate(per_replica_ops):
+            for j, (_, k, d, u, t) in enumerate(ops):
+                if not 0 <= k < NK:
+                    raise ValueError(f"doc_add key={k} out of range")
+                if not 0 <= t < V:
+                    raise ValueError(f"doc_add token={t} out of range")
+                if d < 0 or u < 0:
+                    raise ValueError(f"doc_add doc={d}/uniq={u} negative")
+                key[ri, j], doc[ri, j], uniq[ri, j], tok[ri, j] = k, d, u, t
+        self.state, _ = self.dense.apply_doc_ops(
+            self.state,
+            WordDocOps(
+                key=jnp.asarray(key), doc=jnp.asarray(doc),
+                uniq=jnp.asarray(uniq), token=jnp.asarray(tok),
+            ),
+        )
+        return 0
 
     def merge_all(self) -> None:
         """One-dispatch inter-DC reconciliation, by merge algebra:

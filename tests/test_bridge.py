@@ -582,3 +582,27 @@ def test_grid_monoid_merge_all_total_invariant(script):
     sums = np.asarray(grid.state.sum).sum(axis=0)
     nums = np.asarray(grid.state.num).sum(axis=0)
     assert list(sums) == exact_sum and list(nums) == exact_num
+
+
+def test_dense_grid_worddoc_device_dedup_over_wire(client):
+    """The device-side per-document dedup is reachable over the wire:
+    {doc_add, Key, Doc, Uniq, Token} records dedup on (doc, uniq) —
+    string identity — in one device sort (worddocumentcount.erl:76-86);
+    two distinct words sharing a bucket still count twice."""
+    client.grid_new("gdd", "worddocumentcount", n_replicas=1, n_keys=1,
+                    n_buckets=8)
+    client.grid_apply("gdd", [[
+        (Atom("doc_add"), 0, 0, 11, 3),  # doc 0, word#11 -> bucket 3
+        (Atom("doc_add"), 0, 0, 11, 3),  # same word, same doc: dedups
+        (Atom("doc_add"), 0, 0, 12, 3),  # DIFFERENT word, same bucket: +1
+        (Atom("doc_add"), 0, 1, 11, 3),  # same word, other doc: +1
+    ]])
+    assert dict(client.grid_observe("gdd", 0)) == {3: 3}
+    with pytest.raises(Exception, match="mixes doc_add"):
+        client.grid_apply("gdd", [[(Atom("doc_add"), 0, 0, 1, 1),
+                                   (Atom("add"), 0, 1)]])
+    with pytest.raises(Exception, match="token=9 out of range"):
+        client.grid_apply("gdd", [[(Atom("doc_add"), 0, 0, 1, 9)]])
+    # Plain pre-deduped adds still work on the same grid.
+    client.grid_apply("gdd", [[(Atom("add"), 0, 5)]])
+    assert dict(client.grid_observe("gdd", 0)) == {3: 3, 5: 1}

@@ -131,6 +131,7 @@ REQUESTS = [
     (A("grid_new"), A("gw"), A("wordcount"),
      {A("n_replicas"): 2, A("n_buckets"): 64}),
     (A("grid_apply"), A("gw"), [[(A("add"), 0, 3)], []]),
+    (A("grid_apply"), A("gd"), [[(A("doc_add"), 0, 1, 7, 3)], []]),
     (A("grid_new"), A("gt"), A("topk"),
      {A("n_replicas"): 2, A("n_ids"): 64, A("size"): 4}),
     (A("grid_apply"), A("gt"), [[(A("add"), 0, 1, 10)], []]),

@@ -144,6 +144,15 @@ class BridgeClient:
     def grid_apply(self, name: str, per_replica_ops: List[List[Any]]) -> int:
         return self.call((Atom("grid_apply"), name.encode(), per_replica_ops))
 
+    def grid_apply_extras(self, name: str, per_replica_ops: List[List[Any]]):
+        """Like grid_apply, but returns the generated extra effect ops
+        per replica (dominated-add re-broadcast rmvs for topk_rmv,
+        ban-promotion add_r for leaderboard; [] for the other types) —
+        feed them back into replication like update/2 extras."""
+        return self.call(
+            (Atom("grid_apply_extras"), name.encode(), per_replica_ops)
+        )
+
     def grid_merge_all(self, name: str) -> None:
         self.call((Atom("grid_merge_all"), name.encode()))
 

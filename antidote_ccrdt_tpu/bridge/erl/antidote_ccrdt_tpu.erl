@@ -31,7 +31,8 @@
          to_binary/2, equal/3, compact/3, free/2, batch_merge/3,
          is_type/2, generates_extra_operations/2, is_operation/3,
          require_state_downstream/3, is_replicate_tagged/3,
-         grid_new/4, grid_apply/3, grid_merge_all/2, grid_observe/4,
+         grid_new/4, grid_apply/3, grid_apply_extras/3,
+         grid_merge_all/2, grid_observe/4,
          grid_to_binary/2, grid_from_binary/3,
          wire_atoms/0, main/1]).
 
@@ -144,6 +145,15 @@ grid_new(Sock, Grid, Type, Params) when is_map(Params) ->
 %%     string-identity id, one document's records must stay in one batch)
 grid_apply(Sock, Grid, OpsPerReplica) when is_list(OpsPerReplica) ->
     call(Sock, {grid_apply, Grid, OpsPerReplica}).
+
+%% Like grid_apply/3 but returns the generated extra effect ops per
+%% replica row (update/2 extras over the grid wire), in the grid's OWN
+%% op shapes so they feed straight back into grid_apply: topk_rmv yields
+%% dominated-add re-broadcast {rmv, Key, Id, [{Dc,Ts}]} and rmv-driven
+%% promotions {add, Key, Id, Score, Dc, Ts}; leaderboard yields
+%% ban-promotions {add, Key, Id, Score}; other types [].
+grid_apply_extras(Sock, Grid, OpsPerReplica) when is_list(OpsPerReplica) ->
+    call(Sock, {grid_apply_extras, Grid, OpsPerReplica}).
 
 grid_merge_all(Sock, Grid) ->
     call(Sock, {grid_merge_all, Grid}).

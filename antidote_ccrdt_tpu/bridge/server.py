@@ -162,6 +162,25 @@ class _Grid:
             raise ValueError(f"expected {self.R} replica op lists")
         return getattr(self, f"_apply_{self.type_name}")(per_replica_ops)
 
+    def apply_extras(self, per_replica_ops):
+        """Like `apply`, but return the generated extra effect ops per
+        replica (one list per replica row) instead of a count — the
+        reference's update/2 extras surface (antidote_ccrdt.erl:37-40)
+        over the grid wire. topk_rmv yields dominated-add re-broadcast
+        removals; leaderboard yields ban-promotion {add_r, ...}; the
+        other types generate no extras (registry
+        generates_extra_operations) and return empty lists."""
+        if self.type_name == "topk_rmv":
+            if len(per_replica_ops) != self.R:
+                raise ValueError(f"expected {self.R} replica op lists")
+            return self._apply_topk_rmv(per_replica_ops, want_extras=True)
+        if self.type_name == "leaderboard":
+            if len(per_replica_ops) != self.R:
+                raise ValueError(f"expected {self.R} replica op lists")
+            return self._apply_leaderboard(per_replica_ops, want_extras=True)
+        self.apply(per_replica_ops)
+        return [[] for _ in range(self.R)]
+
     @staticmethod
     def _check_tags(per_replica_ops, allowed) -> None:
         for ops in per_replica_ops:
@@ -169,7 +188,7 @@ class _Grid:
                 if op[0] not in allowed:
                     raise ValueError(f"unknown grid op tag: {op[0]!r}")
 
-    def _apply_topk_rmv(self, per_replica_ops) -> int:
+    def _apply_topk_rmv(self, per_replica_ops, want_extras: bool = False):
         import jax.numpy as jnp
 
         from ..models.topk_rmv_dense import TopkRmvOps
@@ -219,8 +238,50 @@ class _Grid:
             rmv_id=jnp.asarray(r_id),
             rmv_vc=jnp.asarray(r_vc),
         )
-        self.state, extras = self.dense.apply_ops(self.state, ops_batch)
-        return int(np.asarray(extras.dominated).sum())
+        self.state, extras = self.dense.apply_ops(
+            self.state, ops_batch, collect_promotions=want_extras
+        )
+        if not want_extras:
+            return int(np.asarray(extras.dominated).sum())
+        # Re-broadcast removals for dominated adds (topk_rmv.erl:234-237):
+        # op-aligned {rmv, Key, Id, VcList} terms, same shape the rmv
+        # INPUT op uses — the host feeds them straight back into
+        # replication.
+        dom = np.asarray(extras.dominated)
+        dvc = np.asarray(extras.dominated_vc)
+        out = []
+        for ri, ops in enumerate(adds):
+            row = []
+            for j in range(len(ops)):
+                if dom[ri, j]:
+                    vc_list = [
+                        (int(d), int(t))
+                        for d, t in enumerate(dvc[ri, j])
+                        if t > 0
+                    ]
+                    row.append(
+                        (Atom("rmv"), int(a[ri, j, 0]), int(a[ri, j, 1]),
+                         vc_list)
+                    )
+            out.append(row)
+        # Promotion extras (reference :291-295): removals that uncover a
+        # masked element re-broadcast it as a plain add {add, Key, Id,
+        # Score, Dc, Ts} — the grid's own add op shape, feedable straight
+        # back (scalar parity: _rmv returns ("add", (i, s, t))).
+        pids = np.asarray(extras.promoted.ids)
+        pscores = np.asarray(extras.promoted.scores)
+        pdcs = np.asarray(extras.promoted.dcs)
+        ptss = np.asarray(extras.promoted.tss)
+        pkeep = np.asarray(extras.promoted.valid)
+        for ri in range(self.R):
+            for k in range(self.NK):
+                for j in np.nonzero(pkeep[ri, k])[0]:
+                    out[ri].append(
+                        (Atom("add"), int(k), int(pids[ri, k, j]),
+                         int(pscores[ri, k, j]), int(pdcs[ri, k, j]),
+                         int(ptss[ri, k, j]))
+                    )
+        return out
 
     def _apply_topk(self, per_replica_ops) -> int:
         import jax.numpy as jnp
@@ -249,7 +310,7 @@ class _Grid:
         )
         return 0
 
-    def _apply_leaderboard(self, per_replica_ops) -> int:
+    def _apply_leaderboard(self, per_replica_ops, want_extras: bool = False):
         import jax.numpy as jnp
 
         from ..models.leaderboard import LeaderboardOps
@@ -279,16 +340,36 @@ class _Grid:
                     raise ValueError(f"ban (key={k}, id={i}) out of range")
                 b_key[ri, j], b_id[ri, j] = k, i
                 b_valid[ri, j] = True
-        self.state, _ = self.dense.apply_ops(
-            self.state,
-            LeaderboardOps(
-                add_key=jnp.asarray(a_key), add_id=jnp.asarray(a_id),
-                add_score=jnp.asarray(a_score), add_valid=jnp.asarray(a_valid),
-                ban_key=jnp.asarray(b_key), ban_id=jnp.asarray(b_id),
-                ban_valid=jnp.asarray(b_valid),
-            ),
+        ops_batch = LeaderboardOps(
+            add_key=jnp.asarray(a_key), add_id=jnp.asarray(a_id),
+            add_score=jnp.asarray(a_score), add_valid=jnp.asarray(a_valid),
+            ban_key=jnp.asarray(b_key), ban_id=jnp.asarray(b_id),
+            ban_valid=jnp.asarray(b_valid),
         )
-        return 0
+        self.state, promoted = self.dense.apply_ops(
+            self.state, ops_batch, collect_promotions=want_extras
+        )
+        if not want_extras:
+            return 0
+        # Ban-promotion extras (leaderboard.erl:279-283): entries newly
+        # visible that this batch's adds don't explain — re-broadcast as
+        # plain adds {add, Key, Id, Score}, the grid's own op shape, so
+        # the host can feed them straight back (the scalar reference's
+        # update likewise returns ("add", new_elem); the replicate-tagged
+        # add_r distinction is an inter-DC shipping concern the scalar
+        # surface's is_replicate_tagged covers).
+        ids, scores, keep = (np.asarray(x) for x in promoted)
+        out = []
+        for ri in range(self.R):
+            row = []
+            for k in range(self.NK):
+                for j in np.nonzero(keep[ri, k])[0]:
+                    row.append(
+                        (Atom("add"), int(k), int(ids[ri, k, j]),
+                         int(scores[ri, k, j]))
+                    )
+            out.append(row)
+        return out
 
     def _apply_average(self, per_replica_ops) -> int:
         import jax.numpy as jnp
@@ -512,7 +593,10 @@ class BridgeServer:
         "downstream": (1,), "update": (1,), "value": (1,), "to_binary": (1,),
         "compact": (1,), "equal": (1, 2),
     }
-    _GRID_TAGS = {"grid_apply", "grid_merge_all", "grid_observe", "grid_to_binary"}
+    _GRID_TAGS = {
+        "grid_apply", "grid_apply_extras", "grid_merge_all", "grid_observe",
+        "grid_to_binary",
+    }
 
     def _dispatch(self, term: Any) -> Any:
         if not (isinstance(term, tuple) and len(term) == 3 and term[0] == P.A_CALL):
@@ -711,6 +795,9 @@ class BridgeServer:
         if tag == "grid_apply":
             _, gname, per_replica = op
             return self._grids[gname].apply(per_replica)
+        if tag == "grid_apply_extras":
+            _, gname, per_replica = op
+            return self._grids[gname].apply_extras(per_replica)
         if tag == "grid_merge_all":
             _, gname = op
             self._grids[gname].merge_all()

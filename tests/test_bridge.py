@@ -606,3 +606,60 @@ def test_dense_grid_worddoc_device_dedup_over_wire(client):
     # Plain pre-deduped adds still work on the same grid.
     client.grid_apply("gdd", [[(Atom("add"), 0, 5)]])
     assert dict(client.grid_observe("gdd", 0)) == {3: 3, 5: 1}
+
+
+def test_grid_apply_extras_topk_rmv_dominated_rebroadcast(client):
+    """update/2's extras surface over the grid wire: a dominated add
+    returns its re-broadcast removal {rmv, Key, Id, VcList}
+    (topk_rmv.erl:234-237) that the host can feed straight back into
+    replication — same term shape the rmv INPUT op uses."""
+    client.grid_new("gx", "topk_rmv", n_replicas=2, n_keys=1, n_ids=32,
+                    n_dcs=2, size=4)
+    # Replica 1 removes id 7 at vc {0: 5}; a later add of id 7 with a
+    # stale ts at dc 0 ON THAT REPLICA is dominated by the stored
+    # tombstone and must bounce the rmv back (rows are independent
+    # replica states — a tombstone only dominates within its own row
+    # until a merge ships it).
+    assert client.grid_apply_extras("gx", [[], [rmv(0, 7, {0: 5})]]) == [[], []]
+    extras = client.grid_apply_extras("gx", [[], [add(0, 7, 99, 0, 3)]])
+    assert extras[0] == []
+    assert extras[1] == [(Atom("rmv"), 0, 7, [(0, 5)])]
+    # The dominated add did not enter the observable.
+    client.grid_merge_all("gx")
+    assert dict(client.grid_observe("gx", 0)) == {}
+    # A fresh add survives and generates no extras.
+    assert client.grid_apply_extras("gx", [[add(0, 3, 50, 1, 1)], []]) == [[], []]
+    # Promotion extra: id 9 has an observed best (90 @ dc0) and a masked
+    # runner-up (70 @ dc1); a removal dominating only the dc0 add
+    # uncovers the masked element, which must re-broadcast as a plain
+    # add in the grid's own op shape (reference :291-295).
+    client.grid_apply("gx", [[add(0, 9, 90, 0, 1), add(0, 9, 70, 1, 1)], []])
+    extras = client.grid_apply_extras("gx", [[rmv(0, 9, {0: 1})], []])
+    assert (Atom("add"), 0, 9, 70, 1, 1) in extras[0], extras
+    # ...and it feeds straight back into another replica.
+    client.grid_apply("gx", [[], extras[0]])
+
+
+def test_grid_apply_extras_leaderboard_promotion(client):
+    """Ban-promotion extras over the wire (leaderboard.erl:279-283): a
+    ban that opens a board slot re-broadcasts the newly visible player as
+    a replicate-tagged add {add_r, Key, Id, Score} (:158-160)."""
+    client.grid_new("gxl", "leaderboard", n_replicas=1, n_keys=1,
+                    n_players=16, size=2)
+    # Fill the K=2 board with 10/9; 8 stays masked below the board.
+    assert client.grid_apply_extras("gxl", [[
+        (Atom("add"), 0, 1, 10), (Atom("add"), 0, 2, 9), (Atom("add"), 0, 3, 8),
+    ]]) == [[]]
+    # Banning player 1 promotes the masked player 3 into the board; the
+    # extra is the grid's own add shape, so it feeds straight back.
+    extras = client.grid_apply_extras("gxl", [[(Atom("ban"), 0, 1)]])
+    assert extras == [[(Atom("add"), 0, 3, 8)]]
+    client.grid_apply("gxl", extras)  # re-broadcast round trip
+    assert dict(client.grid_observe("gxl", 0)) == {2: 9, 3: 8}
+
+
+def test_grid_apply_extras_other_types_empty(client):
+    client.grid_new("gxa", "average", n_replicas=2, n_keys=1)
+    out = client.grid_apply_extras("gxa", [[(Atom("add"), 0, 5, 1)], []])
+    assert out == [[], []]
+    assert client.grid_observe("gxa", 0, 0) == (5, 1)  # state still applied

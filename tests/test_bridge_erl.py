@@ -138,6 +138,7 @@ REQUESTS = [
     (A("grid_new"), A("gl"), A("leaderboard"),
      {A("n_replicas"): 2, A("n_players"): 64, A("size"): 4}),
     (A("grid_apply"), A("gl"), [[(A("add"), 0, 1, 10)], [(A("ban"), 0, 1)]]),
+    (A("grid_apply_extras"), A("g"), [[(A("add"), 0, 1, 10, 0, 1)], []]),
     (A("grid_merge_all"), A("g")),
     (A("grid_observe"), A("g"), 0, 0),
     (A("grid_to_binary"), A("g")),

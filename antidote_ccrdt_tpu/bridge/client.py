@@ -146,9 +146,10 @@ class BridgeClient:
 
     def grid_apply_extras(self, name: str, per_replica_ops: List[List[Any]]):
         """Like grid_apply, but returns the generated extra effect ops
-        per replica (dominated-add re-broadcast rmvs for topk_rmv,
-        ban-promotion add_r for leaderboard; [] for the other types) —
-        feed them back into replication like update/2 extras."""
+        per replica, in the grid's own op shapes so they feed straight
+        back into grid_apply: topk_rmv yields dominated-add re-broadcast
+        rmvs and rmv-driven promotion adds; leaderboard yields
+        ban-promotion adds; the other types []."""
         return self.call(
             (Atom("grid_apply_extras"), name.encode(), per_replica_ops)
         )

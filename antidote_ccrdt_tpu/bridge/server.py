@@ -166,17 +166,16 @@ class _Grid:
         """Like `apply`, but return the generated extra effect ops per
         replica (one list per replica row) instead of a count — the
         reference's update/2 extras surface (antidote_ccrdt.erl:37-40)
-        over the grid wire. topk_rmv yields dominated-add re-broadcast
-        removals; leaderboard yields ban-promotion {add_r, ...}; the
-        other types generate no extras (registry
-        generates_extra_operations) and return empty lists."""
+        over the grid wire, each extra in the grid's OWN op shape so it
+        feeds straight back into `apply`. topk_rmv yields dominated-add
+        re-broadcast removals + rmv-driven promotion adds; leaderboard
+        yields ban-promotion adds; the other types generate no extras
+        (registry generates_extra_operations) and return empty lists."""
+        if len(per_replica_ops) != self.R:
+            raise ValueError(f"expected {self.R} replica op lists")
         if self.type_name == "topk_rmv":
-            if len(per_replica_ops) != self.R:
-                raise ValueError(f"expected {self.R} replica op lists")
             return self._apply_topk_rmv(per_replica_ops, want_extras=True)
         if self.type_name == "leaderboard":
-            if len(per_replica_ops) != self.R:
-                raise ValueError(f"expected {self.R} replica op lists")
             return self._apply_leaderboard(per_replica_ops, want_extras=True)
         self.apply(per_replica_ops)
         return [[] for _ in range(self.R)]

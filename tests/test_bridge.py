@@ -643,7 +643,9 @@ def test_grid_apply_extras_topk_rmv_dominated_rebroadcast(client):
 def test_grid_apply_extras_leaderboard_promotion(client):
     """Ban-promotion extras over the wire (leaderboard.erl:279-283): a
     ban that opens a board slot re-broadcasts the newly visible player as
-    a replicate-tagged add {add_r, Key, Id, Score} (:158-160)."""
+    a plain add {add, Key, Id, Score} — the grid's own op shape, so the
+    host feeds it straight back (the add_r replicate-tag distinction is
+    the scalar surface's is_replicate_tagged concern)."""
     client.grid_new("gxl", "leaderboard", n_replicas=1, n_keys=1,
                     n_players=16, size=2)
     # Fill the K=2 board with 10/9; 8 stays masked below the board.

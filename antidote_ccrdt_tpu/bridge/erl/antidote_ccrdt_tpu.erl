@@ -222,6 +222,23 @@ main(Args) ->
     {ok, [{2, 5}]} = grid_observe(S, gl, 0, 0),
     io:format("dense grids (average + leaderboard) OK~n", []),
 
+    %% extras over the grid wire: a ban that opens a slot re-broadcasts
+    %% the promoted player in the grid's own add shape — feed it back
+    {ok, true} = grid_new(S, gp, leaderboard,
+                          #{n_replicas => 1, n_players => 8, size => 1}),
+    {ok, [[]]} = grid_apply_extras(S, gp, [[{add, 0, 1, 9}, {add, 0, 2, 4}]]),
+    {ok, [[{add, 0, 2, 4}]]} = grid_apply_extras(S, gp, [[{ban, 0, 1}]]),
+    {ok, 0} = grid_apply(S, gp, [[{add, 0, 2, 4}]]),
+
+    %% device-side per-document dedup over the wire
+    {ok, true} = grid_new(S, gd, worddocumentcount,
+                          #{n_replicas => 1, n_buckets => 8}),
+    {ok, 0} = grid_apply(S, gd, [[{doc_add, 0, 0, 5, 3},
+                                  {doc_add, 0, 0, 5, 3},
+                                  {doc_add, 0, 1, 5, 3}]]),
+    {ok, [{3, 2}]} = grid_observe(S, gd, 0, 0),
+    io:format("grid extras + doc dedup OK~n", []),
+
     ok = close(S),
     io:format("bridge smoke OK~n", []),
     halt(0).

@@ -24,7 +24,7 @@ def test_bench_all_emits_every_config():
     metrics = " ".join(r["metric"] for r in recs)
     for frag in (
         "average", "topk adds", "leaderboard", "wordcount tokens",
-        "delta-state publish", "worddocumentcount corpus",
+        "delta-state publish", "monoid row-replace", "worddocumentcount corpus",
     ):
         assert frag in metrics, f"missing bench config: {frag}"
     assert all(r["value"] > 0 for r in recs)

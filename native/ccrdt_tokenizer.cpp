@@ -174,18 +174,53 @@ class Tokenizer {
   }
 
   // Encode with the token's FNV already computed (the dedup needed it).
+  // Exact mode probes an open-addressed flat table with the SAME hash —
+  // the unordered_map it replaced re-hashed every token on lookup, which
+  // dominated exact-mode encode (measured ~2x the hashed mode's cost).
   int32_t EncodeTokenHashed(const StringPiece& piece, uint32_t h) {
     if (buckets_ > 0) {
       return static_cast<int32_t>(h % static_cast<uint32_t>(buckets_));
     }
-    auto it = vocab_.find(piece);
-    if (it != vocab_.end()) return it->second;
+    if (vocab_ids_.empty()) GrowVocabTable(1 << 12);
+    size_t i = h & vocab_mask_;
+    while (vocab_ids_[i] >= 0) {
+      int32_t cand = vocab_ids_[i];
+      const std::string& owned = storage_[cand];
+      if (vocab_hashes_[i] == h && owned.size() == piece.len &&
+          std::memcmp(owned.data(), piece.data, piece.len) == 0) {
+        return cand;
+      }
+      i = (i + 1) & vocab_mask_;
+    }
     // Own the bytes: the piece points into the caller's buffer.
     storage_.emplace_back(piece.data, piece.len);
-    const std::string& owned = storage_.back();
     int32_t id = static_cast<int32_t>(storage_.size()) - 1;
-    vocab_.emplace(StringPiece{owned.data(), owned.size()}, id);
+    vocab_ids_[i] = id;
+    vocab_hashes_[i] = h;
+    if ((storage_.size() + 1) * 2 > vocab_ids_.size()) {
+      GrowVocabTable(vocab_ids_.size() * 2);
+    }
     return id;
+  }
+
+  void GrowVocabTable(size_t n) {
+    // Reinsert occupied slots using their SAVED hashes (cf.
+    // DedupScratch::Grow) — recomputing FNV over the stored strings
+    // would redo exactly the hashing work this table exists to avoid.
+    std::vector<int32_t> old_ids;
+    old_ids.swap(vocab_ids_);
+    std::vector<uint32_t> old_hashes;
+    old_hashes.swap(vocab_hashes_);
+    vocab_ids_.assign(n, -1);
+    vocab_hashes_.assign(n, 0u);
+    vocab_mask_ = n - 1;
+    for (size_t i = 0; i < old_ids.size(); ++i) {
+      if (old_ids[i] < 0) continue;
+      size_t j = old_hashes[i] & vocab_mask_;
+      while (vocab_ids_[j] >= 0) j = (j + 1) & vocab_mask_;
+      vocab_ids_[j] = old_ids[i];
+      vocab_hashes_[j] = old_hashes[i];
+    }
   }
 
   int32_t EncodeToken(const StringPiece& piece) {
@@ -217,10 +252,22 @@ class Tokenizer {
 
   int32_t buckets() const { return buckets_; }
 
-  // Read-only lookup (safe concurrently while no inserts run).
-  const int32_t* Find(const StringPiece& p) const {
-    auto it = vocab_.find(p);
-    return it == vocab_.end() ? nullptr : &it->second;
+  // Read-only lookup (safe concurrently while no inserts run). Callers
+  // pass the piece's FNV so the probe reuses it.
+  bool Find(const StringPiece& p, uint32_t h, int32_t* out) const {
+    if (vocab_ids_.empty()) return false;
+    size_t i = h & vocab_mask_;
+    while (vocab_ids_[i] >= 0) {
+      int32_t cand = vocab_ids_[i];
+      const std::string& owned = storage_[cand];
+      if (vocab_hashes_[i] == h && owned.size() == p.len &&
+          std::memcmp(owned.data(), p.data, p.len) == 0) {
+        *out = cand;
+        return true;
+      }
+      i = (i + 1) & vocab_mask_;
+    }
+    return false;
   }
 
   int64_t EncodeBatch(const char* buf, const int64_t* offsets, int n_docs,
@@ -243,11 +290,12 @@ class Tokenizer {
 
  private:
   int32_t buckets_;
-  // Exact mode: vocabulary keyed by pieces pointing into storage_. A deque
-  // never relocates elements on push_back, so the StringPiece keys stay
-  // valid (a vector<string> would move short SSO strings on growth and
-  // dangle their inline character buffers).
-  std::unordered_map<StringPiece, int32_t, PieceHash> vocab_;
+  // Exact mode: open-addressed (hash, id) table probing into storage_ (a
+  // deque never relocates on push_back, so the string bytes referenced
+  // by lookups stay put). Power-of-two sized, <= 50% load.
+  std::vector<int32_t> vocab_ids_;
+  std::vector<uint32_t> vocab_hashes_;
+  size_t vocab_mask_ = 0;
   std::deque<std::string> storage_;
   DedupScratch scratch_;
 };
@@ -314,8 +362,8 @@ int64_t Tokenizer::EncodeBatchMT(const char* buf, const int64_t* offsets,
             if (self->buckets_ > 0) {
               id = static_cast<int32_t>(
                   h % static_cast<uint32_t>(self->buckets_));
-            } else if (const int32_t* g = self->Find(piece)) {
-              id = *g;  // global vocab is frozen while threads run
+            } else if (int32_t g; self->Find(piece, h, &g)) {
+              id = g;  // global vocab is frozen while threads run
             } else {
               auto it = sh.local_vocab.find(piece);
               if (it != sh.local_vocab.end()) {

@@ -31,18 +31,24 @@ def dedup_rows_run_max(rows: jax.Array, upd: jax.Array, n_rows: int):
     one update so a sum-of-products accumulation equals that update.
 
     rows [Br] i32, upd [Br, D] i32. Returns (head_rows [Br], total [Br, D]).
+
+    The suffix run-max is `segment.run_max`'s log-step doubling loop
+    rather than `lax.associative_scan` with a (key, val) combiner: the
+    scan's odd/even tree lowers to ~log(Br) levels of strided slice/pad
+    ops that XLA schedules as separate fusions (~1.4ms visible in the
+    round-4 device profile plus tail), while the doubling loop is shift +
+    where chains that fuse flat. Measured in full-apply composition at
+    north-star shapes (benchmarks/residual_probe.py probe M):
+    ~54.7 -> ~49.2ms. The sorted row ids serve directly as run_max's
+    segment ids (equality-compared only; values >= 0 never match its -1
+    shift fill).
     """
+    from .segment import run_max
+
     order = jnp.argsort(rows)
     r_s = jnp.take_along_axis(rows, order, axis=0)
     u_s = jnp.take_along_axis(upd, order[:, None], axis=0)
-
-    def comb(a, b):
-        (ka, va), (kb, vb) = a, b
-        same = (ka == kb)[..., None]
-        return (kb, jnp.where(same, jnp.maximum(va, vb), vb))
-
-    _, suf = lax.associative_scan(comb, (r_s[::-1], u_s[::-1]), axis=0)
-    total = suf[::-1]  # run max from each position to its run's end
+    total = run_max(u_s, r_s, direction="suffix")
     is_head = jnp.concatenate([jnp.ones((1,), bool), r_s[1:] != r_s[:-1]])
     head_rows = jnp.where(is_head, r_s, n_rows)
     return head_rows, total

@@ -308,13 +308,26 @@ def compute_model(R, NK, I, D_DCS, M, B, Br, apply_ms, apply_hbm_bytes):
     mxu_floor_ms = macs * 2 / (MXU_INT8_PEAK_TOPS * 1e12) * 1e3
     hbm_floor_ms = apply_hbm_bytes / (HBM_PEAK_GB_S * 1e9) * 1e3
     floor_ms = max(mxu_floor_ms, hbm_floor_ms)
-    # The ablation attribution is a v5e measurement at the north-star
-    # shapes — attach it only where it applies (not tiny/CPU configs).
+    # Round-4 attribution, two independent methodologies that now AGREE
+    # (round 3's ~25ms "residual_fusion" no longer exists — it was the
+    # D-step dom-lookup slice/select chains plus the associative_scan
+    # odd/even tree, both restructured away this round; the remaining
+    # removal deltas sum to the measured round within ~1ms):
+    # * per-HLO device-timeline profile (benchmarks/profile_north_star.py,
+    #   committed as benchmarks/profile_r04.json): tombstone one-hot conv
+    #   11.2 + plane-unpack/max 3.9 (the unpack reads the 5x-wide s32 conv
+    #   output — ~2.9GB/round, ~3.5ms HBM floor, so it runs at ~90% of
+    #   peak), 3x delta scalar scatters 5.13 each, sorts 3.7, join
+    #   compares/placement ~2.3, dom one-hot reduce 1.4, tail ~2.7.
+    # * removal-delta ablation (ablate_apply.py), measured v5e r4.
+    # These are v5e measurements at the north-star shapes — attach only
+    # where they apply (not tiny/CPU configs).
     attribution = (
         {
-            "tombstones": 14.6, "delta_build": 20.9, "join": 1.2,
-            "residual_fusion": round(62.1 - 14.6 - 20.9 - 1.2, 1),
-            "full_round": 62.1,
+            "tombstones": 19.0, "delta_build": 23.3,
+            "join_and_filter": 8.9, "vc_track": 0.3,
+            "residual_unattributed": round(52.6 - 19.0 - 23.3 - 8.9 - 0.3, 1),
+            "full_round": 52.6,
             # full_round is the ablation harness's UNADJUSTED per-rep wall
             # (includes ~RTT/REPS of tunnel overhead), so it reads higher
             # than measured_ms above (RTT-adjusted). The piece values are
@@ -340,13 +353,100 @@ def compute_model(R, NK, I, D_DCS, M, B, Br, apply_ms, apply_hbm_bytes):
             "sort_elems": int(R * B * 6),
             "scatter_rows": int(R * B * 3),
             "join_elementwise_ops": int(R * T * 2 * M * 12),
-            "attribution_ms_r3": attribution,
+            "attribution_ms_r4": attribution,
+            "hlo_profile_artifact": "benchmarks/profile_r04.json",
             "binding_constraint": (
-                "xla-scheduling/serialized-small-ops; MAC-cutting "
-                "restructurings regress (benchmarks/tomb_bucket_probe.py)"
+                "3x delta scalar scatters (XLA's serialized update loop; "
+                "sorted/unique hints, i64 packing, cond-packing and "
+                "M-major layouts all measured neutral-or-worse in "
+                "benchmarks/residual_probe.py) + tombstone one-hot conv "
+                "(~47% MXU util; MAC-cutting restructurings regress, "
+                "benchmarks/tomb_bucket_probe.py) + its plane-unpack "
+                "(~90% of HBM floor)"
             ),
         },
     }
+
+
+def bench_curve(R, I, D_DCS, K, M, points, windows, W, e2e_samples):
+    """Throughput/latency frontier over round batch size (VERDICT-r3 item
+    4): the committed artifact behind BASELINE.md's former prose curve.
+
+    Per point: windowed p50/p99 (scan-fused, W rounds/window) and
+    single-dispatch e2e p50/p99 over `e2e_samples` real host-readback
+    round trips (p99 of a small sample ~= max; the sample count is in the
+    record). Rmv batch keeps the north-star 1/16 ratio.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from antidote_ccrdt_tpu.harness.opgen import TopkRmvEffectGen, Workload
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import make_dense
+
+    D = make_dense(n_ids=I, n_dcs=D_DCS, size=K, slots_per_id=M)
+    gen = TopkRmvEffectGen(
+        Workload(n_replicas=R, n_ids=I, zipf_a=1.2, score_max=100_000, seed=11)
+    )
+    out = []
+    for B in points:
+        Br = B // 16
+        state = D.init(n_replicas=R, n_keys=1)
+        batches = [
+            _stack_rounds([gen.next_batch(B, Br) for _ in range(W)])
+            for _ in range(windows + 1)
+        ]
+
+        @jax.jit
+        def run_window(state, stacked):
+            def body(st, ops):
+                st2, _ = D.apply_ops(st, ops, collect_dominated=False)
+                return st2, ()
+            o, _ = lax.scan(body, state, stacked)
+            return o
+
+        state = run_window(state, batches[0])
+        _sync(state)
+        per_round = []
+        for w in range(windows):
+            t0 = time.perf_counter()
+            state = run_window(state, batches[1 + w])
+            _sync(state)
+            per_round.extend([(time.perf_counter() - t0) / W] * W)
+        p50 = float(np.percentile(per_round, 50) * 1e3)
+        p99 = float(np.percentile(per_round, 99) * 1e3)
+        rate = R * (B + Br) / float(np.median(per_round))
+
+        @jax.jit
+        def run_one(state, ops):
+            st2, _ = D.apply_ops(state, ops, collect_dominated=False)
+            return st2
+
+        singles = []
+        one_ops = [
+            jax.tree.map(lambda a: a[i % W], batches[1 + (i // W) % windows])
+            for i in range(e2e_samples)
+        ]
+        st1 = run_one(state, one_ops[0])
+        _sync(st1)
+        for ops in one_ops:
+            t0 = time.perf_counter()
+            st1 = run_one(st1, ops)
+            _sync(st1)
+            singles.append(time.perf_counter() - t0)
+        out.append(
+            {
+                "batch_adds": B,
+                "batch_rmvs": Br,
+                "merges_per_sec": round(rate),
+                "p50_round_ms_windowed": round(p50, 2),
+                "p99_round_ms_windowed": round(p99, 2),
+                "p50_round_ms_e2e": round(float(np.percentile(singles, 50) * 1e3), 2),
+                "p99_round_ms_e2e": round(float(np.percentile(singles, 99) * 1e3), 2),
+                "e2e_samples": e2e_samples,
+            }
+        )
+    return out
 
 
 def bench_scalar_baseline(R, I, D_DCS, K, n_ops):
@@ -381,14 +481,24 @@ def bench_scalar_baseline(R, I, D_DCS, K, n_ops):
 def main():
     import jax
 
+    try:  # persistent compile cache: harmless if the backend rejects it
+        jax.config.update("jax_compilation_cache_dir", "/tmp/ccrdt_jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    except Exception:
+        pass
+
     backend = jax.default_backend()
     if os.environ.get("CCRDT_BENCH_TINY"):
         # Smoke-test mode (tests/test_bench_smoke.py): exercise the full
         # path in seconds; the numbers are meaningless.
         R, I, B, Br, windows, W, base_ops = 2, 256, 32, 8, 2, 2, 200
+        curve_points = (32, 64)
+        curve_cfg = dict(windows=1, W=2, e2e_samples=2)
     elif backend == "cpu":
         # CI / no-accelerator fallback: shrink so the bench still completes.
         R, I, B, Br, windows, W, base_ops = 8, 10_000, 1024, 64, 3, 3, 5_000
+        curve_points = (512, 1024)
+        curve_cfg = dict(windows=1, W=2, e2e_samples=2)
     else:
         # W amortizes the fixed per-window cost (host sync readback + op
         # upload, ~75-90ms measured) to a few ms/round without hiding it.
@@ -401,6 +511,15 @@ def main():
         # sort+scatter cost). B=32768 is the balanced default: near-peak
         # throughput without letting round latency run away.
         R, I, B, Br, windows, W, base_ops = 32, 100_000, 32768, 2048, 6, 10, 20_000
+        # Frontier sweep (committed as the `curve` block). Each point costs
+        # two remote compiles (~35s each cold on this tunnel), so the sweep
+        # is 3 extra points and the headline B=32768 point is carried over
+        # from the main measurement (marked source=headline). A manually
+        # probed 40960 point measured SLOWER per round than 49152 on v5e
+        # (71.1 vs 72.4ms but 8k fewer ops — shape/padding-dependent
+        # compilation), the kind of fact a prose curve hides.
+        curve_points = (16384, 49152, 65536)
+        curve_cfg = dict(windows=2, W=6, e2e_samples=8)
     D_DCS, K, M = R, 100, 4  # every simulated replica is a DC: vc width = R
 
     (
@@ -408,6 +527,32 @@ def main():
         p50_e2e_ms, p99_e2e_ms, dispatch_overhead_ms,
         state_merge_rate, hbm, compute,
     ) = bench_dense(R, I, D_DCS, K, M, B, Br, windows, W)
+    curve = bench_curve(R, I, D_DCS, K, M, curve_points, **curve_cfg)
+    curve.append(
+        {
+            "batch_adds": B,
+            "batch_rmvs": Br,
+            "merges_per_sec": round(apply_rate),
+            "p50_round_ms_windowed": round(p50_ms, 2),
+            "p99_round_ms_windowed": round(p99_ms, 2),
+            "p50_round_ms_e2e": round(p50_e2e_ms, 2),
+            "p99_round_ms_e2e": round(p99_e2e_ms, 2),
+            "source": "headline",
+        }
+    )
+    curve.sort(key=lambda p: p["batch_adds"])
+    # Operating-point decision (explicit, as the curve artifact demands):
+    # the headline stays at the largest point whose windowed p50 holds the
+    # ~60ms round budget; the knee (~49152 on v5e, ~23M merges/sec at
+    # ~72ms) is there for deployments whose latency budget allows it.
+    chosen = {
+        "batch_adds": B,
+        "why": (
+            "largest sweep point with windowed p50 <= ~62ms; the higher-"
+            "throughput knee trades ~18ms/round of latency for ~+13% "
+            "rate and is a config knob, not the default"
+        ),
+    }
     baseline_rate = bench_scalar_baseline(R, I, D_DCS, K, base_ops)
 
     print(
@@ -432,6 +577,7 @@ def main():
                 "extras_mode": "table",
                 "merges_per_sec_with_extras": round(extras_rate),
                 "merges_per_sec_with_extras_op_aligned": round(extras_ops_rate),
+                "curve": {"points": curve, "operating_point": chosen},
                 "replica_state_merges_per_sec": round(state_merge_rate, 1),
                 "baseline_cpu_merges_per_sec": round(baseline_rate),
                 "batch_per_replica_round": f"{B} adds + {Br} rmvs",

@@ -1,0 +1,219 @@
+"""XLA device-timeline profile of ONE north-star apply window (VERDICT-r3
+item 1): name every HLO slice inside the round, especially the ~25ms
+"residual_fusion" the round-3 removal-ablation attribution could not
+assign to any piece.
+
+Method: capture a `jax.profiler` trace around one scan-fused window
+(W rounds of `TopkRmvDense.apply_ops` at bench.py's north-star shapes),
+then aggregate the DEVICE-side trace events (the TPU timeline comes
+through the tunneled backend — verified: fusion-level events appear under
+the /device:TPU pid) by HLO op name, divide by W, and map each fusion
+name to its computation body from the compiled HLO text so every slice
+has a human-readable "what it computes".
+
+Outputs:
+  benchmarks/profile_r04.json  — per-slice table (ms/round, share, body)
+  stdout                       — the same table, human-readable
+
+Env knobs: PROF_B / PROF_BR / PROF_W (default north-star 32768/2048/10),
+PROF_EXTRAS=table to profile the extras-on configuration.
+"""
+
+import collections
+import gzip
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from antidote_ccrdt_tpu.harness.opgen import TopkRmvEffectGen, Workload
+from antidote_ccrdt_tpu.models.topk_rmv_dense import make_dense
+from antidote_ccrdt_tpu.utils.benchtime import stack_rounds, sync
+
+R, NK, I, D_DCS, K, M = 32, 1, 100_000, 32, 100, 4
+B = int(os.environ.get("PROF_B", 32768))
+Br = int(os.environ.get("PROF_BR", 2048))
+W = int(os.environ.get("PROF_W", 10))
+EXTRAS = os.environ.get("PROF_EXTRAS", "")  # "" (off) or "table"
+TRACE_DIR = os.environ.get("PROF_TRACE_DIR", "/tmp/ns_trace")
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "profile_r04.json")
+
+
+def build_runner():
+    D = make_dense(n_ids=I, n_dcs=D_DCS, size=K, slots_per_id=M)
+    state = D.init(n_replicas=R, n_keys=1)
+    gen = TopkRmvEffectGen(
+        Workload(n_replicas=R, n_ids=I, zipf_a=1.2, score_max=100_000, seed=7)
+    )
+    batches = [
+        stack_rounds([gen.next_batch(B, Br) for _ in range(W)]) for _ in range(2)
+    ]
+    mode = EXTRAS if EXTRAS else False
+
+    @jax.jit
+    def run_window(state, stacked):
+        def body(st, ops):
+            st2, extras = D.apply_ops(st, ops, collect_dominated=mode)
+            if mode == "table":
+                return st2, jnp.sum(extras.dominated_tbl)
+            return st2, ()
+        out, tail = lax.scan(body, state, stacked)
+        if mode == "table":
+            return out, jnp.sum(tail)
+        return out
+
+    return D, state, batches, run_window
+
+
+def capture(state, batches, run_window):
+    out = run_window(state, batches[0])  # compile + warm
+    sync(out)
+    jax.profiler.start_trace(TRACE_DIR)
+    out = run_window(out if not EXTRAS else out[0], batches[1])
+    sync(out)
+    jax.profiler.stop_trace()
+    return out
+
+
+def newest_trace_json(root):
+    cands = []
+    for dirpath, _dirs, files in os.walk(root):
+        for f in files:
+            if f.endswith(".trace.json.gz"):
+                p = os.path.join(dirpath, f)
+                cands.append((os.path.getmtime(p), p))
+    return max(cands)[1]
+
+
+def device_slices(trace_path):
+    """Aggregate device-pid complete events by (deduped) HLO op name."""
+    with gzip.open(trace_path) as f:
+        d = json.load(f)
+    ev = d.get("traceEvents", [])
+    dev_pids = {
+        e["pid"]
+        for e in ev
+        if e.get("ph") == "M"
+        and e.get("name") == "process_name"
+        and "TPU" in e.get("args", {}).get("name", "")
+    }
+    # Device timelines nest (e.g. a module event spanning its fusions) and
+    # split across "XLA Ops"/"XLA Modules" threads; keep the op-level line
+    # only: drop events whose name looks like a module (jit_*).
+    agg = collections.Counter()
+    hits = collections.Counter()
+    for e in ev:
+        if e.get("ph") != "X" or e.get("pid") not in dev_pids:
+            continue
+        name = e.get("name", "?")
+        if name.startswith("jit_") or name.startswith("buffer"):
+            continue
+        agg[name] += e.get("dur", 0)  # microseconds
+        hits[name] += 1
+    return agg, hits
+
+
+BODY_OPS = re.compile(r"^\s+(?:ROOT\s+)?\S+\s+=\s+\S+\s+([a-z0-9_-]+)\(", re.M)
+
+
+def fusion_bodies(hlo_text):
+    """Map each fusion's computation name -> a compressed op census of its
+    body, e.g. 'sort x2, scatter x3, add x41'. HLO text layout: computations
+    are `%name (args) -> type {' blocks; fusions reference `calls=%comp`."""
+    comps = {}
+    cur = None
+    ops = None
+    for line in hlo_text.splitlines():
+        m = re.match(r"^(?:ENTRY )?%?([\w.\-]+)\s*\([^)]*\)\s*->", line)
+        if m and line.rstrip().endswith("{"):
+            cur = m.group(1)
+            ops = collections.Counter()
+            comps[cur] = ops
+            continue
+        if cur is not None:
+            if line.startswith("}"):
+                cur = None
+                continue
+            m2 = re.match(r"^\s+(?:ROOT\s+)?\S+\s+=\s+\S+\s+([a-z0-9\-]+)\(", line)
+            if m2 and ops is not None:
+                ops[m2.group(1)] += 1
+    # fusion instruction name -> called computation
+    fuse_map = {}
+    for m in re.finditer(
+        r"%?([\w.\-]+)\s+=\s+\S+\s+fusion\(.*?calls=%?([\w.\-]+)", hlo_text
+    ):
+        fuse_map[m.group(1)] = m.group(2)
+    out = {}
+    for fname, comp in fuse_map.items():
+        census = comps.get(comp)
+        if not census:
+            continue
+        major = [
+            f"{op} x{n}"
+            for op, n in sorted(census.items(), key=lambda kv: -kv[1])
+            if op
+            not in ("parameter", "constant", "tuple", "get-tuple-element", "bitcast")
+        ][:8]
+        out[fname] = ", ".join(major)
+    return out
+
+
+def main():
+    D, state, batches, run_window = build_runner()
+    lowered = run_window.lower(state, batches[0])
+    hlo_text = lowered.compile().as_text()
+    bodies = fusion_bodies(hlo_text)
+
+    capture(state, batches, run_window)
+    trace_path = newest_trace_json(TRACE_DIR)
+    agg, hits = device_slices(trace_path)
+
+    total_us = sum(agg.values())
+    rows = []
+    for name, us in agg.most_common():
+        base = name.split(".")[0]
+        rows.append(
+            {
+                "hlo": name,
+                "ms_per_round": round(us / 1e3 / W, 3),
+                "calls_per_round": round(hits[name] / W, 1),
+                "share": round(us / total_us, 4),
+                "body": bodies.get(name, bodies.get(base, "")),
+            }
+        )
+    # Collapse the tail for the committed artifact; keep every slice >=1%.
+    head = [r for r in rows if r["share"] >= 0.01]
+    tail_ms = round(sum(r["ms_per_round"] for r in rows if r["share"] < 0.01), 3)
+    artifact = {
+        "config": {
+            "R": R, "I": I, "B": B, "Br": Br, "W": W,
+            "extras": EXTRAS or "off",
+            "backend": jax.default_backend(),
+        },
+        "device_total_ms_per_round": round(total_us / 1e3 / W, 2),
+        "slices": head,
+        "tail_under_1pct_ms": tail_ms,
+        "trace": trace_path,
+    }
+    with open(OUT, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(f"device total: {artifact['device_total_ms_per_round']:.2f} ms/round")
+    print(f"{'ms/rnd':>8} {'share':>6} {'calls':>6}  name  |  body")
+    for r in head:
+        print(
+            f"{r['ms_per_round']:8.3f} {r['share']*100:5.1f}% {r['calls_per_round']:6.1f}"
+            f"  {r['hlo'][:48]:48s}| {r['body'][:70]}"
+        )
+    print(f"{tail_ms:8.3f}        (tail: slices under 1%)")
+    print(f"wrote {OUT}")
+
+
+if __name__ == "__main__":
+    main()

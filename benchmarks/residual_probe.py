@@ -1,0 +1,585 @@
+"""Round-4 probes attacking the named slices of the apply round (the
+round-3 attribution's "residual_fusion" is now decomposed by the XLA
+device-timeline profile — benchmarks/profile_north_star.py /
+profile_r04.json):
+
+    11.2ms  tombstone one-hot MXU matmul        (dense_table.py:96)
+    15.4ms  3x delta scalar scatters            (topk_rmv_dense.py:533-535)
+     3.9ms  tombstone 7-bit plane unpack + max  (dense_table.py:102-103)
+     4.7ms  32x per-DC slices of rmv_vc feeding the D-step dom lookup
+            (_live_mask/_filter_slots, topk_rmv_dense.py:147/163) +
+     2.3ms  their select chains
+     3.7ms  the 4-key add sort
+     4.0ms  join cross-compares + rank one-hot placement
+     ~.9ms  rmv dedup (argsort custom calls)
+     1.4ms  conv input slice/pad
+     4.2ms  486 slices under 0.15ms
+
+Probes (each is the FULL apply with one piece restructured — composition
+timing, same discipline as ablate_apply.py):
+
+  A. baseline: current apply_ops.
+  B. delta scatters with indices_are_sorted=True + unique_indices=True —
+     (kid3, rank) IS sorted-unique by construction (sorted by kid asc,
+     rank asc within group; rank collisions impossible).
+  C. dom lookup via one-hot multiply-reduce over D instead of the 32-step
+     slice/select chain (one fused [.., M, D] reduce; no T(1,128) slices).
+  D. dom lookup via a log2(D) binary select tree on the bits of dc.
+  E. tombstones via XLA scatter-max over row-sorted updates with
+     indices_are_sorted=True (replacing the one-hot MXU matmul + unpack).
+  F. best combination of the winners.
+
+Run: [PROBE_B=32768 PROBE_BR=2048] python benchmarks/residual_probe.py [filters]
+"""
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from antidote_ccrdt_tpu.harness.opgen import TopkRmvEffectGen, Workload
+from antidote_ccrdt_tpu.models.topk_rmv_dense import (
+    NEG_INF,
+    TopkRmvDenseState,
+    _cmp_better,
+    make_dense,
+)
+from antidote_ccrdt_tpu.ops.dense_table import scatter_max_rows_mxu
+
+R, NK, I, D_DCS, K, M = 32, 1, 100_000, 32, 100, 4
+B = int(os.environ.get("PROBE_B", 32768))
+Br = int(os.environ.get("PROBE_BR", 2048))
+REPS = int(os.environ.get("PROBE_REPS", 12))
+
+D = make_dense(n_ids=I, n_dcs=D_DCS, size=K, slots_per_id=M)
+state0 = D.init(n_replicas=R, n_keys=1)
+gen = TopkRmvEffectGen(Workload(n_replicas=R, n_ids=I, zipf_a=1.2, score_max=100_000, seed=7))
+warm = gen.next_batch(B, Br)
+state0, _ = D.apply_ops(state0, warm, collect_dominated=False)
+stacked = jax.tree.map(
+    lambda *xs: jnp.stack(xs), *[gen.next_batch(B, Br) for _ in range(REPS)]
+)
+
+SELECT = sys.argv[1:]
+
+
+def sync(x):
+    return np.asarray(jax.tree.leaves(x)[0].ravel()[0])
+
+
+def timeit(name, step_fn, expect=None):
+    if SELECT and not any(s in name for s in SELECT):
+        return None
+
+    @jax.jit
+    def run(c, seq):
+        def body(c, ops):
+            return step_fn(c, ops), ()
+        out, _ = lax.scan(body, c, seq)
+        return out
+
+    out = run(state0, stacked)
+    sync(out)
+    t0 = time.perf_counter()
+    out = run(state0, stacked)
+    sync(out)
+    ms = (time.perf_counter() - t0) / REPS * 1e3
+    ok = ""
+    if expect is not None:
+        same = all(
+            bool(jnp.array_equal(a, b))
+            for a, b in zip(jax.tree.leaves(out), jax.tree.leaves(expect))
+        )
+        ok = "  state==baseline" if same else "  STATE MISMATCH"
+    print(f"{name:58s} {ms:9.2f} ms{ok}")
+    return out
+
+
+# --- dom lookup variants ---------------------------------------------------
+
+
+def dom_select_loop(dc, rmv_vc):
+    """Current production path: D-step broadcast-select."""
+    dom = jnp.zeros(dc.shape, jnp.int32)
+    for d in range(rmv_vc.shape[-1]):
+        dom = jnp.where(dc == d, rmv_vc[..., d : d + 1], dom)
+    return dom
+
+
+def dom_onehot_reduce(dc, rmv_vc):
+    """One fused one-hot multiply + reduce over D (no strided slices)."""
+    Dd = rmv_vc.shape[-1]
+    oh = dc[..., None] == jnp.arange(Dd, dtype=dc.dtype)  # [.., M, D]
+    return jnp.max(
+        jnp.where(oh, rmv_vc[..., None, :], 0), axis=-1
+    )
+
+
+def dom_bit_tree(dc, rmv_vc):
+    """log2(D) binary select tree on dc's bits. Level k halves the
+    candidate table along D by selecting on bit k (little-endian)."""
+    Dd = rmv_vc.shape[-1]
+    cand = jnp.broadcast_to(
+        rmv_vc[..., None, :], (*dc.shape, Dd)
+    )  # [.., M, D]
+    bit = 0
+    while cand.shape[-1] > 1:
+        half = cand.shape[-1] // 2
+        lo = cand[..., 0::2]
+        hi = cand[..., 1::2]
+        sel = ((dc >> bit) & 1).astype(bool)[..., None]
+        cand = jnp.where(sel, hi, lo)
+        bit += 1
+    return cand[..., 0]
+
+
+def make_variant(dom_fn=dom_select_loop, scatter_hints=False, tomb="mxu"):
+    def live_mask(dcs, ts, rmv_vc):
+        return ts > dom_fn(dcs, rmv_vc)
+
+    def join_slots(a, b, rmv_vc, m_keep):
+        a_s, a_d, a_t = a
+        b_s, b_d, b_t = b
+        live_a = live_mask(a_d, a_t, rmv_vc)
+        live_b = live_mask(b_d, b_t, rmv_vc)
+        A = lambda x: x[..., :, None]  # noqa: E731
+        Bx = lambda x: x[..., None, :]  # noqa: E731
+        a_beats_b = _cmp_better(A(a_s), A(a_t), A(a_d), Bx(b_s), Bx(b_t), Bx(b_d))
+        eq = (A(a_s) == Bx(b_s)) & (A(a_t) == Bx(b_t)) & (A(a_d) == Bx(b_d))
+        live_b = live_b & ~jnp.any(eq & A(live_a), axis=-2)
+        b_beats_a = ~a_beats_b & ~eq
+        la = live_a.astype(jnp.int32)
+        lb = live_b.astype(jnp.int32)
+        pref_a = jnp.cumsum(la, axis=-1) - la
+        pref_b = jnp.cumsum(lb, axis=-1) - lb
+        r_a = pref_a + jnp.sum(b_beats_a & Bx(live_b), axis=-1)
+        r_b = pref_b + jnp.sum(a_beats_b & A(live_a), axis=-2)
+        r_a = jnp.where(live_a, r_a, 2 * a_s.shape[-1])
+        r_b = jnp.where(live_b, r_b, 2 * b_s.shape[-1])
+        ranks = jnp.arange(m_keep, dtype=jnp.int32)
+        oh_a = r_a[..., :, None] == ranks
+        oh_b = r_b[..., :, None] == ranks
+
+        def place(xa, xb, empty):
+            out = jnp.sum(jnp.where(oh_a, xa[..., :, None], 0), axis=-2) + jnp.sum(
+                jnp.where(oh_b, xb[..., :, None], 0), axis=-2
+            )
+            filled = jnp.any(oh_a, axis=-2) | jnp.any(oh_b, axis=-2)
+            return jnp.where(filled, out, empty)
+
+        f_score = place(a_s, b_s, NEG_INF)
+        f_dc = place(a_d, b_d, 0)
+        f_ts = place(a_t, b_t, 0)
+        n_live = jnp.sum(la, axis=-1) + jnp.sum(lb, axis=-1)
+        return f_score, f_dc, f_ts, n_live
+
+    def tombstones(state, ops):
+        rmv_valid = (
+            (ops.rmv_id >= 0) & (ops.rmv_id < I)
+            & (ops.rmv_key >= 0) & (ops.rmv_key < NK)
+        )
+        rrow = jnp.where(rmv_valid, ops.rmv_key * I + ops.rmv_id, NK * I)
+        table = state.rmv_vc.reshape(NK * I, D_DCS)
+        if tomb == "mxu":
+            out = scatter_max_rows_mxu(table, rrow, ops.rmv_vc)
+        else:  # sorted XLA scatter-max
+            order = jnp.argsort(rrow)
+            r_s = jnp.take_along_axis(rrow, order, axis=0)
+            u_s = jnp.take_along_axis(ops.rmv_vc, order[:, None], axis=0)
+            out = table.at[r_s].max(
+                u_s, mode="drop", indices_are_sorted=True
+            )
+        return out.reshape(NK, I, D_DCS)
+
+    def one(state, ops):
+        rmv_vc = tombstones(state, ops)
+        add_valid = (
+            (ops.add_ts > 0)
+            & (ops.add_key >= 0) & (ops.add_key < NK)
+            & (ops.add_id >= 0) & (ops.add_id < I)
+            & (ops.add_dc >= 0) & (ops.add_dc < D_DCS)
+        )
+        slot = ops.add_key * D_DCS + ops.add_dc
+        hit = slot[:, None] == jnp.arange(NK * D_DCS, dtype=slot.dtype)[None, :]
+        contrib = jnp.where(hit & add_valid[:, None], ops.add_ts[:, None], 0)
+        vc = jnp.maximum(state.vc, jnp.max(contrib, axis=0).reshape(NK, D_DCS))
+
+        kid = jnp.where(add_valid, ops.add_key * I + ops.add_id, NK * I)
+        s_kid, ns, nt, s_dc = lax.sort(
+            (kid, -ops.add_score, -ops.add_ts, ops.add_dc), num_keys=4
+        )
+        s_score, s_ts = -ns, -nt
+        dup = (
+            (s_kid == jnp.roll(s_kid, 1))
+            & (s_score == jnp.roll(s_score, 1))
+            & (s_ts == jnp.roll(s_ts, 1))
+            & (s_dc == jnp.roll(s_dc, 1))
+        )
+        dup = dup.at[0].set(False)
+        live = (s_kid < NK * I) & ~dup
+        grp_start = (s_kid != jnp.roll(s_kid, 1)).at[0].set(True)
+        c = jnp.cumsum(live.astype(jnp.int32))
+        base = lax.cummax(jnp.where(grp_start, c - live.astype(jnp.int32), -1))
+        rank = c - live.astype(jnp.int32) - base
+        overflow = live & (rank >= M)
+        s_key = s_kid // I
+        key_hit = s_key[:, None] == jnp.arange(NK, dtype=s_key.dtype)[None, :]
+        lossy = state.lossy | jnp.any(overflow[:, None] & key_hit, axis=0)
+        rank = jnp.where(live & (rank < M), rank, M)
+        kid3 = jnp.where(live, s_kid, NK * I)
+        hints = (
+            dict(indices_are_sorted=True, unique_indices=True)
+            if scatter_hints
+            else {}
+        )
+        d_score = jnp.full((NK * I, M), NEG_INF, dtype=jnp.int32)
+        d_dc = jnp.zeros((NK * I, M), dtype=jnp.int32)
+        d_ts = jnp.zeros((NK * I, M), dtype=jnp.int32)
+        d_score = d_score.at[kid3, rank].set(s_score, mode="drop", **hints).reshape(NK, I, M)
+        d_dc = d_dc.at[kid3, rank].set(s_dc, mode="drop", **hints).reshape(NK, I, M)
+        d_ts = d_ts.at[kid3, rank].set(s_ts, mode="drop", **hints).reshape(NK, I, M)
+
+        f_score, f_dc, f_ts, n_live = join_slots(
+            (state.slot_score, state.slot_dc, state.slot_ts),
+            (d_score, d_dc, d_ts),
+            rmv_vc,
+            M,
+        )
+        lossy = lossy | jnp.any(n_live > M, axis=-1)
+        return TopkRmvDenseState(f_score, f_dc, f_ts, rmv_vc, vc, lossy)
+
+    def step(st, ops):
+        return jax.vmap(one)(st, ops)
+
+    return step
+
+
+def current(st, ops):
+    s, _ = D.apply_ops(st, ops, collect_dominated=False)
+    return s
+
+
+base = timeit("A. baseline apply_ops (current code)", current)
+timeit("A'. re-impl sanity (should ~= A)", make_variant(), expect=base)
+timeit("B. sorted+unique hints on delta scatters", make_variant(scatter_hints=True), expect=base)
+timeit("C. dom via one-hot multiply-reduce", make_variant(dom_fn=dom_onehot_reduce), expect=base)
+timeit("D. dom via log-D bit select tree", make_variant(dom_fn=dom_bit_tree), expect=base)
+timeit("E. tombstones via sorted XLA scatter-max", make_variant(tomb="sorted_scatter"), expect=base)
+timeit("F. B+C", make_variant(dom_fn=dom_onehot_reduce, scatter_hints=True), expect=base)
+timeit("G. B+C+E", make_variant(dom_fn=dom_onehot_reduce, scatter_hints=True, tomb="sorted_scatter"), expect=base)
+
+
+# --- H: two delta scatters via (ts << 5) | dc packing ----------------------
+# dc < 32 needs 5 bits; ts fits 26 bits in the overwhelmingly common case
+# (logical clocks; i32 state bounds ts < 2^31 already). The packed path
+# runs when max(ts) < 2^26, guarded by a lax.cond that falls back to the
+# 3-scatter path — correctness is unconditional, the win is conditional.
+
+
+def make_two_scatter(dom_fn=dom_onehot_reduce):
+    base_variant = make_variant(dom_fn=dom_fn)
+
+    def one(state, ops):
+        rmv_valid = (
+            (ops.rmv_id >= 0) & (ops.rmv_id < I)
+            & (ops.rmv_key >= 0) & (ops.rmv_key < NK)
+        )
+        rrow = jnp.where(rmv_valid, ops.rmv_key * I + ops.rmv_id, NK * I)
+        table = state.rmv_vc.reshape(NK * I, D_DCS)
+        rmv_vc = scatter_max_rows_mxu(table, rrow, ops.rmv_vc).reshape(NK, I, D_DCS)
+
+        add_valid = (
+            (ops.add_ts > 0)
+            & (ops.add_key >= 0) & (ops.add_key < NK)
+            & (ops.add_id >= 0) & (ops.add_id < I)
+            & (ops.add_dc >= 0) & (ops.add_dc < D_DCS)
+        )
+        slot = ops.add_key * D_DCS + ops.add_dc
+        hit = slot[:, None] == jnp.arange(NK * D_DCS, dtype=slot.dtype)[None, :]
+        contrib = jnp.where(hit & add_valid[:, None], ops.add_ts[:, None], 0)
+        vc = jnp.maximum(state.vc, jnp.max(contrib, axis=0).reshape(NK, D_DCS))
+
+        kid = jnp.where(add_valid, ops.add_key * I + ops.add_id, NK * I)
+        s_kid, ns, nt, s_dc = lax.sort(
+            (kid, -ops.add_score, -ops.add_ts, ops.add_dc), num_keys=4
+        )
+        s_score, s_ts = -ns, -nt
+        dup = (
+            (s_kid == jnp.roll(s_kid, 1))
+            & (s_score == jnp.roll(s_score, 1))
+            & (s_ts == jnp.roll(s_ts, 1))
+            & (s_dc == jnp.roll(s_dc, 1))
+        )
+        dup = dup.at[0].set(False)
+        live = (s_kid < NK * I) & ~dup
+        grp_start = (s_kid != jnp.roll(s_kid, 1)).at[0].set(True)
+        c = jnp.cumsum(live.astype(jnp.int32))
+        base = lax.cummax(jnp.where(grp_start, c - live.astype(jnp.int32), -1))
+        rank = c - live.astype(jnp.int32) - base
+        overflow = live & (rank >= M)
+        s_key = s_kid // I
+        key_hit = s_key[:, None] == jnp.arange(NK, dtype=s_key.dtype)[None, :]
+        lossy = state.lossy | jnp.any(overflow[:, None] & key_hit, axis=0)
+        rank = jnp.where(live & (rank < M), rank, M)
+        kid3 = jnp.where(live, s_kid, NK * I)
+
+        def packed(_):
+            d_score = jnp.full((NK * I, M), NEG_INF, dtype=jnp.int32)
+            d_td = jnp.zeros((NK * I, M), dtype=jnp.int32)
+            d_score = d_score.at[kid3, rank].set(s_score, mode="drop")
+            d_td = d_td.at[kid3, rank].set((s_ts << 5) | s_dc, mode="drop")
+            return d_score, d_td >> 5, d_td & 31
+
+        def unpacked(_):
+            d_score = jnp.full((NK * I, M), NEG_INF, dtype=jnp.int32)
+            d_dc = jnp.zeros((NK * I, M), dtype=jnp.int32)
+            d_ts = jnp.zeros((NK * I, M), dtype=jnp.int32)
+            d_score = d_score.at[kid3, rank].set(s_score, mode="drop")
+            d_dc = d_dc.at[kid3, rank].set(s_dc, mode="drop")
+            d_ts = d_ts.at[kid3, rank].set(s_ts, mode="drop")
+            return d_score, d_ts, d_dc
+
+        d_score, d_ts, d_dc = lax.cond(
+            jnp.max(s_ts) < (1 << 26), packed, unpacked, operand=None
+        )
+        d_score = d_score.reshape(NK, I, M)
+        d_ts = d_ts.reshape(NK, I, M)
+        d_dc = d_dc.reshape(NK, I, M)
+
+        def live_mask(dcs, ts, rv):
+            return ts > dom_fn(dcs, rv)
+
+        from antidote_ccrdt_tpu.models.topk_rmv_dense import _join_slots
+        f_score, f_dc, f_ts, n_live = _join_slots(
+            (state.slot_score, state.slot_dc, state.slot_ts),
+            (d_score, d_dc, d_ts),
+            rmv_vc,
+            M,
+        )
+        lossy = lossy | jnp.any(n_live > M, axis=-1)
+        return TopkRmvDenseState(f_score, f_dc, f_ts, rmv_vc, vc, lossy)
+
+    def step(st, ops):
+        return jax.vmap(one)(st, ops)
+
+    return step
+
+
+timeit("H. 2-scatter (ts<<5|dc) + cond fallback (dom=select)", make_two_scatter(dom_fn=dom_select_loop), expect=base)
+timeit("I. 2-scatter + dom one-hot reduce", make_two_scatter(), expect=base)
+
+
+# --- J: M-major delta scatter ----------------------------------------------
+# The compiled HLO lays slot tables out I-minor/M-major ([4][R][100k]
+# physical), so the 2-D scalar scatters into logical [NK*I, M] each pay
+# two transposes inside the scatter fusion. Scatter into [M, NK*I] with
+# (rank, kid) indices instead — matching the physical layout — and hand
+# the join a moveaxis view.
+
+
+def make_mmajor(dom_fn=dom_onehot_reduce):
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import _join_slots
+
+    def one(state, ops):
+        rmv_valid = (
+            (ops.rmv_id >= 0) & (ops.rmv_id < I)
+            & (ops.rmv_key >= 0) & (ops.rmv_key < NK)
+        )
+        rrow = jnp.where(rmv_valid, ops.rmv_key * I + ops.rmv_id, NK * I)
+        table = state.rmv_vc.reshape(NK * I, D_DCS)
+        rmv_vc = scatter_max_rows_mxu(table, rrow, ops.rmv_vc).reshape(NK, I, D_DCS)
+
+        add_valid = (
+            (ops.add_ts > 0)
+            & (ops.add_key >= 0) & (ops.add_key < NK)
+            & (ops.add_id >= 0) & (ops.add_id < I)
+            & (ops.add_dc >= 0) & (ops.add_dc < D_DCS)
+        )
+        slot = ops.add_key * D_DCS + ops.add_dc
+        hit = slot[:, None] == jnp.arange(NK * D_DCS, dtype=slot.dtype)[None, :]
+        contrib = jnp.where(hit & add_valid[:, None], ops.add_ts[:, None], 0)
+        vc = jnp.maximum(state.vc, jnp.max(contrib, axis=0).reshape(NK, D_DCS))
+
+        kid = jnp.where(add_valid, ops.add_key * I + ops.add_id, NK * I)
+        s_kid, ns, nt, s_dc = lax.sort(
+            (kid, -ops.add_score, -ops.add_ts, ops.add_dc), num_keys=4
+        )
+        s_score, s_ts = -ns, -nt
+        dup = (
+            (s_kid == jnp.roll(s_kid, 1))
+            & (s_score == jnp.roll(s_score, 1))
+            & (s_ts == jnp.roll(s_ts, 1))
+            & (s_dc == jnp.roll(s_dc, 1))
+        )
+        dup = dup.at[0].set(False)
+        live = (s_kid < NK * I) & ~dup
+        grp_start = (s_kid != jnp.roll(s_kid, 1)).at[0].set(True)
+        c = jnp.cumsum(live.astype(jnp.int32))
+        base = lax.cummax(jnp.where(grp_start, c - live.astype(jnp.int32), -1))
+        rank = c - live.astype(jnp.int32) - base
+        overflow = live & (rank >= M)
+        s_key = s_kid // I
+        key_hit = s_key[:, None] == jnp.arange(NK, dtype=s_key.dtype)[None, :]
+        lossy = state.lossy | jnp.any(overflow[:, None] & key_hit, axis=0)
+        rank = jnp.where(live & (rank < M), rank, M)
+        kid3 = jnp.where(live, s_kid, NK * I)
+
+        # [M, NK*I] tables, (rank, kid) indices: no transposes needed to
+        # reach the I-minor physical layout the join consumes.
+        d_score = jnp.full((M, NK * I), NEG_INF, dtype=jnp.int32)
+        d_dc = jnp.zeros((M, NK * I), dtype=jnp.int32)
+        d_ts = jnp.zeros((M, NK * I), dtype=jnp.int32)
+        d_score = d_score.at[rank, kid3].set(s_score, mode="drop")
+        d_dc = d_dc.at[rank, kid3].set(s_dc, mode="drop")
+        d_ts = d_ts.at[rank, kid3].set(s_ts, mode="drop")
+        mm = lambda x: jnp.moveaxis(x.reshape(M, NK, I), 0, -1)  # noqa: E731
+
+        f_score, f_dc, f_ts, n_live = _join_slots(
+            (state.slot_score, state.slot_dc, state.slot_ts),
+            (mm(d_score), mm(d_dc), mm(d_ts)),
+            rmv_vc,
+            M,
+        )
+        lossy = lossy | jnp.any(n_live > M, axis=-1)
+        return TopkRmvDenseState(f_score, f_dc, f_ts, rmv_vc, vc, lossy)
+
+    def step(st, ops):
+        return jax.vmap(one)(st, ops)
+
+    return step
+
+
+timeit("J. M-major delta scatters + dom select-loop", make_mmajor(dom_fn=dom_select_loop), expect=base)
+timeit("K. M-major delta scatters + dom one-hot reduce", make_mmajor(), expect=base)
+
+
+# --- L: two scatters via i64 (ts << 5) | dc, static under x64 --------------
+# (ts < 2^31) | dc < 32 always fits 36 bits: no range cliff, no cond.
+# Needs JAX_ENABLE_X64=1; the probe self-skips otherwise.
+# --- M: hand-rolled log-step run-max replacing associative_scan ------------
+
+
+def dedup_logstep(rows, upd, n_rows):
+    order = jnp.argsort(rows)
+    r_s = jnp.take_along_axis(rows, order, axis=0)
+    u_s = jnp.take_along_axis(upd, order[:, None], axis=0)
+    total = u_s
+    k = 1
+    n = rows.shape[0]
+    while k < n:
+        # suffix run-max: pull from k ahead while still in the same run
+        r_shift = jnp.concatenate([r_s[k:], jnp.full((k,), -1, r_s.dtype)])
+        t_shift = jnp.concatenate([total[k:], jnp.zeros((k, upd.shape[1]), total.dtype)])
+        same = (r_s == r_shift)[:, None]
+        total = jnp.where(same, jnp.maximum(total, t_shift), total)
+        k *= 2
+    is_head = jnp.concatenate([jnp.ones((1,), bool), r_s[1:] != r_s[:-1]])
+    head_rows = jnp.where(is_head, r_s, n_rows)
+    return head_rows, total
+
+
+def scatter_max_rows_mxu_logstep(table, rows, upd):
+    T, Dd = table.shape
+    head_rows, total = dedup_logstep(rows, upd, T)
+    onehot = (head_rows[:, None] == jnp.arange(T, dtype=jnp.int32)[None, :]).astype(jnp.int8)
+    n_planes = 5
+    planes = jnp.concatenate(
+        [((total >> (7 * k)) & 0x7F).astype(jnp.int8) for k in range(n_planes)], axis=-1
+    )
+    out = lax.dot_general(
+        onehot, planes, (((0,), (0,)), ((), ())), preferred_element_type=jnp.int32
+    )
+    delta = jnp.zeros((T, Dd), jnp.int32)
+    for k in range(n_planes):
+        delta = delta | (out[:, k * Dd : (k + 1) * Dd] << (7 * k))
+    return jnp.maximum(table, delta)
+
+
+def make_l_or_m(i64_pack=False, logstep_dedup=False):
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import _join_slots
+
+    def one(state, ops):
+        rmv_valid = (
+            (ops.rmv_id >= 0) & (ops.rmv_id < I)
+            & (ops.rmv_key >= 0) & (ops.rmv_key < NK)
+        )
+        rrow = jnp.where(rmv_valid, ops.rmv_key * I + ops.rmv_id, NK * I)
+        table = state.rmv_vc.reshape(NK * I, D_DCS)
+        fn = scatter_max_rows_mxu_logstep if logstep_dedup else scatter_max_rows_mxu
+        rmv_vc = fn(table, rrow, ops.rmv_vc).reshape(NK, I, D_DCS)
+
+        add_valid = (
+            (ops.add_ts > 0)
+            & (ops.add_key >= 0) & (ops.add_key < NK)
+            & (ops.add_id >= 0) & (ops.add_id < I)
+            & (ops.add_dc >= 0) & (ops.add_dc < D_DCS)
+        )
+        slot = ops.add_key * D_DCS + ops.add_dc
+        hit = slot[:, None] == jnp.arange(NK * D_DCS, dtype=slot.dtype)[None, :]
+        contrib = jnp.where(hit & add_valid[:, None], ops.add_ts[:, None], 0)
+        vc = jnp.maximum(state.vc, jnp.max(contrib, axis=0).reshape(NK, D_DCS))
+
+        kid = jnp.where(add_valid, ops.add_key * I + ops.add_id, NK * I)
+        s_kid, ns, nt, s_dc = lax.sort(
+            (kid, -ops.add_score, -ops.add_ts, ops.add_dc), num_keys=4
+        )
+        s_score, s_ts = -ns, -nt
+        dup = (
+            (s_kid == jnp.roll(s_kid, 1))
+            & (s_score == jnp.roll(s_score, 1))
+            & (s_ts == jnp.roll(s_ts, 1))
+            & (s_dc == jnp.roll(s_dc, 1))
+        )
+        dup = dup.at[0].set(False)
+        live = (s_kid < NK * I) & ~dup
+        grp_start = (s_kid != jnp.roll(s_kid, 1)).at[0].set(True)
+        c = jnp.cumsum(live.astype(jnp.int32))
+        base = lax.cummax(jnp.where(grp_start, c - live.astype(jnp.int32), -1))
+        rank = c - live.astype(jnp.int32) - base
+        overflow = live & (rank >= M)
+        s_key = s_kid // I
+        key_hit = s_key[:, None] == jnp.arange(NK, dtype=s_key.dtype)[None, :]
+        lossy = state.lossy | jnp.any(overflow[:, None] & key_hit, axis=0)
+        rank = jnp.where(live & (rank < M), rank, M)
+        kid3 = jnp.where(live, s_kid, NK * I)
+
+        d_score = jnp.full((NK * I, M), NEG_INF, dtype=jnp.int32)
+        d_score = d_score.at[kid3, rank].set(s_score, mode="drop").reshape(NK, I, M)
+        if i64_pack:
+            tsdc = (s_ts.astype(jnp.int64) << 5) | s_dc.astype(jnp.int64)
+            d_tsdc = jnp.zeros((NK * I, M), dtype=jnp.int64)
+            d_tsdc = d_tsdc.at[kid3, rank].set(tsdc, mode="drop").reshape(NK, I, M)
+            d_ts = (d_tsdc >> 5).astype(jnp.int32)
+            d_dc = (d_tsdc & 31).astype(jnp.int32)
+        else:
+            d_dc = jnp.zeros((NK * I, M), dtype=jnp.int32)
+            d_ts = jnp.zeros((NK * I, M), dtype=jnp.int32)
+            d_dc = d_dc.at[kid3, rank].set(s_dc, mode="drop").reshape(NK, I, M)
+            d_ts = d_ts.at[kid3, rank].set(s_ts, mode="drop").reshape(NK, I, M)
+
+        f_score, f_dc, f_ts, n_live = _join_slots(
+            (state.slot_score, state.slot_dc, state.slot_ts),
+            (d_score, d_dc, d_ts),
+            rmv_vc,
+            M,
+        )
+        lossy = lossy | jnp.any(n_live > M, axis=-1)
+        return TopkRmvDenseState(f_score, f_dc, f_ts, rmv_vc, vc, lossy)
+
+    def step(st, ops):
+        return jax.vmap(one)(st, ops)
+
+    return step
+
+
+if jax.config.jax_enable_x64:
+    timeit("L. i64-packed tsdc scatter (x64, static)", make_l_or_m(i64_pack=True), expect=base)
+    timeit("L'. x64 on, 3-scatter control", make_l_or_m(), expect=base)
+timeit("M. log-step run-max dedup (no associative_scan)", make_l_or_m(logstep_dedup=True), expect=base)

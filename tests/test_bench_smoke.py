@@ -33,7 +33,7 @@ def test_bench_dense_tiny():
     assert ca["measured_ms"] > 0 and ca["floor_ms"] >= ca["hbm_floor_ms"]
     assert ca["mxu"]["tombstone_onehot_macs"] == 2 * 4 * 64 * 5 * 2
     # The v5e ablation attribution only attaches at north-star shapes.
-    assert ca["attribution_ms_r3"] is None
+    assert ca["attribution_ms_r4"] is None
 
 
 def test_bench_scalar_baseline_tiny():
@@ -55,3 +55,11 @@ def test_bench_main_emits_one_json_line():
     rec = json.loads(lines[0])
     assert rec["unit"] == "merges/sec" and rec["value"] > 0
     assert "vs_baseline" in rec
+    pts = rec["curve"]["points"]
+    # 2 sweep points + the carried-over headline point (source=headline).
+    assert len(pts) == 3 and all(p["merges_per_sec"] > 0 for p in pts)
+    assert sum(1 for p in pts if p.get("source") == "headline") == 1
+    assert all(
+        p["p99_round_ms_e2e"] >= p["p50_round_ms_e2e"] > 0 for p in pts
+    )
+    assert rec["curve"]["operating_point"]["batch_adds"] > 0

@@ -66,10 +66,21 @@ class LiftedMonoidState:
     """A monoid dense state plus per-replica-row versions.
 
     ``ver[r]`` counts op batches applied to row r by its writer; the
-    lifted join replaces whole rows by version (see module docstring)."""
+    lifted join replaces whole rows by version (see module docstring).
+
+    ``swept`` (static metadata, not a device leaf) marks states that have
+    been through `merge` — i.e. that may contain rows adopted from gossip.
+    The write-once (version, content) contract forbids applying ops onto
+    such a state (the adopted rows' versions already count their writers'
+    batches; re-applying would double-count under a legitimate version),
+    and `apply_ops` enforces it (ADVICE r3 #2). The flag is advisory
+    metadata: tree ops that rebuild the dataclass from leaves (device
+    puts, checkpoint restore) reset it to False, so it catches the
+    in-process misuse pattern, not adversarial laundering."""
 
     inner: Any
     ver: jax.Array  # i32[R]
+    swept: bool = dataclasses.field(default=False, metadata=dict(static=True))
 
 
 class MonoidLift:
@@ -99,13 +110,31 @@ class MonoidLift:
 
     def apply_ops(
         self, state: LiftedMonoidState, ops: Any,
-        owned: Optional[Sequence[int]] = None, **kw: Any,
+        owned: Optional[Sequence[int]] = None,
+        allow_swept: bool = False, **kw: Any,
     ) -> Tuple[LiftedMonoidState, Any]:
         """Apply one op batch and bump the version of the rows this member
         WRITES. `owned=None` bumps every row (single-process use, where
         the caller owns the whole grid); gossiping members MUST pass their
         owned rows — bumping a row you only padded would shadow its real
-        writer's content with your identity row."""
+        writer's content with your identity row.
+
+        Raises on a state that has been through `merge` (``swept=True``):
+        applying ops onto gossip-adopted rows double-counts batches under
+        a legitimate version — the exact failure the lift exists to
+        prevent. Writers keep a merge-free contribution state
+        (`MonoidContributor.own`); `allow_swept=True` is the explicit
+        escape hatch for callers that have re-established the write-once
+        contract some other way."""
+        if state.swept and not allow_swept:
+            raise ValueError(
+                "apply_ops on a merged (swept) LiftedMonoidState: its rows "
+                "may have been adopted from gossip, and re-applying ops "
+                "onto them double-counts under a legitimate version. Apply "
+                "onto the writer's own contribution state "
+                "(MonoidContributor), or pass allow_swept=True if the "
+                "write-once contract is re-established."
+            )
         new_inner, extras = self.inner.apply_ops(state.inner, ops, **kw)
         R = state.ver.shape[0]
         if owned is None:
@@ -114,7 +143,7 @@ class MonoidLift:
             b = np.zeros((R,), np.int32)
             b[np.asarray(sorted(owned), np.int64)] = 1
             bump = jnp.asarray(b)
-        return LiftedMonoidState(new_inner, state.ver + bump), extras
+        return LiftedMonoidState(new_inner, state.ver + bump, swept=state.swept), extras
 
     def merge(self, a: LiftedMonoidState, b: LiftedMonoidState) -> LiftedMonoidState:
         take_b = b.ver > a.ver  # ties keep a: same (ver, content) by contract
@@ -126,6 +155,7 @@ class MonoidLift:
         return LiftedMonoidState(
             inner=jax.tree.map(pick, a.inner, b.inner),
             ver=jnp.maximum(a.ver, b.ver),
+            swept=True,
         )
 
     def observe(self, state: LiftedMonoidState) -> Any:
@@ -222,6 +252,9 @@ def apply_monoid_row_delta(
     return LiftedMonoidState(
         inner=jax.tree_util.tree_unflatten(treedef, rebuilt),
         ver=jnp.asarray(local_ver.astype(np.int32)),
+        # Adopting peer rows via a delta is gossip adoption exactly like
+        # merge(): the result must trip apply_ops' write-once guard too.
+        swept=True,
     )
 
 
@@ -246,10 +279,18 @@ def monoid_delta_in_bounds(
     dver = np.asarray(delta.get("ver", None))
     if rows.ndim != 1 or not np.issubdtype(rows.dtype, np.integer):
         return False
+    if not np.issubdtype(dver.dtype, np.integer):
+        return False
     n = rows.size
     if dver.shape != (n,):
         return False
     if n and (rows.min() < 0 or rows.max() >= R):
+        return False
+    # Duplicate row indices would make apply's fancy assignment last-write-
+    # wins: a crafted [ver 10, ver 3] pair for one row leaves the stale
+    # ver-3 payload in place even though each entry individually passes the
+    # version guard. Honest publishers never emit duplicates (ADVICE r3 #1).
+    if np.unique(rows).size != n:
         return False
     flat = jax.tree_util.tree_flatten_with_path(like_state.inner)[0]
     paths = {jax.tree_util.keystr(p): leaf.shape for p, leaf in flat}

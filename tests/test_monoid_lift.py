@@ -144,10 +144,17 @@ def test_contributor_discipline_vs_naive_reapply(tmp_path):
     published = w.view
     # A naive adopter merges the snapshot, then "catches up" by applying
     # the full history ON TOP of it (the JOIN drill's in-place re-apply).
+    # Since round 4 the raw surface REJECTS this (ADVICE r3 #2) — the
+    # demonstration below has to opt in explicitly to show the hazard the
+    # guard now screens.
     naive = lift.init(R, NK)
     naive = lift.merge(naive, published)
+    with pytest.raises(ValueError, match="swept"):
+        lift.apply_ops(naive, avg_ops([0], 0), owned=[0])
     for s in range(3):
-        naive, _ = lift.apply_ops(naive, avg_ops([0], s), owned=[0])
+        naive, _ = lift.apply_ops(
+            naive, avg_ops([0], s), owned=[0], allow_swept=True
+        )
     ref_sum, _ = exact_totals(lift, [3, 0, 0, 0])
     assert np.asarray(lift.total(naive).sum)[0].sum() == 2 * ref_sum[0].sum(), (
         "the naive path should double-count — if it doesn't, this test "
@@ -359,3 +366,69 @@ def test_apply_ops_owned_none_bumps_all_rows():
     assert list(np.asarray(st.ver)) == [1] * R
     st, _ = lift.apply_ops(st, avg_ops([], 1), owned=[])
     assert list(np.asarray(st.ver)) == [1] * R
+
+
+def test_delta_bounds_rejects_duplicate_rows_and_float_ver():
+    """ADVICE r4 #1: apply's fancy assignment is last-write-wins, so a
+    crafted delta carrying one row twice ([ver 10, ver 3]) would leave the
+    stale payload in place; the validator screens it out. Same for
+    non-integer ver dtypes (the guard compares against i32 versions)."""
+    lift = lift_avg()
+    like = lift.init(R, NK)
+    shapes = {".sum": (R, NK), ".num": (R, NK)}
+
+    def mk(rows, ver):
+        n = len(rows)
+        return {
+            "rows": jnp.asarray(rows, jnp.int32),
+            "ver": jnp.asarray(ver),
+            "leaves": {
+                p: jnp.zeros((n,) + tuple(s[1:]), jnp.int32)
+                for p, s in shapes.items()
+            },
+        }
+
+    assert monoid_delta_in_bounds(lift, like, mk([0, 2], [1, 1]))
+    assert not monoid_delta_in_bounds(lift, like, mk([2, 2], [10, 3]))
+    assert not monoid_delta_in_bounds(
+        lift, like, mk([0], jnp.asarray([1.0], jnp.float32))
+    )
+
+
+def test_apply_ops_rejects_swept_states():
+    """ADVICE r4 #2: the write-once contract is now enforced, not just
+    documented — a gossip-merged state refuses further apply_ops unless
+    the caller explicitly re-establishes the contract."""
+    lift = lift_avg()
+    a = lift.init(R, NK)
+    a, _ = lift.apply_ops(a, avg_ops([0], 0), owned=[0])
+    assert not a.swept
+    b = lift.init(R, NK)
+    b, _ = lift.apply_ops(b, avg_ops([1], 0), owned=[1])
+    merged = lift.merge(a, b)
+    assert merged.swept
+    with pytest.raises(ValueError, match="swept"):
+        lift.apply_ops(merged, avg_ops([0], 1), owned=[0])
+    # Escape hatch is explicit and stays sticky on the result.
+    forced, _ = lift.apply_ops(merged, avg_ops([0], 1), owned=[0], allow_swept=True)
+    assert forced.swept
+    # The contributor discipline never trips the guard: own is merge-free.
+    contrib = MonoidContributor(lift, R, NK)
+    contrib.apply(avg_ops([0], 0), owned=[0])
+    contrib.absorb(merged)
+    assert contrib.view.swept  # view is a merge product, as expected
+    contrib.apply(avg_ops([0], 1), owned=[0])  # still fine: applies to own
+
+
+def test_delta_adoption_marks_swept():
+    """Adopting rows via a row delta is gossip adoption like merge():
+    the result must trip apply_ops' write-once guard (code-review r4)."""
+    lift = lift_avg()
+    a = lift.init(R, NK)
+    a, _ = lift.apply_ops(a, avg_ops([0], 0), owned=[0])
+    d = monoid_row_delta(lift, lift.init(R, NK), a)
+    fresh = lift.init(R, NK)
+    got = apply_monoid_row_delta(lift, fresh, d)
+    assert got.swept
+    with pytest.raises(ValueError, match="swept"):
+        lift.apply_ops(got, avg_ops([0], 1), owned=[0])

@@ -93,6 +93,17 @@ class BridgeClient:
     def compact(self, handle: Any, effect_terms: List[Any]) -> List[Any]:
         return self.call((Atom("compact"), handle, effect_terms))
 
+    def grid_compact(
+        self, type_name: str, effect_terms: List[Any], m_keep: int = 0
+    ) -> List[Any]:
+        """Whole-log vectorized compaction of an effect-op log (no handle:
+        stateless). m_keep=0 keeps every non-dominated add (reference
+        compaction semantics); >0 bounds survivors per id."""
+        params = [(Atom("m_keep"), m_keep)] if m_keep else []
+        return self.call(
+            (Atom("grid_compact"), Atom(type_name), params, effect_terms)
+        )
+
     def free(self, handle: Any) -> None:
         self.call((Atom("free"), handle))
 

@@ -216,6 +216,15 @@ class _Grid:
                     # wrong element's tombstones and then be silently
                     # discarded — reject at the boundary instead.
                     raise ValueError(f"add (key={key}, id={id_}) out of range")
+                if ts < 1:
+                    # ts == 0 is the dense engines' empty-slot sentinel: the
+                    # add would be silently treated as padding and its dc
+                    # dropped from re-broadcast vcs (reference add/2 returns
+                    # the full removal vc, topk_rmv.erl:234-237). Enforce the
+                    # repo-wide "real timestamps start at 1" convention
+                    # loudly at the wire, like the other field checks
+                    # (ADVICE r3 #3).
+                    raise ValueError(f"add ts {ts} out of range (ts >= 1)")
                 a[ri, j] = (key, id_, score, dc, ts)
         for ri, ops in enumerate(rmvs):
             for j, (_, key, id_, vc_list) in enumerate(ops):
@@ -780,6 +789,25 @@ class BridgeServer:
                     if changed:
                         break
             return [op_to_term(e) for e in log if e is not None]
+        if tag == "grid_compact":
+            # Whole-log compaction of a host effect-op log in one
+            # vectorized pass (ops/compaction.py) — the device-path
+            # equivalent of the scalar pairwise `compact` op above (the
+            # reference's can_compact/2 + compact_ops/2 walk,
+            # antidote_ccrdt.erl:55-56). Same effect-term shapes in and
+            # out; m_keep (proplist) optionally bounds surviving adds per
+            # id for topk_rmv (default: keep all, reference semantics).
+            _, type_atom, params, effects = op
+            from ..ops.compaction import compact_effect_ops
+
+            m_keep = None
+            for kv in params:
+                if (isinstance(kv, tuple) and len(kv) == 2
+                        and str(kv[0]) == "m_keep"):
+                    m_keep = int(kv[1])
+            log = [op_from_term(e) for e in effects]
+            out = compact_effect_ops(str(type_atom), log, m_keep=m_keep)
+            return [op_to_term(e) for e in out]
         if tag == "free":
             _, h = op
             with self._meta:

@@ -134,6 +134,31 @@ class DenseReplay:
         self.metrics.count("rounds")
         return extras
 
+    def apply_coalesced(self, ops_list: Sequence[Any], **coalesce_kw: Any) -> Any:
+        """Whole-log compaction as a pre-apply pass: fuse several op
+        batches into one compacted batch via the engine's `coalesce_ops`
+        (reference: the host compacts its log before shipping,
+        antidote_ccrdt.erl:55-56), then apply it as a single round.
+
+        Note the extras caveat: compaction deletes dominated adds, so
+        their re-broadcast extras are not generated — use on logs whose
+        dominated extras are not consumed (see
+        ops.compaction.coalesce_topk_rmv_ops)."""
+        coalesce = getattr(self.dense, "coalesce_ops", None)
+        if coalesce is None:
+            raise TypeError(
+                f"{type(self.dense).__name__} does not support batch "
+                "coalescing (no coalesce_ops)"
+            )
+        with self.metrics.timer("coalesce"):
+            ops, n_add, n_rmv = coalesce(ops_list, **coalesce_kw)
+        self.metrics.count("coalesce_ops_in", sum(
+            o.add_key.shape[0] * (o.add_key.shape[1] + o.rmv_key.shape[1])
+            for o in ops_list
+        ))
+        self.metrics.count("coalesce_ops_out", int(n_add.sum() + n_rmv.sum()))
+        return self.apply(ops)
+
     # -- reconciliation ----------------------------------------------------
 
     def sync(self, contributors: Optional[Sequence[int]] = None) -> None:

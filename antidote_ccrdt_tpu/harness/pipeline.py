@@ -106,17 +106,44 @@ def stream_apply(
     reconcile_every: int = 0,
     reconcile: Optional[Callable[[Any], Any]] = None,
     apply_kwargs: Optional[dict] = None,
+    coalesce: int = 0,
+    coalesce_kwargs: Optional[dict] = None,
 ):
     """Fold a stream of op batches into `state` with prefetch overlap:
     ``state = engine.apply_ops(state, batch)[0]`` per batch, calling
     `reconcile(state)` every `reconcile_every` batches (0 = never).
-    Returns (state, n_batches)."""
+
+    `coalesce=k` buffers k batches and pre-compacts them into ONE batch
+    via the engine's whole-log `coalesce_ops` (ops/compaction.py) before
+    applying — the pre-ship log-compaction pass (the reference host's
+    can_compact/compact_ops walk, antidote_ccrdt.erl:55-56). The final
+    partial group is coalesced too. `reconcile_every` then counts
+    coalesced applications. Returns (state, n_batches) with n_batches
+    the RAW batch count consumed."""
     kw = apply_kwargs or {}
     n = 0
+    applied = 0
+
+    def do_apply(ops):
+        nonlocal state, applied
+        state, _ = engine.apply_ops(state, ops, **kw)
+        applied += 1
+        if reconcile_every and reconcile is not None and applied % reconcile_every == 0:
+            state = reconcile(state)
+
+    buf = []
     with Prefetcher(batches, depth=depth) as pf:
         for ops in pf:
-            state, _ = engine.apply_ops(state, ops, **kw)
             n += 1
-            if reconcile_every and reconcile is not None and n % reconcile_every == 0:
-                state = reconcile(state)
+            if coalesce and coalesce > 1:
+                buf.append(ops)
+                if len(buf) == coalesce:
+                    fused, _, _ = engine.coalesce_ops(buf, **(coalesce_kwargs or {}))
+                    buf = []
+                    do_apply(fused)
+            else:
+                do_apply(ops)
+    if buf:
+        fused, _, _ = engine.coalesce_ops(buf, **(coalesce_kwargs or {}))
+        do_apply(fused)
     return state, n

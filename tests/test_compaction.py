@@ -350,3 +350,181 @@ class TestLeaderboardCompaction:
         assert int(n1) == int(n2)
         np.testing.assert_array_equal(np.asarray(k1), np.asarray(k2))
         np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+
+
+# --- round 4: the production callers (VERDICT-r3 item 2) -------------------
+
+
+class TestCompactEffectOps:
+    """Term-level whole-log compaction (`compact_effect_ops`) — the surface
+    the bridge's grid_compact serves. Differential contract: replaying the
+    compacted effect list through the scalar reference semantics matches
+    replaying the raw list."""
+
+    @staticmethod
+    def _log_to_effects(log):
+        kind, key, id_, score, dc, ts, vc = _log_to_np(log)
+        names = {KIND_ADD: "add", KIND_ADD_R: "add_r",
+                 KIND_RMV: "rmv", KIND_RMV_R: "rmv_r"}
+        out = []
+        for i in range(len(kind)):
+            k = int(kind[i])
+            if k == KIND_DEAD:
+                continue
+            if k in (KIND_ADD, KIND_ADD_R):
+                out.append((names[k],
+                            (int(id_[i]), int(score[i]),
+                             (int(dc[i]), int(ts[i])))))
+            else:
+                vcd = {d: int(vc[i, d]) for d in range(vc.shape[1]) if vc[i, d] > 0}
+                out.append((names[k], (int(id_[i]), vcd)))
+        return out
+
+    @pytest.mark.parametrize("seed", [0, 5])
+    def test_topk_rmv_differential(self, seed):
+        from antidote_ccrdt_tpu.ops.compaction import compact_effect_ops
+
+        rng = np.random.default_rng(seed)
+        log = _random_topk_rmv_log(rng, 192, n_ids=24, n_dcs=3)
+        effects = self._log_to_effects(log)
+        compacted = compact_effect_ops("topk_rmv", effects)
+        assert len(compacted) < len(effects)
+        S = TopkRmvScalar()
+        raw = S.new(8)
+        for e in effects:
+            raw, _ = S.update(e, raw)
+        cmp_ = S.new(8)
+        for e in compacted:
+            cmp_, _ = S.update(e, cmp_)
+        # value/1's list order tracks insertion (unspecified in the
+        # reference); compaction reorders groups, so compare as sets.
+        assert sorted(S.value(raw)) == sorted(S.value(cmp_))
+        # Tombstones fused per id, never dropped.
+        assert raw.removals == cmp_.removals
+        # One rmv per id at most.
+        rmv_ids = [p[0] for k, p in compacted if k.startswith("rmv")]
+        assert len(rmv_ids) == len(set(rmv_ids))
+
+    def test_average_topk_wordcount_leaderboard(self):
+        from antidote_ccrdt_tpu.ops.compaction import compact_effect_ops
+
+        out = compact_effect_ops("average", [("add", (3, 1)), ("add", (5, 2))])
+        assert out == [("add", (8, 3))]
+
+        out = compact_effect_ops(
+            "topk", [("add", (4, 10)), ("add", (4, 30)), ("add", (2, 7))]
+        )
+        assert sorted(out) == [("add", (2, 7)), ("add", (4, 30))]
+
+        out = compact_effect_ops(
+            "leaderboard",
+            [("add", (1, 10)), ("add_r", (1, 40)), ("ban", 2), ("add", (2, 99))],
+        )
+        assert ("ban", 2) in out
+        assert ("add_r", (1, 40)) in out and len(out) == 2
+
+        out = compact_effect_ops(
+            "wordcount", [("add", "a b a"), ("add_counts", {"b": 2, "c": 1})]
+        )
+        assert out == [("add_counts", {"a": 2, "b": 3, "c": 1})]
+        # worddocumentcount dedupes per document FIRST (wordcount.erl:76-86).
+        out = compact_effect_ops(
+            "worddocumentcount", [("add", "a b a"), ("add", "a c")]
+        )
+        assert out == [("add_counts", {"a": 2, "b": 1, "c": 1})]
+
+    def test_unknown_type_and_empty(self):
+        from antidote_ccrdt_tpu.ops.compaction import compact_effect_ops
+
+        assert compact_effect_ops("topk_rmv", []) == []
+        with pytest.raises(ValueError, match="no whole-log compactor"):
+            compact_effect_ops("mystery", [("add", 1)])
+
+
+class TestCoalesce:
+    """Batch coalescing (`coalesce_topk_rmv_ops` via the engine's
+    `coalesce_ops`): k batches fuse into one compacted batch whose single
+    apply reaches the same observable state as applying the k batches in
+    sequence."""
+
+    def _gen(self, seed, R=3, I=64, D=3, B=48, Br=8, k=3, zipf_a=1.1):
+        from antidote_ccrdt_tpu.harness.opgen import TopkRmvEffectGen, Workload
+
+        gen = TopkRmvEffectGen(
+            Workload(n_replicas=R, n_ids=I, zipf_a=zipf_a, score_max=1000, seed=seed)
+        )
+        return [gen.next_batch(B, Br) for _ in range(k)]
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_matches_sequential_apply(self, seed):
+        from antidote_ccrdt_tpu.models.topk_rmv_dense import make_dense
+
+        # Id space wide / skew mild enough that per-batch rank overflow
+        # (lossy) stays clear — the precondition for exact slot equality
+        # (asserted below; a lossy sequential path may drop history the
+        # coalesced union keeps).
+        R, I, D = 3, 4096, 3
+        dense = make_dense(n_ids=I, n_dcs=D, size=8, slots_per_id=4)
+        batches = self._gen(seed, R=R, I=I, D=D, B=32, Br=6, zipf_a=1.02)
+        seq = dense.init(n_replicas=R)
+        for ops in batches:
+            seq, _ = dense.apply_ops(seq, ops, collect_dominated=False)
+        fused, n_add, n_rmv = dense.coalesce_ops(batches)
+        assert (n_add > 0).all()
+        one = dense.init(n_replicas=R)
+        one, _ = dense.apply_ops(one, fused, collect_dominated=False)
+        # The id-space is large enough that per-batch rank overflow is not
+        # hit (no lossy truncation) — then slot/tombstone equality is exact.
+        assert not np.asarray(seq.lossy).any()
+        assert np.array_equal(np.asarray(seq.slot_score), np.asarray(one.slot_score))
+        assert np.array_equal(np.asarray(seq.slot_ts), np.asarray(one.slot_ts))
+        assert np.array_equal(np.asarray(seq.slot_dc), np.asarray(one.slot_dc))
+        assert np.array_equal(np.asarray(seq.rmv_vc), np.asarray(one.rmv_vc))
+        # vc is NOT compared: compaction deletes dominated adds, which the
+        # sequential path lets advance the clock (the same divergence the
+        # reference's add/rmv compaction rule accepts, topk_rmv.erl:182-187).
+        assert dense.equal(seq, one)
+
+    def test_window_overflow_raises(self):
+        from antidote_ccrdt_tpu.models.topk_rmv_dense import make_dense
+
+        dense = make_dense(n_ids=64, n_dcs=3, size=8, slots_per_id=4)
+        batches = self._gen(2)
+        with pytest.raises(ValueError, match="overflows"):
+            dense.coalesce_ops(batches, out_adds=4, out_rmvs=1)
+
+    def test_dense_replay_and_stream_apply(self):
+        from antidote_ccrdt_tpu.harness.dense_replay import DenseReplay
+        from antidote_ccrdt_tpu.harness.pipeline import stream_apply
+        from antidote_ccrdt_tpu.models.topk_rmv_dense import make_dense
+
+        R = 3
+        dense = make_dense(n_ids=64, n_dcs=3, size=8, slots_per_id=4)
+        batches = self._gen(3, R=R)
+
+        rp_raw = DenseReplay(dense, n_replicas=R)
+        for ops in batches:
+            rp_raw.apply(ops)
+        rp_c = DenseReplay(dense, n_replicas=R)
+        rp_c.apply_coalesced(batches)
+        assert dense.equal(rp_raw.state, rp_c.state)
+        assert rp_c.metrics.counters["coalesce_ops_out"] < rp_c.metrics.counters[
+            "coalesce_ops_in"
+        ]
+
+        # stream_apply(coalesce=2) over 3 batches: one fused pair + the
+        # partial tail group, same observable end state.
+        st, n = stream_apply(
+            dense, dense.init(n_replicas=R), iter(batches), coalesce=2,
+            apply_kwargs=dict(collect_dominated=False),
+        )
+        assert n == 3
+        assert dense.equal(rp_raw.state, st)
+
+    def test_replay_without_capability_raises(self):
+        from antidote_ccrdt_tpu.harness.dense_replay import DenseReplay
+        from antidote_ccrdt_tpu.models.average import AverageDense
+
+        rp = DenseReplay(AverageDense(), n_replicas=2)
+        with pytest.raises(TypeError, match="coalesce"):
+            rp.apply_coalesced([])

@@ -412,6 +412,58 @@ class WordcountDense:
         return WordcountDenseState(counts, lost), None
 
     @functools.partial(jax.jit, static_argnums=0)
+    def apply_doc_ops_compact(
+        self,
+        state: WordcountDenseState,
+        uniq: jax.Array,
+        doc_lens: jax.Array,
+        counts: jax.Array,
+        bucket_table: Optional[jax.Array] = None,
+        key: jax.Array | int = 0,
+    ):
+        """`apply_doc_ops` fed by the COMPACT ingest wire (VERDICT-r3 item
+        6): of the three [R, B] planes the raw wire carries, two are pure
+        redundancy — `doc` is the run-length expansion of per-document
+        token counts, and `token` is a function of `uniq` (the exact-id ->
+        bucket map, one FNV pass over the vocabulary). So the wire ships
+        only `uniq` + `doc_lens` [R, DOCS] + per-replica live `counts`,
+        and this wrapper rebuilds the planes device-side:
+
+        * doc — positions are document-major, so doc[p] is a searchsorted
+          of p against the cumulative lengths (empty documents own no
+          positions and are skipped by side='right').
+        * token — one gather from the resident `bucket_table` (uploaded
+          once per corpus like model weights; ~2 bytes/vocab-word vs
+          2 bytes/TOKEN for the full plane). `None` = exact mode
+          (token == uniq), matching WordDocOps' exact-mode convention.
+
+        Dedup semantics are unchanged — the rebuilt planes feed the same
+        sort kernel, and `uniq` (string identity) remains the dedup key
+        (worddocumentcount.erl:76-86). Padding beyond counts[r] is
+        remapped to token=-1 exactly like the raw wire's sentinel.
+        `key` (scalar) targets one NK row like the raw builder's key
+        plane; a compact batch addresses a single key — batches spanning
+        keys must use the raw WordDocOps wire."""
+        B = uniq.shape[1]
+        pos = jnp.arange(B, dtype=jnp.int32)
+        live = pos[None, :] < counts[:, None]
+        uniq32 = jnp.where(live, uniq.astype(jnp.int32), -1)
+        cum = jnp.cumsum(doc_lens.astype(jnp.int32), axis=-1)
+        doc = jax.vmap(
+            lambda c: jnp.searchsorted(c, pos, side="right")
+        )(cum).astype(jnp.int32)
+        if bucket_table is None:
+            token = uniq32
+        else:
+            tbl = bucket_table.astype(jnp.int32)
+            token = jnp.take(tbl, jnp.clip(uniq32, 0, tbl.shape[0] - 1))
+            token = jnp.where(live, token, -1)
+        ops = WordDocOps(
+            key=jnp.full_like(uniq32, key), doc=doc, uniq=uniq32, token=token
+        )
+        return self.apply_doc_ops(state, ops)
+
+    @functools.partial(jax.jit, static_argnums=0)
     def merge(self, a: WordcountDenseState, b: WordcountDenseState):
         return WordcountDenseState(a.counts + b.counts, a.lost + b.lost)
 

@@ -1,0 +1,339 @@
+"""Piece ablation + restructuring probes for the batched replica-state
+merge at NORTH-STAR bench shapes (VERDICT-r3 item 3: give `merge` the
+treatment `apply` got in round 3).
+
+The merge (`TopkRmvDense.merge`) is three pieces:
+  * maxes   — elementwise rmv_vc/vc max: pure bandwidth, and the rmv_vc
+              plane is 400MB of the 563MB state, so this is most of the
+              bytes floor.
+  * dom     — the add-wins live masks: two one-hot max-reduces of each
+              side's (dc, ts) slots against the merged rmv_vc
+              (`_dom_lookup`), broadcasting rmv_vc over the M slot axis.
+  * join    — M x M cross-compares, rank arithmetic, one-hot placement
+              (`_join_slots` minus the dom part).
+
+Methodology is ablate_apply.py's: the full merge is timed with one piece
+removed at a time; because XLA fuses across pieces, removal deltas are
+the honest attribution. Scan-fused reps with a carried state keep every
+iteration live; host-readback sync (utils/benchtime.py).
+
+Restructuring probes (VERDICT-r3 asked for at least one attempt,
+committed either way):
+  * packedcmp — fold the lexicographic (score desc, ts desc, dc asc)
+    compare + the equality test into one sign-combine integer
+    (r = 4*sgn(ds) + 2*sgn(dt) + sgn(-dd); better <=> r > 0,
+    eq <=> r == 0) — fewer VPU lanes than the boolean chain.
+  * domdist — dom(dc, max(a_rmv, b_rmv)) == max(dom(dc, a_rmv),
+    dom(dc, b_rmv)) (one-hot max-reduce distributes over elementwise
+    max), so the live masks can be computed from the two INPUT rmv
+    planes without re-reading the merged plane the maxes piece writes —
+    breaks the dom -> maxes data dependency.
+  * fusedpair — one one-hot reduce over the concatenated [.., 2M] slot
+    planes instead of two M-wide reduces (same flops, half the
+    broadcast-iota/where chains for XLA to schedule).
+
+Run: [MERGE_REPS=32] python benchmarks/merge_probe.py [name-filter ...]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from antidote_ccrdt_tpu.harness.opgen import TopkRmvEffectGen, Workload
+from antidote_ccrdt_tpu.models.topk_rmv_dense import (
+    NEG_INF,
+    TopkRmvDenseState,
+    _join_slots,
+    make_dense,
+)
+
+R, NK, I, D_DCS, K, M = 32, 1, 100_000, 32, 100, 4
+REPS = int(os.environ.get("MERGE_REPS", 32))
+D = make_dense(n_ids=I, n_dcs=D_DCS, size=K, slots_per_id=M)
+
+# Two realistically divergent sides: a common warm prefix, then disjoint
+# op suffixes per side (so slots are populated, tombstones nonzero, and
+# the join has real cross-side work to do — an empty-vs-empty merge would
+# let XLA's `where` chains short-circuit into broadcast constants).
+gen = TopkRmvEffectGen(
+    Workload(n_replicas=R, n_ids=I, zipf_a=1.2, score_max=100_000, seed=7)
+)
+state0 = D.init(n_replicas=R, n_keys=1)
+for _ in range(2):
+    state0, _ = D.apply_ops(state0, gen.next_batch(32768, 2048), collect_dominated=False)
+side_a, _ = D.apply_ops(state0, gen.next_batch(32768, 2048), collect_dominated=False)
+side_b, _ = D.apply_ops(state0, gen.next_batch(32768, 2048), collect_dominated=False)
+# Peer rows rolled like bench.py so replica r merges a genuinely foreign row.
+side_b = jax.tree.map(lambda x: jnp.roll(x, 1, axis=0), side_b)
+
+
+def sync(x):
+    return np.asarray(jax.tree.leaves(x)[0].ravel()[0])
+
+
+SELECT = sys.argv[1:]
+RESULTS = {}
+
+
+def timeit(name, step_fn, peer=None):
+    """Time REPS scan-fused applications of step_fn(carry, peer)."""
+    if SELECT and not any(s in name for s in SELECT):
+        return None
+    peer = side_b if peer is None else peer
+
+    @jax.jit
+    def run(c, p):
+        def body(c, _):
+            return step_fn(c, p), ()
+        out, _ = lax.scan(body, c, None, length=REPS)
+        return out
+
+    sync(run(side_a, peer))
+    t0 = time.perf_counter()
+    out = run(side_a, peer)
+    sync(out)
+    ms = (time.perf_counter() - t0) / REPS * 1e3
+    RESULTS[name] = ms
+    print(f"{name:44s} {ms:9.3f} ms/merge", flush=True)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pieces (removal variants of the full merge)
+# ---------------------------------------------------------------------------
+
+def full(a, b):
+    return D.merge(a, b)
+
+
+def maxes_only(a, b):
+    """Only the elementwise maxes; slots carried through untouched."""
+    return TopkRmvDenseState(
+        a.slot_score, a.slot_dc, a.slot_ts,
+        jnp.maximum(a.rmv_vc, b.rmv_vc),
+        jnp.maximum(a.vc, b.vc),
+        a.lossy | b.lossy,
+    )
+
+
+def _merge_variant(a, b, live_fn, place=True, contract=None):
+    """The full merge with the live-mask computation (dom piece) replaced
+    by `live_fn`, the one-hot placement optionally dropped, and the
+    one-hot contraction optionally swapped (`contract(oh, x) -> [.., m]`,
+    e.g. merge_probe2's einsum placement)."""
+    rmv_vc = jnp.maximum(a.rmv_vc, b.rmv_vc)
+    vc = jnp.maximum(a.vc, b.vc)
+    a_s, a_d, a_t = a.slot_score, a.slot_dc, a.slot_ts
+    b_s, b_d, b_t = b.slot_score, b.slot_dc, b.slot_ts
+    live_a, live_b0 = live_fn(a, b, rmv_vc)
+
+    A = lambda x: x[..., :, None]  # noqa: E731
+    B_ = lambda x: x[..., None, :]  # noqa: E731
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import _cmp_better
+
+    a_beats_b = _cmp_better(A(a_s), A(a_t), A(a_d), B_(b_s), B_(b_t), B_(b_d))
+    eq = (A(a_s) == B_(b_s)) & (A(a_t) == B_(b_t)) & (A(a_d) == B_(b_d))
+    live_b = live_b0 & ~jnp.any(eq & A(live_a), axis=-2)
+    b_beats_a = ~a_beats_b & ~eq
+
+    la = live_a.astype(jnp.int32)
+    lb = live_b.astype(jnp.int32)
+    pref_a = jnp.cumsum(la, axis=-1) - la
+    pref_b = jnp.cumsum(lb, axis=-1) - lb
+    r_a = pref_a + jnp.sum(b_beats_a & B_(live_b), axis=-1)
+    r_b = pref_b + jnp.sum(a_beats_b & A(live_a), axis=-2)
+    n_live = jnp.sum(la, axis=-1) + jnp.sum(lb, axis=-1)
+
+    if not place:
+        # Keep the rank computation live via an OPAQUE data dependency:
+        # (r_a + r_b) < -1 is always false (ranks are >= 0) but XLA's
+        # algebraic simplifier cannot prove it, so the compare/rank chain
+        # survives DCE. (A first cut used a_s + (r_a - r_a), which folds
+        # to a_s and silently ablated compare+ranks along with placement.)
+        f_score = jnp.where((r_a + r_b) < -1, r_b, a_s)
+        f_dc = jnp.where((r_a + r_b) < -1, r_a, a_d)
+        f_ts = a_t
+    else:
+        r_a = jnp.where(live_a, r_a, 2 * M)
+        r_b = jnp.where(live_b, r_b, 2 * M)
+        ranks = jnp.arange(M, dtype=jnp.int32)
+        oh_a = r_a[..., :, None] == ranks
+        oh_b = r_b[..., :, None] == ranks
+
+        if contract is None:
+            def place_one(xa, xb, empty):
+                out = jnp.sum(
+                    jnp.where(oh_a, xa[..., :, None], 0), axis=-2
+                ) + jnp.sum(jnp.where(oh_b, xb[..., :, None], 0), axis=-2)
+                filled = jnp.any(oh_a, axis=-2) | jnp.any(oh_b, axis=-2)
+                return jnp.where(filled, out, empty)
+        else:
+            oha_i = oh_a.astype(jnp.int32)
+            ohb_i = oh_b.astype(jnp.int32)
+
+            def place_one(xa, xb, empty):
+                out = contract(oha_i, xa) + contract(ohb_i, xb)
+                filled = (
+                    jnp.max(oha_i, axis=-2) + jnp.max(ohb_i, axis=-2)
+                ) > 0
+                return jnp.where(filled, out, empty)
+
+        f_score = place_one(a_s, b_s, NEG_INF)
+        f_dc = place_one(a_d, b_d, 0)
+        f_ts = place_one(a_t, b_t, 0)
+
+    lossy = a.lossy | b.lossy | jnp.any(n_live > M, axis=-1)
+    return TopkRmvDenseState(f_score, f_dc, f_ts, rmv_vc, vc, lossy)
+
+
+def _live_dom(a, b, rmv_vc):
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import _live_mask
+    return (
+        _live_mask(a.slot_dc, a.slot_ts, rmv_vc),
+        _live_mask(b.slot_dc, b.slot_ts, rmv_vc),
+    )
+
+
+def _live_ts_only(a, b, rmv_vc):
+    """Dom piece removed: live = any nonempty slot (no tombstone lookup)."""
+    return a.slot_ts > 0, b.slot_ts > 0
+
+
+# ---------------------------------------------------------------------------
+# Restructurings
+# ---------------------------------------------------------------------------
+
+def packedcmp(a, b):
+    """Sign-combine compare: one small-int recombination replaces the
+    boolean lexicographic chain AND the equality test."""
+    rmv_vc = jnp.maximum(a.rmv_vc, b.rmv_vc)
+    vc = jnp.maximum(a.vc, b.vc)
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import _live_mask
+
+    a_s, a_d, a_t = a.slot_score, a.slot_dc, a.slot_ts
+    b_s, b_d, b_t = b.slot_score, b.slot_dc, b.slot_ts
+    live_a = _live_mask(a_d, a_t, rmv_vc)
+    live_b = _live_mask(b_d, b_t, rmv_vc)
+
+    A = lambda x: x[..., :, None]  # noqa: E731
+    B_ = lambda x: x[..., None, :]  # noqa: E731
+
+    def sgn(x, y):  # sign(x - y) without subtraction overflow
+        return (x > y).astype(jnp.int32) - (x < y).astype(jnp.int32)
+
+    r = (
+        4 * sgn(A(a_s), B_(b_s))
+        + 2 * sgn(A(a_t), B_(b_t))
+        + sgn(B_(b_d), A(a_d))  # dc ASC: smaller dc is better
+    )
+    a_beats_b = r > 0
+    eq = r == 0
+    live_b = live_b & ~jnp.any(eq & A(live_a), axis=-2)
+    b_beats_a = (r < 0) & ~eq
+
+    la = live_a.astype(jnp.int32)
+    lb = live_b.astype(jnp.int32)
+    pref_a = jnp.cumsum(la, axis=-1) - la
+    pref_b = jnp.cumsum(lb, axis=-1) - lb
+    r_a = pref_a + jnp.sum(b_beats_a & B_(live_b), axis=-1)
+    r_b = pref_b + jnp.sum(a_beats_b & A(live_a), axis=-2)
+    n_live = jnp.sum(la, axis=-1) + jnp.sum(lb, axis=-1)
+    r_a = jnp.where(live_a, r_a, 2 * M)
+    r_b = jnp.where(live_b, r_b, 2 * M)
+    ranks = jnp.arange(M, dtype=jnp.int32)
+    oh_a = r_a[..., :, None] == ranks
+    oh_b = r_b[..., :, None] == ranks
+
+    def place_one(xa, xb, empty):
+        out = jnp.sum(jnp.where(oh_a, xa[..., :, None], 0), axis=-2) + jnp.sum(
+            jnp.where(oh_b, xb[..., :, None], 0), axis=-2
+        )
+        filled = jnp.any(oh_a, axis=-2) | jnp.any(oh_b, axis=-2)
+        return jnp.where(filled, out, empty)
+
+    lossy = a.lossy | b.lossy | jnp.any(n_live > M, axis=-1)
+    return TopkRmvDenseState(
+        place_one(a_s, b_s, NEG_INF), place_one(a_d, b_d, 0),
+        place_one(a_t, b_t, 0), rmv_vc, vc, lossy,
+    )
+
+
+def domdist(a, b):
+    """Live masks from max(dom(a_rmv), dom(b_rmv)) — never broadcasts the
+    merged rmv plane, decoupling the join from the maxes piece."""
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import _dom_lookup
+
+    def live_fn(a, b, rmv_vc):
+        dom_a = jnp.maximum(
+            _dom_lookup(a.slot_dc, a.rmv_vc), _dom_lookup(a.slot_dc, b.rmv_vc)
+        )
+        dom_b = jnp.maximum(
+            _dom_lookup(b.slot_dc, a.rmv_vc), _dom_lookup(b.slot_dc, b.rmv_vc)
+        )
+        return a.slot_ts > dom_a, b.slot_ts > dom_b
+
+    return _merge_variant(a, b, live_fn)
+
+
+def fusedpair(a, b):
+    """One 2M-wide dom reduce over the concatenated slot planes."""
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import _dom_lookup
+
+    def live_fn(a, b, rmv_vc):
+        dc2 = jnp.concatenate([a.slot_dc, b.slot_dc], axis=-1)
+        ts2 = jnp.concatenate([a.slot_ts, b.slot_ts], axis=-1)
+        live2 = ts2 > _dom_lookup(dc2, rmv_vc)
+        return live2[..., :M], live2[..., M:]
+
+    return _merge_variant(a, b, live_fn)
+
+
+def main():
+    backend = jax.default_backend()
+    print(f"# backend={backend} R={R} I={I} D={D_DCS} M={M} REPS={REPS}")
+    state_mb = sum(x.nbytes for x in jax.tree.leaves(side_a)) / 1e6
+    print(f"# state={state_mb:.1f}MB; 3x-state bytes floor = "
+          f"{3 * state_mb / 819.0:.2f} ms (v5e 819GB/s)")
+
+    timeit("full_merge", full)
+    timeit("maxes_only (bandwidth part)", maxes_only)
+    timeit("no_dom (live = ts>0)", lambda a, b: _merge_variant(a, b, _live_ts_only))
+    timeit("no_place (ranks, no one-hot output)",
+           lambda a, b: _merge_variant(a, b, _live_dom, place=False))
+    timeit("variant_baseline (inline copy of full)",
+           lambda a, b: _merge_variant(a, b, _live_dom))
+    timeit("restructure: packedcmp", packedcmp)
+    timeit("restructure: domdist", domdist)
+    timeit("restructure: fusedpair", fusedpair)
+
+    # Equivalence spot-check: restructurings must produce the identical
+    # merged state (one application, not the scan tower).
+    ref = D.merge(side_a, side_b)
+    for name, fn in (("packedcmp", packedcmp), ("domdist", domdist),
+                     ("fusedpair", fusedpair)):
+        if SELECT and not any(s in name for s in SELECT):
+            continue
+        got = fn(side_a, side_b)
+        ok = all(
+            bool(jnp.array_equal(x, y))
+            for x, y in zip(jax.tree.leaves(ref), jax.tree.leaves(got))
+        )
+        print(f"# equivalence {name}: {'OK' if ok else 'MISMATCH'}")
+        assert ok, name
+
+    if RESULTS:
+        print("# removal deltas (ms):")
+        fullms = RESULTS.get("full_merge")
+        for k, v in RESULTS.items():
+            if fullms and k.startswith("no_"):
+                print(f"#   {k}: {fullms - v:+.3f}")
+
+
+if __name__ == "__main__":
+    main()

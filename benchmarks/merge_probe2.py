@@ -1,0 +1,136 @@
+"""Merge probe round 2: RTT calibration + placement restructurings.
+
+merge_probe.py's REPS=32 numbers carry ~RTT/32 of tunnel overhead per
+rep (the same trap bench.py's MERGE_REPS=64 comment documents); this
+probe adds a null-scan calibration and runs the survivors at higher REPS
+so the per-piece attribution is device time, not tunnel time.
+
+Placement restructurings (the ~4-5ms piece — ~20x its 154MB write
+floor):
+  * place2m  — concatenate both sides into [.., 2M] planes and compute
+    ONE global rank per candidate from a single 2M x 2M compare matrix
+    (dedup folded in as a position tie-break), then ONE one-hot
+    placement (2M x M) instead of two (M x M) + two masked sums per
+    plane. ~2x the compare flops (same-side pairs are recomputed
+    by value instead of prefix counts) but roughly half the HLO chain
+    for XLA to schedule — testing whether the piece is flop-bound or
+    schedule-bound.
+  * placedot — the two one-hot masks contracted against the value
+    planes with dot_general (batched [M, m] x [M] matvec) instead of
+    where+sum, testing whether reduce-of-select chains are the cost.
+
+Run: [MERGE_REPS=128] python benchmarks/merge_probe2.py [filter ...]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from antidote_ccrdt_tpu.models.topk_rmv_dense import (
+    NEG_INF,
+    TopkRmvDenseState,
+    _cmp_better,
+    _live_mask,
+)
+
+from benchmarks.merge_probe import (  # noqa: E402 — reuses the warmed sides
+    D,
+    M,
+    RESULTS,
+    _live_dom,
+    _merge_variant,
+    full,
+    side_a,
+    side_b,
+    timeit,
+)
+
+
+def null_scan(a, b):
+    """Near-zero device work with a live carry: measures per-rep overhead
+    of the scan+dispatch harness itself (tunnel RTT / REPS + scan cost)."""
+    return TopkRmvDenseState(
+        a.slot_score, a.slot_dc, a.slot_ts, a.rmv_vc, a.vc, ~a.lossy
+    )
+
+
+def place2m(a, b):
+    rmv_vc = jnp.maximum(a.rmv_vc, b.rmv_vc)
+    vc = jnp.maximum(a.vc, b.vc)
+    c_s = jnp.concatenate([a.slot_score, b.slot_score], axis=-1)
+    c_d = jnp.concatenate([a.slot_dc, b.slot_dc], axis=-1)
+    c_t = jnp.concatenate([a.slot_ts, b.slot_ts], axis=-1)
+    live = _live_mask(c_d, c_t, rmv_vc)
+
+    X = lambda x: x[..., :, None]  # noqa: E731 — candidate axis
+    Y = lambda x: x[..., None, :]  # noqa: E731 — opponent axis
+    beats = _cmp_better(Y(c_s), Y(c_t), Y(c_d), X(c_s), X(c_t), X(c_d))
+    eq = (X(c_s) == Y(c_s)) & (X(c_t) == Y(c_t)) & (X(c_d) == Y(c_d))
+    # Cross-side exact duplicates: the a copy (positions 0..M-1) wins;
+    # the b copy dies (idempotence), same as _join_slots.
+    pos = jnp.arange(2 * M, dtype=jnp.int32)
+    a_side = pos < M
+    dup = jnp.any(eq & Y(live) & Y(a_side), axis=-1) & ~a_side
+    live = live & ~dup
+    # Global rank = live opponents that strictly beat me, + live EQUAL
+    # opponents at an earlier position (only same-side "us" remain after
+    # the dup kill, and within a side equal triples cannot occur — the
+    # term is the standard stable tie-break and keeps ranks a permutation).
+    earlier = Y(pos) < X(pos)
+    r = jnp.sum((beats | (eq & earlier)) & Y(live), axis=-1)
+    r = jnp.where(live, r, 2 * M)
+
+    ranks = jnp.arange(M, dtype=jnp.int32)
+    oh = r[..., :, None] == ranks  # [.., 2M, M]
+
+    def place_one(x, empty):
+        out = jnp.sum(jnp.where(oh, x[..., :, None], 0), axis=-2)
+        return jnp.where(jnp.any(oh, axis=-2), out, empty)
+
+    n_live = jnp.sum(live.astype(jnp.int32), axis=-1)
+    lossy = a.lossy | b.lossy | jnp.any(n_live > M, axis=-1)
+    return TopkRmvDenseState(
+        place_one(c_s, NEG_INF), place_one(c_d, 0), place_one(c_t, 0),
+        rmv_vc, vc, lossy,
+    )
+
+
+def placedot(a, b):
+    """_merge_variant with the one-hot contraction done by einsum
+    (batched [M, m] x [M] matvec) instead of where+sum."""
+    return _merge_variant(
+        a, b, _live_dom,
+        contract=lambda oh, x: jnp.einsum("...km,...k->...m", oh, x),
+    )
+
+
+def main():
+    reps = int(os.environ.get("MERGE_REPS", 128))
+    print(f"# backend={jax.default_backend()} REPS={reps}")
+    timeit("null_scan (per-rep harness overhead)", null_scan)
+    timeit("full_merge", full)
+    timeit("variant_baseline", lambda a, b: _merge_variant(a, b, _live_dom))
+    timeit("restructure: place2m", place2m)
+    timeit("restructure: placedot", placedot)
+
+    ref = D.merge(side_a, side_b)
+    for name, fn in (("place2m", place2m), ("placedot", placedot)):
+        got = fn(side_a, side_b)
+        ok = all(
+            bool(jnp.array_equal(x, y))
+            for x, y in zip(jax.tree.leaves(ref), jax.tree.leaves(got))
+        )
+        print(f"# equivalence {name}: {'OK' if ok else 'MISMATCH'}")
+        assert ok, name
+
+    null = RESULTS.get("null_scan (per-rep harness overhead)")
+    if null is not None:
+        print(f"# per-rep harness overhead: {null:.3f} ms — subtract from "
+              "every row above for device time")
+
+
+if __name__ == "__main__":
+    main()

@@ -266,12 +266,95 @@ def bench_dense(R, I, D_DCS, K, M, B, Br, windows, rounds_per_window):
         apply_ms=adj(window_med, W) * 1e3,
         apply_hbm_bytes=hbm["apply"]["bytes_per_dispatch"],
     )
+    compute.update(compute_merge_model(
+        R, 1, I, D_DCS, M,
+        merge_ms=adj(merge_time, MERGE_REPS) * 1e3,
+        merge_hbm_bytes=3 * state_nbytes,
+    ))
 
     return (
         apply_rate, extras_rate, extras_ops_rate, p50_ms, p99_ms,
         p50_e2e_ms, p99_e2e_ms, dispatch_overhead_ms,
         state_merges_per_sec, hbm, compute,
     )
+
+
+# TPU v5e VPU peak (derived from public specs: 8x128 vector lanes x 4
+# ALUs x the ~1.5GHz clock the 197 bf16 TFLOPS MXU figure implies).
+VPU_PEAK_OPS = 8 * 128 * 4 * 1.5e9
+
+
+def compute_merge_model(R, NK, I, D_DCS, M, merge_ms, merge_hbm_bytes):
+    """Analytic compute roofline for the batched replica-state merge
+    (VERDICT-r3 item 3 — the apply treatment for the metric the north
+    star literally names). Kernel: `TopkRmvDense.merge` = elementwise
+    rmv_vc/vc maxes + `_join_slots_union` (single 2M-wide add-wins
+    filter, 2M x 2M compare matrix, one-hot placement).
+
+    Per-id VPU op counts from the kernel shapes (2M candidates, D-wide
+    one-hot tombstone reduce, m_keep=M outputs):
+    * live/dom:   2M * D * 3   (iota==dc, where, max-reduce)
+    * compares:   (2M)^2 * 13  (lexicographic cmp 8 + eq 5)
+    * dedup+rank: (2M)^2 * 3   (tie-break or, mask and, sum)
+    * placement:  2M*M + 3 * 2M*M * 2 + 2M*M  (one-hot, 3 planes, filled)
+
+    Measured verdict (v5e, north-star shapes, benchmarks/merge_probe.py
+    + merge_probe2.py, REPS>=64 with a null-scan RTT calibration —
+    removal deltas are RTT-free): the merge sits ~4x above the bytes
+    floor and ~8x above the VPU floor; attribution of the ~8.5ms device
+    round (taken on the pairwise-join merge the union join replaced):
+    elementwise maxes ~1.8ms (AT their 1.5ms bytes floor — the rmv_vc
+    plane is 400MB of the 563MB state), dom one-hot reduces ~3.7ms
+    (~2.5x their floor; the top residual), placement ~2.3ms,
+    compares+ranks ~0.6ms. Restructurings measured: union join ADOPTED
+    (9.51 -> 9.00 ms harness time, ~6% of device time); packedcmp
+    (sign-combine compare) neutral; domdist (dom distributed over max)
+    and einsum placement regress. Like apply, the binding constraint
+    above the maxes piece is XLA's scheduling of the fused small-op
+    chain, not any peak."""
+    cand = 2 * M
+    per_id = (
+        cand * D_DCS * 3
+        + cand * cand * 13
+        + cand * cand * 3
+        + cand * M + 3 * cand * M * 2 + cand * M
+    )
+    vpu_ops = R * NK * I * per_id
+    vpu_floor_ms = vpu_ops / VPU_PEAK_OPS * 1e3
+    hbm_floor_ms = merge_hbm_bytes / (HBM_PEAK_GB_S * 1e9) * 1e3
+    floor_ms = max(vpu_floor_ms, hbm_floor_ms)
+    attribution = (
+        {
+            "elementwise_maxes": 1.8, "dom_onehot_reduces": 3.7,
+            "placement": 2.3, "compares_ranks": 0.6,
+            "methodology": "removal deltas, RTT-calibrated (null-scan "
+                           "probe); taken on the pre-union pairwise join",
+            "repro": "MERGE_REPS=64 python benchmarks/merge_probe.py; "
+                     "MERGE_REPS=128 python benchmarks/merge_probe2.py",
+        }
+        if (R, I, D_DCS, M) == (32, 100_000, 32, 4)
+        else None
+    )
+    return {
+        "merge": {
+            "measured_ms": round(merge_ms, 2),
+            "vpu": {
+                "join_ops_per_id": int(per_id),
+                "total_ops": int(vpu_ops),
+                "peak_ops_per_sec": VPU_PEAK_OPS,
+                "floor_ms": round(vpu_floor_ms, 2),
+            },
+            "hbm_floor_ms": round(hbm_floor_ms, 2),
+            "floor_ms": round(floor_ms, 2),
+            "headroom_vs_floor_x": round(merge_ms / max(floor_ms, 1e-9), 1),
+            "attribution_ms_r4": attribution,
+            "binding_constraint": (
+                "dom one-hot tombstone reduces (~2.5x floor) + one-hot "
+                "placement; elementwise rmv/vc maxes already run at their "
+                "bytes floor — see attribution + probe scripts"
+            ),
+        },
+    }
 
 
 def compute_model(R, NK, I, D_DCS, M, B, Br, apply_ms, apply_hbm_bytes):

@@ -14,7 +14,12 @@ The merge (`TopkRmvDense.merge`) is three pieces:
 
 Methodology is ablate_apply.py's: the full merge is timed with one piece
 removed at a time; because XLA fuses across pieces, removal deltas are
-the honest attribution. Scan-fused reps with a carried state keep every
+the honest attribution. NOTE: after round 4 adopted the union join,
+`full_merge` (D.merge -> `_join_slots_union`) and `variant_baseline`
+(an inline copy of the PRE-union pairwise join) are different kernels —
+the removal variants ablate the pairwise join the attribution was taken
+on; compare restructurings against `variant_baseline`, and `full_merge`
+against it to see the adopted delta. Scan-fused reps with a carried state keep every
 iteration live; host-readback sync (utils/benchtime.py).
 
 Restructuring probes (VERDICT-r3 asked for at least one attempt,
@@ -306,7 +311,7 @@ def main():
     timeit("no_dom (live = ts>0)", lambda a, b: _merge_variant(a, b, _live_ts_only))
     timeit("no_place (ranks, no one-hot output)",
            lambda a, b: _merge_variant(a, b, _live_dom, place=False))
-    timeit("variant_baseline (inline copy of full)",
+    timeit("variant_baseline (pre-union pairwise join)",
            lambda a, b: _merge_variant(a, b, _live_dom))
     timeit("restructure: packedcmp", packedcmp)
     timeit("restructure: domdist", domdist)

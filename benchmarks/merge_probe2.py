@@ -108,7 +108,9 @@ def placedot(a, b):
 
 
 def main():
-    reps = int(os.environ.get("MERGE_REPS", 128))
+    # timeit() scans merge_probe.REPS — print the value actually used
+    # (set MERGE_REPS; merge_probe's default is 32).
+    from benchmarks.merge_probe import REPS as reps
     print(f"# backend={jax.default_backend()} REPS={reps}")
     timeit("null_scan (per-rep harness overhead)", null_scan)
     timeit("full_merge", full)

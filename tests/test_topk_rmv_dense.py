@@ -223,6 +223,40 @@ def test_merge_lattice_laws():
     assert D.equal(D.merge(a, bot), a)
 
 
+def test_union_join_matches_pairwise_join():
+    """The merge path's `_join_slots_union` (single 2M x 2M compare
+    matrix, benchmarks/merge_probe2.py restructuring) is slot-for-slot
+    identical to the apply path's `_join_slots` — exact array equality,
+    not just observable equality, across randomized divergent states."""
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import (
+        _join_slots,
+        _join_slots_union,
+    )
+
+    n_ids, n_dcs, size = 16, 3, 4
+    D = make_dense(n_ids=n_ids, n_dcs=n_dcs, size=size, slots_per_id=4)
+    for seed in range(5):
+        r = np.random.default_rng(seed)
+        _, log = gen_effect_log(r, 60, n_ids, n_dcs, size)
+        base = D.init(n_replicas=1, n_keys=1)
+        base, _ = D.apply_ops(base, pack_ops(log[:20], n_dcs, 32, 16))
+        a, _ = D.apply_ops(base, pack_ops(log[20:40], n_dcs, 32, 16))
+        b, _ = D.apply_ops(base, pack_ops(log[40:], n_dcs, 32, 16))
+        rmv_vc = jnp.maximum(a.rmv_vc, b.rmv_vc)
+        got = _join_slots_union(
+            (a.slot_score, a.slot_dc, a.slot_ts),
+            (b.slot_score, b.slot_dc, b.slot_ts),
+            rmv_vc, D.M,
+        )
+        want = _join_slots(
+            (a.slot_score, a.slot_dc, a.slot_ts),
+            (b.slot_score, b.slot_dc, b.slot_ts),
+            rmv_vc, D.M,
+        )
+        for g, w in zip(got, want):
+            assert jnp.array_equal(g, w), seed
+
+
 def test_merge_converges_replicas():
     """Two replicas that saw different halves of a log converge via merge to
     the replica that saw everything."""

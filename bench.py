@@ -269,7 +269,7 @@ def bench_dense(R, I, D_DCS, K, M, B, Br, windows, rounds_per_window):
     compute.update(compute_merge_model(
         R, 1, I, D_DCS, M,
         merge_ms=adj(merge_time, MERGE_REPS) * 1e3,
-        merge_hbm_bytes=3 * state_nbytes,
+        merge_hbm_bytes=hbm["replica_state_merge"]["bytes_per_dispatch"],
     ))
 
     return (
@@ -442,10 +442,14 @@ def compute_model(R, NK, I, D_DCS, M, B, Br, apply_ms, apply_hbm_bytes):
                 "3x delta scalar scatters (XLA's serialized update loop; "
                 "sorted/unique hints, i64 packing, cond-packing and "
                 "M-major layouts all measured neutral-or-worse in "
-                "benchmarks/residual_probe.py) + tombstone one-hot conv "
-                "(~47% MXU util; MAC-cutting restructurings regress, "
-                "benchmarks/tomb_bucket_probe.py) + its plane-unpack "
-                "(~90% of HBM floor)"
+                "benchmarks/residual_probe.py; the entire gather family "
+                "— position-scatter+gathers, binary-search expansion, "
+                "sorted block-window expansion — regresses 9-130x in "
+                "benchmarks/delta_probe.py: data-dependent gathers/"
+                "slices are poison on this backend) + tombstone one-hot "
+                "conv (~47% MXU util; MAC-cutting restructurings "
+                "regress, benchmarks/tomb_bucket_probe.py) + its "
+                "plane-unpack (~90% of HBM floor)"
             ),
         },
     }

@@ -180,10 +180,15 @@ def bench_dense(R, I, D_DCS, K, M, B, Br, windows, rounds_per_window):
     # sides + write). Round 1 re-rolled inside the loop, which billed an
     # extra full-state copy to every rep (~5.4ms of the then-11.4ms,
     # measured by ablation) — that was measuring roll+merge, not merge.
-    # The carried dependency keeps every scan iteration live; 64 scan-
-    # fused reps amortize the fixed dispatch RTT (~100ms on this tunnel)
-    # to ~2% of the total.
-    MERGE_REPS = 64
+    # The carried dependency keeps every scan iteration live. Round 4
+    # measured the RTT at ~100-125ms via a null-scan probe
+    # (benchmarks/merge_probe2.py): at 64 reps that is still ~20% of a
+    # ~9.6ms/rep total, so the RAW state_merges_per_sec figure under-read
+    # the device by a fifth (the round-3 "~2%" comment was wrong about
+    # its own arithmetic). 192 reps cut the bias to ~6%; the
+    # overhead-adjusted mean (compute.merge.measured_ms) stays the
+    # authoritative device number either way.
+    MERGE_REPS = 192
     peer = jax.tree.map(lambda x: jnp.roll(x, 1, axis=0), state)
 
     @jax.jit

@@ -447,6 +447,14 @@ def bench_worddocumentcount():
             "wire_mb_raw_planes": round(
                 sum(w.nbytes for w in wire2.values()) / 1e6, 2
             ),
+            # The trade is wire bytes vs device rebuild cost (searchsorted
+            # doc plane + bucket-table gather): measured r4, the rebuild
+            # added ~155ms while saving ~8.2MB — net win whenever the
+            # tunnel's effective upload runs below ~50MB/s (the dedicated
+            # calibration typically reads 5-10MB/s; only an unusually
+            # fast session inverts it, and the record self-describes via
+            # encode_ms/apply_ms/wire_mb either way).
+            "note": "device plane-rebuild vs wire trade; see apply_ms",
         })
     return out
 

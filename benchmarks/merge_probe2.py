@@ -1,9 +1,10 @@
 """Merge probe round 2: RTT calibration + placement restructurings.
 
 merge_probe.py's REPS=32 numbers carry ~RTT/32 of tunnel overhead per
-rep (the same trap bench.py's MERGE_REPS=64 comment documents); this
-probe adds a null-scan calibration and runs the survivors at higher REPS
-so the per-piece attribution is device time, not tunnel time.
+rep (the trap that also under-read bench.py's state-merge rate until its
+MERGE_REPS went 64 -> 192 in round 4); this probe adds a null-scan
+calibration and runs the survivors at higher REPS so the per-piece
+attribution is device time, not tunnel time.
 
 Placement restructurings (the ~4-5ms piece — ~20x its 154MB write
 floor):

@@ -1,0 +1,99 @@
+"""Does the merge path's union join help the APPLY round too?
+
+Measured verdict (v5e, north-star shapes, round 4): YES — 56.4 -> 51.7
+ms/round (~8%), end-state array-equal; the union join became the
+production join for BOTH hot paths on the strength of this probe.
+
+The apply round's join is different from merge: the delta side is
+sparse (most ids empty), and the pairwise join's prefix-count rank was
+originally chosen for it. Since production now runs the union join,
+this probe reproduces the comparison by patching `_join_slots_union`
+BACK to the pairwise reference `_join_slots` for the baseline arm —
+same scan-fused window methodology as bench.py.
+
+Run: python benchmarks/apply_join_probe.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+import antidote_ccrdt_tpu.models.topk_rmv_dense as trd
+from antidote_ccrdt_tpu.harness.opgen import TopkRmvEffectGen, Workload
+from antidote_ccrdt_tpu.utils.benchtime import stack_rounds, sync
+
+R, NK, I, D_DCS, K, M = 32, 1, 100_000, 32, 100, 4
+B, Br, W = 32768, 2048, 8
+
+
+def build():
+    D = trd.make_dense(n_ids=I, n_dcs=D_DCS, size=K, slots_per_id=M)
+    state = D.init(n_replicas=R, n_keys=1)
+    gen = TopkRmvEffectGen(
+        Workload(n_replicas=R, n_ids=I, zipf_a=1.2, score_max=100_000, seed=7)
+    )
+    batches = [
+        stack_rounds([gen.next_batch(B, Br) for _ in range(W)])
+        for _ in range(3)
+    ]
+    return D, state, batches
+
+
+def time_window(D, state, batches):
+    @jax.jit
+    def run_window(state, stacked):
+        def body(st, ops):
+            st2, _ = D.apply_ops(st, ops, collect_dominated=False)
+            return st2, ()
+        out, _ = lax.scan(body, state, stacked)
+        return out
+
+    state = run_window(state, batches[0])
+    sync(state)
+    best = []
+    for b in batches[1:]:
+        t0 = time.perf_counter()
+        state = run_window(state, b)
+        sync(state)
+        best.append((time.perf_counter() - t0) / W * 1e3)
+    return min(best), state
+
+
+def main():
+    print(f"# backend={jax.default_backend()} B={B} Br={Br} W={W}")
+    # Baseline arm: production engine with the union join patched back to
+    # the pairwise reference join (production calls _join_slots_union
+    # directly since round 4 — patching the OTHER direction would time
+    # the union join against itself).
+    orig = trd._join_slots_union
+    trd._join_slots_union = lambda a, b, rmv_vc, m: trd._join_slots(
+        a, b, rmv_vc, m
+    )
+    try:
+        D, state, batches = build()
+        pairwise_ms, s1 = time_window(D, state, batches)
+    finally:
+        trd._join_slots_union = orig
+    print(f"apply round, pairwise reference join  {pairwise_ms:8.2f} ms")
+
+    D2, state2, _ = build()  # fresh engine -> fresh jit cache entry
+    union_ms, s2 = time_window(D2, state2, batches)
+    print(f"apply round, union join (production)  {union_ms:8.2f} ms")
+
+    eq = all(
+        bool(jnp.array_equal(x, y))
+        for x, y in zip(jax.tree.leaves(s1), jax.tree.leaves(s2))
+    )
+    print(f"# end-state equivalence: {'OK' if eq else 'MISMATCH'}")
+    assert eq
+    print(f"# delta (union - pairwise): {union_ms - pairwise_ms:+.2f} ms/round")
+
+
+if __name__ == "__main__":
+    main()

@@ -224,9 +224,10 @@ def test_merge_lattice_laws():
 
 
 def test_union_join_matches_pairwise_join():
-    """The merge path's `_join_slots_union` (single 2M x 2M compare
-    matrix, benchmarks/merge_probe2.py restructuring) is slot-for-slot
-    identical to the apply path's `_join_slots` — exact array equality,
+    """The production join on both hot paths (`_join_slots_union`,
+    single 2M x 2M compare matrix, benchmarks/merge_probe2.py
+    restructuring) is slot-for-slot identical to the independently-
+    derived pairwise reference `_join_slots` — exact array equality,
     not just observable equality, across randomized divergent states."""
     from antidote_ccrdt_tpu.models.topk_rmv_dense import (
         _join_slots,

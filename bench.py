@@ -420,7 +420,13 @@ def compute_model(R, NK, I, D_DCS, M, B, Br, apply_ms, apply_hbm_bytes):
             # (includes ~RTT/REPS of tunnel overhead), so it reads higher
             # than measured_ms above (RTT-adjusted). The piece values are
             # removal DELTAS between equal-overhead runs — RTT-free.
-            "methodology": "removal deltas; full_round unadjusted",
+            "methodology": (
+                "removal deltas; full_round unadjusted. Taken on the "
+                "pairwise join; the union-join adoption afterwards "
+                "shaved ~4.7ms off the measured round "
+                "(benchmarks/apply_join_probe.py), mostly from the "
+                "join_and_filter slice"
+            ),
             "repro": "ABLATE_B=32768 ABLATE_BR=2048 python "
                      "benchmarks/ablate_apply.py",
         }

@@ -400,7 +400,8 @@ def compute_model(R, NK, I, D_DCS, M, B, Br, apply_ms, apply_hbm_bytes):
     # (round 3's ~25ms "residual_fusion" no longer exists — it was the
     # D-step dom-lookup slice/select chains plus the associative_scan
     # odd/even tree, both restructured away this round; the remaining
-    # removal deltas sum to the measured round within ~1ms):
+    # removal deltas + ~RTT/REPS overhead sum to the measured round
+    # within ~2ms):
     # * per-HLO device-timeline profile (benchmarks/profile_north_star.py,
     #   committed as benchmarks/profile_r04.json): tombstone one-hot conv
     #   11.2 + plane-unpack/max 3.9 (the unpack reads the 5x-wide s32 conv
@@ -412,20 +413,28 @@ def compute_model(R, NK, I, D_DCS, M, B, Br, apply_ms, apply_hbm_bytes):
     # where they apply (not tiny/CPU configs).
     attribution = (
         {
-            "tombstones": 19.0, "delta_build": 23.3,
-            "join_and_filter": 8.9, "vc_track": 0.3,
-            "residual_unattributed": round(52.6 - 19.0 - 23.3 - 8.9 - 0.3, 1),
-            "full_round": 52.6,
+            # Re-measured after the union-join adoption (the ablation
+            # variants join through _join_slots_union like production):
+            # the join's removal delta collapsed 8.9 -> ~0.1ms — swapping
+            # it for an elementwise max changes nothing measurable, i.e.
+            # the union join fuses into the surrounding round for free.
+            # The earlier pairwise-join numbers (full 52.6: tombstones
+            # 19.0, delta 23.3, join 8.9) are kept in git history.
+            "tombstones": 16.2, "delta_build": 19.2,
+            "join_and_filter": 0.1, "vc_track": 0.0,
+            "residual_unattributed": round(
+                47.98 - 16.2 - 19.2 - 0.1 - 0.0, 1
+            ),
+            "full_round": 47.98,
             # full_round is the ablation harness's UNADJUSTED per-rep wall
-            # (includes ~RTT/REPS of tunnel overhead), so it reads higher
-            # than measured_ms above (RTT-adjusted). The piece values are
-            # removal DELTAS between equal-overhead runs — RTT-free.
+            # (includes ~RTT/REPS of tunnel overhead — ~10ms at REPS=12
+            # this session, which is most of residual_unattributed), so
+            # it reads higher than measured_ms above (RTT-adjusted). The
+            # piece values are removal DELTAS between equal-overhead
+            # runs — RTT-free.
             "methodology": (
-                "removal deltas; full_round unadjusted. Taken on the "
-                "pairwise join; the union-join adoption afterwards "
-                "shaved ~4.7ms off the measured round "
-                "(benchmarks/apply_join_probe.py), mostly from the "
-                "join_and_filter slice"
+                "removal deltas; full_round unadjusted; union-join "
+                "production kernel (r4 final)"
             ),
             "repro": "ABLATE_B=32768 ABLATE_BR=2048 python "
                      "benchmarks/ablate_apply.py",

@@ -9,6 +9,11 @@ bench.py's default batch has since moved to B=32768/Br=2048, so scale
 attributions accordingly (B-linear pieces roughly double).
 
 Same measurement discipline: scan-fused windows, host-readback sync.
+
+The inline variants track production: since round 4 they join through
+`_join_slots_union` (the adopted production join on both hot paths —
+benchmarks/apply_join_probe.py), so removal deltas ablate the kernel
+that actually runs.
 """
 import os
 import sys
@@ -25,7 +30,7 @@ from antidote_ccrdt_tpu.harness.opgen import TopkRmvEffectGen, Workload
 from antidote_ccrdt_tpu.models.topk_rmv_dense import (
     NEG_INF,
     TopkRmvDenseState,
-    _join_slots,
+    _join_slots_union,
     _sort_adds,
     make_dense,
 )
@@ -135,7 +140,7 @@ def make_variant(
                 d_ts = d_ts.at[sk3, s_id, rank].set(s_ts, mode="drop")
 
         if join:
-            f_score, f_dc, f_ts, n_live = _join_slots(
+            f_score, f_dc, f_ts, n_live = _join_slots_union(
                 (state.slot_score, state.slot_dc, state.slot_ts),
                 (d_score, d_dc, d_ts),
                 rmv_vc,
@@ -234,10 +239,21 @@ def timeit_unrolled(name, step_fn):
             c = step_fn(c, jax.tree.map(lambda x: x[i], seq))
         return c
 
-    sync(run(state0, stacked))
-    t0 = time.perf_counter()
-    out = run(state0, stacked)
-    sync(out)
+    try:
+        sync(run(state0, stacked))
+        t0 = time.perf_counter()
+        out = run(state0, stacked)
+        sync(out)
+    except jax.errors.JaxRuntimeError as e:
+        # Known at ABLATE_B=32768 since the union join: the unrolled
+        # graph keeps every iteration's [R, NK*I, 5D] conv output alive
+        # as remat temps (9 x 1.91G measured) and exceeds HBM. Only the
+        # runtime/compile error is tolerated — real code breakage in the
+        # variants must still fail loudly. The scan-fused variants above
+        # are the load-bearing measurements.
+        first = (str(e).splitlines() or ["<no message>"])[0][:80]
+        print(f"{name:56s}    SKIPPED ({type(e).__name__}: {first})")
+        return None
     print(f"{name:56s} {(time.perf_counter() - t0) / REPS * 1e3:9.2f} ms")
     return out
 
@@ -276,7 +292,7 @@ def make_flat_scatter_variant():
         d_score = d_score.at[flat].set(s_score, mode="drop").reshape(NKl, Il, Ml)
         d_dc = d_dc.at[flat].set(s_dc, mode="drop").reshape(NKl, Il, Ml)
         d_ts = d_ts.at[flat].set(s_ts, mode="drop").reshape(NKl, Il, Ml)
-        f_score, f_dc, f_ts, n_live = _join_slots(
+        f_score, f_dc, f_ts, n_live = _join_slots_union(
             (state.slot_score, state.slot_dc, state.slot_ts),
             (d_score, d_dc, d_ts), rmv_vc, Ml)
         return TopkRmvDenseState(f_score, f_dc, f_ts, rmv_vc, state.vc,

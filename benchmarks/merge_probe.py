@@ -38,6 +38,26 @@ committed either way):
     broadcast-iota/where chains for XLA to schedule).
 
 Run: [MERGE_REPS=32] python benchmarks/merge_probe.py [name-filter ...]
+
+PALLAS KERNEL — measured design rejection (round 4). A single-pass
+Mosaic kernel (read both states once, write merged state: exactly the
+2.06ms bytes floor) founders on layout at the pallas boundary:
+
+* Pallas forces row-major inputs, so the [.., I, M=4] slot planes tile
+  as 4-lane blocks (97% lane waste), and the in-kernel group-of-4 ops
+  on a flat [.., I*M] view cannot align with the [.., I*D] tombstone
+  pitch without cross-lane-width reshapes (Mosaic relayouts).
+* The escape — transposing to [.., M, I] / [.., D, I] at the boundary —
+  was MEASURED: the 12-transpose set (6 slot in + 2 rmv in + 3 slot out
+  + 1 rmv out) costs 2.99ms/rep (~3.4GB traffic) by itself, so the
+  best conceivable kernel lands at ~6.5ms vs the 8.04ms XLA merge —
+  a thin upside against the backend's record of pallas composition
+  regressions (ablate_apply: pallas tombstones win isolated, lose
+  composed).
+* The real unlock would be storing the dense state M-major/D-major
+  globally (no boundary transposes; kernel at the 2.06ms floor) — a
+  cross-engine refactor (scatter orientation, observe reads, delta
+  tables) left as the named future direction, not attempted blind.
 """
 import os
 import sys

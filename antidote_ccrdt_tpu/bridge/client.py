@@ -165,6 +165,33 @@ class BridgeClient:
             (Atom("grid_apply_extras"), name.encode(), per_replica_ops)
         )
 
+    def grid_apply_packed(self, name: str, groups) -> int:
+        """The packed-columns throughput surface (server._PACKED_COLUMNS):
+        `groups` is a list of (tag, per_replica_counts, [column, ...])
+        with numpy/sequence int data; each column carries that field for
+        every op, concatenated in replica order, and ships as ONE i32-LE
+        binary instead of per-op ETF tuples."""
+        import numpy as np
+
+        def b(x):
+            arr = np.asarray(x)
+            # Loud at the boundary like the tuple wire (whose ETF encode
+            # raises on out-of-i32 ints): a silent astype would truncate
+            # 2**40+7 to 7 and corrupt CRDT state undetectably.
+            if arr.size and (
+                int(arr.min()) < -(2**31) or int(arr.max()) >= 2**31
+            ):
+                raise ValueError("packed column value out of i32 range")
+            return arr.astype("<i4").tobytes()
+
+        wire_groups = [
+            (Atom(tag), b(counts), [b(c) for c in cols])
+            for tag, counts, cols in groups
+        ]
+        return self.call(
+            (Atom("grid_apply_packed"), name.encode(), wire_groups)
+        )
+
     def grid_merge_all(self, name: str) -> None:
         self.call((Atom("grid_merge_all"), name.encode()))
 

@@ -32,6 +32,7 @@
          is_type/2, generates_extra_operations/2, is_operation/3,
          require_state_downstream/3, is_replicate_tagged/3,
          grid_new/4, grid_apply/3, grid_apply_extras/3,
+         grid_apply_packed/3, pack_i32/1,
          grid_merge_all/2, grid_observe/4,
          grid_to_binary/2, grid_from_binary/3,
          wire_atoms/0, main/1]).
@@ -154,6 +155,28 @@ grid_apply(Sock, Grid, OpsPerReplica) when is_list(OpsPerReplica) ->
 %% ban-promotions {add, Key, Id, Score}; other types [].
 grid_apply_extras(Sock, Grid, OpsPerReplica) when is_list(OpsPerReplica) ->
     call(Sock, {grid_apply_extras, Grid, OpsPerReplica}).
+
+%% Packed-columns throughput surface: Groups is a list of
+%% {Tag, Counts, Cols} where Counts is one op count per replica row and
+%% each Col carries that field's value for EVERY op, concatenated in
+%% replica order (column order per tag matches grid_apply's tuple field
+%% order; topk_rmv rmv columns are key, id, vc_len, vc_dc, vc_ts with
+%% the vc entries concatenated). Integer lists are packed here into one
+%% i32-little binary per column — a single binary comprehension instead
+%% of per-op ETF tuples, which is what lets a BEAM host feed the device
+%% at wire speed. Pre-packed binaries pass through unchanged.
+grid_apply_packed(Sock, Grid, Groups) when is_list(Groups) ->
+    Packed = [{Tag, pack_i32(Counts), [pack_i32(C) || C <- Cols]}
+              || {Tag, Counts, Cols} <- Groups],
+    call(Sock, {grid_apply_packed, Grid, Packed}).
+
+pack_i32(Bin) when is_binary(Bin) -> Bin;
+pack_i32(Ints) when is_list(Ints) ->
+    %% check_i32 makes an out-of-range value a function_clause error —
+    %% a bare <<X:32>> would truncate silently and corrupt CRDT state.
+    << <<(check_i32(X)):32/little-signed>> || X <- Ints >>.
+
+check_i32(X) when is_integer(X), X >= -2147483648, X =< 2147483647 -> X.
 
 grid_merge_all(Sock, Grid) ->
     call(Sock, {grid_merge_all, Grid}).

@@ -687,6 +687,113 @@ def bench_grid_wire():
                 "unit": "ops/sec",
             })
 
+        # Packed-columns surface (round 4, grid_apply_packed): the same
+        # op mixes as the tuple lines above, but generated as column
+        # arrays directly — how a native producer (or a BEAM client with
+        # one binary comprehension per column) would feed the wire. The
+        # timed region covers column->binary packing + ETF + TCP + the
+        # server's vectorized unpack + device dispatch.
+        def timed_packed(gname, groups_batches):
+            client.grid_apply_packed(gname, groups_batches[0])  # warm
+            n_ops = 0
+            t0 = time.perf_counter()
+            for groups in groups_batches:
+                client.grid_apply_packed(gname, groups)
+                n_ops += sum(
+                    int(np.asarray(counts).sum())
+                    for _, counts, _ in groups
+                )
+            return n_ops / (time.perf_counter() - t0)
+
+        def seq_ts(dcs, base):
+            """Per-dc running timestamps (1-based), mirroring the tuple
+            lines' frontier counters, vectorized."""
+            order = np.argsort(dcs, kind="stable")
+            sorted_dcs = dcs[order]
+            grp = np.r_[True, sorted_dcs[1:] != sorted_dcs[:-1]]
+            c = np.arange(dcs.size) - np.maximum.accumulate(
+                np.where(grp, np.arange(dcs.size), 0)
+            )
+            ts = np.empty_like(c)
+            ts[order] = c + 1
+            return ts + base
+
+        Ba = B - B // 16
+        counts_a = np.full(R, Ba, np.int32)
+
+        def tr_packed():
+            dc = rng.integers(0, R, R * Ba).astype(np.int32)
+            ts = np.concatenate([
+                seq_ts(dc[r * Ba:(r + 1) * Ba], 0) for r in range(R)
+            ]).astype(np.int32)
+            adds = ("add", counts_a, [
+                np.zeros(R * Ba, np.int32),
+                rng.integers(0, I, R * Ba).astype(np.int32),
+                rng.integers(1, 10**6, R * Ba).astype(np.int32),
+                dc, ts,
+            ])
+            nr = B // 16
+            counts_r = np.full(R, nr, np.int32)
+            vc_len = np.full(R * nr, R, np.int32)  # dense vc rows
+            vc_dc = np.tile(np.arange(R, dtype=np.int32), R * nr)
+            vc_ts = rng.integers(1, 50, R * nr * R).astype(np.int32)
+            rmvs = ("rmv", counts_r, [
+                np.zeros(R * nr, np.int32),
+                rng.integers(0, I, R * nr).astype(np.int32),
+                vc_len, vc_dc, vc_ts,
+            ])
+            return [adds, rmvs]
+
+        rate = timed_packed("w_tr", [tr_packed() for _ in range(CALLS)])
+        out.append({
+            "metric": f"grid wire topk_rmv ops/sec (packed columns, "
+                      f"{R}x{B}/call)",
+            "value": round(rate), "unit": "ops/sec",
+        })
+
+        counts_b = np.full(R, B, np.int32)
+        packed_simple = {
+            "w_tk": lambda: [("add", counts_b, [
+                np.zeros(R * B, np.int32),
+                rng.integers(0, 10_000, R * B).astype(np.int32),
+                rng.integers(1, 10**6, R * B).astype(np.int32),
+            ])],
+            "w_lb": lambda: [
+                ("add", np.full(R, B - 16, np.int32), [
+                    np.zeros(R * (B - 16), np.int32),
+                    rng.integers(0, 100_000, R * (B - 16)).astype(np.int32),
+                    rng.integers(1, 10**6, R * (B - 16)).astype(np.int32),
+                ]),
+                ("ban", np.full(R, 16, np.int32), [
+                    np.zeros(R * 16, np.int32),
+                    rng.integers(0, 100_000, R * 16).astype(np.int32),
+                ]),
+            ],
+            "w_av": lambda: [("add", counts_b, [
+                rng.integers(0, 64, R * B).astype(np.int32),
+                rng.integers(-100, 100, R * B).astype(np.int32),
+                np.ones(R * B, np.int32),
+            ])],
+            "w_wc": lambda: [("add", counts_b, [
+                np.zeros(R * B, np.int32),
+                ((rng.zipf(1.1, size=R * B) - 1) % 4096).astype(np.int32),
+            ])],
+            "w_wd": lambda: [("add", counts_b, [
+                np.zeros(R * B, np.int32),
+                ((rng.zipf(1.1, size=R * B) - 1) % 4096).astype(np.int32),
+            ])],
+        }
+        for gname, tname in (("w_tk", "topk"), ("w_lb", "leaderboard"),
+                             ("w_av", "average"), ("w_wc", "wordcount"),
+                             ("w_wd", "worddocumentcount")):
+            mk = packed_simple[gname]
+            rate = timed_packed(gname, [mk() for _ in range(CALLS)])
+            out.append({
+                "metric": f"grid wire {tname} ops/sec (packed columns, "
+                          f"{R}x{B}/call)",
+                "value": round(rate), "unit": "ops/sec",
+            })
+
         # batch_merge: N scalar replica states shipped as reference
         # binaries, merged in one batched device pass (the north-star
         # bridge entry point).

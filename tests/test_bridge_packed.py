@@ -1,0 +1,246 @@
+"""Packed-columns grid wire (round 4, `grid_apply_packed`).
+
+The term surface ships one ETF tuple per op; the packed surface ships
+one i32-LE binary per COLUMN (server `_PACKED_COLUMNS`). These tests pin
+that both wire forms drive the engines identically — exact snapshot
+equality, not just observables — and that the packed boundary validates
+as loudly as the tuple packers."""
+
+import numpy as np
+import pytest
+
+from antidote_ccrdt_tpu.bridge import BridgeClient, BridgeServer
+from antidote_ccrdt_tpu.core.etf import Atom
+
+
+@pytest.fixture(scope="module")
+def server():
+    with BridgeServer() as srv:
+        yield srv
+
+
+@pytest.fixture()
+def client(server):
+    with BridgeClient(*server.address) as c:
+        yield c
+
+
+def ragged(rng, R, max_b, gen):
+    """Per-replica ragged op lists + the matching packed columns."""
+    per_replica = []
+    counts = rng.integers(0, max_b + 1, R)
+    for r in range(R):
+        per_replica.append([gen(r) for _ in range(counts[r])])
+    return per_replica, counts
+
+
+def cols_of(per_replica, fields):
+    """Extract packed columns (concatenated in replica order) from tuple
+    ops — fields gives each value's position in the tuple."""
+    return [
+        np.asarray(
+            [op[f] for ops in per_replica for op in ops], np.int32
+        )
+        for f in fields
+    ]
+
+
+TYPE_CASES = {
+    "average": dict(
+        params=dict(n_replicas=3, n_keys=2),
+        gen=lambda rng: lambda r: (
+            Atom("add"), int(rng.integers(0, 2)),
+            int(rng.integers(-50, 90)), int(rng.integers(0, 4)),
+        ),
+        tag="add", fields=(1, 2, 3),
+    ),
+    "topk": dict(
+        params=dict(n_replicas=3, n_keys=2, n_ids=32, size=3),
+        gen=lambda rng: lambda r: (
+            Atom("add"), int(rng.integers(0, 2)),
+            int(rng.integers(0, 32)), int(rng.integers(0, 500)),
+        ),
+        tag="add", fields=(1, 2, 3),
+    ),
+    "wordcount": dict(
+        params=dict(n_replicas=3, n_keys=2, n_buckets=16),
+        gen=lambda rng: lambda r: (
+            Atom("add"), int(rng.integers(0, 2)), int(rng.integers(0, 16)),
+        ),
+        tag="add", fields=(1, 2),
+    ),
+}
+
+
+@pytest.mark.parametrize("type_name", sorted(TYPE_CASES))
+@pytest.mark.parametrize("seed", [0, 1])
+def test_packed_matches_tuple_wire_single_tag(client, type_name, seed):
+    case = TYPE_CASES[type_name]
+    rng = np.random.default_rng(seed)
+    R = case["params"]["n_replicas"]
+    per_replica, counts = ragged(rng, R, 9, case["gen"](rng))
+
+    gt, gp = f"t_{type_name}_{seed}", f"p_{type_name}_{seed}"
+    client.grid_new(gt, type_name, **case["params"])
+    client.grid_new(gp, type_name, **case["params"])
+    client.grid_apply(gt, per_replica)
+    client.grid_apply_packed(
+        gp, [(case["tag"], counts, cols_of(per_replica, case["fields"]))]
+    )
+    assert client.grid_to_binary(gt) == client.grid_to_binary(gp)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_packed_matches_tuple_wire_topk_rmv(client, seed):
+    rng = np.random.default_rng(seed)
+    R, NK, I, D = 3, 2, 24, 3
+    params = dict(n_replicas=R, n_keys=NK, n_ids=I, n_dcs=D, size=4,
+                  slots_per_id=2)
+
+    def gen_add(r):
+        return (Atom("add"), int(rng.integers(0, NK)),
+                int(rng.integers(0, I)), int(rng.integers(0, 300)),
+                int(rng.integers(0, D)), int(rng.integers(1, 40)))
+
+    adds, a_counts = ragged(rng, R, 10, gen_add)
+
+    def gen_rmv(r):
+        n = int(rng.integers(0, D + 1))
+        dcs = rng.permutation(D)[:n]
+        return (Atom("rmv"), int(rng.integers(0, NK)),
+                int(rng.integers(0, I)),
+                [(int(d), int(rng.integers(1, 40))) for d in dcs])
+
+    rmvs, r_counts = ragged(rng, R, 4, gen_rmv)
+
+    gt, gp = f"t_tkr_{seed}", f"p_tkr_{seed}"
+    client.grid_new(gt, "topk_rmv", **params)
+    client.grid_new(gp, "topk_rmv", **params)
+    dom_t = client.grid_apply(
+        gt, [a + r for a, r in zip(adds, rmvs)]
+    )
+
+    a_cols = cols_of(adds, (1, 2, 3, 4, 5))
+    vc_len = np.asarray(
+        [len(op[3]) for ops in rmvs for op in ops], np.int32
+    )
+    vc_dc = np.asarray(
+        [d for ops in rmvs for op in ops for d, _ in op[3]], np.int32
+    )
+    vc_ts = np.asarray(
+        [t for ops in rmvs for op in ops for _, t in op[3]], np.int32
+    )
+    r_cols = cols_of(rmvs, (1, 2)) + [vc_len, vc_dc, vc_ts]
+    dom_p = client.grid_apply_packed(
+        gp, [("add", a_counts, a_cols), ("rmv", r_counts, r_cols)]
+    )
+    assert dom_t == dom_p
+    assert client.grid_to_binary(gt) == client.grid_to_binary(gp)
+
+
+def test_packed_matches_tuple_wire_leaderboard(client):
+    rng = np.random.default_rng(5)
+    R, NK, P_ = 2, 1, 16
+    params = dict(n_replicas=R, n_keys=NK, n_players=P_, size=3)
+
+    adds, a_counts = ragged(
+        rng, R, 8,
+        lambda r: (Atom("add"), 0, int(rng.integers(0, P_)),
+                   int(rng.integers(0, 200))),
+    )
+    bans, b_counts = ragged(
+        rng, R, 3, lambda r: (Atom("ban"), 0, int(rng.integers(0, P_)))
+    )
+    client.grid_new("t_lb", "leaderboard", **params)
+    client.grid_new("p_lb", "leaderboard", **params)
+    client.grid_apply("t_lb", [a + b for a, b in zip(adds, bans)])
+    client.grid_apply_packed("p_lb", [
+        ("add", a_counts, cols_of(adds, (1, 2, 3))),
+        ("ban", b_counts, cols_of(bans, (1, 2))),
+    ])
+    assert client.grid_to_binary("t_lb") == client.grid_to_binary("p_lb")
+
+
+def test_packed_matches_tuple_wire_worddoc_device_dedup(client):
+    rng = np.random.default_rng(9)
+    R, V = 2, 16
+    params = dict(n_replicas=R, n_keys=1, n_buckets=V)
+
+    def gen(r):
+        return (Atom("doc_add"), 0, int(rng.integers(0, 3)),
+                int(rng.integers(0, 12)), int(rng.integers(0, V)))
+
+    docs, counts = ragged(rng, R, 10, gen)
+    client.grid_new("t_wd", "worddocumentcount", **params)
+    client.grid_new("p_wd", "worddocumentcount", **params)
+    client.grid_apply("t_wd", docs)
+    client.grid_apply_packed(
+        "p_wd", [("doc_add", counts, cols_of(docs, (1, 2, 3, 4)))]
+    )
+    assert client.grid_to_binary("t_wd") == client.grid_to_binary("p_wd")
+
+
+def test_packed_validation_is_loud(client):
+    client.grid_new("v_tkr", "topk_rmv", n_replicas=2, n_keys=1, n_ids=8,
+                    n_dcs=2, size=2, slots_per_id=2)
+
+    def packed(tag, counts, cols):
+        return client.grid_apply_packed(
+            "v_tkr", [(tag, np.asarray(counts, np.int32),
+                       [np.asarray(c, np.int32) for c in cols])]
+        )
+
+    with pytest.raises(Exception, match="out of range"):
+        packed("add", [1, 0], [[0], [99], [5], [0], [1]])  # id
+    with pytest.raises(Exception, match="dc 7 out of range"):
+        packed("add", [1, 0], [[0], [1], [5], [7], [1]])
+    with pytest.raises(Exception, match="ts 0 out of range"):
+        packed("add", [1, 0], [[0], [1], [5], [0], [0]])
+    with pytest.raises(Exception, match="replica op counts"):
+        packed("add", [1], [[0], [1], [5], [0], [1]])
+    with pytest.raises(Exception, match="expected 1"):  # column too long
+        packed("add", [1, 0], [[0, 0], [1], [5], [0], [1]])
+    with pytest.raises(Exception, match="unknown grid op tag"):
+        packed("ban", [1, 0], [[0], [1]])
+    with pytest.raises(Exception, match="expected 2"):  # vc cols vs vc_len
+        packed("rmv", [1, 0], [[0], [1], [2], [0], [1]])
+
+    client.grid_new("v_wd", "worddocumentcount", n_replicas=1, n_keys=1,
+                    n_buckets=8)
+    with pytest.raises(Exception, match="mixes doc_add"):
+        client.grid_apply_packed("v_wd", [
+            ("doc_add", np.asarray([1], np.int32),
+             [np.asarray([0], np.int32)] * 4),
+            ("add", np.asarray([1], np.int32),
+             [np.asarray([0], np.int32), np.asarray([1], np.int32)]),
+        ])
+    with pytest.raises(Exception, match="multiple of 4"):
+        client.call((Atom("grid_apply_packed"), b"v_wd",
+                     [(Atom("add"), b"\x01\x00\x00", [b"", b""])]))
+    with pytest.raises(Exception, match="duplicate packed group"):
+        client.grid_apply_packed("v_wd", [
+            ("add", np.asarray([0], np.int32), [np.zeros(0, np.int32)] * 2),
+            ("add", np.asarray([0], np.int32), [np.zeros(0, np.int32)] * 2),
+        ])
+
+
+def test_packed_client_rejects_out_of_i32(client):
+    """The client must fail loudly on out-of-i32 values — a silent astype
+    would truncate 2**40+7 to 7 and corrupt state undetectably (the tuple
+    wire's ETF encoder raises on such ints too)."""
+    client.grid_new("i32_avg", "average", n_replicas=1, n_keys=1)
+    with pytest.raises(ValueError, match="i32 range"):
+        client.grid_apply_packed("i32_avg", [
+            ("add", np.asarray([1], np.int64),
+             [np.asarray([0], np.int64), np.asarray([2**40 + 7], np.int64),
+              np.asarray([1], np.int64)]),
+        ])
+
+
+def test_packed_empty_groups_are_noops(client):
+    client.grid_new("e_avg", "average", n_replicas=2, n_keys=1)
+    snap = client.grid_to_binary("e_avg")
+    client.grid_apply_packed("e_avg", [
+        ("add", np.zeros(2, np.int32), [np.zeros(0, np.int32)] * 3)
+    ])
+    assert client.grid_to_binary("e_avg") == snap

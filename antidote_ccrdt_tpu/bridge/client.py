@@ -171,26 +171,30 @@ class BridgeClient:
         with numpy/sequence int data; each column carries that field for
         every op, concatenated in replica order, and ships as ONE i32-LE
         binary instead of per-op ETF tuples."""
+        return self.call(
+            (Atom("grid_apply_packed"), name.encode(), _pack_groups(groups))
+        )
+
+    def grid_apply_extras_packed(self, name: str, groups):
+        """Packed `grid_apply_extras`: same input form as
+        grid_apply_packed; the generated extras come back as packed
+        groups in the grid's own packed column orders (decoded here to
+        (tag, counts, [columns]) numpy tuples), so they feed straight
+        back into grid_apply_packed."""
         import numpy as np
 
-        def b(x):
-            arr = np.asarray(x)
-            # Loud at the boundary like the tuple wire (whose ETF encode
-            # raises on out-of-i32 ints): a silent astype would truncate
-            # 2**40+7 to 7 and corrupt CRDT state undetectably.
-            if arr.size and (
-                int(arr.min()) < -(2**31) or int(arr.max()) >= 2**31
-            ):
-                raise ValueError("packed column value out of i32 range")
-            return arr.astype("<i4").tobytes()
-
-        wire_groups = [
-            (Atom(tag), b(counts), [b(c) for c in cols])
-            for tag, counts, cols in groups
-        ]
-        return self.call(
-            (Atom("grid_apply_packed"), name.encode(), wire_groups)
+        reply = self.call(
+            (Atom("grid_apply_extras_packed"), name.encode(),
+             _pack_groups(groups))
         )
+        return [
+            (
+                str(tag),
+                np.frombuffer(counts_bin, dtype="<i4"),
+                [np.frombuffer(cb, dtype="<i4") for cb in col_bins],
+            )
+            for tag, counts_bin, col_bins in reply
+        ]
 
     def grid_merge_all(self, name: str) -> None:
         self.call((Atom("grid_merge_all"), name.encode()))
@@ -216,3 +220,23 @@ def add(key: int, id_: Any, score: int, dc: int, ts: int):
 def rmv(key: int, id_: Any, vc: dict):
     """Grid removal op term; vc maps dc -> ts."""
     return (Atom("rmv"), key, id_, [(d, t) for d, t in sorted(vc.items())])
+
+def _pack_i32_col(x) -> bytes:
+    """One packed wire column: i32-LE bytes, loud on out-of-range values
+    (a silent astype would truncate 2**40+7 to 7 and corrupt CRDT state
+    undetectably; the tuple wire's ETF encoder raises on such ints too)."""
+    import numpy as np
+
+    arr = np.asarray(x)
+    if arr.size and (int(arr.min()) < -(2**31) or int(arr.max()) >= 2**31):
+        raise ValueError("packed column value out of i32 range")
+    return arr.astype("<i4").tobytes()
+
+
+def _pack_groups(groups):
+    """Pack (tag, counts, [cols]) groups to the wire form — the Python
+    twin of the Erlang client's pack_groups/1."""
+    return [
+        (Atom(tag), _pack_i32_col(counts), [_pack_i32_col(c) for c in cols])
+        for tag, counts, cols in groups
+    ]

@@ -32,7 +32,7 @@
          is_type/2, generates_extra_operations/2, is_operation/3,
          require_state_downstream/3, is_replicate_tagged/3,
          grid_new/4, grid_apply/3, grid_apply_extras/3,
-         grid_apply_packed/3, pack_i32/1,
+         grid_apply_packed/3, grid_apply_extras_packed/3, pack_i32/1,
          grid_merge_all/2, grid_observe/4,
          grid_to_binary/2, grid_from_binary/3,
          wire_atoms/0, main/1]).
@@ -166,9 +166,18 @@ grid_apply_extras(Sock, Grid, OpsPerReplica) when is_list(OpsPerReplica) ->
 %% of per-op ETF tuples, which is what lets a BEAM host feed the device
 %% at wire speed. Pre-packed binaries pass through unchanged.
 grid_apply_packed(Sock, Grid, Groups) when is_list(Groups) ->
-    Packed = [{Tag, pack_i32(Counts), [pack_i32(C) || C <- Cols]}
-              || {Tag, Counts, Cols} <- Groups],
-    call(Sock, {grid_apply_packed, Grid, Packed}).
+    call(Sock, {grid_apply_packed, Grid, pack_groups(Groups)}).
+
+%% Packed apply_extras: the reply is the generated extras as packed
+%% groups in this grid's own packed column orders ({Tag, CountsBin,
+%% [ColBin...]} with i32-little binaries) — feed them straight back into
+%% grid_apply_packed, or unpack with [X || <<X:32/little-signed>> <= Bin].
+grid_apply_extras_packed(Sock, Grid, Groups) when is_list(Groups) ->
+    call(Sock, {grid_apply_extras_packed, Grid, pack_groups(Groups)}).
+
+pack_groups(Groups) ->
+    [{Tag, pack_i32(Counts), [pack_i32(C) || C <- Cols]}
+     || {Tag, Counts, Cols} <- Groups].
 
 pack_i32(Bin) when is_binary(Bin) -> Bin;
 pack_i32(Ints) when is_list(Ints) ->

@@ -100,7 +100,14 @@ def _i32_col(buf, what: str) -> np.ndarray:
         raise ValueError(f"packed {what} must be a binary")
     if len(buf) % 4:
         raise ValueError(f"packed {what} length {len(buf)} not a multiple of 4")
-    return np.frombuffer(buf, dtype="<i4").astype(np.int32)
+    # copy=False: zero-copy on little-endian hosts (the hot path); only a
+    # big-endian host pays the byte-order normalization copy.
+    return np.frombuffer(buf, dtype="<i4").astype(np.int32, copy=False)
+
+
+def _bin_col(arr) -> bytes:
+    """Pack an int array as one i32-LE reply column."""
+    return np.ascontiguousarray(arr, dtype="<i4").tobytes()
 
 
 def _reject(mask: np.ndarray, values: np.ndarray, msg: str) -> None:
@@ -227,6 +234,27 @@ class _Grid:
         same loud boundary checking as the tuple packers, vectorized;
         the engine sees identical op batches (differentially pinned by
         tests/test_bridge_packed.py)."""
+        return getattr(self, f"_packed_{self.type_name}")(
+            self._parse_packed(groups)
+        )
+
+    def apply_extras_packed(self, groups):
+        """`apply_extras` over the packed wire: same input form as
+        `apply_packed`; the reply is the generated extras as packed
+        groups in the grid's OWN packed column orders, so a host feeds
+        them straight back into `grid_apply_packed`. topk_rmv replies
+        a {rmv, ...} group (dominated-add re-broadcast vcs) + an
+        {add, ...} group (rmv-driven promotions); leaderboard an {add,
+        ...} group (ban promotions); other types reply []."""
+        parsed = self._parse_packed(groups)
+        if self.type_name == "topk_rmv":
+            return self._packed_topk_rmv(parsed, want_extras=True)
+        if self.type_name == "leaderboard":
+            return self._packed_leaderboard(parsed, want_extras=True)
+        getattr(self, f"_packed_{self.type_name}")(parsed)
+        return []
+
+    def _parse_packed(self, groups):
         parsed: Dict[str, Tuple[np.ndarray, Dict[str, np.ndarray]]] = {}
         for g in groups:
             if not (isinstance(g, tuple) and len(g) == 3):
@@ -265,7 +293,7 @@ class _Grid:
                         f"{tag}.{name} has {col.size} values, expected {want}"
                     )
             parsed[tag] = (counts, cols)
-        return getattr(self, f"_packed_{self.type_name}")(parsed)
+        return parsed
 
     def _pad_cols(self, counts: np.ndarray, cols, fills):
         """Scatter concatenated ragged columns into padded [R, B] arrays
@@ -332,7 +360,7 @@ class _Grid:
         )
         return 0
 
-    def _packed_leaderboard(self, parsed) -> int:
+    def _packed_leaderboard(self, parsed, want_extras: bool = False):
         import jax.numpy as jnp
 
         from ..models.leaderboard import LeaderboardOps
@@ -356,7 +384,7 @@ class _Grid:
             padded[tag] = (*arrs, valid)
         a_key, a_id, a_score, a_valid = padded["add"]
         b_key, b_id, b_valid = padded["ban"]
-        self.state, _ = self.dense.apply_ops(
+        self.state, promoted = self.dense.apply_ops(
             self.state,
             LeaderboardOps(
                 add_key=jnp.asarray(a_key), add_id=jnp.asarray(a_id),
@@ -364,9 +392,19 @@ class _Grid:
                 ban_key=jnp.asarray(b_key), ban_id=jnp.asarray(b_id),
                 ban_valid=jnp.asarray(b_valid),
             ),
-            collect_promotions=False,
+            collect_promotions=want_extras,
         )
-        return 0
+        if not want_extras:
+            return 0
+        # Ban-promotion extras as a packed {add, ...} reply group —
+        # same (r, k, j) emission order as the term surface.
+        ids, scores, keep = (np.asarray(x) for x in promoted)
+        rr, kk, jj = np.nonzero(keep)
+        p_counts = keep.reshape(self.R, -1).sum(axis=1)
+        return [(Atom("add"), _bin_col(p_counts), [
+            _bin_col(kk), _bin_col(ids[rr, kk, jj]),
+            _bin_col(scores[rr, kk, jj]),
+        ])]
 
     def _packed_wordcount(self, parsed) -> int:
         import jax.numpy as jnp
@@ -420,7 +458,7 @@ class _Grid:
         )
         return 0
 
-    def _packed_topk_rmv(self, parsed) -> int:
+    def _packed_topk_rmv(self, parsed, want_extras: bool = False):
         import jax.numpy as jnp
 
         from ..models.topk_rmv_dense import TopkRmvOps
@@ -475,9 +513,42 @@ class _Grid:
                 rmv_key=jnp.asarray(r_key), rmv_id=jnp.asarray(r_id),
                 rmv_vc=jnp.asarray(r_vc),
             ),
-            collect_promotions=False,
+            collect_promotions=want_extras,
         )
-        return int(np.asarray(extras.dominated).sum())
+        if not want_extras:
+            return int(np.asarray(extras.dominated).sum())
+        # Dominated-add re-broadcast rmvs as a packed {rmv, ...} group —
+        # emission order (replica-major, op order) matches the term
+        # surface; the vc rows are the op-aligned dominated_vc rows with
+        # zero entries elided, exactly like the term path's vc_list.
+        dom = np.asarray(extras.dominated)
+        dvc = np.asarray(extras.dominated_vc)
+        live = np.arange(dom.shape[1])[None, :] < a_counts[:, None]
+        mask = dom & live
+        r_sel, j_sel = np.nonzero(mask)
+        rows = dvc[r_sel, j_sel]  # [n_dom, D]
+        nz = rows > 0
+        rmv_group = (Atom("rmv"), _bin_col(mask.sum(axis=1)), [
+            _bin_col(a_key[r_sel, j_sel]), _bin_col(a_id[r_sel, j_sel]),
+            _bin_col(nz.sum(axis=1)),
+            _bin_col(np.broadcast_to(
+                np.arange(D, dtype=np.int32), rows.shape
+            )[nz]),
+            _bin_col(rows[nz]),
+        ])
+        # Promotion adds (rmv-uncovered elements), (r, k, j) order like
+        # the term loop.
+        pr = extras.promoted
+        pids, pscores, pdcs, ptss, keep = (
+            np.asarray(x) for x in (pr.ids, pr.scores, pr.dcs, pr.tss, pr.valid)
+        )
+        rr, kk, jj = np.nonzero(keep)
+        add_group = (Atom("add"), _bin_col(keep.reshape(self.R, -1).sum(axis=1)), [
+            _bin_col(kk), _bin_col(pids[rr, kk, jj]),
+            _bin_col(pscores[rr, kk, jj]), _bin_col(pdcs[rr, kk, jj]),
+            _bin_col(ptss[rr, kk, jj]),
+        ])
+        return [rmv_group, add_group]
 
     @staticmethod
     def _check_tags(per_replica_ops, allowed) -> None:
@@ -902,6 +973,7 @@ class BridgeServer:
     }
     _GRID_TAGS = {
         "grid_apply", "grid_apply_extras", "grid_apply_packed",
+        "grid_apply_extras_packed",
         "grid_merge_all", "grid_observe", "grid_to_binary",
     }
 
@@ -1127,6 +1199,9 @@ class BridgeServer:
         if tag == "grid_apply_packed":
             _, gname, groups = op
             return self._grids[gname].apply_packed(groups)
+        if tag == "grid_apply_extras_packed":
+            _, gname, groups = op
+            return self._grids[gname].apply_extras_packed(groups)
         if tag == "grid_merge_all":
             _, gname = op
             self._grids[gname].merge_all()

@@ -706,8 +706,11 @@ def bench_grid_wire():
             return n_ops / (time.perf_counter() - t0)
 
         def seq_ts(dcs, base):
-            """Per-dc running timestamps (1-based), mirroring the tuple
-            lines' frontier counters, vectorized."""
+            """Per-dc running timestamps (1-based past `base[dc]`),
+            mirroring the tuple lines' PERSISTENT frontier counters
+            (base carries across calls — restarting at 1 every call
+            would replay stale (dc, ts) pairs the tuple line never
+            generates), vectorized."""
             order = np.argsort(dcs, kind="stable")
             sorted_dcs = dcs[order]
             grp = np.r_[True, sorted_dcs[1:] != sorted_dcs[:-1]]
@@ -716,16 +719,20 @@ def bench_grid_wire():
             )
             ts = np.empty_like(c)
             ts[order] = c + 1
-            return ts + base
+            return ts + base[dcs]
 
         Ba = B - B // 16
         counts_a = np.full(R, Ba, np.int32)
+        frontier_base = np.zeros((R, R), np.int64)  # [replica, dc]
 
         def tr_packed():
             dc = rng.integers(0, R, R * Ba).astype(np.int32)
-            ts = np.concatenate([
-                seq_ts(dc[r * Ba:(r + 1) * Ba], 0) for r in range(R)
-            ]).astype(np.int32)
+            ts_parts = []
+            for r in range(R):
+                dcr = dc[r * Ba:(r + 1) * Ba]
+                ts_parts.append(seq_ts(dcr, frontier_base[r]))
+                frontier_base[r] += np.bincount(dcr, minlength=R)
+            ts = np.concatenate(ts_parts).astype(np.int32)
             adds = ("add", counts_a, [
                 np.zeros(R * Ba, np.int32),
                 rng.integers(0, I, R * Ba).astype(np.int32),

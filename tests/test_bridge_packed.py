@@ -224,6 +224,123 @@ def test_packed_validation_is_loud(client):
         ])
 
 
+def term_extras_to_packed(extras_per_replica, D):
+    """Convert the term surface's per-replica extras lists to the packed
+    reply shape for comparison (rmv group then add group, replica-major
+    op order — the documented packed emission order)."""
+    rmv_counts, add_counts = [], []
+    rk, rid, vl, vdc, vts = [], [], [], [], []
+    acols = [[] for _ in range(5)]
+    for ops in extras_per_replica:
+        nr = na = 0
+        for op in ops:
+            if str(op[0]) == "rmv":
+                nr += 1
+                rk.append(op[1]); rid.append(op[2])
+                vl.append(len(op[3]))
+                for d, t in op[3]:
+                    vdc.append(d); vts.append(t)
+            else:
+                na += 1
+                for c, v in zip(acols, op[1:]):
+                    c.append(v)
+        rmv_counts.append(nr); add_counts.append(na)
+    return (
+        ("rmv", np.asarray(rmv_counts, np.int32),
+         [np.asarray(x, np.int32) for x in (rk, rid, vl, vdc, vts)]),
+        ("add", np.asarray(add_counts, np.int32),
+         [np.asarray(x, np.int32) for x in acols]),
+    )
+
+
+def test_packed_extras_match_term_extras_topk_rmv(client):
+    """apply_extras over both wires: identical state AND identical extras
+    content; the packed extras feed back through grid_apply_packed to the
+    same converged snapshot as the term extras through grid_apply."""
+    rng = np.random.default_rng(3)
+    R, NK, I, D = 2, 1, 16, 2
+    params = dict(n_replicas=R, n_keys=NK, n_ids=I, n_dcs=D, size=3,
+                  slots_per_id=2)
+    client.grid_new("xt", "topk_rmv", **params)
+    client.grid_new("xp", "topk_rmv", **params)
+
+    # Seed both with adds, then a batch whose rmvs uncover (promotions)
+    # and whose adds hit fresh tombstones (dominated re-broadcasts).
+    seed = [[(Atom("add"), 0, i, 10 * i + r, r, 1 + i) for i in range(6)]
+            for r in range(R)]
+    client.grid_apply("xt", seed)
+    client.grid_apply_packed(
+        "xp", [("add", np.full(R, 6, np.int32), cols_of(seed, (1, 2, 3, 4, 5)))]
+    )
+    batch = [
+        [(Atom("rmv"), 0, 3, [(0, 99)]), (Atom("add"), 0, 3, 1, 0, 50)],
+        [(Atom("rmv"), 0, 5, [(1, 99)])],
+    ]
+    ex_term = client.grid_apply_extras("xt", batch)
+
+    a_ops = [[op for op in ops if str(op[0]) == "add"] for ops in batch]
+    r_ops = [[op for op in ops if str(op[0]) == "rmv"] for ops in batch]
+    a_counts = np.asarray([len(o) for o in a_ops], np.int32)
+    r_counts = np.asarray([len(o) for o in r_ops], np.int32)
+    vc_len = np.asarray([len(op[3]) for ops in r_ops for op in ops], np.int32)
+    vc_dc = np.asarray(
+        [d for ops in r_ops for op in ops for d, _ in op[3]], np.int32)
+    vc_ts = np.asarray(
+        [t for ops in r_ops for op in ops for _, t in op[3]], np.int32)
+    ex_packed = client.grid_apply_extras_packed("xp", [
+        ("add", a_counts, cols_of(a_ops, (1, 2, 3, 4, 5))),
+        ("rmv", r_counts, cols_of(r_ops, (1, 2)) + [vc_len, vc_dc, vc_ts]),
+    ])
+    assert client.grid_to_binary("xt") == client.grid_to_binary("xp")
+
+    want = term_extras_to_packed(ex_term, D)
+    assert len(ex_packed) == 2
+    for (wtag, wcounts, wcols), (gtag, gcounts, gcols) in zip(want, ex_packed):
+        assert wtag == gtag
+        np.testing.assert_array_equal(wcounts, gcounts)
+        for wc, gc in zip(wcols, gcols):
+            np.testing.assert_array_equal(wc, gc)
+
+    # Feedback loop: term extras -> grid_apply; packed extras ->
+    # grid_apply_packed; snapshots stay identical.
+    if any(ex_term):
+        client.grid_apply("xt", ex_term)
+        client.grid_apply_packed("xp", ex_packed)
+        assert client.grid_to_binary("xt") == client.grid_to_binary("xp")
+
+
+def test_packed_extras_leaderboard_promotions(client):
+    client.grid_new("xlt", "leaderboard", n_replicas=1, n_keys=1,
+                    n_players=8, size=2)
+    client.grid_new("xlp", "leaderboard", n_replicas=1, n_keys=1,
+                    n_players=8, size=2)
+    seed = [[(Atom("add"), 0, p, 100 - p) for p in range(4)]]
+    client.grid_apply("xlt", seed)
+    client.grid_apply_packed(
+        "xlp", [("add", np.asarray([4], np.int32), cols_of(seed, (1, 2, 3)))]
+    )
+    batch = [[(Atom("ban"), 0, 0)]]  # banning the leader promotes
+    ex_term = client.grid_apply_extras("xlt", batch)
+    ex_packed = client.grid_apply_extras_packed(
+        "xlp", [("ban", np.asarray([1], np.int32), cols_of(batch, (1, 2)))]
+    )
+    assert client.grid_to_binary("xlt") == client.grid_to_binary("xlp")
+    assert len(ex_packed) == 1 and ex_packed[0][0] == "add"
+    flat = [list(op[1:]) for ops in ex_term for op in ops]
+    got = list(zip(*[c.tolist() for c in ex_packed[0][2]]))
+    assert [tuple(x) for x in flat] == got
+
+
+def test_packed_extras_other_types_empty(client):
+    client.grid_new("xe_avg", "average", n_replicas=1, n_keys=1)
+    out = client.grid_apply_extras_packed("xe_avg", [
+        ("add", np.asarray([1], np.int32),
+         [np.asarray([0], np.int32), np.asarray([5], np.int32),
+          np.asarray([1], np.int32)]),
+    ])
+    assert out == []
+
+
 def test_packed_client_rejects_out_of_i32(client):
     """The client must fail loudly on out-of-i32 values — a silent astype
     would truncate 2**40+7 to 7 and corrupt state undetectably (the tuple

@@ -34,6 +34,18 @@ def ragged(rng, R, max_b, gen):
     return per_replica, counts
 
 
+def rmv_cols_of(rmvs):
+    """topk_rmv rmv group columns (key, id, vc_len, vc_dc, vc_ts) from
+    tuple ops — the single place the ragged-vc flattening lives."""
+    return cols_of(rmvs, (1, 2)) + [
+        np.asarray([len(op[3]) for ops in rmvs for op in ops], np.int32),
+        np.asarray(
+            [d for ops in rmvs for op in ops for d, _ in op[3]], np.int32),
+        np.asarray(
+            [t for ops in rmvs for op in ops for _, t in op[3]], np.int32),
+    ]
+
+
 def cols_of(per_replica, fields):
     """Extract packed columns (concatenated in replica order) from tuple
     ops — fields gives each value's position in the tuple."""
@@ -121,16 +133,7 @@ def test_packed_matches_tuple_wire_topk_rmv(client, seed):
     )
 
     a_cols = cols_of(adds, (1, 2, 3, 4, 5))
-    vc_len = np.asarray(
-        [len(op[3]) for ops in rmvs for op in ops], np.int32
-    )
-    vc_dc = np.asarray(
-        [d for ops in rmvs for op in ops for d, _ in op[3]], np.int32
-    )
-    vc_ts = np.asarray(
-        [t for ops in rmvs for op in ops for _, t in op[3]], np.int32
-    )
-    r_cols = cols_of(rmvs, (1, 2)) + [vc_len, vc_dc, vc_ts]
+    r_cols = rmv_cols_of(rmvs)
     dom_p = client.grid_apply_packed(
         gp, [("add", a_counts, a_cols), ("rmv", r_counts, r_cols)]
     )
@@ -282,14 +285,9 @@ def test_packed_extras_match_term_extras_topk_rmv(client):
     r_ops = [[op for op in ops if str(op[0]) == "rmv"] for ops in batch]
     a_counts = np.asarray([len(o) for o in a_ops], np.int32)
     r_counts = np.asarray([len(o) for o in r_ops], np.int32)
-    vc_len = np.asarray([len(op[3]) for ops in r_ops for op in ops], np.int32)
-    vc_dc = np.asarray(
-        [d for ops in r_ops for op in ops for d, _ in op[3]], np.int32)
-    vc_ts = np.asarray(
-        [t for ops in r_ops for op in ops for _, t in op[3]], np.int32)
     ex_packed = client.grid_apply_extras_packed("xp", [
         ("add", a_counts, cols_of(a_ops, (1, 2, 3, 4, 5))),
-        ("rmv", r_counts, cols_of(r_ops, (1, 2)) + [vc_len, vc_dc, vc_ts]),
+        ("rmv", r_counts, rmv_cols_of(r_ops)),
     ])
     assert client.grid_to_binary("xt") == client.grid_to_binary("xp")
 
@@ -352,6 +350,72 @@ def test_packed_client_rejects_out_of_i32(client):
              [np.asarray([0], np.int64), np.asarray([2**40 + 7], np.int64),
               np.asarray([1], np.int64)]),
         ])
+
+
+from hypothesis import HealthCheck, given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
+
+
+# max_examples=10: every drawn op mix has a different padded batch
+# shape, so each example pays a dense-kernel recompile (~3s); 10 keeps
+# the duplicate/empty-vc edge coverage at half the wall cost.
+@settings(
+    max_examples=10, deadline=None,
+    suppress_health_check=[HealthCheck.too_slow],
+)
+@given(
+    ops=st.lists(
+        st.one_of(
+            # (add, replica, key, id, score, dc, ts>=1)
+            st.tuples(st.just("add"), st.integers(0, 1), st.integers(0, 1),
+                      st.integers(0, 11), st.integers(-50, 50),
+                      st.integers(0, 2), st.integers(1, 30)),
+            # (rmv, replica, key, id, [(dc, ts)])
+            st.tuples(st.just("rmv"), st.integers(0, 1), st.integers(0, 1),
+                      st.integers(0, 11),
+                      st.lists(st.tuples(st.integers(0, 2),
+                                         st.integers(1, 30)), max_size=3)),
+        ),
+        max_size=16,
+    ),
+)
+def test_packed_tuple_parity_property_topk_rmv(ops):
+    """Property form of the packed/tuple differential: ANY ragged mix of
+    adds and rmvs (duplicate ops, duplicate vc dcs, empty vc lists,
+    empty replicas included) drives both wire packers to the identical
+    dense state."""
+    from antidote_ccrdt_tpu.bridge.server import _Grid
+
+    params = {Atom("n_replicas"): 2, Atom("n_keys"): 2, Atom("n_ids"): 12,
+              Atom("n_dcs"): 3, Atom("size"): 3, Atom("slots_per_id"): 2}
+    gt, gp = _Grid("topk_rmv", params), _Grid("topk_rmv", params)
+
+    per_replica = [[], []]
+    for op in ops:
+        if op[0] == "add":
+            _, r, k, i, s, d, t = op
+            per_replica[r].append((Atom("add"), k, i, s, d, t))
+        else:
+            _, r, k, i, vc = op
+            per_replica[r].append((Atom("rmv"), k, i, vc))
+    dom_t = gt.apply(per_replica)
+
+    adds = [[o for o in ops_ if str(o[0]) == "add"] for ops_ in per_replica]
+    rmvs = [[o for o in ops_ if str(o[0]) == "rmv"] for ops_ in per_replica]
+    groups = [
+        ("add", np.asarray([len(a) for a in adds], np.int32),
+         cols_of(adds, (1, 2, 3, 4, 5))),
+        ("rmv", np.asarray([len(r) for r in rmvs], np.int32),
+         rmv_cols_of(rmvs)),
+    ]
+    wire_groups = [
+        (Atom(tag), np.asarray(counts, "<i4").tobytes(),
+         [np.asarray(c, "<i4").tobytes() for c in cols])
+        for tag, counts, cols in groups
+    ]
+    dom_p = gp.apply_packed(wire_groups)
+    assert dom_t == dom_p
+    assert gt.to_binary() == gp.to_binary()
 
 
 def test_packed_empty_groups_are_noops(client):

@@ -1,0 +1,138 @@
+"""Would the M-major state layout actually pay? (round-5 decision probe)
+
+merge_probe.py's round-4 verdict left one named future direction: store
+the slot planes [.., M, I] and the tombstone table [.., D, I] (small dim
+major) so a single-pass kernel — or plain XLA — stops fighting the
+minor-dim-4 tiling. Refactoring the whole engine on a hunch is exactly
+what this repo doesn't do, so this probe measures the merge itself on
+BOTH layouts with states RESIDENT in each (no per-rep transposes —
+the ~3ms boundary-transpose cost only applies to a mixed design):
+
+  * imajor — the production union join on [G, I, M] / [G, I, D] states
+    (D.merge's exact kernel, timed on the same harness for a same-RTT
+    baseline).
+  * mmajor — the same union-join semantics re-expressed on [G, M, I] /
+    [G, D, I] states: candidate axis is -2, the dom one-hot reduce runs
+    over the D-major axis, placement one-hots over (2M, m_keep) with I
+    riding minor — every elementwise op now has the long axis in lanes.
+
+Equivalence is asserted against the production merge (transposing the
+mmajor result back once, outside timing). The delta answers whether the
+round-5 cross-engine layout refactor has real headroom behind it or the
+merge is schedule-bound regardless of layout.
+
+Run: [MERGE_REPS=64] python benchmarks/merge_layout_probe.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from antidote_ccrdt_tpu.models.topk_rmv_dense import (
+    NEG_INF,
+    TopkRmvDenseState,
+    _cmp_better,
+)
+from benchmarks.merge_probe import D, M, REPS, side_a, side_b, sync
+
+
+def to_mmajor(st):
+    """[R, NK, I, M] -> [R, NK, M, I] and rmv [.., I, D] -> [.., D, I]."""
+    sw = lambda x: jnp.swapaxes(x, -1, -2)  # noqa: E731
+    return TopkRmvDenseState(
+        sw(st.slot_score), sw(st.slot_dc), sw(st.slot_ts),
+        sw(st.rmv_vc), st.vc, st.lossy,
+    )
+
+
+def merge_mmajor(a, b):
+    """Union join on M-major planes: same semantics as
+    `_join_slots_union` + the elementwise maxes, long axis minor."""
+    rmv_vc = jnp.maximum(a.rmv_vc, b.rmv_vc)  # [.., D, I]
+    vc = jnp.maximum(a.vc, b.vc)
+    c_s = jnp.concatenate([a.slot_score, b.slot_score], axis=-2)  # [.., 2M, I]
+    c_d = jnp.concatenate([a.slot_dc, b.slot_dc], axis=-2)
+    c_t = jnp.concatenate([a.slot_ts, b.slot_ts], axis=-2)
+
+    Dd = rmv_vc.shape[-2]
+    # dom[.., c, i] = rmv_vc[.., dc[c, i], i]: one-hot over the D axis,
+    # broadcast [.., 2M, 1, I] x [.., 1, D, I] -> reduce D.
+    oh = c_d[..., :, None, :] == jnp.arange(Dd, dtype=c_d.dtype)[:, None]
+    dom = jnp.max(
+        jnp.where(oh, rmv_vc[..., None, :, :], 0), axis=-2
+    )  # [.., 2M, I]
+    live = c_t > dom
+
+    X = lambda x: x[..., :, None, :]  # noqa: E731 — candidate axis
+    Y = lambda x: x[..., None, :, :]  # noqa: E731 — opponent axis
+    beats = _cmp_better(Y(c_s), Y(c_t), Y(c_d), X(c_s), X(c_t), X(c_d))
+    eq = (X(c_s) == Y(c_s)) & (X(c_t) == Y(c_t)) & (X(c_d) == Y(c_d))
+    pos = jnp.arange(2 * M, dtype=jnp.int32)[:, None]
+    a_side = pos < M
+    dup = jnp.any(eq & Y(live) & Y(a_side), axis=-2) & ~a_side
+    live = live & ~dup
+    earlier = Y(pos) < X(pos)
+    r = jnp.sum((beats | (eq & earlier)) & Y(live), axis=-2)
+    r = jnp.where(live, r, 2 * M)
+
+    ranks = jnp.arange(M, dtype=jnp.int32)[:, None]
+    oh_r = r[..., :, None, :] == ranks  # [.., 2M, m_keep, I]
+
+    def place(x, empty):
+        out = jnp.sum(jnp.where(oh_r, x[..., :, None, :], 0), axis=-3)
+        return jnp.where(jnp.any(oh_r, axis=-3), out, empty)
+
+    n_live = jnp.sum(live.astype(jnp.int32), axis=-2)  # [.., I]
+    lossy = a.lossy | b.lossy | jnp.any(n_live > M, axis=-1)
+    return TopkRmvDenseState(
+        place(c_s, NEG_INF), place(c_d, 0), place(c_t, 0), rmv_vc, vc, lossy,
+    )
+
+
+def timeit(name, step_fn, a0, peer):
+    @jax.jit
+    def run(c, p):
+        def body(c, _):
+            return step_fn(c, p), ()
+        out, _ = lax.scan(body, c, None, length=REPS)
+        return out
+
+    sync(run(a0, peer))
+    t0 = time.perf_counter()
+    out = run(a0, peer)
+    sync(out)
+    ms = (time.perf_counter() - t0) / REPS * 1e3
+    print(f"{name:44s} {ms:9.3f} ms/merge", flush=True)
+    return ms
+
+
+def main():
+    print(f"# backend={jax.default_backend()} REPS={REPS}")
+    am, bm = to_mmajor(side_a), to_mmajor(side_b)
+    for x in jax.tree.leaves(am) + jax.tree.leaves(bm):
+        sync(x)
+
+    # Equivalence first: transpose the mmajor result back once.
+    ref = D.merge(side_a, side_b)
+    got = to_mmajor(merge_mmajor(am, bm))  # to_mmajor is its own inverse
+    ok = all(
+        bool(jnp.array_equal(x, y))
+        for x, y in zip(jax.tree.leaves(ref), jax.tree.leaves(got))
+    )
+    print(f"# equivalence mmajor: {'OK' if ok else 'MISMATCH'}")
+    assert ok
+
+    imaj = timeit("imajor (production union join)", D.merge, side_a, side_b)
+    mmaj = timeit("mmajor (long axis minor)", merge_mmajor, am, bm)
+    print(f"# layout delta: {mmaj - imaj:+.3f} ms/merge "
+          f"({(mmaj / imaj - 1) * 100:+.1f}%)")
+
+
+if __name__ == "__main__":
+    main()

@@ -54,10 +54,14 @@ Mosaic kernel (read both states once, write merged state: exactly the
   a thin upside against the backend's record of pallas composition
   regressions (ablate_apply: pallas tombstones win isolated, lose
   composed).
-* The real unlock would be storing the dense state M-major/D-major
-  globally (no boundary transposes; kernel at the 2.06ms floor) — a
-  cross-engine refactor (scatter orientation, observe reads, delta
-  tables) left as the named future direction, not attempted blind.
+* The presumed real unlock — storing the dense state M-major/D-major
+  globally — was then MEASURED before anyone refactored toward it
+  (benchmarks/merge_layout_probe.py: the full union-join merge
+  re-expressed on [.., M, I] / [.., D, I] RESIDENT states, exact
+  equivalence asserted): -6.7% (10.21 -> 9.53 ms harness, ~8.3 -> 7.6
+  device). The merge is schedule-bound regardless of layout; the
+  cross-engine layout refactor is a measured dead end, not a future
+  direction.
 """
 import os
 import sys

@@ -8,8 +8,11 @@ as loudly as the tuple packers."""
 
 import numpy as np
 import pytest
+from hypothesis import HealthCheck, given, settings
+from hypothesis import strategies as st
 
 from antidote_ccrdt_tpu.bridge import BridgeClient, BridgeServer
+from antidote_ccrdt_tpu.bridge.server import _bin_col
 from antidote_ccrdt_tpu.core.etf import Atom
 
 
@@ -352,10 +355,6 @@ def test_packed_client_rejects_out_of_i32(client):
         ])
 
 
-from hypothesis import HealthCheck, given, settings  # noqa: E402
-from hypothesis import strategies as st  # noqa: E402
-
-
 # max_examples=10: every drawn op mix has a different padded batch
 # shape, so each example pays a dense-kernel recompile (~3s); 10 keeps
 # the duplicate/empty-vc edge coverage at half the wall cost.
@@ -409,8 +408,7 @@ def test_packed_tuple_parity_property_topk_rmv(ops):
          rmv_cols_of(rmvs)),
     ]
     wire_groups = [
-        (Atom(tag), np.asarray(counts, "<i4").tobytes(),
-         [np.asarray(c, "<i4").tobytes() for c in cols])
+        (Atom(tag), _bin_col(counts), [_bin_col(c) for c in cols])
         for tag, counts, cols in groups
     ]
     dom_p = gp.apply_packed(wire_groups)

@@ -21,7 +21,13 @@ device->host readback — on tunneled TPU backends `jax.block_until_ready`
 returns without waiting, so naive per-round timing measures dispatch, not
 compute.
 
-Prints exactly ONE JSON line.
+Prints TWO JSON lines: a full-detail line (hbm roofline, compute
+attribution, throughput/latency curve — mirrored to
+benchmarks/bench_details.json) and then a compact final summary line
+(<1,900 chars). The driver records only the tail of stdout and parses the
+LAST line, so the summary must stay small — round 4's single fat line
+overflowed the driver's window and the official record came back
+unparseable (VERDICT-r4 weak #1).
 """
 
 import dataclasses
@@ -662,36 +668,68 @@ def main():
     }
     baseline_rate = bench_scalar_baseline(R, I, D_DCS, K, base_ops)
 
-    print(
-        json.dumps(
-            {
-                "metric": f"topk_rmv merges/sec ({I//1000}k ids x {R} replicas, K={K})",
-                "value": round(apply_rate),
-                "unit": "merges/sec",
-                "vs_baseline": round(apply_rate / baseline_rate, 2),
-                "p50_round_ms_windowed": round(p50_ms, 2),
-                "p99_round_ms_windowed": round(p99_ms, 2),
-                "p50_round_ms_e2e": round(p50_e2e_ms, 2),
-                "p99_round_ms_e2e": round(p99_e2e_ms, 2),
-                "dispatch_overhead_ms_p50": round(dispatch_overhead_ms, 2),
-                "hbm": hbm,
-                "compute": compute,
-                # extras_mode disambiguates the two rates below (ADVICE-r2
-                # item 3): "table" is the id-keyed dominated table (the
-                # replication-path default), "op_aligned" the legacy
-                # per-op gather mode — same key names across rounds used
-                # to read a methodology switch as a speedup.
-                "extras_mode": "table",
-                "merges_per_sec_with_extras": round(extras_rate),
-                "merges_per_sec_with_extras_op_aligned": round(extras_ops_rate),
-                "curve": {"points": curve, "operating_point": chosen},
-                "replica_state_merges_per_sec": round(state_merge_rate, 1),
-                "baseline_cpu_merges_per_sec": round(baseline_rate),
-                "batch_per_replica_round": f"{B} adds + {Br} rmvs",
-                "backend": backend,
-            }
+    # The driver records only the TAIL of stdout (<=2,000 chars) as
+    # BENCH_r{N}.json and parses the LAST line; round 4's single fat line
+    # (2,258 chars with hbm/compute/curve inline) overflowed that window and
+    # left the official record unparseable (VERDICT-r4 weak #1). So: the
+    # bulky analysis blocks go to a committed sidecar file (and an earlier
+    # stdout line for anyone reading the log), and the final line stays a
+    # compact headline the driver can always parse.
+    details = {
+        "hbm": hbm,
+        "compute": compute,
+        # extras_mode disambiguates the two rates below (ADVICE-r2 item 3):
+        # "table" is the id-keyed dominated table (the replication-path
+        # default), "op_aligned" the legacy per-op gather mode — same key
+        # names across rounds used to read a methodology switch as a
+        # speedup.
+        "extras_mode": "table",
+        "merges_per_sec_with_extras": round(extras_rate),
+        "merges_per_sec_with_extras_op_aligned": round(extras_ops_rate),
+        "curve": {"points": curve, "operating_point": chosen},
+        "dispatch_overhead_ms_p50": round(dispatch_overhead_ms, 2),
+        "batch_per_replica_round": f"{B} adds + {Br} rmvs",
+        "backend": backend,
+    }
+    # Only a real-accelerator run mirrors the details to the committed
+    # sidecar path: the tiny smoke mode and the CPU CI fallback produce
+    # meaningless numbers, and letting them overwrite the official artifact
+    # would recreate the stale-record failure this code exists to prevent.
+    sidecar = None
+    if backend != "cpu" and not os.environ.get("CCRDT_BENCH_TINY"):
+        sidecar = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "benchmarks", "bench_details.json",
         )
-    )
+        try:
+            with open(sidecar, "w") as f:
+                json.dump(details, f, indent=1)
+        except OSError:
+            sidecar = None  # read-only checkout: the stdout copy suffices
+    summary = {
+        "metric": f"topk_rmv merges/sec ({I//1000}k ids x {R} replicas, K={K})",
+        "value": round(apply_rate),
+        "unit": "merges/sec",
+        "vs_baseline": round(apply_rate / baseline_rate, 2),
+        "p50_round_ms_windowed": round(p50_ms, 2),
+        "p99_round_ms_windowed": round(p99_ms, 2),
+        "p50_round_ms_e2e": round(p50_e2e_ms, 2),
+        "p99_round_ms_e2e": round(p99_e2e_ms, 2),
+        "operating_point_batch_adds": B,
+        "replica_state_merges_per_sec": round(state_merge_rate, 1),
+        "baseline_cpu_merges_per_sec": round(baseline_rate),
+        "backend": backend,
+        "details_file": "benchmarks/bench_details.json" if sidecar else "stdout",
+    }
+    line = json.dumps(summary)
+    # Explicit check (not assert: python -O would strip it), and BEFORE the
+    # details print — if the summary somehow outgrows the driver's window
+    # the failure must not leave the fat details line as the last stdout
+    # line, which is exactly the unparseable-record mode being prevented.
+    if len(line) >= 1900:
+        raise RuntimeError(f"final bench line too long ({len(line)} chars)")
+    print(json.dumps({"details": details}))
+    print(line)
 
 
 if __name__ == "__main__":

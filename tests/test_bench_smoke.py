@@ -1,7 +1,8 @@
 """Smoke-test the driver-facing benchmark entry points at tiny shapes on
 the CPU test mesh: bench.py must keep producing its numbers (the driver
-records its one JSON line every round — signature rot or a shape bug here
-fails the round, not just a test)."""
+records the tail of its stdout every round and parses the final compact
+summary line — signature rot or a shape bug here fails the round, not
+just a test)."""
 
 import json
 import os
@@ -41,7 +42,12 @@ def test_bench_scalar_baseline_tiny():
     assert rate > 0
 
 
-def test_bench_main_emits_one_json_line():
+def test_bench_main_final_line_is_compact_and_parses():
+    """The driver keeps only the tail (<=2,000 chars) of stdout and parses
+    the LAST line; round 4's fat single line overflowed that window and the
+    official record came back unparseable (VERDICT-r4 weak #1). The contract
+    is now: a compact final summary line (<1,900 chars) plus a full-detail
+    line earlier in stdout, mirrored to benchmarks/bench_details.json."""
     from conftest import cpu_subprocess_env
 
     env = cpu_subprocess_env(CCRDT_BENCH_TINY="1")
@@ -51,15 +57,22 @@ def test_bench_main_emits_one_json_line():
     )
     assert out.returncode == 0, out.stderr[-2000:]
     lines = [l for l in out.stdout.strip().splitlines() if l.startswith("{")]
-    assert len(lines) == 1, out.stdout
-    rec = json.loads(lines[0])
+    assert len(lines) == 2, out.stdout
+    rec = json.loads(lines[-1])
+    assert len(lines[-1]) < 1900
     assert rec["unit"] == "merges/sec" and rec["value"] > 0
     assert "vs_baseline" in rec
-    pts = rec["curve"]["points"]
+    assert rec["replica_state_merges_per_sec"] > 0
+    details = json.loads(lines[0])["details"]
+    pts = details["curve"]["points"]
     # 2 sweep points + the carried-over headline point (source=headline).
     assert len(pts) == 3 and all(p["merges_per_sec"] > 0 for p in pts)
     assert sum(1 for p in pts if p.get("source") == "headline") == 1
     assert all(
         p["p99_round_ms_e2e"] >= p["p50_round_ms_e2e"] > 0 for p in pts
     )
-    assert rec["curve"]["operating_point"]["batch_adds"] > 0
+    assert details["curve"]["operating_point"]["batch_adds"] > 0
+    # Tiny-mode numbers are meaningless, so the run must NOT have touched
+    # the committed sidecar (only real-accelerator runs write it) and must
+    # say so in the summary.
+    assert rec["details_file"] == "stdout"

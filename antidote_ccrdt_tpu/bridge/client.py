@@ -228,6 +228,11 @@ def _pack_i32_col(x) -> bytes:
     import numpy as np
 
     arr = np.asarray(x)
+    if arr.size and not np.issubdtype(arr.dtype, np.integer):
+        # A float column (e.g. 3.7) would pass the range check below and
+        # astype would silently truncate it to 3; the tuple wire's ETF
+        # encoder rejects non-integers, so the packed wire must too.
+        raise ValueError(f"packed column requires integer dtype, got {arr.dtype}")
     if arr.size and (int(arr.min()) < -(2**31) or int(arr.max()) >= 2**31):
         raise ValueError("packed column value out of i32 range")
     return arr.astype("<i4").tobytes()

@@ -500,9 +500,16 @@ class _Grid:
         r_vc = np.zeros((self.R, Br, D), np.int32)
         if vc_dc.size:
             op_of_vc = np.repeat(np.arange(ri_.size), vc_len)
-            # Same last-wins overwrite for duplicate dcs within one op's
-            # vc list as the sequential tuple loop.
-            r_vc[r_idx[op_of_vc], j_idx[op_of_vc], vc_dc] = vc_ts
+            # Last-wins for duplicate dcs within one op's vc list, matching
+            # the tuple path's sequential overwrite — made explicit, since
+            # NumPy does not guarantee assignment order for repeated fancy
+            # indices: keep only the final (op, dc) entry per pair.
+            pair = op_of_vc.astype(np.int64) * D + vc_dc
+            _, first_in_rev = np.unique(pair[::-1], return_index=True)
+            keep = pair.size - 1 - first_in_rev
+            r_vc[r_idx[op_of_vc[keep]], j_idx[op_of_vc[keep]], vc_dc[keep]] = (
+                vc_ts[keep]
+            )
 
         self.state, extras = self.dense.apply_ops(
             self.state,

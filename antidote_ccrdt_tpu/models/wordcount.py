@@ -457,6 +457,15 @@ class WordcountDense:
         else:
             tbl = bucket_table.astype(jnp.int32)
             token = jnp.take(tbl, jnp.clip(uniq32, 0, tbl.shape[0] - 1))
+            # A live uniq id outside the resident table (negative or past
+            # the end) has no bucket; the raw wire could never produce it,
+            # so route it to an out-of-range token that lands in `lost`
+            # rather than clamping it into an arbitrary table entry (a
+            # silent miscount). Dead entries get -1 below regardless.
+            token = jnp.where(
+                (uniq32 >= tbl.shape[0]) | (uniq32 < 0),
+                jnp.int32(self.V), token,
+            )
             token = jnp.where(live, token, -1)
         ops = WordDocOps(
             key=jnp.full_like(uniq32, key), doc=doc, uniq=uniq32, token=token

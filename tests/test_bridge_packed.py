@@ -416,6 +416,40 @@ def test_packed_tuple_parity_property_topk_rmv(ops):
     assert gt.to_binary() == gp.to_binary()
 
 
+def test_packed_client_rejects_float_dtype(client):
+    """A float column (e.g. 3.7) passes the i32 range check but astype
+    would silently truncate it to 3; the tuple wire's ETF encoder rejects
+    non-integers, so the packed client must too (ADVICE-r4 #1)."""
+    client.grid_new("f_avg", "average", n_replicas=1, n_keys=1)
+    with pytest.raises(ValueError, match="integer dtype"):
+        client.grid_apply_packed("f_avg", [
+            ("add", np.asarray([1], np.int64),
+             [np.asarray([0]), np.asarray([3.7]), np.asarray([1])]),
+        ])
+
+
+def test_packed_rmv_duplicate_dc_last_wins(client):
+    """Duplicate dc entries within one rmv's vc list must resolve
+    last-wins on the packed path, matching the tuple wire's sequential
+    overwrite — now explicit in the server scatter (ADVICE-r4 #3), not an
+    accident of NumPy fancy-assignment order. The add here (ts=3 at dc 0)
+    survives only if the LAST vc entry (ts=1) wins; first-wins (ts=5)
+    would remove it, diverging the two snapshots."""
+    params = dict(n_replicas=1, n_keys=1, n_ids=8, n_dcs=2, size=2,
+                  slots_per_id=2)
+    client.grid_new("t_lw", "topk_rmv", **params)
+    client.grid_new("p_lw", "topk_rmv", **params)
+    add = (Atom("add"), 0, 3, 50, 0, 3)
+    rmv = (Atom("rmv"), 0, 3, [(0, 5), (0, 1)])
+    client.grid_apply("t_lw", [[add, rmv]])
+    client.grid_apply_packed("p_lw", [
+        ("add", np.asarray([1], np.int32), cols_of([[add]], (1, 2, 3, 4, 5))),
+        ("rmv", np.asarray([1], np.int32), rmv_cols_of([[rmv]])),
+    ])
+    assert client.grid_to_binary("t_lw") == client.grid_to_binary("p_lw")
+    assert client.grid_observe("p_lw") == client.grid_observe("t_lw")
+
+
 def test_packed_empty_groups_are_noops(client):
     client.grid_new("e_avg", "average", n_replicas=2, n_keys=1)
     snap = client.grid_to_binary("e_avg")

@@ -120,6 +120,26 @@ def test_compact_key_targets_nk_row():
     np.testing.assert_array_equal(counts[0, 2], expect)
 
 
+def test_compact_out_of_table_uniq_lands_in_lost():
+    """A live uniq id beyond the resident bucket table must land in
+    `lost`, not be clamped into the last table entry (ADVICE-r4 #2): the
+    raw wire could never produce such an id, and a clamped count would be
+    a silent miscount into an arbitrary bucket."""
+    V, vocab = 16, 8
+    D = make_dense(V)
+    docs = [[[5, 3]]]
+    _, compact = build_raw_and_compact(docs, V, vocab)
+    for bad in (12, -2):  # past the end AND negative: both sides guarded
+        uniq = np.asarray(compact["uniq"]).copy()
+        uniq[0, 1] = bad
+        c2 = dict(compact, uniq=jnp.asarray(uniq))
+        s, _ = D.apply_doc_ops_compact(D.init(1, 1), **c2)
+        counts = np.asarray(s.counts)
+        tbl = np.asarray(compact["bucket_table"])
+        assert int(np.asarray(s.lost)[0, 0]) == 1, bad
+        assert counts[0, 0].sum() == 1 and counts[0, 0, tbl[5]] == 1
+
+
 def test_compact_counts_expected_values():
     """End-to-end value check, not just raw-vs-compact agreement."""
     V, vocab = 32, 16

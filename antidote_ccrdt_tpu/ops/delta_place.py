@@ -1,0 +1,184 @@
+"""Delta-table placement as a Mosaic carry-walk kernel (round 5).
+
+The delta build in `TopkRmvDense._apply_one_replica` places B sorted adds
+into three [NK*I, M] tables at (kid, rank) — XLA lowers this to a
+serialized scalar-scatter loop (~15.4ms of the ~53.5ms apply round at
+north-star shapes pre-r5, ~9.7ms with the r5 sorted/unique hints; the
+HBM bytes floor of the same writes is ~0.4ms). Structural replacement:
+
+1. Output address ``o = kid*M + rank`` is UNIQUE and STRICTLY INCREASING
+   over kept entries (kid nondecreasing from the shared sort; rank
+   increments within a group). A cheap 1-key compaction sort by ``o``
+   pushes the non-kept entries (o = sentinel) to the stream tail.
+2. After compaction, the entries targeting any 128-address output block
+   are at most 128 CONSECUTIVE stream positions — so a kernel can walk
+   the stream with a carried scalar offset per replica, with no
+   data-dependent gathers, no searchsorted, and no unbounded spans.
+3. Per 128-address sub-block: one [128, 128] iota-compare one-hot and
+   one s8 MXU matmul against 16 seven-bit value planes (score rides
+   u32-wrapped against its NEG_INF background so unwritten cells decode
+   to NEG_INF with zero masking; ts 5 planes; dc 1 plane, D <= 128).
+   Each output cell receives at most one nonzero term (o unique), so
+   s32 accumulation is exact — the `scatter_max_rows_mxu` argument
+   (ops/dense_table.py) applied to placement.
+
+Semantics replaced: the three `.at[kid, rank].set` scatters of
+`models/topk_rmv_dense.py` step 3 (reference update/2,
+antidote_ccrdt_topk_rmv.erl:231-249 batch analog). Equivalence is pinned
+by tests/test_pallas_kernels.py and benchmarks/delta_place_probe.py.
+
+Status: verified infrastructure, NOT the production path. Correct on
+first TPU compile (probe equivalence OK at full north-star shapes), but
+measured 57.2 ms/round vs 24.3 for the unique-hint XLA scatters
+(benchmarks/delta_place_probe.py, REPS=12): the per-sub-block fixed
+costs — 4 tiny dynamic VMEM loads x ~3,125 sub-blocks x 32 replicas,
+plus the SMEM carry serializing consecutive grid steps — dominate; the
+design is load-latency-bound, not flop-bound, and growing GROUP only
+converges to ~14-16ms. The probe docstring carries the full verdict.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .dense_table import NEG_INF
+
+SB = 128       # addresses per sub-block (= one one-hot / matmul)
+GROUP = 4096   # addresses per grid step (SB * sub-blocks per step)
+
+
+def _carry_walk_kernel(
+    B, n_sub, o_ref, sc_ref, dc_ref, ts_ref, os_ref, od_ref, ot_ref, carry_ref
+):
+    g = pl.program_id(1)
+
+    @pl.when(g == 0)
+    def _():
+        carry_ref[0] = 0
+
+    carry = carry_ref[0]
+    base = g * GROUP
+    # Window of GROUP+SB stream entries starting at (the 128-aligned floor
+    # of) the first unconsumed one — Mosaic requires dynamic lane-dim
+    # offsets provably 128-aligned, and `(x // SB) * SB` is. All entries
+    # consumable this step lie in [carry, carry+GROUP) (their addresses
+    # are unique within a GROUP-address range), so the widened window
+    # covers them; entries before `carry` (alignment slack or the tail
+    # clamp) are excluded by the jvalid position mask.
+    WEXT = GROUP + SB
+    st = ((jnp.minimum(carry, B - WEXT) // SB) * SB)
+    o_w = o_ref[0, 0, pl.ds(st, WEXT)]
+    jpos = st + lax.broadcasted_iota(jnp.int32, (1, WEXT), 1)[0]
+    jvalid = jpos >= carry
+    consumable = jvalid & (o_w < base + GROUP)
+
+    for sb in range(n_sub):
+        sub_base = base + sb * SB
+        # First stream position targeting this sub-block = carry + count
+        # of consumable entries below it (they are consecutive). The load
+        # is floored to the 128-aligned slot and widened to 2*SB; the
+        # alignment-slack entries need no mask — anything before the true
+        # offset has o < sub_base and anything beyond the sub-block's run
+        # has o >= sub_base+SB, so the one-hot's local-range compare
+        # drops both.
+        nb = jnp.sum((consumable & (o_w < sub_base)).astype(jnp.int32))
+        off = ((jnp.minimum(carry + nb, B - 2 * SB) // SB) * SB)
+        o2 = o_ref[0, 0, pl.ds(off, 2 * SB)]
+        sc2 = sc_ref[0, 0, pl.ds(off, 2 * SB)]
+        dc2 = dc_ref[0, 0, pl.ds(off, 2 * SB)]
+        ts2 = ts_ref[0, 0, pl.ds(off, 2 * SB)]
+
+        local = o2 - sub_base  # stale -> <0, later/sentinel -> >=SB
+        oh = (
+            lax.broadcasted_iota(jnp.int32, (SB, 2 * SB), 0) == local[None, :]
+        ).astype(jnp.int8)  # [addr, j]
+
+        # 16 rows of 7-bit planes: score (u32-wrapped against NEG_INF so
+        # zero accumulation decodes to the background), ts, dc, zero pad.
+        diff = sc2 - NEG_INF  # i32 wrap == u32 subtraction bits
+        rows = [((diff >> (7 * k)) & 0x7F).astype(jnp.int8) for k in range(5)]
+        rows += [((ts2 >> (7 * k)) & 0x7F).astype(jnp.int8) for k in range(5)]
+        rows += [(dc2 & 0x7F).astype(jnp.int8)]
+        rows += [jnp.zeros((2 * SB,), jnp.int8)] * 5
+        planes_t = jnp.stack(rows, axis=0)  # [16, 2*SB]
+
+        acc = lax.dot_general(
+            oh, planes_t, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )  # [SB addr, 16]
+
+        def bits(c0):
+            v = acc[:, c0]
+            for k in range(1, 5):
+                v = v | (acc[:, c0 + k] << (7 * k))
+            return v
+
+        # Output blocks are [1, 1, 8, GROUP//8] (Mosaic's trailing-dims
+        # tiling rule); the sub-block's 128 addresses land at row sb//4,
+        # columns (sb%4)*SB.. — flattening [8, GROUP//8] row-major
+        # reproduces base + sb*SB + a exactly.
+        row, cs = sb // 4, (sb % 4) * SB
+        sl = (0, 0, row, slice(cs, cs + SB))
+        os_ref[sl] = bits(0) + NEG_INF
+        ot_ref[sl] = bits(5)
+        od_ref[sl] = acc[:, 10]
+
+    carry_ref[0] = carry + jnp.sum(consumable.astype(jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnums=(6, 7, 8, 9))
+def delta_place_pallas(
+    s_score, s_ts, s_dc, kid3, rank, keep, T, M, D, interpret: bool = False
+):
+    """Build (d_score[R,T,M], d_dc, d_ts) from the sorted add stream.
+
+    Inputs are the per-replica outputs of the shared sort+rank stage
+    ([R, B] each): kid3 (nondecreasing; sentinel T for dead entries),
+    rank in [0, M) for kept entries, keep marking the entries to place.
+    Exact same tables as the production 3-scatter build.
+    """
+    assert D <= 128, "dc rides a single 7-bit plane; D > 128 unsupported"
+    R, B = kid3.shape
+    OUT = T * M
+    assert OUT < 2**30, "address space must leave sentinel headroom"
+    NG = -(-OUT // GROUP)
+    OUTP = NG * GROUP
+    SENT = jnp.int32(OUTP)  # beyond every block: never matched or consumed
+
+    o = jnp.where(keep, kid3 * M + rank, SENT)
+    if B < GROUP + SB:  # tiny shapes: pad the stream with sentinels
+        pad = GROUP + SB - B
+        o = jnp.pad(o, ((0, 0), (0, pad)), constant_values=OUTP)
+        s_score = jnp.pad(s_score, ((0, 0), (0, pad)))
+        s_dc = jnp.pad(s_dc, ((0, 0), (0, pad)))
+        s_ts = jnp.pad(s_ts, ((0, 0), (0, pad)))
+        B = GROUP + SB
+    o_s, sc_s, dc_s, ts_s = jax.vmap(
+        lambda *a: lax.sort(a, num_keys=1)
+    )(o, s_score, s_dc, s_ts)
+
+    # Streams ride with a unit sublane dim so the block's trailing two
+    # dims (1, B) equal the array dims (Mosaic's tiling rule); outputs
+    # are [NG, 8, GROUP//8] per replica so trailing block dims divide
+    # (8, 128).
+    spec_in = pl.BlockSpec((1, 1, B), lambda r, g: (r, 0, 0))
+    spec_out = pl.BlockSpec((1, 1, 8, GROUP // 8), lambda r, g: (r, g, 0, 0))
+    out3 = pl.pallas_call(
+        functools.partial(_carry_walk_kernel, B, GROUP // SB),
+        grid=(R, NG),
+        in_specs=[spec_in] * 4,
+        out_specs=[spec_out] * 3,
+        out_shape=[jax.ShapeDtypeStruct((R, NG, 8, GROUP // 8), jnp.int32)] * 3,
+        scratch_shapes=[pltpu.SMEM((1,), jnp.int32)],
+        interpret=interpret,
+    )(*(x[:, None, :] for x in (o_s, sc_s, dc_s, ts_s)))
+    d_score, d_dc, d_ts = (
+        x.reshape(R, OUTP)[:, :OUT].reshape(R, T, M) for x in out3
+    )
+    return d_score, d_dc, d_ts

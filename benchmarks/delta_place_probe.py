@@ -1,0 +1,293 @@
+"""Round-5 delta-placement probe: the 15.4ms scatter slice, attacked again.
+
+VERDICT-r4 item 1 names the 3 delta scalar scatters (~15.4ms of the
+~53.5ms apply round at north-star shapes) as the largest remaining slice
+and asks for structural attempts beyond the hint-level probes already
+rejected (residual_probe.py, delta_probe.py). Variants here:
+
+  * scatter3 (production)  — baseline: vmapped 3x scalar 2-D scatter.
+  * scatter3_flat_replica  — the SAME writes as ONE un-vmapped scatter
+    with replica-global indices [R*B]: tests whether the vmap batching
+    dimension (not the writes) is what XLA serializes.
+  * scatter1_concat3       — all three fields through ONE scatter call
+    into a [3*NK*I, M] table (indices offset per field): tests per-call
+    vs per-element cost at the exact r5 shapes.
+  * scatter3_hinted        — indices_are_sorted + unique_indices on the
+    production formulation (r2 tested these on an older path; re-pinned
+    here at the exact current shapes).
+  * pallas_carry_walk      — the structural rewrite: compaction-sort the
+    kept entries by output address o = kid*M + rank (o is unique and
+    strictly increasing over kept entries, so each 128-address output
+    block is served by <= 128 CONSECUTIVE stream entries), then a Mosaic
+    kernel walks the stream with a carried offset per replica: per
+    128-address sub-block, one [128, 128] iota-compare one-hot and one
+    s8 MXU matmul against the 11 seven-bit value planes (score 5 planes
+    u32-wrapped against the NEG_INF background, ts 5, dc 1) — placement
+    with zero data-dependent gathers and zero serialized scatter loops
+    (ops/delta_place.py).
+
+Timing discipline: scan-fused REPS with the shared sort included
+(identical across variants, so deltas isolate the build), host-readback
+sync (utils/benchtime).
+
+VERDICT (measured v5e, tunneled backend, REPS=12, all equivalence-OK;
+sort included in every number, so deltas isolate the build step):
+
+    scatter3 (production r4)        28.1  ms/round
+    scatter3_hinted                 21.9  ms/round  (UNSOUND - see below)
+    scatter3_unique                 24.3  ms/round  <- production r5
+    scatter3_flat_replica           32.7  ms/round  (rejected)
+    scatter1_concat3                32.2  ms/round  (rejected)
+    pallas_carry_walk               57.2  ms/round  (rejected)
+
+* The r2 "hints neutral" result does NOT hold on the current kid-packed
+  path: hints move the build. But indices_are_sorted's promise is FALSE
+  here — duplicate-delivery ops keep their sentinel row mid-stream — so
+  the 21.9 number is an implementation-defined upper bound, not a
+  candidate. unique_indices alone (made formally true via per-position
+  dropped columns) is sound and takes -3.8ms/round.
+* The carry-walk kernel is correct first-compile (equivalence OK at
+  full north-star shapes) but 2x SLOWER than the scatters: its
+  per-sub-block work is 4 tiny (256-entry) dynamic VMEM loads + one
+  [128,256] one-hot + a small s8 dot — ~3,125 sub-blocks x 32 replicas
+  = ~400k tiny dynamic loads per round, each ~0.1-0.2us under Mosaic,
+  plus an SMEM carry that serializes consecutive grid steps (no block
+  pipelining). The structure is load-latency-bound, not flop-bound;
+  growing GROUP only converges to ~14-16ms of fixed per-sub-block cost.
+  This also prices the same pattern out for the tombstone one-hot conv
+  (T/4096 x 32 steps of identical shape — est. ~15ms vs the 11.2ms XLA
+  conv it would replace). Kernel kept in ops/delta_place.py as verified
+  infrastructure; the XLA unique-hint scatters stay production.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from antidote_ccrdt_tpu.harness.opgen import TopkRmvEffectGen, Workload
+from antidote_ccrdt_tpu.models.topk_rmv_dense import NEG_INF
+from antidote_ccrdt_tpu.utils.benchtime import stack_rounds
+
+R, NK, I, D_DCS, M = 32, 1, 100_000, 32, 4
+B, Br = 32768, 2048
+REPS = int(os.environ.get("DELTA_REPS", 12))
+
+gen = TopkRmvEffectGen(
+    Workload(n_replicas=R, n_ids=I, zipf_a=1.2, score_max=100_000, seed=7)
+)
+stacked = stack_rounds([gen.next_batch(B, Br) for _ in range(REPS)])
+one = jax.tree.map(lambda x: x[0], stacked)
+
+
+def sync(x):
+    return np.asarray(jax.tree.leaves(x)[0].ravel()[0])
+
+
+def sorted_adds(ops):
+    """The shared sort + rank stage (verbatim semantics of
+    _apply_one_replica steps 3a-3c), vmapped over replicas."""
+    def per_replica(key, id_, score, ts, dc):
+        add_valid = (
+            (ts > 0)
+            & (key >= 0) & (key < NK)
+            & (id_ >= 0) & (id_ < I)
+            & (dc >= 0) & (dc < D_DCS)
+        )
+        kid = jnp.where(add_valid, key * I + id_, NK * I)
+        s_kid, ns, nt, s_dc = lax.sort((kid, -score, -ts, dc), num_keys=4)
+        s_score, s_ts = -ns, -nt
+        dup = (
+            (s_kid == jnp.roll(s_kid, 1))
+            & (s_score == jnp.roll(s_score, 1))
+            & (s_ts == jnp.roll(s_ts, 1))
+            & (s_dc == jnp.roll(s_dc, 1))
+        )
+        dup = dup.at[0].set(False)
+        live = (s_kid < NK * I) & ~dup
+        grp_start = (s_kid != jnp.roll(s_kid, 1)).at[0].set(True)
+        c = jnp.cumsum(live.astype(jnp.int32))
+        base = lax.cummax(
+            jnp.where(grp_start, c - live.astype(jnp.int32), -1)
+        )
+        rank = c - live.astype(jnp.int32) - base
+        keep = live & (rank < M)
+        rank = jnp.where(keep, rank, M)
+        kid3 = jnp.where(live, s_kid, NK * I)
+        return s_score, s_ts, s_dc, kid3, rank, keep
+
+    return jax.vmap(per_replica)(
+        ops.add_key, ops.add_id, ops.add_score, ops.add_ts, ops.add_dc
+    )
+
+
+def scatter3(s_score, s_ts, s_dc, kid3, rank, keep):
+    def per_replica(s_score, s_ts, s_dc, kid3, rank, keep):
+        d_score = jnp.full((NK * I, M), NEG_INF, dtype=jnp.int32)
+        d_dc = jnp.zeros((NK * I, M), dtype=jnp.int32)
+        d_ts = jnp.zeros((NK * I, M), dtype=jnp.int32)
+        d_score = d_score.at[kid3, rank].set(s_score, mode="drop")
+        d_dc = d_dc.at[kid3, rank].set(s_dc, mode="drop")
+        d_ts = d_ts.at[kid3, rank].set(s_ts, mode="drop")
+        return d_score, d_dc, d_ts
+
+    return jax.vmap(per_replica)(s_score, s_ts, s_dc, kid3, rank, keep)
+
+
+def scatter3_flat_replica(s_score, s_ts, s_dc, kid3, rank, keep):
+    """Same writes, one un-vmapped scatter per field with replica-global
+    row indices: [R*B] scalar writes into [R*(NK*I+1), M]."""
+    T1 = NK * I + 1  # per-replica sentinel row rides along
+    Rl = kid3.shape[0]
+    roff = jnp.arange(Rl, dtype=jnp.int32)[:, None] * T1
+    rows = (kid3 + roff).ravel()
+    cols = rank.ravel()
+
+    def place(vals, empty):
+        d = jnp.full((Rl * T1, M), empty, dtype=jnp.int32)
+        d = d.at[rows, cols].set(vals.ravel(), mode="drop")
+        return d.reshape(Rl, T1, M)[:, : NK * I]
+
+    return place(s_score, NEG_INF), place(s_dc, 0), place(s_ts, 0)
+
+
+def scatter1_concat3(s_score, s_ts, s_dc, kid3, rank, keep):
+    """All three fields in ONE scatter call into a [3*(NK*I+1), M] table
+    (per-replica under vmap): tests per-call vs per-element cost."""
+    T1 = NK * I + 1
+
+    def per_replica(s_score, s_ts, s_dc, kid3, rank, keep):
+        d = jnp.concatenate(
+            [
+                jnp.full((T1, M), NEG_INF, dtype=jnp.int32),
+                jnp.zeros((T1, M), dtype=jnp.int32),
+                jnp.zeros((T1, M), dtype=jnp.int32),
+            ]
+        )
+        rows = jnp.concatenate([kid3, kid3 + T1, kid3 + 2 * T1])
+        cols = jnp.concatenate([rank, rank, rank])
+        vals = jnp.concatenate([s_score, s_dc, s_ts])
+        d = d.at[rows, cols].set(vals, mode="drop")
+        return (
+            d[: NK * I],
+            d[T1 : T1 + NK * I],
+            d[2 * T1 : 2 * T1 + NK * I],
+        )
+
+    return jax.vmap(per_replica)(s_score, s_ts, s_dc, kid3, rank, keep)
+
+
+def scatter3_hinted(s_score, s_ts, s_dc, kid3, rank, keep):
+    """Both hints. NOT production: the sorted promise is false (duplicate
+    ops keep their sentinel row mid-stream) — kept as the measured upper
+    bound the sound variant below is compared against."""
+    def per_replica(s_score, s_ts, s_dc, kid3, rank, keep):
+        kw = dict(mode="drop", indices_are_sorted=True, unique_indices=True)
+        d_score = jnp.full((NK * I, M), NEG_INF, dtype=jnp.int32)
+        d_dc = jnp.zeros((NK * I, M), dtype=jnp.int32)
+        d_ts = jnp.zeros((NK * I, M), dtype=jnp.int32)
+        d_score = d_score.at[kid3, rank].set(s_score, **kw)
+        d_dc = d_dc.at[kid3, rank].set(s_dc, **kw)
+        d_ts = d_ts.at[kid3, rank].set(s_ts, **kw)
+        return d_score, d_dc, d_ts
+
+    return jax.vmap(per_replica)(s_score, s_ts, s_dc, kid3, rank, keep)
+
+
+def scatter3_unique(s_score, s_ts, s_dc, kid3, rank, keep):
+    """PRODUCTION (round 5): unique_indices only, made formally true by
+    giving every dropped entry a distinct out-of-range column."""
+    def per_replica(s_score, s_ts, s_dc, kid3, rank, keep):
+        kw = dict(mode="drop", unique_indices=True)
+        rank3 = jnp.where(
+            keep, rank, M + jnp.arange(rank.shape[0], dtype=jnp.int32)
+        )
+        d_score = jnp.full((NK * I, M), NEG_INF, dtype=jnp.int32)
+        d_dc = jnp.zeros((NK * I, M), dtype=jnp.int32)
+        d_ts = jnp.zeros((NK * I, M), dtype=jnp.int32)
+        d_score = d_score.at[kid3, rank3].set(s_score, **kw)
+        d_dc = d_dc.at[kid3, rank3].set(s_dc, **kw)
+        d_ts = d_ts.at[kid3, rank3].set(s_ts, **kw)
+        return d_score, d_dc, d_ts
+
+    return jax.vmap(per_replica)(s_score, s_ts, s_dc, kid3, rank, keep)
+
+
+VARIANTS = {
+    "scatter3 (production)": scatter3,
+    "scatter3_flat_replica": scatter3_flat_replica,
+    "scatter1_concat3": scatter1_concat3,
+    "scatter3_hinted": scatter3_hinted,
+    "scatter3_unique": scatter3_unique,
+}
+
+try:
+    from antidote_ccrdt_tpu.ops.delta_place import delta_place_pallas
+
+    def pallas_carry_walk(s_score, s_ts, s_dc, kid3, rank, keep):
+        return delta_place_pallas(
+            s_score, s_ts, s_dc, kid3, rank, keep, NK * I, M, D_DCS
+        )
+
+    VARIANTS["pallas_carry_walk"] = pallas_carry_walk
+except ImportError:
+    pass
+
+
+def main():
+    print(f"# backend={jax.default_backend()} R={R} B={B} REPS={REPS}")
+    sel = sys.argv[1:]
+    results = {}
+
+    srt = jax.tree.map(lambda x: x[:1], sorted_adds(one))
+    want = scatter3(*srt)
+    for name, fn in VARIANTS.items():
+        if name == "scatter3 (production)":
+            continue
+        if sel and not any(s in name for s in sel):
+            continue
+        got = fn(*srt)
+        ok = all(bool(jnp.array_equal(g, w)) for g, w in zip(got, want))
+        print(f"# equivalence {name}: {'OK' if ok else 'MISMATCH'}")
+        assert ok, name
+
+    for name, fn in VARIANTS.items():
+        if sel and not any(s in name for s in sel):
+            continue
+
+        @jax.jit
+        def run(stacked, fn=fn):
+            def body(carry, ops):
+                srt = sorted_adds(ops)
+                ds, dd, dt = fn(*srt)
+                return carry + jnp.sum(ds) + jnp.sum(dd) + jnp.sum(dt), ()
+            out, _ = lax.scan(body, jnp.zeros((), jnp.int32), stacked)
+            return out
+
+        sync(run(stacked))
+        t0 = time.perf_counter()
+        sync(run(stacked))
+        ms = (time.perf_counter() - t0) / REPS * 1e3
+        results[name] = round(ms, 3)
+        print(f"{name:32s} {ms:9.3f} ms/round (sort included)", flush=True)
+
+    out_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "delta_place_results.json"
+    )
+    with open(out_path, "w") as f:
+        json.dump(
+            {"backend": jax.default_backend(), "R": R, "B": B,
+             "reps": REPS, "ms_per_round_sort_included": results},
+            f, indent=1,
+        )
+    print(f"# wrote {out_path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -118,3 +118,54 @@ def test_combine_duplicate_rows_idempotent_totals():
         assert (u2[0, j] == [5, 9]).all(), u2[0]
     # padding went to row 0 with a zero update (row 0 untouched)
     assert r2[0, 3] == 0 and (u2[0, 3] == 0).all()
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_delta_place_carry_walk_matches_scatter(seed):
+    """The compaction-sort + carry-walk placement kernel
+    (ops/delta_place.py) must reproduce the production 3-scatter delta
+    build exactly: full-range signed scores/ts, duplicate kid runs with
+    keep gaps, dead sentinels, and streams shorter than one GROUP
+    (exercising the pad path) included."""
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import NEG_INF
+    from antidote_ccrdt_tpu.ops.delta_place import delta_place_pallas
+
+    rng = np.random.default_rng(200 + seed)
+    R = int(rng.integers(1, 3))
+    T = int(rng.integers(10, 400))
+    M = int(rng.integers(1, 5))
+    D = int(rng.integers(1, 33))
+    B = int(rng.integers(8, 700))
+
+    kid = np.sort(rng.integers(0, T + 1, (R, B)).astype(np.int32), axis=1)
+    rank = np.full((R, B), M, np.int32)
+    keep = np.zeros((R, B), bool)
+    for r in range(R):
+        prev, cnt = -1, 0
+        for j in range(B):
+            k = kid[r, j]
+            cnt = cnt + 1 if k == prev else 0
+            prev = k
+            if k < T and cnt < M and rng.random() > 0.25:
+                rank[r, j], keep[r, j] = cnt, True
+    score = rng.integers(-(2**31) + 2, 2**31 - 1, (R, B)).astype(np.int32)
+    ts = rng.integers(-(2**31) + 2, 2**31 - 1, (R, B)).astype(np.int32)
+    dc = rng.integers(0, D, (R, B)).astype(np.int32)
+
+    exp_s = np.full((R, T, M), NEG_INF, np.int32)
+    exp_d = np.zeros((R, T, M), np.int32)
+    exp_t = np.zeros((R, T, M), np.int32)
+    for r in range(R):
+        for j in range(B):
+            if keep[r, j]:
+                exp_s[r, kid[r, j], rank[r, j]] = score[r, j]
+                exp_d[r, kid[r, j], rank[r, j]] = dc[r, j]
+                exp_t[r, kid[r, j], rank[r, j]] = ts[r, j]
+
+    got = delta_place_pallas(
+        jnp.asarray(score), jnp.asarray(ts), jnp.asarray(dc),
+        jnp.asarray(kid), jnp.asarray(rank), jnp.asarray(keep),
+        T, M, D, True,
+    )
+    for g, w in zip(got, (exp_s, exp_d, exp_t)):
+        assert np.array_equal(np.asarray(g), w), seed

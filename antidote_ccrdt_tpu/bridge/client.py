@@ -175,6 +175,18 @@ class BridgeClient:
             (Atom("grid_apply_packed"), name.encode(), _pack_groups(groups))
         )
 
+    def grid_apply_packed_multi(self, name: str, batches) -> int:
+        """Pipelined `grid_apply_packed`: ship several packed batches in
+        ONE wire call; the server decodes and dispatches batch k+1 while
+        the device runs batch k and pays the device sync once at the end
+        — so both the wire round-trip and the dispatch round-trip
+        amortize over len(batches) applies. Returns the total extras
+        count (topk_rmv dominated elements) across batches."""
+        return self.call(
+            (Atom("grid_apply_packed_multi"), name.encode(),
+             [_pack_groups(groups) for groups in batches])
+        )
+
     def grid_apply_extras_packed(self, name: str, groups):
         """Packed `grid_apply_extras`: same input form as
         grid_apply_packed; the generated extras come back as packed

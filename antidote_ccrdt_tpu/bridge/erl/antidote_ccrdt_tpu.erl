@@ -32,7 +32,8 @@
          is_type/2, generates_extra_operations/2, is_operation/3,
          require_state_downstream/3, is_replicate_tagged/3,
          grid_new/4, grid_apply/3, grid_apply_extras/3,
-         grid_apply_packed/3, grid_apply_extras_packed/3, pack_i32/1,
+         grid_apply_packed/3, grid_apply_extras_packed/3,
+         grid_apply_packed_multi/3, pack_i32/1,
          grid_merge_all/2, grid_observe/4,
          grid_to_binary/2, grid_from_binary/3,
          wire_atoms/0, main/1]).
@@ -167,6 +168,14 @@ grid_apply_extras(Sock, Grid, OpsPerReplica) when is_list(OpsPerReplica) ->
 %% at wire speed. Pre-packed binaries pass through unchanged.
 grid_apply_packed(Sock, Grid, Groups) when is_list(Groups) ->
     call(Sock, {grid_apply_packed, Grid, pack_groups(Groups)}).
+
+%% Pipelined packed apply: several packed batches in ONE wire call; the
+%% server dispatches batch K+1 while the device runs batch K and syncs
+%% once, so the tunnel round-trip and the device round-trip both
+%% amortize over length(Batches). Returns the total extras count.
+grid_apply_packed_multi(Sock, Grid, Batches) when is_list(Batches) ->
+    call(Sock, {grid_apply_packed_multi, Grid,
+                [pack_groups(Groups) || Groups <- Batches]}).
 
 %% Packed apply_extras: the reply is the generated extras as packed
 %% groups in this grid's own packed column orders ({Tag, CountsBin,

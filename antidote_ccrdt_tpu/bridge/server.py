@@ -238,6 +238,55 @@ class _Grid:
             self._parse_packed(groups)
         )
 
+    def apply_packed_multi(self, batches) -> int:
+        """Pipelined packed applies: decode and dispatch batch k+1 while
+        the device still runs batch k (dispatches are async on this
+        backend; the host-side unpack of the next batch overlaps device
+        compute), and pay the one forced sync on this path — topk_rmv's
+        dominated-count readback — ONCE for the whole call: the deferred
+        per-batch scalars are stacked device-side and read back in a
+        single transfer. This is the ingest wire's proven async-chunk
+        pattern (BASELINE.md) applied to the grid surface, and it also
+        amortizes one wire round-trip over len(batches) applies for a
+        remote (BEAM) host. Returns the total extras count.
+
+        Failure atomicity: every batch is parsed (structure + column
+        validation) before ANY dispatch, so a malformed batch rejects the
+        whole call with the grid untouched by this call's decode errors;
+        a range-validation failure inside batch k's packer aborts with
+        batches 0..k-1 applied and says so in the error — the same bound
+        a host gets from k sequential calls."""
+        import jax.numpy as jnp
+
+        parsed_all = []
+        for k, groups in enumerate(batches):
+            try:
+                parsed_all.append(self._parse_packed(groups))
+            except Exception as e:
+                raise ValueError(
+                    f"batch {k} (no batch applied): {e}"
+                ) from e
+        deferred = []
+        for k, parsed in enumerate(parsed_all):
+            try:
+                if self.type_name == "topk_rmv":
+                    deferred.append(
+                        self._packed_topk_rmv(parsed, defer_count=True)
+                    )
+                else:
+                    deferred.append(
+                        getattr(self, f"_packed_{self.type_name}")(parsed)
+                    )
+            except Exception as e:
+                raise ValueError(
+                    f"batch {k} ({k} batch(es) already applied): {e}"
+                ) from e
+        total = sum(d for d in deferred if isinstance(d, int))
+        lazy = [d for d in deferred if not isinstance(d, int)]
+        if lazy:
+            total += int(np.asarray(jnp.stack(lazy).sum()))
+        return total
+
     def apply_extras_packed(self, groups):
         """`apply_extras` over the packed wire: same input form as
         `apply_packed`; the reply is the generated extras as packed
@@ -458,7 +507,9 @@ class _Grid:
         )
         return 0
 
-    def _packed_topk_rmv(self, parsed, want_extras: bool = False):
+    def _packed_topk_rmv(
+        self, parsed, want_extras: bool = False, defer_count: bool = False
+    ):
         import jax.numpy as jnp
 
         from ..models.topk_rmv_dense import TopkRmvOps
@@ -523,7 +574,12 @@ class _Grid:
             collect_promotions=want_extras,
         )
         if not want_extras:
-            return int(np.asarray(extras.dominated).sum())
+            # Device-side scalar sum: the deferred path hands it back
+            # unsynced (apply_packed_multi reads all batches' counts in
+            # one drain); the plain path reads one scalar instead of
+            # pulling the whole [R, B] mask to the host.
+            cnt = jnp.sum(extras.dominated)
+            return cnt if defer_count else int(np.asarray(cnt))
         # Dominated-add re-broadcast rmvs as a packed {rmv, ...} group —
         # emission order (replica-major, op order) matches the term
         # surface; the vc rows are the op-aligned dominated_vc rows with
@@ -980,7 +1036,7 @@ class BridgeServer:
     }
     _GRID_TAGS = {
         "grid_apply", "grid_apply_extras", "grid_apply_packed",
-        "grid_apply_extras_packed",
+        "grid_apply_extras_packed", "grid_apply_packed_multi",
         "grid_merge_all", "grid_observe", "grid_to_binary",
     }
 
@@ -1206,6 +1262,9 @@ class BridgeServer:
         if tag == "grid_apply_packed":
             _, gname, groups = op
             return self._grids[gname].apply_packed(groups)
+        if tag == "grid_apply_packed_multi":
+            _, gname, batches = op
+            return self._grids[gname].apply_packed_multi(batches)
         if tag == "grid_apply_extras_packed":
             _, gname, groups = op
             return self._grids[gname].apply_extras_packed(groups)

@@ -450,6 +450,59 @@ def test_packed_rmv_duplicate_dc_last_wins(client):
     assert client.grid_observe("p_lw") == client.grid_observe("t_lw")
 
 
+@pytest.mark.parametrize("seed", [0, 1])
+def test_packed_multi_matches_sequential(client, seed):
+    """grid_apply_packed_multi (one wire call, pipelined dispatches, one
+    device sync) must leave the grid in the same state — and return the
+    same total dominated count — as the same batches applied through
+    sequential grid_apply_packed calls."""
+    rng = np.random.default_rng(40 + seed)
+    R, NK, I, D = 2, 2, 16, 3
+    params = dict(n_replicas=R, n_keys=NK, n_ids=I, n_dcs=D, size=3,
+                  slots_per_id=2)
+    gs, gm = f"seq_{seed}", f"multi_{seed}"
+    client.grid_new(gs, "topk_rmv", **params)
+    client.grid_new(gm, "topk_rmv", **params)
+
+    def batch():
+        n = rng.integers(1, 6, R)
+        adds = [
+            [(Atom("add"), int(rng.integers(0, NK)), int(rng.integers(0, I)),
+              int(rng.integers(0, 99)), int(rng.integers(0, D)),
+              int(rng.integers(1, 30))) for _ in range(n[r])]
+            for r in range(R)
+        ]
+        return [("add", n.astype(np.int32), cols_of(adds, (1, 2, 3, 4, 5)))]
+
+    # Seed tombstones first (high-ts removals on every id) so later adds
+    # with lower ts are dominated and a NONZERO count crosses the
+    # deferred-count drain — an all-adds mix would pin 0 == 0 only.
+    rmvs = [[(Atom("rmv"), 0, i, [(d, 40) for d in range(D)])
+             for i in range(I)] for _ in range(R)]
+    rmv_batch = [("rmv", np.full(R, I, np.int32), rmv_cols_of(rmvs))]
+    batches = [rmv_batch] + [batch() for _ in range(4)]
+    total_seq = sum(client.grid_apply_packed(gs, b) for b in batches)
+    total_multi = client.grid_apply_packed_multi(gm, batches)
+    assert total_multi == total_seq
+    assert total_multi > 0  # the deferred path must carry a real count
+    assert client.grid_to_binary(gm) == client.grid_to_binary(gs)
+
+
+def test_packed_multi_validates_all_batches_before_dispatch(client):
+    """A structurally bad batch anywhere in the list rejects the whole
+    multi call before ANY batch is applied (the parse pass runs first);
+    the error names the failing batch."""
+    client.grid_new("mv_av", "average", n_replicas=1, n_keys=1)
+    snap = client.grid_to_binary("mv_av")
+    good = [("add", np.asarray([1], np.int32),
+             [np.zeros(1, np.int32), np.asarray([5], np.int32),
+              np.ones(1, np.int32)])]
+    bad = [("add", np.asarray([1], np.int32), [np.zeros(1, np.int32)])]
+    with pytest.raises(Exception, match="batch 1.*no batch applied"):
+        client.grid_apply_packed_multi("mv_av", [good, bad])
+    assert client.grid_to_binary("mv_av") == snap
+
+
 def test_packed_empty_groups_are_noops(client):
     client.grid_new("e_avg", "average", n_replicas=2, n_keys=1)
     snap = client.grid_to_binary("e_avg")

@@ -176,12 +176,13 @@ class BridgeClient:
         )
 
     def grid_apply_packed_multi(self, name: str, batches) -> int:
-        """Pipelined `grid_apply_packed`: ship several packed batches in
-        ONE wire call; the server decodes and dispatches batch k+1 while
-        the device runs batch k and pays the device sync once at the end
-        — so both the wire round-trip and the dispatch round-trip
-        amortize over len(batches) applies. Returns the total extras
-        count (topk_rmv dominated elements) across batches."""
+        """Multi-batch `grid_apply_packed` in ONE wire call. For topk_rmv
+        the server validates every batch up front (all-or-nothing), then
+        runs the sequential rounds as a single scan-fused device dispatch
+        with one dominated-count readback — wire round-trip, upload,
+        dispatch, and sync all amortize over len(batches). Other types
+        apply batch by batch, amortizing the wire round-trip. Returns
+        the total extras count (topk_rmv dominated elements)."""
         return self.call(
             (Atom("grid_apply_packed_multi"), name.encode(),
              [_pack_groups(groups) for groups in batches])

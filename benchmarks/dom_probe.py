@@ -1,0 +1,200 @@
+"""Round-5 probe: the dom one-hot reduce — merge's top residual.
+
+VERDICT-r4 item 5: the add-wins filter's tombstone lookup
+(`_dom_lookup`: dom[.., m] = rmv_vc[.., dc[.., m]], computed as a fused
+one-hot where+max over the D axis) is the replica-state merge's largest
+residual (~3.7ms of ~9ms, ~2.5x its bytes floor) and the same family as
+apply's filter. Variants here re-express the LOOKUP only — everything
+else in the union join is byte-identical — so deltas isolate the piece:
+
+  * production  — where(oh, vc, 0) + max over D (_dom_lookup).
+  * dom_sum     — where(oh, vc, 0) + SUM over D: the one-hot has a
+    single nonzero, so sum == the selected value exactly; tests whether
+    max-reduce chains schedule worse than add-reduce chains.
+  * dom_mul     — oh.astype(i32) * vc + sum: multiply instead of
+    select, the form XLA can turn into a (batched) integer dot.
+  * dom_dot     — the lookup contracted with einsum('...md,...d->...m'),
+    letting XLA choose the dot lowering outright.
+  * dom_tree    — 5-level binary select on the bits of dc (D=32):
+    ~D-1 selects per slot instead of D compares + D selects + a D-wide
+    max tree. (The r2 'bit tree' probe was on the APPLY path; this
+    re-tests the idea on the merge's 2M-wide filter.)
+
+Run: [MERGE_REPS=128] python benchmarks/dom_probe.py [filter ...]
+
+VERDICT (measured v5e, REPS=128, null harness overhead 1.08 ms/rep,
+all equivalence-OK; benchmarks/dom_probe_results.json):
+
+    full_merge (production)        8.87  ms/merge
+    union+dom_production           8.87
+    union+dom_sum                  8.81
+    union+dom_mul                  8.82
+    union+dom_dot                  8.81
+    union+dom_tree                19.49  (2.2x REGRESSION)
+
+Every dot/sum/mul reformulation lands within noise of the production
+where+max — XLA already fuses the lookup into one select-reduce and the
+expression form does not change the schedule — and the bit tree's 5
+dependent select levels cost 2.2x despite ~3x fewer ops. This closes
+VERDICT-r4 item 5 as a measured rejection: the dom reduce residual
+(~2.2ms above its bytes floor) is schedule-bound like the rest of the
+merge (merge_probe.py's pallas/layout rejections), and the s8-plane
+idea is priced out before implementation by dom_mul/dom_dot sitting at
+baseline — the multiply/accumulate form they would feed is not the
+bottleneck.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from antidote_ccrdt_tpu.models.topk_rmv_dense import (
+    NEG_INF,
+    TopkRmvDenseState,
+    _cmp_better,
+)
+
+from benchmarks.merge_probe import (  # noqa: E402 — reuses the warmed sides
+    D,
+    M,
+    RESULTS,
+    full,
+    side_a,
+    side_b,
+    timeit,
+)
+from benchmarks.merge_probe2 import null_scan  # noqa: E402
+
+
+def dom_production(dc, rmv_vc):
+    Dd = rmv_vc.shape[-1]
+    oh = dc[..., None] == jnp.arange(Dd, dtype=dc.dtype)
+    return jnp.max(jnp.where(oh, rmv_vc[..., None, :], 0), axis=-1)
+
+
+def dom_sum(dc, rmv_vc):
+    Dd = rmv_vc.shape[-1]
+    oh = dc[..., None] == jnp.arange(Dd, dtype=dc.dtype)
+    return jnp.sum(jnp.where(oh, rmv_vc[..., None, :], 0), axis=-1)
+
+
+def dom_mul(dc, rmv_vc):
+    Dd = rmv_vc.shape[-1]
+    oh = (dc[..., None] == jnp.arange(Dd, dtype=dc.dtype)).astype(jnp.int32)
+    return jnp.sum(oh * rmv_vc[..., None, :], axis=-1)
+
+
+def dom_dot(dc, rmv_vc):
+    Dd = rmv_vc.shape[-1]
+    oh = (dc[..., None] == jnp.arange(Dd, dtype=dc.dtype)).astype(jnp.int32)
+    return jnp.einsum("...md,...d->...m", oh, rmv_vc)
+
+
+def dom_tree(dc, rmv_vc):
+    """Binary select over dc's bits; assumes D a power of two <= 32."""
+    Dd = rmv_vc.shape[-1]
+    v = jnp.broadcast_to(
+        rmv_vc[..., None, :], dc.shape + (Dd,)
+    )
+    width, bit = Dd, 0
+    while width > 1:
+        half = width // 2
+        take_hi = ((dc >> bit) & 1)[..., None].astype(bool)
+        v = jnp.where(take_hi, v[..., half:width], v[..., :half])
+        width, bit = half, bit + 1
+    return v[..., 0]
+
+
+def union_merge(dom_fn):
+    """The production union join (_join_slots_union semantics, verbatim)
+    with only the dom lookup swapped."""
+
+    def merge(a, b):
+        rmv_vc = jnp.maximum(a.rmv_vc, b.rmv_vc)
+        vc = jnp.maximum(a.vc, b.vc)
+        c_s = jnp.concatenate([a.slot_score, b.slot_score], axis=-1)
+        c_d = jnp.concatenate([a.slot_dc, b.slot_dc], axis=-1)
+        c_t = jnp.concatenate([a.slot_ts, b.slot_ts], axis=-1)
+        live = c_t > dom_fn(c_d, rmv_vc)
+
+        X = lambda x: x[..., :, None]  # noqa: E731
+        Y = lambda x: x[..., None, :]  # noqa: E731
+        beats = _cmp_better(Y(c_s), Y(c_t), Y(c_d), X(c_s), X(c_t), X(c_d))
+        eq = (X(c_s) == Y(c_s)) & (X(c_t) == Y(c_t)) & (X(c_d) == Y(c_d))
+        pos = jnp.arange(2 * M, dtype=jnp.int32)
+        a_side = pos < M
+        dup = jnp.any(eq & Y(live) & Y(a_side), axis=-1) & ~a_side
+        live = live & ~dup
+        earlier = Y(pos) < X(pos)
+        r = jnp.sum((beats | (eq & earlier)) & Y(live), axis=-1)
+        r = jnp.where(live, r, 2 * M)
+
+        ranks = jnp.arange(M, dtype=jnp.int32)
+        oh = r[..., :, None] == ranks
+
+        def place_one(x, empty):
+            out = jnp.sum(jnp.where(oh, x[..., :, None], 0), axis=-2)
+            return jnp.where(jnp.any(oh, axis=-2), out, empty)
+
+        n_live = jnp.sum(live.astype(jnp.int32), axis=-1)
+        lossy = a.lossy | b.lossy | jnp.any(n_live > M, axis=-1)
+        return TopkRmvDenseState(
+            place_one(c_s, NEG_INF), place_one(c_d, 0), place_one(c_t, 0),
+            rmv_vc, vc, lossy,
+        )
+
+    return merge
+
+
+VARIANTS = {
+    "dom_production": dom_production,
+    "dom_sum": dom_sum,
+    "dom_mul": dom_mul,
+    "dom_dot": dom_dot,
+    "dom_tree": dom_tree,
+}
+
+
+def main():
+    from benchmarks.merge_probe import REPS as reps
+
+    print(f"# backend={jax.default_backend()} REPS={reps}")
+    sel = sys.argv[1:]
+
+    ref = D.merge(side_a, side_b)
+    for name, fn in VARIANTS.items():
+        if sel and not any(s in name for s in sel):
+            continue
+        got = union_merge(fn)(side_a, side_b)
+        ok = all(
+            bool(jnp.array_equal(x, y))
+            for x, y in zip(jax.tree.leaves(ref), jax.tree.leaves(got))
+        )
+        print(f"# equivalence {name}: {'OK' if ok else 'MISMATCH'}")
+        assert ok, name
+
+    timeit("null_scan (per-rep harness overhead)", null_scan)
+    timeit("full_merge (production)", full)
+    for name, fn in VARIANTS.items():
+        if sel and not any(s in name for s in sel):
+            continue
+        timeit(f"union+{name}", union_merge(fn))
+
+    out = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "dom_probe_results.json"
+    )
+    with open(out, "w") as f:
+        json.dump(
+            {"backend": jax.default_backend(), "reps": reps,
+             "ms_per_rep": {k: round(v, 3) for k, v in RESULTS.items()}},
+            f, indent=1,
+        )
+    print(f"# wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -488,6 +488,15 @@ def test_packed_multi_matches_sequential(client, seed):
     assert client.grid_to_binary(gm) == client.grid_to_binary(gs)
 
 
+def test_packed_multi_empty_batches_is_noop(client):
+    params = dict(n_replicas=1, n_keys=1, n_ids=4, n_dcs=1, size=2,
+                  slots_per_id=2)
+    client.grid_new("mt_e", "topk_rmv", **params)
+    snap = client.grid_to_binary("mt_e")
+    assert client.grid_apply_packed_multi("mt_e", []) == 0
+    assert client.grid_to_binary("mt_e") == snap
+
+
 def test_packed_multi_validates_all_batches_before_dispatch(client):
     """A structurally bad batch anywhere in the list rejects the whole
     multi call before ANY batch is applied (the parse pass runs first);

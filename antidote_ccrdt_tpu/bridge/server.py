@@ -95,40 +95,96 @@ _PACKED_COLUMNS: Dict[Tuple[str, str], Tuple[str, ...]] = {
 }
 
 
-_SCAN_APPLY_TOPK_RMV = None
+# Padding fills per op-plane for the scan-fused multi path, by scan kind.
+# Every fill is the same semantically-inert sentinel the per-batch padding
+# (_pad_cols / valid planes) already uses: ts=0 / valid=False / token=-1 /
+# rmv_id=-1 ops are dropped by the engines.
+_MULTI_FILLS = {
+    "topk_rmv": (0, 0, 0, 0, 0, 0, -1, 0),
+    "average": (0, 0, 0),
+    "topk": (0, 0, 0, False),
+    "leaderboard": (0, 0, 0, False, 0, 0, False),
+    "wordcount": (0, -1),
+    "worddoc_doc": (0, 0, 0, -1),
+}
+
+_SCAN_FNS: Dict[str, Any] = {}
 
 
-def _get_scan_apply_topk_rmv():
+def _get_scan_fn(kind: str):
     """Jitted (dense-static) scan over stacked op batches: the sequential
-    multi-batch apply as ONE device dispatch. Built lazily so importing
-    the bridge never initializes a JAX backend (multihost import rule);
-    jax.jit's shape keying caches one executable per (MB, Ba, Br) bucket."""
-    global _SCAN_APPLY_TOPK_RMV
-    if _SCAN_APPLY_TOPK_RMV is None:
-        import functools
+    multi-batch apply as ONE device dispatch, per scan kind. Built lazily
+    so importing the bridge never initializes a JAX backend (multihost
+    import rule); jax.jit's shape keying caches one executable per
+    (MB, widths) bucket."""
+    if kind in _SCAN_FNS:
+        return _SCAN_FNS[kind]
+    import functools
 
-        import jax
-        import jax.numpy as jnp
-        from jax import lax
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
 
+    if kind == "topk_rmv":
         from ..models.topk_rmv_dense import TopkRmvOps
 
-        @functools.partial(jax.jit, static_argnums=0)
-        def scan_apply(dense, state, stacked):
-            def step(st, arrs):
-                ops = TopkRmvOps(
-                    add_key=arrs[0], add_id=arrs[1], add_score=arrs[2],
-                    add_dc=arrs[3], add_ts=arrs[4],
-                    rmv_key=arrs[5], rmv_id=arrs[6], rmv_vc=arrs[7],
-                )
-                st, extras = dense.apply_ops(st, ops)
-                return st, jnp.sum(extras.dominated)
+        def step(dense, st, a):
+            st, ex = dense.apply_ops(st, TopkRmvOps(
+                add_key=a[0], add_id=a[1], add_score=a[2], add_dc=a[3],
+                add_ts=a[4], rmv_key=a[5], rmv_id=a[6], rmv_vc=a[7],
+            ))
+            return st, jnp.sum(ex.dominated)
+    elif kind == "average":
+        from ..models.average import AverageOps
 
-            state, doms = lax.scan(step, state, stacked)
-            return state, jnp.sum(doms)
+        def step(dense, st, a):
+            st, _ = dense.apply_ops(
+                st, AverageOps(key=a[0], value=a[1], count=a[2])
+            )
+            return st, jnp.int32(0)
+    elif kind == "topk":
+        from ..models.topk import TopkOps
 
-        _SCAN_APPLY_TOPK_RMV = scan_apply
-    return _SCAN_APPLY_TOPK_RMV
+        def step(dense, st, a):
+            st, _ = dense.apply_ops(
+                st, TopkOps(key=a[0], id=a[1], score=a[2], valid=a[3])
+            )
+            return st, jnp.int32(0)
+    elif kind == "leaderboard":
+        from ..models.leaderboard import LeaderboardOps
+
+        def step(dense, st, a):
+            st, _ = dense.apply_ops(st, LeaderboardOps(
+                add_key=a[0], add_id=a[1], add_score=a[2], add_valid=a[3],
+                ban_key=a[4], ban_id=a[5], ban_valid=a[6],
+            ))
+            return st, jnp.int32(0)
+    elif kind == "wordcount":
+        from ..models.wordcount import WordcountOps
+
+        def step(dense, st, a):
+            st, _ = dense.apply_ops(st, WordcountOps(key=a[0], token=a[1]))
+            return st, jnp.int32(0)
+    elif kind == "worddoc_doc":
+        from ..models.wordcount import WordDocOps
+
+        def step(dense, st, a):
+            st, _ = dense.apply_doc_ops(
+                st, WordDocOps(key=a[0], doc=a[1], uniq=a[2], token=a[3])
+            )
+            return st, jnp.int32(0)
+    else:  # pragma: no cover - registry and kinds move together
+        raise ValueError(f"no scan kind {kind!r}")
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def scan_apply(dense, state, stacked):
+        state, counts = lax.scan(
+            lambda st, arrs: step(dense, st, arrs), state, stacked
+        )
+        return state, jnp.sum(counts)
+
+    _SCAN_FNS[kind] = scan_apply
+    return scan_apply
 
 
 def _i32_col(buf, what: str) -> np.ndarray:
@@ -275,25 +331,27 @@ class _Grid:
         )
 
     def apply_packed_multi(self, batches) -> int:
-        """Multi-batch packed apply in one wire call. For topk_rmv (the
-        flagship) the sequential rounds run SCAN-FUSED: all batches are
-        validated, padded to a common bucketed width, stacked, and
-        applied by one lax.scan dispatch — one host->device upload, one
-        dispatch, one dominated-count readback per call (measured r5:
-        10% -> 36% of the device-native rate at the bench shape; the
-        residual is the op-plane upload bandwidth itself, see
-        bench_all's decomposition fields). Other types apply batch by
-        batch, amortizing the wire round-trip only — their per-batch
-        handlers have no forced sync. Returns the total extras count.
+        """Multi-batch packed apply in one wire call, SCAN-FUSED for all
+        six types: every batch is parsed and range-validated up front
+        (all-or-nothing — a bad batch anywhere rejects the call with the
+        grid untouched), the op planes are padded to a common bucketed
+        width and stacked, and the sequential rounds run as ONE lax.scan
+        dispatch — one host->device upload, one dispatch, and one
+        extras-count readback per call instead of one of each per batch
+        (measured r5 on topk_rmv at the bench shape: per-call dispatch
+        ~10% of the same-shape device-native rate, per-batch deferred
+        dispatches 19%, scan-fused 25-36%, at which point the residual
+        is the op-plane upload bandwidth itself — see bench_all's
+        decomposition fields). Returns the total extras count (topk_rmv
+        dominated elements; 0 for the others on this surface).
 
-        Failure atomicity: every batch is parsed (structure + column
-        validation) before ANY dispatch, so a malformed batch rejects the
-        whole call with the grid untouched by this call's decode errors.
-        For topk_rmv, range validation ALSO runs for every batch up
-        front (the build/dispatch split), so the scan path is all-or-
-        nothing; for the other types a range failure inside batch k
-        aborts with batches 0..k-1 applied and says so in the error —
-        the same bound a host gets from k sequential calls."""
+        worddocumentcount accepts either all-doc_add or all-token
+        batches in one call; mixing modes across batches falls back to
+        validated sequential applies (each mode's dedup is batch-scoped
+        either way) — on THAT fallback path only, a range failure inside
+        batch k aborts with batches 0..k-1 applied and says so in the
+        error, the same bound as k sequential calls; every uniform-mode
+        call keeps the all-or-nothing guarantee."""
         if not batches:
             return 0
         parsed_all = []
@@ -304,40 +362,48 @@ class _Grid:
                 raise ValueError(
                     f"batch {k} (no batch applied): {e}"
                 ) from e
-        if self.type_name == "topk_rmv":
-            return self._apply_multi_topk_rmv(parsed_all)
-        total = 0
-        for k, parsed in enumerate(parsed_all):
-            try:
-                total += getattr(self, f"_packed_{self.type_name}")(parsed)
-            except Exception as e:
-                raise ValueError(
-                    f"batch {k} ({k} batch(es) already applied): {e}"
-                ) from e
-        return total
 
-    def _apply_multi_topk_rmv(self, parsed_all) -> int:
-        """Scan-fused multi apply: build + range-validate EVERY batch,
-        pad the op planes to a common bucketed width, stack them on a
-        leading axis, and run the sequential rounds as ONE lax.scan
-        dispatch — one host->device upload, one dispatch, and one
-        dominated-count readback per wire call instead of one of each
-        per batch (measured r5: the per-batch dispatch variant plateaued
-        at ~19% of the device-native rate; the uploads/dispatches
-        through the tunnel dominated). Padding is semantically inert —
-        padded adds carry ts=0 (add_valid drops them) and padded rmvs
-        carry id=-1 (out-of-range tombstone rows are dropped) — exactly
-        the fills _pad_cols already uses per batch. Widths bucket up to
-        the next power of two (>=64) so the compiled (MB, Ba, Br)
-        variant count stays bounded."""
+        kind, build = self.type_name, None
+        if kind == "topk_rmv":
+            build = lambda p: self._build_topk_rmv_arrays(p)[1]  # noqa: E731
+        elif kind == "worddocumentcount":
+            modes = ["doc" if "doc_add" in p else "wc" for p in parsed_all]
+            for k, p in enumerate(parsed_all):
+                if "doc_add" in p and "add" in p:
+                    raise ValueError(
+                        f"batch {k} (no batch applied): batch mixes "
+                        "doc_add with other ops"
+                    )
+            if len(set(modes)) > 1:
+                total = 0
+                for k, parsed in enumerate(parsed_all):
+                    try:
+                        total += self._packed_worddocumentcount(parsed)
+                    except Exception as e:
+                        raise ValueError(
+                            f"batch {k} ({k} batch(es) already applied): {e}"
+                        ) from e
+                return total
+            if modes[0] == "doc":
+                kind, build = "worddoc_doc", self._build_worddoc_arrays
+            else:
+                kind, build = "wordcount", self._build_wordcount_arrays
+        if build is None:
+            build = getattr(self, f"_build_{kind}_arrays")
+
         builds = []
         for k, parsed in enumerate(parsed_all):
             try:
-                builds.append(self._build_topk_rmv_arrays(parsed)[1])
+                builds.append(build(parsed))
             except Exception as e:
                 raise ValueError(
                     f"batch {k} (no batch applied): {e}"
                 ) from e
+
+        # Pad each plane to its own bucketed max width across batches
+        # (power of two >= 64 bounds the compiled-variant count), with
+        # the plane's semantically-inert fill, then stack for the scan.
+        fills = _MULTI_FILLS[kind]
 
         def bucket(n):
             w = 64
@@ -345,25 +411,21 @@ class _Grid:
                 w *= 2
             return w
 
-        Ba = bucket(max(b[0].shape[1] for b in builds))
-        Br = bucket(max(b[5].shape[1] for b in builds))
-
         def pad(x, w, fill):
             if x.shape[1] == w:
                 return x
             widths = [(0, 0), (0, w - x.shape[1])] + [(0, 0)] * (x.ndim - 2)
             return np.pad(x, widths, constant_values=fill)
 
+        widths = [
+            bucket(max(b[i].shape[1] for b in builds))
+            for i in range(len(fills))
+        ]
         stacked = tuple(
-            np.stack(
-                [pad(b[i], Ba if i < 5 else Br, -1 if i == 6 else 0)
-                 for b in builds]
-            )
-            for i in range(8)
+            np.stack([pad(b[i], widths[i], fills[i]) for b in builds])
+            for i in range(len(fills))
         )
-        self.state, total = _get_scan_apply_topk_rmv()(
-            self.dense, self.state, stacked
-        )
+        self.state, total = _get_scan_fn(kind)(self.dense, self.state, stacked)
         return int(np.asarray(total))
 
     def apply_extras_packed(self, groups):
@@ -438,11 +500,7 @@ class _Grid:
             out.append(arr)
         return B, r_idx, j_idx, out
 
-    def _packed_average(self, parsed) -> int:
-        import jax.numpy as jnp
-
-        from ..models.average import AverageOps
-
+    def _build_average_arrays(self, parsed):
         counts, cols = parsed.get("add", (np.zeros(self.R, np.int32), {}))
         k = cols.get("key", np.zeros(0, np.int32))
         _reject(~((0 <= k) & (k < self.NK)), k, "add key={} out of range")
@@ -453,6 +511,14 @@ class _Grid:
             (k, cols.get("value", np.zeros(0, np.int32)), c),
             (0, 0, 0),
         )
+        return key, val, cnt
+
+    def _packed_average(self, parsed) -> int:
+        import jax.numpy as jnp
+
+        from ..models.average import AverageOps
+
+        key, val, cnt = self._build_average_arrays(parsed)
         self.state, _ = self.dense.apply_ops(
             self.state,
             AverageOps(
@@ -462,11 +528,7 @@ class _Grid:
         )
         return 0
 
-    def _packed_topk(self, parsed) -> int:
-        import jax.numpy as jnp
-
-        from ..models.topk import TopkOps
-
+    def _build_topk_arrays(self, parsed):
         counts, cols = parsed.get("add", (np.zeros(self.R, np.int32), {}))
         k = cols.get("key", np.zeros(0, np.int32))
         i = cols.get("id", np.zeros(0, np.int32))
@@ -479,6 +541,14 @@ class _Grid:
         )
         valid = np.zeros(key.shape, bool)
         valid[r_idx, j_idx] = True
+        return key, id_, score, valid
+
+    def _packed_topk(self, parsed) -> int:
+        import jax.numpy as jnp
+
+        from ..models.topk import TopkOps
+
+        key, id_, score, valid = self._build_topk_arrays(parsed)
         self.state, _ = self.dense.apply_ops(
             self.state,
             TopkOps(
@@ -488,11 +558,7 @@ class _Grid:
         )
         return 0
 
-    def _packed_leaderboard(self, parsed, want_extras: bool = False):
-        import jax.numpy as jnp
-
-        from ..models.leaderboard import LeaderboardOps
-
+    def _build_leaderboard_arrays(self, parsed):
         P = self.dense.P
         padded = {}
         for tag, names in (("add", ("key", "id", "score")), ("ban", ("key", "id"))):
@@ -510,8 +576,16 @@ class _Grid:
             valid = np.zeros(arrs[0].shape, bool)
             valid[r_idx, j_idx] = True
             padded[tag] = (*arrs, valid)
-        a_key, a_id, a_score, a_valid = padded["add"]
-        b_key, b_id, b_valid = padded["ban"]
+        return padded["add"] + padded["ban"]
+
+    def _packed_leaderboard(self, parsed, want_extras: bool = False):
+        import jax.numpy as jnp
+
+        from ..models.leaderboard import LeaderboardOps
+
+        (a_key, a_id, a_score, a_valid, b_key, b_id, b_valid) = (
+            self._build_leaderboard_arrays(parsed)
+        )
         self.state, promoted = self.dense.apply_ops(
             self.state,
             LeaderboardOps(
@@ -534,22 +608,42 @@ class _Grid:
             _bin_col(scores[rr, kk, jj]),
         ])]
 
-    def _packed_wordcount(self, parsed) -> int:
-        import jax.numpy as jnp
-
-        from ..models.wordcount import WordcountOps
-
+    def _build_wordcount_arrays(self, parsed):
         counts, cols = parsed.get("add", (np.zeros(self.R, np.int32), {}))
         k = cols.get("key", np.zeros(0, np.int32))
         t = cols.get("token", np.zeros(0, np.int32))
         _reject(~((0 <= k) & (k < self.NK)), k, "add key={} out of range")
         _reject(~((0 <= t) & (t < self.dense.V)), t, "add token={} out of range")
         _, _, _, (key, tok) = self._pad_cols(counts, (k, t), (0, -1))
+        return key, tok
+
+    def _packed_wordcount(self, parsed) -> int:
+        import jax.numpy as jnp
+
+        from ..models.wordcount import WordcountOps
+
+        key, tok = self._build_wordcount_arrays(parsed)
         self.state, _ = self.dense.apply_ops(
             self.state,
             WordcountOps(key=jnp.asarray(key), token=jnp.asarray(tok)),
         )
         return 0
+
+    def _build_worddoc_arrays(self, parsed):
+        counts, cols = parsed["doc_add"]
+        k, d = cols["key"], cols["doc"]
+        u, t = cols["uniq"], cols["token"]
+        _reject(~((0 <= k) & (k < self.NK)), k, "doc_add key={} out of range")
+        _reject(
+            ~((0 <= t) & (t < self.dense.V)), t, "doc_add token={} out of range"
+        )
+        if ((d < 0) | (u < 0)).any():
+            j = int(np.argmax((d < 0) | (u < 0)))
+            raise ValueError(f"doc_add doc={d[j]}/uniq={u[j]} negative")
+        _, _, _, (key, doc, uniq, tok) = self._pad_cols(
+            counts, (k, d, u, t), (0, 0, 0, -1)
+        )
+        return key, doc, uniq, tok
 
     def _packed_worddocumentcount(self, parsed) -> int:
         import jax.numpy as jnp
@@ -564,19 +658,7 @@ class _Grid:
                 "per-document dedup is batch-scoped — send one mode per "
                 "batch"
             )
-        counts, cols = parsed["doc_add"]
-        k, d = cols["key"], cols["doc"]
-        u, t = cols["uniq"], cols["token"]
-        _reject(~((0 <= k) & (k < self.NK)), k, "doc_add key={} out of range")
-        _reject(
-            ~((0 <= t) & (t < self.dense.V)), t, "doc_add token={} out of range"
-        )
-        if ((d < 0) | (u < 0)).any():
-            j = int(np.argmax((d < 0) | (u < 0)))
-            raise ValueError(f"doc_add doc={d[j]}/uniq={u[j]} negative")
-        _, _, _, (key, doc, uniq, tok) = self._pad_cols(
-            counts, (k, d, u, t), (0, 0, 0, -1)
-        )
+        key, doc, uniq, tok = self._build_worddoc_arrays(parsed)
         self.state, _ = self.dense.apply_doc_ops(
             self.state,
             WordDocOps(

@@ -488,6 +488,85 @@ def test_packed_multi_matches_sequential(client, seed):
     assert client.grid_to_binary(gm) == client.grid_to_binary(gs)
 
 
+@pytest.mark.parametrize("type_name", sorted(TYPE_CASES))
+def test_packed_multi_matches_sequential_simple_types(client, type_name):
+    """The generic scan-fused multi path must equal sequential
+    grid_apply_packed calls for every single-group type, including
+    batches of DIFFERENT sizes (exercising the per-plane pad+bucket)."""
+    case = TYPE_CASES[type_name]
+    rng = np.random.default_rng(11)
+    gen = case["gen"](rng)
+    R = case["params"]["n_replicas"]
+    gs, gm = f"ms_{type_name}", f"mm_{type_name}"
+    client.grid_new(gs, type_name, **case["params"])
+    client.grid_new(gm, type_name, **case["params"])
+
+    def batch(max_b):
+        ops, counts = ragged(rng, R, max_b, gen)
+        return [(case["tag"], counts, cols_of(ops, case["fields"]))]
+
+    batches = [batch(3), batch(40), batch(7)]
+    seq = sum(client.grid_apply_packed(gs, b) for b in batches)
+    multi = client.grid_apply_packed_multi(gm, batches)
+    assert multi == seq
+    assert client.grid_to_binary(gm) == client.grid_to_binary(gs)
+
+
+def test_packed_multi_matches_sequential_leaderboard(client):
+    rng = np.random.default_rng(12)
+    R = 2
+    params = dict(n_replicas=R, n_keys=1, n_players=64, size=4)
+    client.grid_new("ms_lb", "leaderboard", **params)
+    client.grid_new("mm_lb", "leaderboard", **params)
+
+    def batch(na, nb):
+        adds = [[(Atom("add"), 0, int(rng.integers(0, 64)),
+                  int(rng.integers(1, 999))) for _ in range(na + r)]
+                for r in range(R)]
+        bans = [[(Atom("ban"), 0, int(rng.integers(0, 64)))
+                 for _ in range(nb)] for _ in range(R)]
+        return [
+            ("add", np.asarray([na, na + 1], np.int32),
+             cols_of(adds, (1, 2, 3))),
+            ("ban", np.full(R, nb, np.int32), cols_of(bans, (1, 2))),
+        ]
+
+    batches = [batch(5, 1), batch(30, 2), batch(2, 0)]
+    seq = sum(client.grid_apply_packed("ms_lb", b) for b in batches)
+    multi = client.grid_apply_packed_multi("mm_lb", batches)
+    assert multi == seq
+    assert client.grid_to_binary("mm_lb") == client.grid_to_binary("ms_lb")
+
+
+def test_packed_multi_worddoc_doc_mode_and_mixed_fallback(client):
+    rng = np.random.default_rng(13)
+    R, V = 2, 16
+    params = dict(n_replicas=R, n_keys=1, n_buckets=V)
+    client.grid_new("ms_wd", "worddocumentcount", **params)
+    client.grid_new("mm_wd", "worddocumentcount", **params)
+
+    def doc_batch(max_b):
+        def gen(r):
+            return (Atom("doc_add"), 0, int(rng.integers(0, 3)),
+                    int(rng.integers(0, 12)), int(rng.integers(0, V)))
+        docs, counts = ragged(rng, R, max_b, gen)
+        return [("doc_add", counts, cols_of(docs, (1, 2, 3, 4)))]
+
+    def tok_batch(n):
+        toks = [[(Atom("add"), 0, int(rng.integers(0, V)))
+                 for _ in range(n)] for _ in range(R)]
+        return [("add", np.full(R, n, np.int32), cols_of(toks, (1, 2)))]
+
+    # all-doc_add batches ride the scan; a doc+token mix per CALL falls
+    # back to validated sequential applies — both must equal sequential.
+    for batches in ([doc_batch(6), doc_batch(20)],
+                    [doc_batch(5), tok_batch(9)]):
+        seq = sum(client.grid_apply_packed("ms_wd", b) for b in batches)
+        multi = client.grid_apply_packed_multi("mm_wd", batches)
+        assert multi == seq
+        assert client.grid_to_binary("mm_wd") == client.grid_to_binary("ms_wd")
+
+
 def test_packed_multi_empty_batches_is_noop(client):
     params = dict(n_replicas=1, n_keys=1, n_ids=4, n_dcs=1, size=2,
                   slots_per_id=2)

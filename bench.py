@@ -618,11 +618,11 @@ def main():
         # B (1/16 rmv ratio preserved) amortizes the per-round full-grid
         # join — batch size is a free engine parameter (BASELINE pins
         # keys/replicas/K, not batch), and p50/p99 round latency stays
-        # reported honestly. Measured scaling on v5e: B=4096 -> 4.9M
-        # merges/s @ 28ms/round; 16384 -> 14.2M @ 40ms; 32768 -> 18.6M @
-        # 60ms; 65536 -> 22.4M @ 99ms (asymptote ~26M set by the ~1.2us/op
-        # sort+scatter cost). B=32768 is the balanced default: near-peak
-        # throughput without letting round latency run away.
+        # reported honestly. Measured scaling on v5e (round 5, unique-hint
+        # scatters): B=16384 -> 12.9M merges/s @ 43ms/round; 32768 ->
+        # 21.1-21.9M @ 51-53ms; 49152 -> 22.6M @ 74ms; 65536 -> 24.8M @
+        # 90ms. B=32768 is the balanced default: near-peak throughput
+        # without letting round latency run away.
         R, I, B, Br, windows, W, base_ops = 32, 100_000, 32768, 2048, 6, 10, 20_000
         # Frontier sweep (committed as the `curve` block). Each point costs
         # two remote compiles (~35s each cold on this tunnel), so the sweep
@@ -656,8 +656,8 @@ def main():
     curve.sort(key=lambda p: p["batch_adds"])
     # Operating-point decision (explicit, as the curve artifact demands):
     # the headline stays at the largest point whose windowed p50 holds the
-    # ~60ms round budget; the knee (~49152 on v5e, ~23M merges/sec at
-    # ~72ms) is there for deployments whose latency budget allows it.
+    # ~60ms round budget; the knee (~49152 on v5e, ~22.6M merges/sec at
+    # ~74ms r5) is there for deployments whose latency budget allows it.
     chosen = {
         "batch_adds": B,
         "why": (

@@ -339,9 +339,15 @@ def compute_merge_model(R, NK, I, D_DCS, M, merge_ms, merge_hbm_bytes):
             "elementwise_maxes": 1.8, "dom_onehot_reduces": 3.7,
             "placement": 2.3, "compares_ranks": 0.6,
             "methodology": "removal deltas, RTT-calibrated (null-scan "
-                           "probe); taken on the pre-union pairwise join",
+                           "probe); taken on the pre-union pairwise join. "
+                           "r5 re-validated the structure: the full union "
+                           "merge measures 8.87ms at REPS=128 and every "
+                           "dom-lookup reformulation (sum/mul/einsum-dot) "
+                           "lands within noise, bit tree 2.2x worse "
+                           "(benchmarks/dom_probe.py) - schedule-bound",
             "repro": "MERGE_REPS=64 python benchmarks/merge_probe.py; "
-                     "MERGE_REPS=128 python benchmarks/merge_probe2.py",
+                     "MERGE_REPS=128 python benchmarks/merge_probe2.py; "
+                     "MERGE_REPS=128 python benchmarks/dom_probe.py",
         }
         if (R, I, D_DCS, M) == (32, 100_000, 32, 4)
         else None
@@ -358,7 +364,7 @@ def compute_merge_model(R, NK, I, D_DCS, M, merge_ms, merge_hbm_bytes):
             "hbm_floor_ms": round(hbm_floor_ms, 2),
             "floor_ms": round(floor_ms, 2),
             "headroom_vs_floor_x": round(merge_ms / max(floor_ms, 1e-9), 1),
-            "attribution_ms_r4": attribution,
+            "attribution_ms_r5": attribution,
             "binding_constraint": (
                 "dom one-hot tombstone reduces (~2.5x floor) + one-hot "
                 "placement; elementwise rmv/vc maxes already run at their "
@@ -402,45 +408,43 @@ def compute_model(R, NK, I, D_DCS, M, B, Br, apply_ms, apply_hbm_bytes):
     mxu_floor_ms = macs * 2 / (MXU_INT8_PEAK_TOPS * 1e12) * 1e3
     hbm_floor_ms = apply_hbm_bytes / (HBM_PEAK_GB_S * 1e9) * 1e3
     floor_ms = max(mxu_floor_ms, hbm_floor_ms)
-    # Round-4 attribution, two independent methodologies that now AGREE
-    # (round 3's ~25ms "residual_fusion" no longer exists — it was the
-    # D-step dom-lookup slice/select chains plus the associative_scan
-    # odd/even tree, both restructured away this round; the remaining
-    # removal deltas + ~RTT/REPS overhead sum to the measured round
-    # within ~2ms):
-    # * per-HLO device-timeline profile (benchmarks/profile_north_star.py,
-    #   committed as benchmarks/profile_r04.json): tombstone one-hot conv
-    #   11.2 + plane-unpack/max 3.9 (the unpack reads the 5x-wide s32 conv
-    #   output — ~2.9GB/round, ~3.5ms HBM floor, so it runs at ~90% of
-    #   peak), 3x delta scalar scatters 5.13 each, sorts 3.7, join
-    #   compares/placement ~2.3, dom one-hot reduce 1.4, tail ~2.7.
-    # * removal-delta ablation (ablate_apply.py), measured v5e r4.
+    # Round-5 attribution. Structure (which slices exist, what they
+    # compute) comes from the per-HLO profile (profile_north_star.py,
+    # committed as benchmarks/profile_r05.json): tombstone one-hot conv
+    # 11.2 + plane-unpack/max 3.9 (reads the 5x-wide s32 conv output —
+    # ~2.9GB/round, ~3.5ms HBM floor, ~90% of peak), 3x delta scalar
+    # scatter fusions ~5.1 each, sorts, join compares/placement, dom
+    # one-hot reduce. CAVEAT (discovered r5, recorded in the profile
+    # script's docstring): that timeline is a deterministic MODELED
+    # schedule on this AOT backend — r4/r5 captures reproduce to
+    # +-0.001ms across sessions and code changes — so magnitudes below
+    # come from wall-clock removal deltas (ablate_apply.py), which DO
+    # see runtime effects like the r5 unique-indices scatter hint.
     # These are v5e measurements at the north-star shapes — attach only
     # where they apply (not tiny/CPU configs).
     attribution = (
         {
-            # Re-measured after the union-join adoption (the ablation
-            # variants join through _join_slots_union like production):
-            # the join's removal delta collapsed 8.9 -> ~0.1ms — swapping
-            # it for an elementwise max changes nothing measurable, i.e.
-            # the union join fuses into the surrounding round for free.
-            # The earlier pairwise-join numbers (full 52.6: tombstones
-            # 19.0, delta 23.3, join 8.9) are kept in git history.
-            "tombstones": 16.2, "delta_build": 19.2,
-            "join_and_filter": 0.1, "vc_track": 0.0,
+            # r5 session removal deltas (post unique-hint scatters).
+            # delta_build = sort+rank+3 scatters removed together; the
+            # scatters-only line extrapolates 3/2 x the 2-of-3-scatters
+            # delta (11.9) and sits inside delta_build. The r4 session's
+            # join delta read ~0.1 ("fuses free"); this session reads
+            # 5.0 — treat cross-session piece values as +-2ms.
+            "tombstones": 15.9, "delta_build": 20.6,
+            "delta_scatters_3x_est": 17.8,
+            "join_and_filter": 5.0, "vc_track": 0.0,
             "residual_unattributed": round(
-                47.98 - 16.2 - 19.2 - 0.1 - 0.0, 1
+                49.43 - 15.9 - 20.6 - 5.0 - 0.0, 1
             ),
-            "full_round": 47.98,
+            "full_round": 49.43,
             # full_round is the ablation harness's UNADJUSTED per-rep wall
-            # (includes ~RTT/REPS of tunnel overhead — ~10ms at REPS=12
-            # this session, which is most of residual_unattributed), so
-            # it reads higher than measured_ms above (RTT-adjusted). The
-            # piece values are removal DELTAS between equal-overhead
-            # runs — RTT-free.
+            # (includes ~RTT/REPS of tunnel overhead — ~8-10ms at REPS=12,
+            # which is most of residual_unattributed), so it reads higher
+            # than measured_ms above (RTT-adjusted). The piece values are
+            # removal DELTAS between equal-overhead runs — RTT-free.
             "methodology": (
-                "removal deltas; full_round unadjusted; union-join "
-                "production kernel (r4 final)"
+                "removal deltas; full_round unadjusted; union-join + "
+                "unique-hint scatters (r5 production)"
             ),
             "repro": "ABLATE_B=32768 ABLATE_BR=2048 python "
                      "benchmarks/ablate_apply.py",
@@ -462,20 +466,23 @@ def compute_model(R, NK, I, D_DCS, M, B, Br, apply_ms, apply_hbm_bytes):
             "sort_elems": int(R * B * 6),
             "scatter_rows": int(R * B * 3),
             "join_elementwise_ops": int(R * T * 2 * M * 12),
-            "attribution_ms_r4": attribution,
-            "hlo_profile_artifact": "benchmarks/profile_r04.json",
+            "attribution_ms_r5": attribution,
+            "hlo_profile_artifact": "benchmarks/profile_r05.json",
             "binding_constraint": (
                 "3x delta scalar scatters (XLA's serialized update loop; "
-                "sorted/unique hints, i64 packing, cond-packing and "
-                "M-major layouts all measured neutral-or-worse in "
-                "benchmarks/residual_probe.py; the entire gather family "
-                "— position-scatter+gathers, binary-search expansion, "
+                "r5 adopts the unique_indices hint — formally-unique "
+                "indices, -3.8ms on the isolated sort+build, "
+                "benchmarks/delta_place_probe.py — while the unsound "
+                "sorted hint and the Mosaic carry-walk placement kernel "
+                "are recorded rejections there; i64 packing, cond-"
+                "packing and M-major layouts measured neutral-or-worse "
+                "in benchmarks/residual_probe.py; the gather family — "
+                "position-scatter+gathers, binary-search expansion, "
                 "sorted block-window expansion — regresses 9-130x in "
-                "benchmarks/delta_probe.py: data-dependent gathers/"
-                "slices are poison on this backend) + tombstone one-hot "
-                "conv (~47% MXU util; MAC-cutting restructurings "
-                "regress, benchmarks/tomb_bucket_probe.py) + its "
-                "plane-unpack (~90% of HBM floor)"
+                "benchmarks/delta_probe.py) + tombstone one-hot conv "
+                "(~47% MXU util; MAC-cutting restructurings regress, "
+                "benchmarks/tomb_bucket_probe.py) + its plane-unpack "
+                "(~90% of HBM floor)"
             ),
         },
     }

@@ -12,11 +12,26 @@ name to its computation body from the compiled HLO text so every slice
 has a human-readable "what it computes".
 
 Outputs:
-  benchmarks/profile_r04.json  — per-slice table (ms/round, share, body)
+  benchmarks/profile_r05.json  — per-slice table (ms/round, share, body)
   stdout                       — the same table, human-readable
 
 Env knobs: PROF_B / PROF_BR / PROF_W (default north-star 32768/2048/10),
 PROF_EXTRAS=table to profile the extras-on configuration.
+
+CAVEAT discovered round 5: on this tunneled AOT backend the "device
+timeline" is a DETERMINISTIC MODELED schedule, not measured hardware
+events — the r4 and r5 captures (different sessions, different compiled
+code after the scatter-hint change) reproduce slice times to +-0.001ms,
+which real silicon cannot do. The table is therefore trustworthy for
+STRUCTURE (which fusions exist, their relative cost model, what each
+computes) but blind to runtime-only effects: the r5 unique-indices
+scatter hint measurably moves wall-clock (benchmarks/delta_place_probe
+-3.8ms isolated; bench.py p50 ~53.5 -> ~51-53 across sessions) while
+leaving this modeled timeline byte-stable. Treat removal-delta
+ablations + host-synced wall clock (ablate_apply.py, bench.py) as
+ground truth for magnitudes; use this artifact to NAME the slices.
+(The `while` wrapper line is the scan body measured inclusively — it
+approximates the whole round and double-counts its children.)
 """
 
 import collections
@@ -43,7 +58,7 @@ Br = int(os.environ.get("PROF_BR", 2048))
 W = int(os.environ.get("PROF_W", 10))
 EXTRAS = os.environ.get("PROF_EXTRAS", "")  # "" (off) or "table"
 TRACE_DIR = os.environ.get("PROF_TRACE_DIR", "/tmp/ns_trace")
-OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "profile_r04.json")
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "profile_r05.json")
 
 
 def build_runner():

@@ -34,7 +34,7 @@ def test_bench_dense_tiny():
     assert ca["measured_ms"] > 0 and ca["floor_ms"] >= ca["hbm_floor_ms"]
     assert ca["mxu"]["tombstone_onehot_macs"] == 2 * 4 * 64 * 5 * 2
     # The v5e ablation attribution only attaches at north-star shapes.
-    assert ca["attribution_ms_r4"] is None
+    assert ca["attribution_ms_r5"] is None
 
 
 def test_bench_scalar_baseline_tiny():

@@ -634,10 +634,14 @@ def main():
         # Frontier sweep (committed as the `curve` block). Each point costs
         # two remote compiles (~35s each cold on this tunnel), so the sweep
         # is 3 extra points and the headline B=32768 point is carried over
-        # from the main measurement (marked source=headline). A manually
-        # probed 40960 point measured SLOWER per round than 49152 on v5e
-        # (71.1 vs 72.4ms but 8k fewer ops — shape/padding-dependent
-        # compilation), the kind of fact a prose curve hides.
+        # from the main measurement (marked source=headline). Non-power-of-
+        # two-ish points compile BADLY on v5e (shape/padding-dependent):
+        # manually probed 40960 ran slower per round than 49152 (71.1 vs
+        # 72.4ms with 8k fewer ops, r4), and r5's probe of the 52.7->62ms
+        # latency headroom found 36864 WORSE THAN 32768 ON BOTH AXES
+        # (19.0M @ 65.8ms vs 21.1M @ 52.7) and 45056 dominated by 49152
+        # (21.7M @ 70.5 vs 22.6M @ 73.8) — the kind of fact a prose curve
+        # hides; the sweep sticks to the clean shapes.
         curve_points = (16384, 49152, 65536)
         curve_cfg = dict(windows=2, W=6, e2e_samples=8)
     D_DCS, K, M = R, 100, 4  # every simulated replica is a DC: vc width = R

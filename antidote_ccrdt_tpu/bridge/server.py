@@ -101,12 +101,21 @@ _PACKED_COLUMNS: Dict[Tuple[str, str], Tuple[str, ...]] = {
 # rmv_id=-1 ops are dropped by the engines.
 _MULTI_FILLS = {
     "topk_rmv": (0, 0, 0, 0, 0, 0, -1, 0),
+    "topk_rmv_packed_ids": (0, 0, 0, -1, 0),
     "average": (0, 0, 0),
     "topk": (0, 0, 0, False),
     "leaderboard": (0, 0, 0, False, 0, 0, False),
     "wordcount": (0, -1),
     "worddoc_doc": (0, 0, 0, -1),
 }
+
+# The scan path's host->device upload is the multi surface's measured
+# binding constraint (BENCHALL_r05 decomposition), so when the geometry
+# fits, (key, id, dc) pack losslessly into ONE i32 per add — 5 planes ->
+# 3 — and (key, id) per rmv — 2 -> 1 (the on-device unpack is a pair of
+# fused divmods). Tests force the unpacked fallback by patching this
+# limit down.
+_PACKED_IDS_LIMIT = 2**31
 
 _SCAN_FNS: Dict[str, Any] = {}
 
@@ -132,6 +141,26 @@ def _get_scan_fn(kind: str):
             st, ex = dense.apply_ops(st, TopkRmvOps(
                 add_key=a[0], add_id=a[1], add_score=a[2], add_dc=a[3],
                 add_ts=a[4], rmv_key=a[5], rmv_id=a[6], rmv_vc=a[7],
+            ))
+            return st, jnp.sum(ex.dominated)
+    elif kind == "topk_rmv_packed_ids":
+        from ..models.topk_rmv_dense import TopkRmvOps
+
+        def step(dense, st, a):
+            # a = (add_kid_dc, add_score, add_ts, rmv_kid, rmv_vc):
+            # kid_dc = (key*I + id)*D + dc; rmv kid = key*I + id with -1
+            # marking padding (kept out of the packed domain so the
+            # engine's rmv_id < 0 drop fires exactly as unpacked).
+            I, D = dense.I, dense.D
+            kid = a[0] // D
+            rk = a[3]
+            pad = rk < 0
+            st, ex = dense.apply_ops(st, TopkRmvOps(
+                add_key=kid // I, add_id=kid % I, add_score=a[1],
+                add_dc=a[0] % D, add_ts=a[2],
+                rmv_key=jnp.where(pad, 0, rk // I),
+                rmv_id=jnp.where(pad, -1, rk % I),
+                rmv_vc=a[4],
             ))
             return st, jnp.sum(ex.dominated)
     elif kind == "average":
@@ -399,6 +428,29 @@ class _Grid:
                 raise ValueError(
                     f"batch {k} (no batch applied): {e}"
                 ) from e
+
+        if (
+            kind == "topk_rmv"
+            and self.NK * self.dense.I * self.dense.D < _PACKED_IDS_LIMIT
+        ):
+            # Upload-byte packing (the surface's measured binding
+            # constraint): (key, id, dc) -> one i32 per add, (key, id) ->
+            # one i32 per rmv; unpacked on device by the scan step.
+            kind = "topk_rmv_packed_ids"
+            I, D = self.dense.I, self.dense.D
+
+            def pack(b):
+                a_key, a_id, a_score, a_dc, a_ts, r_key, r_id, r_vc = b
+                kid_dc = (a_key.astype(np.int64) * I + a_id) * D + a_dc
+                rk = np.where(
+                    r_id < 0, -1, r_key.astype(np.int64) * I + r_id
+                )
+                return (
+                    kid_dc.astype(np.int32), a_score, a_ts,
+                    rk.astype(np.int32), r_vc,
+                )
+
+            builds = [pack(b) for b in builds]
 
         # Pad each plane to its own bucketed max width across batches
         # (power of two >= 64 bounds the compiled-variant count), with

@@ -846,11 +846,13 @@ def bench_grid_wire():
             return w
 
         # The scan path pads each plane's width to the next power of two
-        # before upload (_apply_multi_topk_rmv), so the bytes actually
-        # crossing the tunnel per batch are the BUCKETED planes.
+        # before upload, so the bytes actually crossing the tunnel per
+        # batch are the BUCKETED planes — with the r5 id-packing (key/id/
+        # dc -> one i32 per add, key/id -> one per rmv; this grid's
+        # NK*I*D fits) that is 3 add planes + 1 rmv plane + the vc rows.
         Ba_b = pow2_bucket(built[0].shape[1])
         Br_b = pow2_bucket(built[5].shape[1])
-        one_batch_bytes = 4 * R * (5 * Ba_b + 2 * Br_b + Br_b * R)
+        one_batch_bytes = 4 * R * (3 * Ba_b + 1 * Br_b + Br_b * g_tr.dense.D)
         rate_m = timed_packed_multi(
             "w_tr", [[tr_packed() for _ in range(MB)] for _ in range(CALLS)]
         )

@@ -567,6 +567,43 @@ def test_packed_multi_worddoc_doc_mode_and_mixed_fallback(client):
         assert client.grid_to_binary("mm_wd") == client.grid_to_binary("ms_wd")
 
 
+def test_packed_multi_id_packing_and_fallback_agree(client, monkeypatch):
+    """The upload-byte id-packing (key/id/dc -> one i32 per add) and the
+    unpacked 8-plane fallback must produce identical state and counts;
+    the fallback is forced by patching the geometry limit to 0."""
+    from antidote_ccrdt_tpu.bridge import server as server_mod
+
+    R, NK, I, D = 2, 2, 16, 3
+    params = dict(n_replicas=R, n_keys=NK, n_ids=I, n_dcs=D, size=3,
+                  slots_per_id=2)
+
+    def batches():
+        rng2 = np.random.default_rng(99)
+        out = []
+        rmvs = [[(Atom("rmv"), int(rng2.integers(0, NK)), i,
+                  [(d, 40) for d in range(D)]) for i in range(I)]
+                for _ in range(R)]
+        out.append([("rmv", np.full(R, I, np.int32), rmv_cols_of(rmvs))])
+        for n in (3, 25):
+            adds = [[(Atom("add"), int(rng2.integers(0, NK)),
+                      int(rng2.integers(0, I)), int(rng2.integers(0, 99)),
+                      int(rng2.integers(0, D)), int(rng2.integers(1, 60)))
+                     for _ in range(n + r)] for r in range(R)]
+            out.append([("add", np.asarray([n, n + 1], np.int32),
+                         cols_of(adds, (1, 2, 3, 4, 5)))])
+        return out
+
+    client.grid_new("pk_on", "topk_rmv", **params)
+    total_on = client.grid_apply_packed_multi("pk_on", batches())
+    snap_on = client.grid_to_binary("pk_on")
+
+    monkeypatch.setattr(server_mod, "_PACKED_IDS_LIMIT", 0)
+    client.grid_new("pk_off", "topk_rmv", **params)
+    total_off = client.grid_apply_packed_multi("pk_off", batches())
+    assert total_on == total_off and total_on > 0
+    assert snap_on == client.grid_to_binary("pk_off")
+
+
 def test_packed_multi_empty_batches_is_noop(client):
     params = dict(n_replicas=1, n_keys=1, n_ids=4, n_dcs=1, size=2,
                   slots_per_id=2)

@@ -23,7 +23,7 @@ PY ?= python
 # meaningful.
 COVER_THRESHOLD ?= 88
 
-.PHONY: all compile test cover typecheck xref native bench benchall dryrun clean
+.PHONY: all compile test cover typecheck xref native bench benchall dryrun net-demo clean
 
 all: compile xref typecheck cover
 
@@ -60,6 +60,12 @@ benchall:
 
 dryrun:
 	$(PY) __graft_entry__.py
+
+# The real-socket gossip drill: three localhost TCP peers, one killed
+# mid-run; survivors detect the death via SWIM ages, adopt its replicas,
+# and converge (tests/test_net_tcp.py::test_real_process_tcp_crash_recovery).
+net-demo:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_net_tcp.py -q -m slow -p no:cacheprovider
 
 clean:
 	rm -rf native/build

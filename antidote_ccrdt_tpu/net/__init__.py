@@ -1,0 +1,38 @@
+"""net/: pluggable gossip anti-entropy transports.
+
+The failure-tolerant tier (`parallel.elastic`) exchanges lattice states
+and join-decomposed deltas between members. Through round 5 the only
+medium was a shared filesystem directory (`GossipStore`) — fine for
+single-host drills, a non-starter for multi-DC traffic. This package
+makes the medium pluggable behind a small blob-plane `Transport`
+protocol:
+
+* `net.transport`  — the `Transport` protocol, the filesystem
+  implementation (`FsTransport`), and `GossipNode`, the state-plane
+  facade every `parallel.elastic` entry point speaks.
+* `net.tcp`        — a real TCP peer: `{packet,4}` ETF frames (the
+  bridge's framing), per-peer connection cache with exponential backoff
+  + jitter, bounded send queues with a drop-oldest-delta-keep-anchor
+  policy.
+* `net.membership` — SWIM-style liveness: heartbeats piggybacked on
+  every frame, suspect -> confirm-dead timeouts, alive set feeding the
+  deterministic `parallel.elastic.owners` assignment.
+* `net.sim`        — a deterministic in-process simulator (seeded RNG,
+  virtual clock; latency / loss / duplication / partitions / crashes)
+  for replay-exact chaos tests.
+"""
+
+from .membership import Membership
+from .sim import SimNet, SimTransport
+from .tcp import TcpTransport
+from .transport import FsTransport, GossipNode, Transport
+
+__all__ = [
+    "Transport",
+    "FsTransport",
+    "GossipNode",
+    "Membership",
+    "TcpTransport",
+    "SimNet",
+    "SimTransport",
+]

@@ -1,0 +1,239 @@
+"""Deterministic in-process simulated transport for chaos testing.
+
+Replay-based convergence checking (the approach "Automatically Verifying
+Replication-aware Linearizability" argues CRDT stacks need) requires the
+fault schedule to be EXACTLY reproducible: same seed -> same drops, same
+duplicates, same delivery order, bit-identical final states. So the
+simulator owns ALL nondeterminism sources:
+
+* a VIRTUAL clock (`SimNet.time`) advanced only by `advance`/`run_until`
+  — no wall clock anywhere; `Membership` runs on it via its injected
+  `now`;
+* one seeded `random.Random` consumed in a deterministic order (the
+  driver steps members single-threaded; there are no threads in here);
+* a message heap ordered by (delivery time, send counter) so latency
+  ties break deterministically.
+
+Faults: per-message latency sampled from a range (which yields
+reordering for free), iid loss and duplication probabilities, named
+partitions (`partition`/`heal` — messages dropped at send time when
+src and dst are in different groups), and member crashes (`crash` — a
+crashed member neither sends nor receives, and its transport raises on
+further use by the driver).
+
+Messages carry the same logical payloads as `net.tcp` frames — the
+blobs are the REAL serialized bytes (`GossipNode` encodes above the
+transport), so chaos runs exercise the production encode/decode and
+validation paths, not a shortcut."""
+
+from __future__ import annotations
+
+import heapq
+import random
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.metrics import Metrics
+from .membership import Membership
+
+
+class SimNet:
+    """The shared medium: clock, fault injection, message scheduling."""
+
+    def __init__(
+        self,
+        seed: int = 0,
+        latency: Tuple[float, float] = (0.001, 0.02),
+        loss: float = 0.0,
+        dup: float = 0.0,
+        metrics: Optional[Metrics] = None,
+    ):
+        self.rng = random.Random(seed)
+        self.latency = latency
+        self.loss = loss
+        self.dup = dup
+        self.metrics = metrics if metrics is not None else Metrics()
+        self.time = 0.0
+        self._heap: List[Tuple[float, int, str, tuple]] = []
+        self._counter = 0
+        self._members: Dict[str, "SimTransport"] = {}
+        self._groups: Optional[List[set]] = None
+        self._crashed: set = set()
+
+    # -- topology ----------------------------------------------------------
+
+    def join(self, member: str) -> "SimTransport":
+        t = SimTransport(self, member)
+        self._members[member] = t
+        return t
+
+    def partition(self, *groups) -> None:
+        """Split the network: members in different groups cannot exchange
+        messages (members in no listed group are isolated)."""
+        self._groups = [set(g) for g in groups]
+
+    def heal(self) -> None:
+        self._groups = None
+
+    def crash(self, member: str) -> None:
+        """Permanently silence `member`: no sends, no deliveries. Its
+        queued in-flight messages are dropped at delivery time."""
+        self._crashed.add(member)
+
+    def reachable(self, src: str, dst: str) -> bool:
+        if src in self._crashed or dst in self._crashed:
+            return False
+        if self._groups is None:
+            return True
+        return any(src in g and dst in g for g in self._groups)
+
+    # -- transmission ------------------------------------------------------
+
+    def send(self, src: str, dst: str, msg: tuple) -> None:
+        """Apply the fault model and schedule delivery. Partition/crash
+        filtering happens at SEND time (a message in flight when a
+        partition forms still arrives — links don't retroactively eat
+        packets); crash filtering repeats at delivery."""
+        if not self.reachable(src, dst):
+            self.metrics.count("net.sim_unreachable")
+            return
+        copies = 1
+        if self.rng.random() < self.loss:
+            self.metrics.count("net.sim_lost")
+            copies = 0
+        elif self.rng.random() < self.dup:
+            self.metrics.count("net.sim_duplicated")
+            copies = 2
+        lo, hi = self.latency
+        for _ in range(copies):
+            at = self.time + lo + (hi - lo) * self.rng.random()
+            self._counter += 1
+            heapq.heappush(self._heap, (at, self._counter, dst, msg))
+            self.metrics.count("net.sim_msgs")
+
+    def advance(self, dt: float) -> None:
+        self.run_until(self.time + dt)
+
+    def run_until(self, t: float) -> None:
+        """Advance the virtual clock to `t`, delivering everything due."""
+        while self._heap and self._heap[0][0] <= t:
+            at, _n, dst, msg = heapq.heappop(self._heap)
+            self.time = max(self.time, at)
+            if dst in self._crashed:
+                continue
+            self._members[dst]._deliver(msg)
+        self.time = max(self.time, t)
+
+
+class SimTransport:
+    """`net.transport.Transport` over a `SimNet` (see module docstring).
+
+    Cache shape mirrors `net.tcp.TcpTransport`: pushes land in local
+    snapshot/delta dicts, fetches read them; liveness is a `Membership`
+    on the virtual clock, fed by piggybacked ages on every message."""
+
+    def __init__(self, net: SimNet, member: str):
+        self.net = net
+        self.member = member
+        self.metrics = net.metrics
+        self.membership = Membership(
+            member, now=lambda: net.time, metrics=net.metrics
+        )
+        self._snaps: Dict[str, bytes] = {}
+        self._deltas: Dict[str, Dict[int, bytes]] = {}
+
+    # -- send side ---------------------------------------------------------
+
+    def _check_live(self) -> None:
+        if self.member in self.net._crashed:
+            raise RuntimeError(f"{self.member} is crashed (driver bug)")
+
+    def _broadcast(self, msg_base: tuple) -> None:
+        for dst in sorted(self.net._members):
+            if dst == self.member:
+                continue
+            # heard_ages is per-send so every copy carries fresh evidence
+            # (matches tcp's encode-at-send-time rule).
+            self.net.send(
+                self.member, dst,
+                msg_base + (dict(self.membership.heard_ages()),),
+            )
+
+    def heartbeat(self) -> None:
+        self._check_live()
+        self._broadcast(("ping", self.member))
+
+    def publish(self, blob: bytes) -> None:
+        self._check_live()
+        self._snaps[self.member] = blob
+        self._broadcast(("snap", self.member, blob))
+
+    def publish_delta(self, seq: int, blob: bytes, keep: int = 16) -> None:
+        self._check_live()
+        window = self._deltas.setdefault(self.member, {})
+        window[seq] = blob
+        for s in [s for s in window if s <= seq - keep]:
+            del window[s]
+        self._broadcast(("delta", self.member, seq, keep, blob))
+
+    # -- receive side ------------------------------------------------------
+
+    def _deliver(self, msg: tuple) -> None:
+        kind, src = msg[0], msg[1]
+        heard = msg[-1]
+        if kind == "snap":
+            blob = msg[2]
+            old = self._snaps.get(src)
+            # Same reorder guard as tcp: only a >= step header replaces.
+            import struct as _struct
+
+            if (
+                old is None
+                or len(blob) < 8
+                or _struct.unpack("<Q", blob[:8])[0]
+                >= _struct.unpack("<Q", old[:8])[0]
+            ):
+                self._snaps[src] = blob
+        elif kind == "delta":
+            _k, _s, seq, keep, blob = msg[:5]
+            window = self._deltas.setdefault(src, {})
+            window[seq] = blob
+            # Prune against the window MAX, not this message's seq: a
+            # reordered old delta must not re-enter past the keep bound.
+            hi = max(window)
+            for s in [s for s in window if s <= hi - keep]:
+                del window[s]
+        self.membership.observe(src)
+        self.membership.absorb(heard)
+
+    # -- Transport reads ---------------------------------------------------
+
+    def members(self) -> List[str]:
+        return self.membership.members()
+
+    def peers(self) -> List[str]:
+        return [m for m in self.members() if m != self.member]
+
+    def alive_members(self, timeout_s: float) -> List[str]:
+        return self.membership.alive(timeout_s)
+
+    def fetch(self, member: str) -> Optional[bytes]:
+        return self._snaps.get(member)
+
+    def fetch_head(self, member: str, n: int) -> Optional[bytes]:
+        blob = self._snaps.get(member)
+        return None if blob is None else blob[:n]
+
+    def snapshot_members(self) -> List[str]:
+        return sorted(self._snaps)
+
+    def fetch_delta(self, member: str, seq: int) -> Optional[bytes]:
+        return self._deltas.get(member, {}).get(seq)
+
+    def delta_seqs(self, member: str) -> List[int]:
+        return sorted(self._deltas.get(member, {}))
+
+    def delta_members(self) -> List[str]:
+        return sorted(self._deltas)
+
+    def close(self) -> None:
+        pass

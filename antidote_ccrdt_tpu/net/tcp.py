@@ -1,0 +1,409 @@
+"""Real TCP gossip peer: push-replicated blobs over `{packet,4}` frames.
+
+Wire: the bridge's framing (`bridge.protocol.pack_frame`/`unpack_frames`
+— u32_be length + ETF payload), so a BEAM host could join the gossip
+mesh natively. Frame terms (member names as utf-8 binaries, `heard` the
+sender's piggybacked `Membership.heard_ages` map):
+
+    {snap,  Member, Blob, Heard}
+    {delta, Member, Seq, Keep, Blob, Heard}
+    {ping,  Member, Heard}
+
+Topology: full mesh over a static address book. Each member keeps ONE
+outgoing connection per peer (`_PeerLink`) feeding from a bounded send
+queue; inbound connections are accept-and-read only. Received blobs land
+in local caches, so the `Transport` fetch surface is a local dict read —
+anti-entropy stays pull-shaped above (`sweep_deltas` chains whatever has
+arrived) while the medium is push-shaped below.
+
+Failure behavior (the design goal: DEGRADE, never hang):
+
+* connects/sends carry timeouts; a stalled peer costs the sender thread,
+  never the caller;
+* reconnects retry forever with exponential backoff + jitter (metrics:
+  `net.retries`) — a dead peer is cheap to keep trying;
+* the send queue is bounded with a drop-oldest-delta-keep-anchor policy:
+  deltas are join-decomposed (`parallel.delta`), so a dropped delta only
+  breaks the receiver's chain, and the periodically-published full
+  anchor resyncs the gap (`sweep_deltas`'s fallback). Snapshots are
+  latest-wins — a newly queued anchor replaces any queued older one;
+* liveness comes from `net.membership` fed by every received frame, so
+  a stalled peer decays ALIVE -> SUSPECT -> DEAD instead of blocking.
+
+Frames are ENCODED AT SEND TIME (the queue holds builders, not bytes) so
+piggybacked ages are measured when the frame actually leaves — a frame
+that sat queued behind a dead link must not deliver stale "I heard X
+recently" claims.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import struct
+import threading
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..bridge.protocol import pack_frame, unpack_frames
+from ..core.etf import Atom
+from ..utils.metrics import Metrics
+from .membership import Membership
+
+A_SNAP = Atom("snap")
+A_DELTA = Atom("delta")
+A_PING = Atom("ping")
+
+_SNAP, _DELTA, _PING = "snap", "delta", "ping"
+
+
+class _PeerLink:
+    """One outgoing connection: bounded queue + sender thread with
+    backoff. `enqueue` never blocks the caller; the queue policy keeps
+    at most one snapshot (latest anchor) and one pending ping, and sheds
+    the OLDEST delta first when full."""
+
+    def __init__(
+        self,
+        addr: Tuple[str, int],
+        rng: random.Random,
+        metrics: Metrics,
+        queue_max: int,
+        connect_timeout: float,
+        send_timeout: float,
+        backoff_base: float,
+        backoff_max: float,
+    ):
+        self.addr = addr
+        self.rng = rng
+        self.metrics = metrics
+        self.queue_max = queue_max
+        self.connect_timeout = connect_timeout
+        self.send_timeout = send_timeout
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self._q: deque = deque()  # (kind, build_frame: () -> bytes)
+        self._cv = threading.Condition()
+        self._stop = False
+        self._sock: Optional[socket.socket] = None
+        self._attempts = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def enqueue(self, kind: str, build_frame: Callable[[], bytes]) -> None:
+        with self._cv:
+            if self._stop:
+                return
+            if kind == _SNAP:
+                # Latest-wins anchor: a queued older snapshot is dead weight.
+                stale = [i for i, (k, _) in enumerate(self._q) if k == _SNAP]
+                for i in reversed(stale):
+                    del self._q[i]
+            elif kind == _PING and any(k == _PING for k, _ in self._q):
+                return  # one pending ping is enough liveness signal
+            if len(self._q) >= self.queue_max:
+                # Backpressure: shed the oldest DELTA (anchors resync the
+                # gap); only if no delta is queued shed the oldest frame.
+                for i, (k, _) in enumerate(self._q):
+                    if k == _DELTA:
+                        del self._q[i]
+                        break
+                else:
+                    self._q.popleft()
+                self.metrics.count("net.send_drops")
+            self._q.append((kind, build_frame))
+            self._cv.notify()
+
+    def close(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify()
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._thread.join(timeout=2.0)
+
+    # -- sender thread -----------------------------------------------------
+
+    def _backoff(self) -> float:
+        d = min(self.backoff_max, self.backoff_base * (2.0 ** self._attempts))
+        return d * (0.5 + self.rng.random())  # jitter in [0.5d, 1.5d)
+
+    def _ensure_connected(self) -> bool:
+        if self._sock is not None:
+            return True
+        try:
+            s = socket.create_connection(self.addr, timeout=self.connect_timeout)
+            s.settimeout(self.send_timeout)
+            self._sock = s
+            self._attempts = 0
+            self.metrics.count("net.connects")
+            return True
+        except OSError:
+            self._attempts += 1
+            self.metrics.count("net.retries")
+            return False
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q and not self._stop:
+                    self._cv.wait()
+                if self._stop:
+                    return
+                kind, build = self._q[0]
+            if not self._ensure_connected():
+                with self._cv:
+                    self._cv.wait(timeout=self._backoff())
+                    if self._stop:
+                        return
+                continue
+            frame = build()
+            try:
+                self._sock.sendall(frame)
+            except OSError:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+                self._attempts += 1
+                self.metrics.count("net.retries")
+                continue  # same frame retries after reconnect
+            with self._cv:
+                # Sent: drop it (the queue head may have been reshuffled
+                # by the snap-replacement policy; remove by identity).
+                try:
+                    self._q.remove((kind, build))
+                except ValueError:
+                    pass
+            self.metrics.count("net.frames_sent")
+            self.metrics.count("net.bytes_sent", len(frame))
+
+
+class TcpTransport:
+    """`net.transport.Transport` over real sockets (see module docstring).
+
+    `peers` is the static address book {member: (host, port)}; `bind`
+    may use port 0 (the kernel-assigned address is `self.address`, for
+    rendezvous schemes like the demo's address files). `members()`
+    reports only members actually HEARD FROM (self included) — the
+    address book is connectivity, membership is evidence — so start
+    barriers wait for real traffic, exactly like heartbeat files."""
+
+    def __init__(
+        self,
+        member: str,
+        bind: Tuple[str, int] = ("127.0.0.1", 0),
+        peers: Optional[Dict[str, Tuple[str, int]]] = None,
+        metrics: Optional[Metrics] = None,
+        queue_max: int = 64,
+        connect_timeout: float = 0.5,
+        send_timeout: float = 2.0,
+        backoff_base: float = 0.05,
+        backoff_max: float = 2.0,
+        seed: Optional[int] = None,
+    ):
+        self.member = member
+        self.metrics = metrics if metrics is not None else Metrics()
+        self.membership = Membership(member, metrics=self.metrics)
+        self._rng = random.Random(
+            seed if seed is not None else hash(member) & 0xFFFFFFFF
+        )
+        self._lock = threading.Lock()
+        self._snaps: Dict[str, bytes] = {}
+        self._deltas: Dict[str, Dict[int, bytes]] = {}
+        self._closed = False
+
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind(bind)
+        self._server.listen(16)
+        self.address: Tuple[str, int] = self._server.getsockname()[:2]
+
+        self._link_params = (
+            queue_max, connect_timeout, send_timeout, backoff_base, backoff_max,
+        )
+        self._links: Dict[str, _PeerLink] = {}
+        for name, addr in sorted((peers or {}).items()):
+            self.add_peer(name, addr)
+
+        self._accept_thread = threading.Thread(target=self._accept, daemon=True)
+        self._accept_thread.start()
+
+    def add_peer(self, name: str, addr: Tuple[str, int]) -> None:
+        """Open (or keep) the outgoing link to `name`. Exists because
+        port-0 binds can't know each other's addresses at construction —
+        rendezvous (the demo's addr files) discovers them afterwards."""
+        if name == self.member:
+            return
+        with self._lock:
+            if name in self._links or self._closed:
+                return
+            self._links[name] = _PeerLink(
+                tuple(addr), self._rng, self.metrics, *self._link_params
+            )
+
+    # -- frame builders (called at send time, see module docstring) --------
+
+    def _heard_term(self) -> Dict[bytes, float]:
+        return {
+            m.encode("utf-8"): float(age)
+            for m, age in self.membership.heard_ages().items()
+        }
+
+    def _snap_frame(self, blob: bytes) -> Callable[[], bytes]:
+        mb = self.member.encode("utf-8")
+        return lambda: pack_frame((A_SNAP, mb, blob, self._heard_term()))
+
+    def _delta_frame(self, seq: int, keep: int, blob: bytes) -> Callable[[], bytes]:
+        mb = self.member.encode("utf-8")
+        return lambda: pack_frame((A_DELTA, mb, seq, keep, blob, self._heard_term()))
+
+    def _ping_frame(self) -> Callable[[], bytes]:
+        mb = self.member.encode("utf-8")
+        return lambda: pack_frame((A_PING, mb, self._heard_term()))
+
+    # -- receive path ------------------------------------------------------
+
+    def _accept(self) -> None:
+        while True:
+            try:
+                conn, _peer = self._server.accept()
+            except OSError:
+                return  # server closed
+            threading.Thread(
+                target=self._read_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _read_conn(self, conn: socket.socket) -> None:
+        buf = bytearray()
+        conn.settimeout(None)
+        try:
+            while True:
+                data = conn.recv(1 << 16)
+                if not data:
+                    return
+                buf.extend(data)
+                self.metrics.count("net.bytes_recv", len(data))
+                for term in unpack_frames(buf):
+                    self._handle(term)
+        except (OSError, ValueError):
+            return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, term) -> None:
+        self.metrics.count("net.frames_recv")
+        tag = term[0]
+        if tag == A_SNAP:
+            _, mb, blob, heard = term
+            m = mb.decode("utf-8")
+            with self._lock:
+                # Ordered within one link, but reconnects can interleave:
+                # only a step-header >= the cached one replaces the anchor.
+                old = self._snaps.get(m)
+                if (
+                    old is None
+                    or len(blob) < 8
+                    or struct.unpack("<Q", blob[:8])[0]
+                    >= struct.unpack("<Q", old[:8])[0]
+                ):
+                    self._snaps[m] = blob
+        elif tag == A_DELTA:
+            _, mb, seq, keep, blob, heard = term
+            m = mb.decode("utf-8")
+            with self._lock:
+                window = self._deltas.setdefault(m, {})
+                window[int(seq)] = blob
+                # Prune against the window MAX: reconnect interleavings can
+                # deliver an old delta late — it must not re-enter past the
+                # keep bound.
+                hi = max(window)
+                for s in [s for s in window if s <= hi - keep]:
+                    del window[s]
+        elif tag == A_PING:
+            _, mb, heard = term
+            m = mb.decode("utf-8")
+        else:
+            return  # unknown frame: ignore (forward compatibility)
+        self.membership.observe(m)
+        self.membership.absorb(
+            {k.decode("utf-8"): v for k, v in heard.items()}
+        )
+
+    # -- Transport: liveness ----------------------------------------------
+
+    def heartbeat(self) -> None:
+        for link in self._links.values():
+            link.enqueue(_PING, self._ping_frame())
+
+    def members(self) -> List[str]:
+        return self.membership.members()
+
+    def peers(self) -> List[str]:
+        return [m for m in self.members() if m != self.member]
+
+    def alive_members(self, timeout_s: float) -> List[str]:
+        return self.membership.alive(timeout_s)
+
+    # -- Transport: snapshots ---------------------------------------------
+
+    def publish(self, blob: bytes) -> None:
+        with self._lock:
+            self._snaps[self.member] = blob
+        for link in self._links.values():
+            link.enqueue(_SNAP, self._snap_frame(blob))
+
+    def fetch(self, member: str) -> Optional[bytes]:
+        with self._lock:
+            return self._snaps.get(member)
+
+    def fetch_head(self, member: str, n: int) -> Optional[bytes]:
+        with self._lock:
+            blob = self._snaps.get(member)
+        return None if blob is None else blob[:n]
+
+    def snapshot_members(self) -> List[str]:
+        with self._lock:
+            return sorted(self._snaps)
+
+    # -- Transport: deltas -------------------------------------------------
+
+    def publish_delta(self, seq: int, blob: bytes, keep: int = 16) -> None:
+        with self._lock:
+            window = self._deltas.setdefault(self.member, {})
+            window[seq] = blob
+            for s in [s for s in window if s <= seq - keep]:
+                del window[s]
+        for link in self._links.values():
+            link.enqueue(_DELTA, self._delta_frame(seq, keep, blob))
+
+    def fetch_delta(self, member: str, seq: int) -> Optional[bytes]:
+        with self._lock:
+            return self._deltas.get(member, {}).get(seq)
+
+    def delta_seqs(self, member: str) -> List[int]:
+        with self._lock:
+            return sorted(self._deltas.get(member, {}))
+
+    def delta_members(self) -> List[str]:
+        with self._lock:
+            return sorted(self._deltas)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        for link in self._links.values():
+            link.close()

@@ -1,0 +1,335 @@
+"""Blob-plane `Transport` protocol + filesystem transport + state facade.
+
+Extraction of the medium out of `parallel.elastic.GossipStore`: the
+gossip tier's needs reduce to publishing/fetching OPAQUE BYTES keyed by
+(member, kind, seq) plus a liveness surface. Everything the engines care
+about — checkpoint headers, treedef validation, delta chaining — lives
+ABOVE the medium in `GossipNode`, so `DeltaPublisher`, `sweep_deltas`,
+`sweep`, and `my_replicas` run unchanged over a shared directory
+(`FsTransport`), real sockets (`net.tcp.TcpTransport`), or the
+deterministic simulator (`net.sim.SimTransport`).
+
+Blob formats are transport-invariant:
+
+* snapshot blob = ``u64le step ++ core.serial.dumps_dense(name, state)``
+  (identical bytes to `harness.checkpoint.save_dense_checkpoint`, so
+  on-disk artifacts from older rounds remain readable);
+* delta blob    = ``core.serial.dumps_dense(f"{name}_delta", delta)``.
+
+Heartbeats: `FsTransport` writes an 8-byte little-endian wall-clock
+timestamp PAYLOAD into `hb-<member>` (atomic replace) and reads that —
+file mtime is only the fallback for empty/foreign heartbeat files,
+because mtime is flaky on coarse-granularity or object-store-backed
+filesystems (the round-5 GossipStore relied on mtime alone). Socket and
+sim transports track liveness via `net.membership` instead.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import time
+from typing import Any, List, Optional, Protocol, Tuple, runtime_checkable
+
+from ..utils.metrics import Metrics
+
+
+@runtime_checkable
+class Transport(Protocol):
+    """What a gossip medium must provide. Blobs are opaque bytes; `seq`
+    namespacing and retention (`keep`) follow the delta-shipping
+    discipline documented in `parallel.delta`. All methods must be
+    total: a missing/torn/unreachable artifact reads as None/[], never
+    an exception — join-based gossip retries on the next sweep."""
+
+    member: str
+
+    # -- liveness ----------------------------------------------------------
+    def heartbeat(self) -> None: ...
+    def members(self) -> List[str]: ...
+    def alive_members(self, timeout_s: float) -> List[str]: ...
+
+    # -- snapshots (latest-wins, one slot per member) ----------------------
+    def publish(self, blob: bytes) -> None: ...
+    def fetch(self, member: str) -> Optional[bytes]: ...
+    def fetch_head(self, member: str, n: int) -> Optional[bytes]: ...
+    def snapshot_members(self) -> List[str]: ...
+
+    # -- deltas (per-member seq-keyed window) ------------------------------
+    def publish_delta(self, seq: int, blob: bytes, keep: int = 16) -> None: ...
+    def fetch_delta(self, member: str, seq: int) -> Optional[bytes]: ...
+    def delta_seqs(self, member: str) -> List[int]: ...
+    def delta_members(self) -> List[str]: ...
+
+    def close(self) -> None: ...
+
+    def peers(self) -> List[str]:
+        """Everyone ever seen, excluding self."""
+        ...
+
+
+class FsTransport:
+    """Shared-directory medium (the round-5 `GossipStore` file layout).
+
+    Layout: `<root>/snap-<member>` (latest snapshot blob, atomic
+    replace), `<root>/delta-<member>-<seq:08d>`, `<root>/hb-<member>`
+    (8-byte timestamp payload, mtime fallback). One writer per member
+    id; any number of readers."""
+
+    def __init__(self, root: str, member: str, metrics: Optional[Metrics] = None):
+        self.root = root
+        self.member = member
+        self.metrics = metrics if metrics is not None else Metrics()
+        os.makedirs(root, exist_ok=True)
+        self.heartbeat()
+
+    # -- liveness ----------------------------------------------------------
+
+    def heartbeat(self) -> None:
+        p = os.path.join(self.root, f"hb-{self.member}")
+        tmp = f"{p}.tmp-{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(struct.pack("<d", time.time()))
+        os.replace(tmp, p)
+
+    def _heartbeat_age(self, member: str) -> Optional[float]:
+        """Seconds since `member` last beat, or None (no evidence).
+        Reads the timestamp payload; falls back to file mtime for
+        empty/short files (a foreign writer using the pre-payload
+        format, or a torn write)."""
+        p = os.path.join(self.root, f"hb-{member}")
+        try:
+            with open(p, "rb") as f:
+                payload = f.read(8)
+            if len(payload) == 8:
+                return time.time() - struct.unpack("<d", payload)[0]
+            return time.time() - os.path.getmtime(p)
+        except OSError:
+            return None
+
+    def members(self) -> List[str]:
+        return sorted(
+            f[3:]
+            for f in os.listdir(self.root)
+            if f.startswith("hb-") and ".tmp" not in f
+        )
+
+    def peers(self) -> List[str]:
+        return [m for m in self.members() if m != self.member]
+
+    def alive_members(self, timeout_s: float) -> List[str]:
+        """Members whose heartbeat is fresher than `timeout_s`. Always
+        includes self (a member never suspects itself)."""
+        out = []
+        for m in self.members():
+            if m == self.member:
+                out.append(m)
+                continue
+            age = self._heartbeat_age(m)
+            if age is not None and age <= timeout_s:
+                out.append(m)
+        return sorted(out)
+
+    # -- snapshots ---------------------------------------------------------
+
+    def publish(self, blob: bytes) -> None:
+        path = os.path.join(self.root, f"snap-{self.member}")
+        tmp = f"{path}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        self.heartbeat()
+
+    def fetch(self, member: str) -> Optional[bytes]:
+        try:
+            with open(os.path.join(self.root, f"snap-{member}"), "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def fetch_head(self, member: str, n: int) -> Optional[bytes]:
+        try:
+            with open(os.path.join(self.root, f"snap-{member}"), "rb") as f:
+                return f.read(n)
+        except OSError:
+            return None
+
+    def snapshot_members(self) -> List[str]:
+        return sorted(
+            f[5:]
+            for f in os.listdir(self.root)
+            if f.startswith("snap-") and not f.endswith(".tmp")
+        )
+
+    # -- deltas ------------------------------------------------------------
+
+    def publish_delta(self, seq: int, blob: bytes, keep: int = 16) -> None:
+        path = os.path.join(self.root, f"delta-{self.member}-{seq:08d}")
+        tmp = f"{path}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+        self.heartbeat()
+        for s in self.delta_seqs(self.member):
+            if s <= seq - keep:
+                try:
+                    os.remove(
+                        os.path.join(self.root, f"delta-{self.member}-{s:08d}")
+                    )
+                except OSError:
+                    pass
+
+    def fetch_delta(self, member: str, seq: int) -> Optional[bytes]:
+        try:
+            with open(
+                os.path.join(self.root, f"delta-{member}-{seq:08d}"), "rb"
+            ) as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def delta_seqs(self, member: str) -> List[int]:
+        pre = f"delta-{member}-"
+        out = []
+        for f in os.listdir(self.root):
+            if f.startswith(pre) and not f.endswith(".tmp"):
+                try:
+                    out.append(int(f[len(pre):]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def delta_members(self) -> List[str]:
+        # Strip "delta-" prefix and "-<seq>" suffix (member names may
+        # themselves contain dashes).
+        return sorted(
+            {
+                f[len("delta-"):].rsplit("-", 1)[0]
+                for f in os.listdir(self.root)
+                if f.startswith("delta-") and not f.endswith(".tmp")
+            }
+        )
+
+    def close(self) -> None:
+        pass
+
+
+class GossipNode:
+    """State-plane facade over any `Transport`: the exact surface the
+    round-5 `GossipStore` exposed to `parallel.elastic`, so every gossip
+    entry point (`DeltaPublisher`, `sweep`, `sweep_deltas`,
+    `my_replicas`) and drill runs unchanged over filesystem, TCP, or
+    simulated media.
+
+    Encoding/decoding and validation live here (not in transports):
+    snapshot blobs carry the dense-checkpoint layout, fetches are TOTAL
+    (any decode/validation failure reads as None — a torn concurrent
+    write or a peer on a mismatched engine config must be skipped, not
+    crash the gossip loop; the next sweep retries)."""
+
+    def __init__(self, transport: Transport, metrics: Optional[Metrics] = None):
+        self.transport = transport
+        self.member = transport.member
+        self.metrics = (
+            metrics
+            if metrics is not None
+            else getattr(transport, "metrics", None) or Metrics()
+        )
+
+    # -- liveness (delegated) ----------------------------------------------
+
+    def heartbeat(self) -> None:
+        self.transport.heartbeat()
+
+    def members(self) -> List[str]:
+        return self.transport.members()
+
+    def alive_members(self, timeout_s: float) -> List[str]:
+        return self.transport.alive_members(timeout_s)
+
+    # -- snapshots ---------------------------------------------------------
+
+    def publish(self, name: str, state: Any, step: int) -> None:
+        """Atomically publish this member's state at `step` (and beat)."""
+        from ..core import serial
+
+        blob = struct.pack("<Q", step) + serial.dumps_dense(name, state)
+        self.metrics.count("net.snap_publishes")
+        self.metrics.count("net.snap_bytes", len(blob))
+        self.transport.publish(blob)
+
+    def fetch(
+        self, member: str, like: Any, dense: Any = None
+    ) -> Optional[Tuple[int, Any]]:
+        """Latest (step, state) published by `member`, or None. ANY decode
+        or validation failure reads as None — see class docstring."""
+        from ..core import serial
+
+        blob = self.transport.fetch(member)
+        if blob is None:
+            return None
+        try:
+            (step,) = struct.unpack("<Q", blob[:8])
+            _name, state = serial.loads_dense(blob[8:], like)
+            if dense is not None:
+                from ..utils.validate import check_state
+
+                check_state(dense, state)
+        except Exception:  # noqa: BLE001 — deliberately total, see docstring
+            return None
+        self.metrics.count("net.snap_fetches")
+        return step, state
+
+    def snapshot_seq(self, member: str) -> Optional[int]:
+        """Seq/step of `member`'s snapshot from its 8-byte header —
+        without parsing the (large) payload."""
+        hdr = self.transport.fetch_head(member, 8)
+        if hdr is None or len(hdr) < 8:
+            return None
+        return struct.unpack("<Q", hdr)[0]
+
+    def snapshot_members(self) -> List[str]:
+        return self.transport.snapshot_members()
+
+    # -- deltas ------------------------------------------------------------
+
+    def publish_delta(self, delta_blob: bytes, seq: int, keep: int = 16) -> None:
+        """Atomically publish a serialized delta at `seq`; retain only the
+        last `keep` (receivers that fall off the window resync from the
+        full snapshot)."""
+        self.metrics.count("net.delta_publishes")
+        self.metrics.count("net.delta_bytes", len(delta_blob))
+        self.transport.publish_delta(seq, delta_blob, keep=keep)
+
+    def fetch_delta(
+        self, member: str, seq: int, like_delta: Any, validate=None
+    ) -> Optional[Any]:
+        """Deserialized delta at `seq`, or None (missing/torn/pruned/
+        mis-configured — same total-failure policy as `fetch`). `validate`
+        (delta -> bool) rejects structurally-decodable deltas from a peer
+        on a DIFFERENT engine config before expansion can index out of
+        range downstream."""
+        from ..core import serial
+
+        blob = self.transport.fetch_delta(member, seq)
+        if blob is None:
+            return None
+        try:
+            _name, delta = serial.loads_dense(blob, like_delta)
+            if validate is not None and not validate(delta):
+                return None
+        except Exception:  # noqa: BLE001 — see fetch
+            return None
+        self.metrics.count("net.delta_fetches")
+        return delta
+
+    def delta_seqs(self, member: str) -> List[int]:
+        return self.transport.delta_seqs(member)
+
+    def delta_members(self) -> List[str]:
+        return self.transport.delta_members()
+
+    def close(self) -> None:
+        self.transport.close()

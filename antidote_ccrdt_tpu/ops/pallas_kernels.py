@@ -43,6 +43,16 @@ from jax.experimental.pallas import tpu as pltpu
 
 from . import dense_table
 
+
+def _side_effect_params():
+    """`pltpu.CompilerParams(has_side_effects=True)` where pallas has it
+    (JAX >= 0.6); 0.4.x pallas has no side-effect channel at all, and the
+    DMA kernel's correctness rides on the input/output alias either way —
+    the flag only guards the store against DCE when outputs go unused."""
+    if hasattr(pltpu, "CompilerParams"):
+        return pltpu.CompilerParams(has_side_effects=True)
+    return None
+
 # Python int (not a jnp scalar): pallas kernels may not capture traced
 # constants, and pad values must be static anyway. int() keeps the value
 # coupled to the XLA reference path's sentinel.
@@ -270,7 +280,7 @@ def scatter_max_rows_pallas(table, rows, upd, interpret: bool = False):
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((R, T, D), jnp.int32),
         input_output_aliases={1: 0},
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        compiler_params=_side_effect_params(),
         interpret=interpret,
     )(rows, table, upd)
 

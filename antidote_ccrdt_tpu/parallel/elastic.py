@@ -20,8 +20,13 @@ built the CRDT way rather than the collective way:
   state and keep going".
 
 Pieces:
-* `GossipStore` — publish/fetch member snapshots + mtime heartbeats in a
+* `GossipStore` — publish/fetch member snapshots + heartbeats in a
   shared directory (atomic rename writes; `harness.checkpoint` format).
+  Since the net/ tier it is the filesystem INSTANCE of the pluggable
+  transport surface: `net.transport.GossipNode` over `FsTransport`.
+  Every entry point below takes any `GossipNode` — sockets
+  (`net.tcp.TcpTransport`) and the deterministic chaos simulator
+  (`net.sim.SimTransport`) gossip through the same code paths.
 * `alive_members` / `owners` — timeout failure detector + the
   deterministic replica→member assignment everyone recomputes from the
   alive set alone (no coordinator, no consensus: ownership only affects
@@ -37,156 +42,22 @@ scripts/elastic_demo.py + tests/test_elastic.py.
 
 from __future__ import annotations
 
-import os
-import struct
-import time
 from typing import Any, Dict, List, Optional, Tuple
 
-import numpy as np
-
-from ..harness.checkpoint import load_dense_checkpoint, save_dense_checkpoint
+from ..net.transport import FsTransport, GossipNode
+from ..utils.metrics import Metrics
 from .delta import empty_delta  # noqa: F401 — part of this module's API
 
 
-class GossipStore:
-    """Shared-directory snapshot exchange with heartbeat files.
+class GossipStore(GossipNode):
+    """Shared-directory gossip node (the historical name and constructor,
+    kept so no caller breaks): `GossipNode` over `net.transport
+    .FsTransport`. See net/transport.py for the file layout and the
+    timestamp-payload heartbeat format."""
 
-    Layout: `<root>/snap-<member>` (latest lattice state, atomic replace)
-    and `<root>/hb-<member>` (empty file; mtime = last heartbeat). One
-    writer per member id; any number of readers."""
-
-    def __init__(self, root: str, member: str):
+    def __init__(self, root: str, member: str, metrics: Optional[Metrics] = None):
+        super().__init__(FsTransport(root, member, metrics=metrics))
         self.root = root
-        self.member = member
-        os.makedirs(root, exist_ok=True)
-        self.heartbeat()
-
-    # -- liveness ----------------------------------------------------------
-
-    def heartbeat(self) -> None:
-        p = os.path.join(self.root, f"hb-{self.member}")
-        with open(p, "a"):
-            os.utime(p, None)
-
-    def members(self) -> List[str]:
-        return sorted(
-            f[3:] for f in os.listdir(self.root) if f.startswith("hb-")
-        )
-
-    def alive_members(self, timeout_s: float) -> List[str]:
-        """Members whose heartbeat is fresher than `timeout_s`. Always
-        includes self (a member never suspects itself)."""
-        now = time.time()
-        out = []
-        for m in self.members():
-            if m == self.member:
-                out.append(m)
-                continue
-            try:
-                age = now - os.path.getmtime(os.path.join(self.root, f"hb-{m}"))
-            except OSError:
-                continue
-            if age <= timeout_s:
-                out.append(m)
-        return sorted(out)
-
-    # -- snapshots ---------------------------------------------------------
-
-    def publish(self, name: str, state: Any, step: int) -> None:
-        """Atomically publish this member's state at `step` (and beat)."""
-        save_dense_checkpoint(
-            os.path.join(self.root, f"snap-{self.member}"), name, state, step
-        )
-        self.heartbeat()
-
-    def fetch(
-        self, member: str, like: Any, dense: Any = None
-    ) -> Optional[Tuple[int, Any]]:
-        """Latest (step, state) published by `member`, or None. ANY decode
-        or validation failure reads as None — torn concurrent writes raise
-        struct.error/BadZipFile (not OSError/ValueError), and a peer
-        publishing under a mismatched engine config must be skipped, not
-        crash the gossip loop: join-based gossip never needs any single
-        fetch to succeed, the next sweep retries."""
-        path = os.path.join(self.root, f"snap-{member}")
-        try:
-            step, _name, state = load_dense_checkpoint(path, like, dense=dense)
-        except Exception:  # noqa: BLE001 — deliberately total, see docstring
-            return None
-        return step, state
-
-    def snapshot_members(self) -> List[str]:
-        return sorted(
-            f[5:]
-            for f in os.listdir(self.root)
-            if f.startswith("snap-") and not f.endswith(".tmp")
-        )
-
-
-    # -- delta publishes (delta-state replication, parallel/delta.py) ------
-
-    def snapshot_seq(self, member: str) -> Optional[int]:
-        """Seq/step of `member`'s full snapshot from its 8-byte header —
-        without parsing the (large) payload."""
-        try:
-            with open(os.path.join(self.root, f"snap-{member}"), "rb") as f:
-                hdr = f.read(8)
-            if len(hdr) < 8:
-                return None
-            return struct.unpack("<Q", hdr)[0]
-        except OSError:
-            return None
-
-    def publish_delta(self, delta_blob: bytes, seq: int, keep: int = 16) -> None:
-        """Atomically publish a serialized delta at `seq`; prune deltas
-        older than `seq - keep` (receivers that fall off the retained
-        window resync from the full snapshot)."""
-        path = os.path.join(self.root, f"delta-{self.member}-{seq:08d}")
-        tmp = f"{path}.tmp"
-        with open(tmp, "wb") as f:
-            f.write(delta_blob)
-        os.replace(tmp, path)
-        self.heartbeat()
-        for s in self.delta_seqs(self.member):
-            if s <= seq - keep:
-                try:
-                    os.remove(
-                        os.path.join(self.root, f"delta-{self.member}-{s:08d}")
-                    )
-                except OSError:
-                    pass
-
-    def delta_seqs(self, member: str) -> List[int]:
-        pre = f"delta-{member}-"
-        out = []
-        for f in os.listdir(self.root):
-            if f.startswith(pre) and not f.endswith(".tmp"):
-                try:
-                    out.append(int(f[len(pre):]))
-                except ValueError:
-                    continue
-        return sorted(out)
-
-    def fetch_delta(
-        self, member: str, seq: int, like_delta: Any, validate=None
-    ) -> Optional[Any]:
-        """Deserialized delta at `seq`, or None (missing/torn/pruned/
-        mis-configured — same total-failure policy as `fetch`). `validate`
-        (delta -> bool) rejects structurally-decodable deltas from a peer
-        on a DIFFERENT engine config (loads_dense checks only the treedef)
-        before expansion can index out of range downstream."""
-        from ..core import serial
-
-        path = os.path.join(self.root, f"delta-{member}-{seq:08d}")
-        try:
-            with open(path, "rb") as f:
-                data = f.read()
-            _name, delta = serial.loads_dense(data, like_delta)
-            if validate is not None and not validate(delta):
-                return None
-        except Exception:  # noqa: BLE001 — see fetch
-            return None
-        return delta
 
 
 class DeltaPublisher:
@@ -200,7 +71,7 @@ class DeltaPublisher:
     enforced at the first publish)."""
 
     def __init__(
-        self, store: GossipStore, dense: Any, name: Optional[str] = None,
+        self, store: GossipNode, dense: Any, name: Optional[str] = None,
         full_every: int = 8, keep: int = 16,
     ):
         from ..core import serial
@@ -250,7 +121,7 @@ class DeltaPublisher:
 
 
 def sweep_deltas(
-    store: GossipStore, dense: Any, state: Any, cursors: Dict[str, int]
+    store: GossipNode, dense: Any, state: Any, cursors: Dict[str, int]
 ) -> Tuple[Any, Dict[str, Any]]:
     """Delta-aware sweep: per peer, chain contiguous deltas from the
     cursor; on a gap (pruned, torn, or never-seen member) resync from the
@@ -285,14 +156,7 @@ def sweep_deltas(
             cur += 1
         return cur
 
-    # Members with any delta file: strip "delta-" prefix and "-<seq>"
-    # suffix (member names may themselves contain dashes).
-    delta_members = {
-        f[len("delta-"):].rsplit("-", 1)[0]
-        for f in os.listdir(store.root)
-        if f.startswith("delta-") and not f.endswith(".tmp")
-    }
-    for m in sorted(set(store.snapshot_members()) | delta_members):
+    for m in sorted(set(store.snapshot_members()) | set(store.delta_members())):
         if m == store.member:
             continue
         cur = cursors.get(m, -1)
@@ -328,7 +192,7 @@ def owners(alive: List[str], n_replicas: int) -> Dict[int, str]:
     return {r: alive[r % len(alive)] for r in range(n_replicas)}
 
 
-def my_replicas(store: GossipStore, n_replicas: int, timeout_s: float) -> List[int]:
+def my_replicas(store: GossipNode, n_replicas: int, timeout_s: float) -> List[int]:
     own = owners(store.alive_members(timeout_s), n_replicas)
     return [r for r, m in own.items() if m == store.member]
 
@@ -354,7 +218,7 @@ def _resolve_monoid(dense: Any, state: Any, where: str) -> Tuple[Any, Any]:
     return dense, state
 
 
-def sweep(store: GossipStore, dense: Any, state: Any) -> Tuple[Any, int]:
+def sweep(store: GossipNode, dense: Any, state: Any) -> Tuple[Any, int]:
     """Fold every peer's latest snapshot into `state` with the engine
     join. Returns (state, n_merged). Self's snapshot is skipped (already
     reflected); stale or concurrent publishes are safe by idempotence

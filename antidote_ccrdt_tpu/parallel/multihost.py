@@ -30,6 +30,7 @@ The public pieces:
 
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, Optional
 
 import numpy as np
@@ -50,7 +51,23 @@ def initialize(
     if cpu_devices_per_process is not None:
         try:
             jax.config.update("jax_platforms", "cpu")
-            jax.config.update("jax_num_cpu_devices", cpu_devices_per_process)
+            try:
+                # Cross-process computations on the CPU backend need a real
+                # collectives implementation (default "none" raises
+                # "Multiprocess computations aren't implemented").
+                jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            except (AttributeError, ValueError):
+                pass  # newer JAX enables CPU collectives by default
+            try:
+                jax.config.update("jax_num_cpu_devices", cpu_devices_per_process)
+            except AttributeError:
+                # Older JAX has no such option; the XLA flag read at the
+                # (not yet done) backend init provisions the same devices.
+                os.environ["XLA_FLAGS"] = (
+                    os.environ.get("XLA_FLAGS", "")
+                    + " --xla_force_host_platform_device_count="
+                    + str(cpu_devices_per_process)
+                ).strip()
         except RuntimeError as e:
             raise RuntimeError(
                 "initialize() must run before the first JAX device op — "
@@ -123,8 +140,9 @@ def hierarchical_reconcile(state: Any, merge: Callable[[Any, Any], Any], mesh):
     silently under-joining.
     """
     import jax
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ..utils.jaxcompat import shard_map
 
     from .dist import lattice_all_reduce
 

@@ -35,10 +35,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.topk_rmv_dense import Observed, TopkRmvDense, TopkRmvOps, make_dense
+from ..utils.jaxcompat import shard_map
 from .dist import lattice_all_reduce
 
 

@@ -1,0 +1,26 @@
+"""Version-compat shims for the JAX surface this repo spans.
+
+The package is written against the current JAX spelling (top-level
+`jax.shard_map`, `check_vma=` keyword); pinned CI images ship 0.4.x
+where the same primitive lives in `jax.experimental.shard_map` and the
+replication check is spelled `check_rep`. Call sites import `shard_map`
+from here and always use the new spelling — the wrapper translates when
+running on an old release.
+"""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _shard_map  # JAX >= 0.6
+
+    _NEW_API = True
+except ImportError:  # 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _NEW_API = False
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+    if check_vma is not None:
+        kw["check_vma" if _NEW_API else "check_rep"] = check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)

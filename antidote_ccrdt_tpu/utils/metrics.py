@@ -24,6 +24,7 @@ Usage:
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional
 
@@ -56,18 +57,27 @@ class LatencyRecorder:
 
 
 class Metrics:
-    """Named counters + latency recorders. One instance per harness run."""
+    """Named counters + latency recorders. One instance per harness run.
+
+    Counter updates are guarded by a lock: the net/ transports bump
+    counters from sender/reader threads concurrently with the gossip
+    loop, and an unguarded read-modify-write would silently drop counts
+    (list.append in `timer` is atomic under the GIL; the += on a dict
+    slot is not)."""
 
     def __init__(self) -> None:
         self.counters: Dict[str, float] = {}
         self.latencies: Dict[str, LatencyRecorder] = {}
         self._t0 = time.perf_counter()
+        self._lock = threading.Lock()
 
     def count(self, name: str, delta: float = 1.0) -> None:
-        self.counters[name] = self.counters.get(name, 0.0) + delta
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0.0) + delta
 
     def set(self, name: str, value: float) -> None:
-        self.counters[name] = value
+        with self._lock:
+            self.counters[name] = value
 
     @contextlib.contextmanager
     def timer(self, name: str) -> Iterator[None]:

@@ -6,7 +6,9 @@ rebar.config:5). Python 3.12's sys.monitoring (PEP 669) makes a real
 line-coverage tool ~60 lines: register a LINE callback, record the first
 hit per location, and return sys.monitoring.DISABLE so every subsequent
 execution of that location costs nothing — the suite runs at near-native
-speed.
+speed. On older interpreters (no PEP 669) a `sys.settrace` fallback
+produces the identical executed-line sets, just without the
+disable-after-first-hit speedup.
 
 Executable-line ground truth comes from compiling each source file and
 walking the code-object tree's co_lines() — the same universe coverage.py
@@ -66,6 +68,41 @@ def executable_lines(path: str) -> set:
 
 
 def _start_monitor():
+    if hasattr(sys, "monitoring"):
+        return _start_monitor_pep669()
+    return _start_monitor_settrace()
+
+
+def _start_monitor_settrace():
+    """Pre-3.12 fallback: classic `sys.settrace` line tracing. Slower —
+    every package-frame line re-fires the callback (no per-location
+    DISABLE) — but the executed set and shard format are identical.
+    Frames outside the package return None from their 'call' event, so
+    no line events are generated for them at all."""
+    import threading
+
+    executed: dict = {}
+    prefix = PKG + os.sep
+
+    def tracer(frame, event, arg):
+        code = frame.f_code
+        if event == "call":
+            return tracer if code.co_filename.startswith(prefix) else None
+        if event == "line":
+            executed.setdefault(code.co_filename, set()).add(frame.f_lineno)
+        return tracer
+
+    threading.settrace(tracer)
+    sys.settrace(tracer)
+
+    def stop():
+        sys.settrace(None)
+        threading.settrace(None)
+
+    return executed, stop
+
+
+def _start_monitor_pep669():
     executed: dict = {}
     mon = sys.monitoring
     TOOL = mon.COVERAGE_ID
@@ -96,7 +133,11 @@ def install_child_cover():
     out_dir = os.environ.get("CCRDT_COVER_DIR")
     if not out_dir:
         return
-    if sys.monitoring.get_tool(sys.monitoring.COVERAGE_ID) is not None:
+    if hasattr(sys, "monitoring"):
+        already = sys.monitoring.get_tool(sys.monitoring.COVERAGE_ID) is not None
+    else:
+        already = sys.gettrace() is not None
+    if already:
         # Already inside a monitored interpreter: the parent cover run
         # imported this entry point in-process (tests do that too) — its
         # monitor sees these lines directly.

@@ -288,18 +288,36 @@ def main() -> None:
 
     jax.config.update("jax_platforms", "cpu")
 
+    from antidote_ccrdt_tpu.parallel.elastic import GossipStore
+
+    drill = DRILLS[args.type]
+    dense = drill.make_engine()
+    state = drill.init(dense)
+    if args.join_late > 0:
+        # Late join: compile the engine first (apply a no-op batch), THEN
+        # register — from the fleet's view the member appears and is
+        # immediately productive.
+        state = drill.apply(dense, state, 0, [])
+        time.sleep(args.join_late)
+    store = GossipStore(args.root, args.member)
+    run_worker(store, drill, dense, state, args, result_dir=args.root)
+
+
+def run_worker(store, drill, dense, state, args, result_dir):
+    """The drill body, transport-agnostic: `store` is any GossipNode
+    (shared-directory here; scripts/net_gossip_demo.py reuses this loop
+    over TCP). Heartbeats in a daemon thread, deterministic op streams
+    for owned replicas, ownership-grows adoption, publish/sweep rounds,
+    and a final convergence barrier; writes final-<member>.json (digest +
+    alive view + metrics counters) into `result_dir`."""
     from antidote_ccrdt_tpu.parallel.elastic import (
         DeltaPublisher,
-        GossipStore,
         my_replicas,
         sweep,
         sweep_deltas,
     )
 
-    drill = DRILLS[args.type]
-    dense = drill.make_engine()
-    state = drill.init(dense)
-    pub = None  # set after the store exists when --delta
+    pub = None  # set below when --delta
     cursors: dict = {}
 
     def do_publish(store, seq_hint):
@@ -317,13 +335,6 @@ def main() -> None:
             swept, stats = sweep(store, dense, view)
         return drill.set_view(dense, st, swept), stats
 
-    if args.join_late > 0:
-        # Late join: compile the engine first (apply a no-op batch), THEN
-        # register — from the fleet's view the member appears and is
-        # immediately productive.
-        state = drill.apply(dense, state, 0, [])
-        time.sleep(args.join_late)
-    store = GossipStore(args.root, args.member)
     if args.delta:
         pub = DeltaPublisher(store, dense, name=drill.publish_name, full_every=4)
 
@@ -364,8 +375,9 @@ def main() -> None:
         owned_prev = owned
         state = drill.apply(dense, state, step, sorted(owned))
         if step % args.publish_every == 0:
-            do_publish(store, step)
-            state, _ = do_sweep(store, state)
+            with store.metrics.timer("net.round"):
+                do_publish(store, step)
+                state, _ = do_sweep(store, state)
         time.sleep(args.step_sleep)
 
     # Final convergence: publish/sweep until every member that ever
@@ -379,6 +391,15 @@ def main() -> None:
     confident_stale = max(1.5 * args.timeout, 0.6)
     deadline = time.time() + 10
     while time.time() < deadline:
+        # Keep adopting here too: a victim whose death is only DETECTED
+        # after the step loop ended (slow failure detection under load)
+        # would otherwise leave its trailing steps applied by no one —
+        # survivors must regenerate its full history before settling.
+        owned = owned_prev | set(my_replicas(store, R, args.timeout))
+        gained = owned - owned_prev
+        if gained:
+            state = drill.adopt(dense, state, sorted(gained), STEPS)
+        owned_prev = owned
         swept, _ = sweep(store, dense, drill.pub_state(dense, state))
         state = drill.set_view(dense, state, swept)
         store.publish(drill.publish_name, drill.pub_state(dense, state), STEPS)
@@ -402,8 +423,9 @@ def main() -> None:
         "member": args.member,
         "alive": store.alive_members(args.timeout),
         "digest": drill.digest(dense, state),
+        "metrics": dict(store.metrics.counters),
     }
-    with open(os.path.join(args.root, f"final-{args.member}.json"), "w") as f:
+    with open(os.path.join(result_dir, f"final-{args.member}.json"), "w") as f:
         json.dump(out, f)
     print(json.dumps(out), flush=True)
 

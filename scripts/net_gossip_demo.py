@@ -1,0 +1,114 @@
+"""Real-socket gossip drill worker: the elastic drill over TCP.
+
+Same drill as scripts/elastic_demo.py (deterministic op streams,
+ownership-grows adoption, convergence to the sequential reference) but
+the medium is `net.tcp.TcpTransport` — real localhost sockets, SWIM
+membership from piggybacked ages, bounded send queues with backoff —
+instead of a shared directory. The shared directory is still used for
+two non-gossip jobs only: address rendezvous (each worker binds port 0
+and publishes `addr-<member>`; a poller thread adds peers as their
+files appear, so late joiners are discovered too) and the
+`final-<member>.json` result drop the supervising test reads.
+
+Run one worker:
+    python scripts/net_gossip_demo.py --root /tmp/g --member w0 --n-members 3
+
+The supervising test (tests/test_net_tcp.py, marked slow) launches
+three, kills one mid-run, and checks the survivors detect the death via
+SWIM timeouts, adopt its replicas, and converge — with the retry/backoff
+counters visible in the result metrics.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts.cover import install_child_cover  # noqa: E402
+
+install_child_cover()  # no-op outside `make cover` runs
+
+from scripts.elastic_demo import DRILLS, run_worker  # noqa: E402
+
+
+def _write_addr(root: str, member: str, addr) -> None:
+    path = os.path.join(root, f"addr-{member}")
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(f"{addr[0]}:{addr[1]}")
+    os.replace(tmp, path)
+
+
+def _read_addrs(root: str) -> dict:
+    out = {}
+    for fn in os.listdir(root):
+        if not fn.startswith("addr-") or ".tmp" in fn:
+            continue
+        try:
+            with open(os.path.join(root, fn)) as f:
+                host, port = f.read().strip().rsplit(":", 1)
+            out[fn[len("addr-"):]] = (host, int(port))
+        except (OSError, ValueError):
+            continue  # torn write: next poll sees it whole
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--root", required=True,
+                    help="rendezvous + results directory (NOT the gossip "
+                    "medium — that is TCP)")
+    ap.add_argument("--member", required=True)
+    ap.add_argument("--n-members", type=int, required=True)
+    ap.add_argument("--type", default="topk_rmv", choices=sorted(DRILLS))
+    ap.add_argument("--die-at", type=int, default=-1)
+    ap.add_argument("--join-late", type=float, default=0.0)
+    ap.add_argument("--hb-interval", type=float, default=0.05)
+    ap.add_argument("--timeout", type=float, default=0.4)
+    ap.add_argument("--step-sleep", type=float, default=0.15)
+    ap.add_argument("--publish-every", type=int, default=2)
+    ap.add_argument("--delta", action="store_true")
+    ap.add_argument("--queue-max", type=int, default=64)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from antidote_ccrdt_tpu.net.tcp import TcpTransport
+    from antidote_ccrdt_tpu.net.transport import GossipNode
+
+    drill = DRILLS[args.type]
+    dense = drill.make_engine()
+    state = drill.init(dense)
+
+    os.makedirs(args.root, exist_ok=True)
+    transport = TcpTransport(args.member, queue_max=args.queue_max)
+
+    if args.join_late > 0:
+        # Compile first, register (addr file + first pings) after the
+        # delay — same late-join discipline as the fs drill.
+        state = drill.apply(dense, state, 0, [])
+        time.sleep(args.join_late)
+    _write_addr(args.root, args.member, transport.address)
+
+    def discover():
+        while True:
+            for name, addr in _read_addrs(args.root).items():
+                transport.add_peer(name, addr)  # no-op for self/known
+            time.sleep(0.05)
+
+    threading.Thread(target=discover, daemon=True).start()
+
+    store = GossipNode(transport)
+    run_worker(store, drill, dense, state, args, result_dir=args.root)
+
+
+if __name__ == "__main__":
+    main()

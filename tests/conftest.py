@@ -5,17 +5,82 @@ run against 8 virtual CPU devices instead (the same compiled programs run
 unchanged on a real TPU mesh).
 
 Note: this environment's axon TPU plugin force-selects ``jax_platforms=
-"axon,cpu"`` from sitecustomize, overriding JAX_PLATFORMS/XLA_FLAGS env
-vars — so the override must go through jax.config, before any backend
+"axon,cpu"`` from sitecustomize, overriding the JAX_PLATFORMS env var —
+so the platform override must go through jax.config, before any backend
 initialization (conftest imports early enough).
+
+The virtual device COUNT needs two paths: newer JAX has the
+``jax_num_cpu_devices`` config option; older JAX (e.g. 0.4.37) only
+honors ``XLA_FLAGS=--xla_force_host_platform_device_count=N``, which must
+be in the environment before ``import jax`` triggers backend setup —
+hence the env mutation above the import.
 """
 
 import os
 
-import jax
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax  # noqa: E402  (after XLA_FLAGS on purpose, see docstring)
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass  # older JAX: the XLA_FLAGS fallback above already took effect
+
+
+# --- hypothesis shim --------------------------------------------------------
+# The property tests use hypothesis when the image ships it; images without
+# it (no egress to install) must still COLLECT every module — a bare
+# module-level `from hypothesis import ...` turns one missing dependency
+# into a whole-file collection error, losing all the non-property tests in
+# the file. Test modules import the names from here instead; when
+# hypothesis is absent, @given marks the test skipped and the strategy /
+# settings objects become inert stand-ins.
+try:
+    from hypothesis import HealthCheck, given, settings
+    from hypothesis import strategies as st
+except ModuleNotFoundError:
+    import pytest as _pytest
+
+    class HealthCheck:  # attribute targets for suppress_health_check=[...]
+        too_slow = data_too_large = filter_too_much = None
+
+    def settings(*_a, **_k):
+        return lambda f: f
+
+    def given(*_a, **_k):
+        # Must skip at CALL time, not via a mark: property bodies are
+        # often inner functions invoked directly by the test (`prop()`),
+        # where a skip mark would never be seen by the collector.
+        def deco(f):
+            # No functools.wraps: it would forward f's signature and make
+            # pytest hunt for the strategy kwargs as fixtures.
+            def skipper(*a, **k):
+                _pytest.skip("hypothesis not installed")
+
+            skipper.__name__ = getattr(f, "__name__", "property")
+            return skipper
+
+        return deco
+
+    class _AnyStrategy:
+        """Inert strategy stand-in: modules build strategies at import
+        time (`st.lists(...).map(...)`, `.filter(...)`), so the stub
+        must absorb any call/attribute chain — the value never
+        materializes, @given already skipped the test."""
+
+        def __call__(self, *_a, **_k):
+            return self
+
+        def __getattr__(self, _name):
+            return self
+
+    st = _AnyStrategy()
+
+__all__ = ["HealthCheck", "given", "settings", "st", "cpu_subprocess_env"]
 
 
 def cpu_subprocess_env(**extra):

@@ -541,8 +541,7 @@ def test_grid_wire_differential_vs_direct_engines(client, seed):
             assert client.grid_observe(g, r, k) == vals_ref[r][k]
 
 
-from hypothesis import HealthCheck, given, settings  # noqa: E402
-from hypothesis import strategies as st  # noqa: E402
+from conftest import HealthCheck, given, settings, st  # noqa: E402  (hypothesis or skip-stub)
 
 
 @settings(

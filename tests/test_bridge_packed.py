@@ -8,8 +8,7 @@ as loudly as the tuple packers."""
 
 import numpy as np
 import pytest
-from hypothesis import HealthCheck, given, settings
-from hypothesis import strategies as st
+from conftest import HealthCheck, given, settings, st  # noqa: E402  (hypothesis or skip-stub)
 
 from antidote_ccrdt_tpu.bridge import BridgeClient, BridgeServer
 from antidote_ccrdt_tpu.bridge.server import _bin_col

@@ -122,6 +122,37 @@ def test_chained_delta_gossip_with_gap_resync(tmp_path):
     assert kinds[0] == "full" and "delta" in kinds[1:]
 
 
+def test_gap_resync_via_periodic_anchor(tmp_path):
+    """The production resync path, end to end: the consumer's cursor
+    falls off the keep window while it isn't sweeping, and the
+    publisher's own periodic full anchor (full_every) — not a manual
+    snapshot — closes the gap; chaining then RESUMES from the anchor
+    (deltas published after it still apply)."""
+    rng = np.random.default_rng(23)
+    a = GossipStore(str(tmp_path), "a")
+    b = GossipStore(str(tmp_path), "b")
+    pub = DeltaPublisher(a, D, full_every=5, keep=2)
+    state_a = D.init(R, NK)
+    state_b = D.init(R, NK)
+    cursors: dict = {}
+    state_b, stats = sweep_deltas(b, D, state_b, cursors)
+    assert stats == {"deltas": 0, "fulls": 0, "skipped": 0}  # nothing yet
+    for step in range(12):
+        state_a, _ = D.apply_ops(state_a, rand_ops(rng, ts_base=1 + 60 * step))
+        pub.publish(state_a)
+    # Seqs 0..11: anchors at 0/5/10, deltas pruned to the keep=2 window —
+    # the consumer's cursor (-1) is far off the retained chain.
+    assert len(a.delta_seqs("a")) <= 2
+    state_b, stats = sweep_deltas(b, D, state_b, cursors)
+    assert stats["fulls"] == 1  # resynced from the seq-10 anchor
+    assert stats["deltas"] == 1  # ...and chained the post-anchor delta 11
+    assert cursors["a"] == pub.seq == 11
+    assert D.equal(state_b, state_a)
+    # Idempotence: a second sweep over the same artifacts is a no-op.
+    state_b2, stats2 = sweep_deltas(b, D, state_b, cursors)
+    assert stats2["deltas"] == 0 and D.equal(state_b2, state_b)
+
+
 def test_torn_delta_skipped(tmp_path):
     a = GossipStore(str(tmp_path), "a")
     b = GossipStore(str(tmp_path), "b")
@@ -171,8 +202,7 @@ def test_mismatched_config_delta_skipped(tmp_path):
     assert D.equal(state_b, D.init(R, NK))
 
 
-from hypothesis import HealthCheck, given, settings  # noqa: E402
-from hypothesis import strategies as st  # noqa: E402
+from conftest import HealthCheck, given, settings, st  # noqa: E402  (hypothesis or skip-stub)
 
 
 @settings(

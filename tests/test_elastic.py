@@ -32,18 +32,36 @@ def test_owners_deterministic_and_total():
 
 
 def test_failure_detector_and_ownership_shift(tmp_path):
+    import struct
+
     a = GossipStore(str(tmp_path), "a")
     b = GossipStore(str(tmp_path), "b")
     assert a.alive_members(10.0) == ["a", "b"]
     assert set(my_replicas(a, 4, 10.0)) == {0, 2}
-    # b goes silent: backdate its heartbeat past the timeout.
+    # b goes silent: backdate its heartbeat PAYLOAD past the timeout (the
+    # payload, not mtime, is the liveness source — mtime is flaky on
+    # coarse-granularity/object-store filesystems).
     hb = os.path.join(str(tmp_path), "hb-b")
-    past = time.time() - 60
-    os.utime(hb, (past, past))
+    with open(hb, "wb") as f:
+        f.write(struct.pack("<d", time.time() - 60))
     assert a.alive_members(1.0) == ["a"]
     assert set(my_replicas(a, 4, 1.0)) == {0, 1, 2, 3}
     # b still considers itself alive (never self-suspects).
     assert "b" in b.alive_members(1.0)
+
+
+def test_heartbeat_mtime_fallback(tmp_path):
+    """A payload-less heartbeat file (pre-payload writer, or a torn
+    write) still reads via mtime — forward compatibility with foreign
+    members on the old format."""
+    a = GossipStore(str(tmp_path), "a")
+    hb_c = os.path.join(str(tmp_path), "hb-c")
+    with open(hb_c, "wb"):
+        pass  # empty: no payload
+    assert a.alive_members(10.0) == ["a", "c"]  # fresh mtime counts
+    past = time.time() - 60
+    os.utime(hb_c, (past, past))
+    assert a.alive_members(1.0) == ["a"]  # stale mtime ages out
 
 
 def test_gossip_sweep_merges_peer_snapshots(tmp_path):

@@ -266,8 +266,7 @@ def test_wordcount_lift_and_generic_delta_dispatch():
     assert int(np.asarray(lift.total(got).counts).sum()) == B
 
 
-from hypothesis import HealthCheck, given, settings  # noqa: E402
-from hypothesis import strategies as st  # noqa: E402
+from conftest import HealthCheck, given, settings, st  # noqa: E402  (hypothesis or skip-stub)
 
 from antidote_ccrdt_tpu.parallel.elastic import sweep_deltas  # noqa: E402
 

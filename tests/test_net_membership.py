@@ -1,0 +1,93 @@
+"""SWIM membership (net/membership.py) on an injected fake clock: the
+ALIVE -> SUSPECT -> CONFIRM-DEAD progression, re-alive on fresh evidence,
+stale-evidence rejection, and transitive piggybacked ages."""
+
+from antidote_ccrdt_tpu.net.membership import ALIVE, DEAD, SUSPECT, Membership
+from antidote_ccrdt_tpu.utils.metrics import Metrics
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_alive_suspect_dead_progression():
+    clk = Clock()
+    m = Metrics()
+    ms = Membership("a", now=clk, confirm_factor=2.0, metrics=m)
+    ms.observe("b")
+    assert ms.state_of("b", 1.0) == ALIVE
+
+    clk.t = 1.5  # past timeout, inside confirm window
+    assert ms.state_of("b", 1.0) == SUSPECT
+    # SUSPECT keeps its replicas: still in the ownership-feeding set.
+    assert ms.alive(1.0) == ["a", "b"]
+
+    clk.t = 2.5  # past confirm_factor * timeout
+    assert ms.state_of("b", 1.0) == DEAD
+    assert ms.alive(1.0) == ["a"]
+
+    # Edge-triggered events: repeated polls count each transition once.
+    ms.state_of("b", 1.0)
+    ms.state_of("b", 1.0)
+    assert m.counters["net.suspect_events"] == 1
+    assert m.counters["net.dead_events"] == 1
+
+
+def test_fresh_evidence_realives():
+    clk = Clock()
+    ms = Membership("a", now=clk)
+    ms.observe("b")
+    clk.t = 10.0
+    assert ms.state_of("b", 1.0) == DEAD
+    ms.observe("b")  # b's next frame refutes (no incarnation numbers needed)
+    assert ms.state_of("b", 1.0) == ALIVE
+    assert ms.alive(1.0) == ["a", "b"]
+
+
+def test_stale_evidence_ignored():
+    clk = Clock()
+    ms = Membership("a", now=clk)
+    clk.t = 10.0
+    ms.observe("b")  # heard directly at t=10
+    ms.observe("b", age=5.0)  # older secondhand claim: t=5 — ignored
+    assert ms.last_heard["b"] == 10.0
+
+
+def test_ancient_gossip_does_not_realive_the_dead():
+    clk = Clock()
+    ms = Membership("a", now=clk)
+    ms.observe("b")
+    clk.t = 10.0
+    assert ms.state_of("b", 1.0) == DEAD
+    # Evidence newer than what we hold but still ancient (age 8 -> t=2)
+    # must not clear the dead flag — only a recent sighting refutes.
+    ms.absorb({"b": 8.0})
+    assert ms.state_of("b", 1.0) == DEAD
+
+
+def test_transitive_piggyback():
+    """C has never exchanged a frame with B, yet A's piggybacked ages keep
+    B alive in C's view — the SWIM indirection without ping-req rounds."""
+    clk = Clock()
+    a = Membership("a", now=clk)
+    c = Membership("c", now=clk)
+    a.observe("b")
+    clk.t = 0.5
+    c.absorb(a.heard_ages())  # what A would put on a frame to C
+    assert c.state_of("b", 1.0) == ALIVE
+    assert c.state_of("a", 1.0) == ALIVE  # sender's self-age is 0
+    clk.t = 3.0
+    assert c.state_of("b", 1.0) == DEAD
+
+
+def test_self_is_always_alive():
+    clk = Clock()
+    ms = Membership("a", now=clk)
+    clk.t = 1000.0
+    assert ms.state_of("a", 0.1) == ALIVE
+    assert "a" in ms.alive(0.1)
+    assert ms.heard_ages()["a"] == 0.0

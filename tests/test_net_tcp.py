@@ -1,0 +1,238 @@
+"""TCP gossip transport (net/tcp.py): fast in-process socket tests
+(frame exchange, membership from traffic, backpressure policy, retry/
+backoff bounds) plus the real-process drill — three localhost workers
+over scripts/net_gossip_demo.py, one killed mid-run — marked slow."""
+
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+import time
+
+import pytest
+
+from antidote_ccrdt_tpu.net.tcp import TcpTransport, _PeerLink
+from antidote_ccrdt_tpu.utils.metrics import Metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEMO = os.path.join(REPO, "scripts", "net_gossip_demo.py")
+
+
+def wait_for(pred, timeout=8.0, interval=0.01):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _closed_port() -> int:
+    """A port that currently refuses connections (bound, then released)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture
+def pair():
+    a = TcpTransport("a")
+    b = TcpTransport("b")
+    a.add_peer("b", b.address)
+    b.add_peer("a", a.address)
+    yield a, b
+    a.close()
+    b.close()
+
+
+def test_snapshot_and_delta_exchange(pair):
+    a, b = pair
+    blob = struct.pack("<Q", 4) + b"payload-a"
+    a.publish(blob)
+    assert wait_for(lambda: b.fetch("a") == blob), "snapshot never arrived"
+    assert b.fetch_head("a", 8) == blob[:8]
+    assert "a" in b.snapshot_members()
+
+    for s in range(5):
+        a.publish_delta(s, b"d%d" % s, keep=3)
+    assert wait_for(lambda: b.delta_seqs("a") == [2, 3, 4]), b.delta_seqs("a")
+    assert b.fetch_delta("a", 3) == b"d3"
+    assert b.delta_members() == ["a"]
+
+
+def test_membership_from_traffic_not_address_book(pair):
+    a, b = pair
+    # The address book alone is NOT membership evidence.
+    assert "b" not in a.members()
+    b.heartbeat()
+    assert wait_for(lambda: "b" in a.members()), "ping never heard"
+    assert "b" in a.alive_members(5.0)
+    assert a.peers() == ["b"]
+
+
+def test_stale_snapshot_does_not_replace(pair):
+    from antidote_ccrdt_tpu.net.tcp import A_SNAP
+
+    a, b = pair
+    newer = struct.pack("<Q", 9) + b"new"
+    a.publish(newer)
+    assert wait_for(lambda: b.fetch("a") == newer)
+    # A reconnect interleaving delivers an older anchor late: the step
+    # header guard must keep the newer one.
+    b._handle((A_SNAP, b"a", struct.pack("<Q", 2) + b"old", {}))
+    assert b.fetch("a") == newer
+    # But an equal-or-newer header does replace (latest-wins).
+    newest = struct.pack("<Q", 9) + b"newest"
+    b._handle((A_SNAP, b"a", newest, {}))
+    assert b.fetch("a") == newest
+
+
+def test_queue_backpressure_drop_oldest_delta_keep_anchor():
+    m = Metrics()
+    import random
+
+    link = _PeerLink(
+        ("127.0.0.1", _closed_port()), random.Random(0), m,
+        queue_max=4, connect_timeout=0.1, send_timeout=0.1,
+        backoff_base=10.0, backoff_max=10.0,  # effectively: never retry
+    )
+    try:
+        mk = lambda payload: (lambda: payload)  # noqa: E731
+        link.enqueue("snap", mk(b"anchor"))
+        for i in range(6):
+            link.enqueue("delta", mk(b"d%d" % i))
+        with link._cv:
+            kinds = [k for k, _ in link._q]
+            builds = [f() for _, f in link._q]
+        # The anchor survived; the OLDEST deltas were shed.
+        assert "snap" in kinds
+        assert b"d5" in builds and b"d0" not in builds
+        assert len(kinds) <= 4
+        assert m.counters["net.send_drops"] >= 2
+    finally:
+        link.close()
+
+
+def test_queue_snap_latest_wins_and_ping_dedup():
+    m = Metrics()
+    import random
+
+    link = _PeerLink(
+        ("127.0.0.1", _closed_port()), random.Random(0), m,
+        queue_max=8, connect_timeout=0.1, send_timeout=0.1,
+        backoff_base=10.0, backoff_max=10.0,
+    )
+    try:
+        mk = lambda payload: (lambda: payload)  # noqa: E731
+        link.enqueue("snap", mk(b"old-anchor"))
+        link.enqueue("snap", mk(b"new-anchor"))
+        link.enqueue("ping", mk(b"p1"))
+        link.enqueue("ping", mk(b"p2"))
+        with link._cv:
+            snaps = [f() for k, f in link._q if k == "snap"]
+            pings = [f() for k, f in link._q if k == "ping"]
+        assert snaps == [b"new-anchor"]  # queued older anchor replaced
+        assert len(pings) == 1  # one pending ping is enough liveness
+    finally:
+        link.close()
+
+
+def test_retry_backoff_bounded_and_never_hangs():
+    """A dead peer costs retries with growing-but-capped backoff — and
+    enqueue never blocks the caller."""
+    m = Metrics()
+    import random
+
+    link = _PeerLink(
+        ("127.0.0.1", _closed_port()), random.Random(0), m,
+        queue_max=8, connect_timeout=0.2, send_timeout=0.2,
+        backoff_base=0.01, backoff_max=0.05,
+    )
+    try:
+        t0 = time.time()
+        link.enqueue("snap", lambda: b"blob")
+        assert time.time() - t0 < 0.1, "enqueue must not block"
+        assert wait_for(lambda: m.counters.get("net.retries", 0) >= 3)
+        # Backoff grows exponentially but stays <= backoff_max * 1.5 jitter.
+        assert link._attempts >= 3
+        assert link._backoff() <= 0.05 * 1.5 + 1e-9
+    finally:
+        link.close()
+
+
+def test_reconnect_after_peer_restart():
+    """Frames queued while the peer is down are delivered once it comes
+    back — retry keeps the frame, backoff keeps the cost bounded."""
+    a = TcpTransport("a", backoff_base=0.02, backoff_max=0.1)
+    port = _closed_port()
+    try:
+        a.add_peer("b", ("127.0.0.1", port))
+        blob = struct.pack("<Q", 1) + b"queued-while-down"
+        a.publish(blob)
+        assert wait_for(lambda: a.metrics.counters.get("net.retries", 0) >= 1)
+        b = TcpTransport("b", bind=("127.0.0.1", port))
+        try:
+            assert wait_for(lambda: b.fetch("a") == blob), "frame lost on restart"
+        finally:
+            b.close()
+    finally:
+        a.close()
+
+
+# --- the real-process drill (slow) ----------------------------------------
+
+
+def _drill_reference(type_name):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import elastic_demo
+
+    return elastic_demo.reference_digest(type_name)
+
+
+@pytest.mark.slow
+def test_real_process_tcp_crash_recovery(tmp_path):
+    """Three localhost TCP peers; w1 is killed mid-run. Survivors must
+    detect the death via SWIM timeouts (no heartbeat files — liveness is
+    piggybacked ages only), adopt its replicas, converge to the
+    sequential reference, and exit — with the retry/backoff machinery
+    observable in the reported metrics."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    spec = (("w0", []), ("w1", ["--die-at", "4"]), ("w2", []))
+    procs = {}
+    for member, extra in spec:
+        procs[member] = subprocess.Popen(
+            [sys.executable, DEMO, "--root", str(tmp_path), "--member", member,
+             "--n-members", "3", "--type", "topk_rmv", *extra],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True,
+        )
+    rcs, outs = {}, {}
+    for member, p in procs.items():
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            pytest.fail(f"worker {member} hung (degrade-never-hang violated):\n{out}")
+        rcs[member], outs[member] = p.returncode, out
+
+    assert rcs["w1"] == 1, f"victim should crash:\n{outs['w1']}"
+    ref = [list(t) for t in _drill_reference("topk_rmv")]
+    assert ref, "reference observable is empty — drill is vacuous"
+    for m in ("w0", "w2"):
+        assert rcs[m] == 0, f"worker {m} failed:\n{outs[m]}"
+        with open(os.path.join(str(tmp_path), f"final-{m}.json")) as f:
+            got = json.load(f)
+        assert got["digest"] == ref, (
+            f"{m} diverged from the sequential reference\n"
+            f"got: {got['digest']}\nref: {ref}\nlog:\n{outs[m]}"
+        )
+        assert "w1" not in got["alive"], "crashed member still considered alive"
+        # The dead peer's link kept retrying with backoff (bounded, counted).
+        assert got["metrics"].get("net.retries", 0) > 0, got["metrics"]
+        assert got["metrics"].get("net.frames_recv", 0) > 0, got["metrics"]

@@ -1,0 +1,133 @@
+"""Transport protocol conformance + the GossipNode state facade
+(net/transport.py): every medium satisfies the same surface, blob
+formats stay checkpoint-compatible, and fetches are total."""
+
+import os
+import struct
+
+import jax.numpy as jnp
+
+from antidote_ccrdt_tpu.models.topk_rmv_dense import TopkRmvOps, make_dense
+from antidote_ccrdt_tpu.net.sim import SimNet
+from antidote_ccrdt_tpu.net.transport import FsTransport, GossipNode, Transport
+from antidote_ccrdt_tpu.parallel.elastic import GossipStore
+
+
+def _engine_and_state():
+    D = make_dense(n_ids=16, n_dcs=2, size=4, slots_per_id=2)
+    st = D.init(2, 1)
+    ops = TopkRmvOps(
+        add_key=jnp.zeros((2, 1), jnp.int32),
+        add_id=jnp.asarray([[3], [7]], jnp.int32),
+        add_score=jnp.asarray([[50], [90]], jnp.int32),
+        add_dc=jnp.asarray([[0], [1]], jnp.int32),
+        add_ts=jnp.asarray([[1], [1]], jnp.int32),
+        rmv_key=jnp.zeros((2, 1), jnp.int32),
+        rmv_id=jnp.zeros((2, 1), jnp.int32) - 1,
+        rmv_vc=jnp.zeros((2, 1, 2), jnp.int32),
+    )
+    st, _ = D.apply_ops(st, ops, collect_dominated=False)
+    return D, st
+
+
+def test_protocol_conformance(tmp_path):
+    """All three media satisfy the runtime-checkable Transport protocol."""
+    fs = FsTransport(str(tmp_path), "a")
+    sim = SimNet(seed=0).join("a")
+    assert isinstance(fs, Transport)
+    assert isinstance(sim, Transport)
+    from antidote_ccrdt_tpu.net.tcp import TcpTransport
+
+    tcp = TcpTransport("a")
+    try:
+        assert isinstance(tcp, Transport)
+    finally:
+        tcp.close()
+
+
+def test_fs_blob_surface(tmp_path):
+    t = FsTransport(str(tmp_path), "a")
+    t.publish(b"\x01" * 16)
+    assert t.fetch("a") == b"\x01" * 16
+    assert t.fetch_head("a", 8) == b"\x01" * 8
+    assert t.fetch("ghost") is None
+    assert t.snapshot_members() == ["a"]
+    for s in range(6):
+        t.publish_delta(s, bytes([s]), keep=3)
+    assert t.delta_seqs("a") == [3, 4, 5]  # pruned to the keep window
+    assert t.fetch_delta("a", 4) == b"\x04"
+    assert t.fetch_delta("a", 1) is None
+    assert t.delta_members() == ["a"]
+
+
+def test_snapshot_blob_is_checkpoint_compatible(tmp_path):
+    """The gossip snapshot blob must be byte-identical to
+    harness.checkpoint.save_dense_checkpoint output: on-disk artifacts
+    from older rounds stay readable, checkpoints are gossipable."""
+    from antidote_ccrdt_tpu.harness.checkpoint import save_dense_checkpoint
+
+    D, st = _engine_and_state()
+    node = GossipStore(str(tmp_path / "g"), "a")
+    node.publish("topk_rmv", st, step=7)
+
+    ckpt = str(tmp_path / "ckpt.bin")
+    save_dense_checkpoint(ckpt, "topk_rmv", st, step=7)
+    with open(ckpt, "rb") as f:
+        assert node.transport.fetch("a") == f.read()
+
+
+def test_gossip_node_roundtrip_and_headers(tmp_path):
+    D, st = _engine_and_state()
+    node = GossipNode(FsTransport(str(tmp_path), "a"))
+    node.publish("topk_rmv", st, step=3)
+    assert node.snapshot_seq("a") == 3
+    got = node.fetch("a", st, dense=D)
+    assert got is not None
+    step, state = got
+    assert step == 3 and D.equal(state, st)
+    assert node.metrics.counters["net.snap_publishes"] == 1
+    assert node.metrics.counters["net.snap_fetches"] == 1
+
+
+def test_gossip_node_fetch_is_total(tmp_path):
+    """Garbage blobs (torn writes, foreign writers) read as None — the
+    gossip loop skips and retries, never crashes."""
+    D, st = _engine_and_state()
+    node = GossipNode(FsTransport(str(tmp_path), "a"))
+    with open(os.path.join(str(tmp_path), "snap-evil"), "wb") as f:
+        f.write(struct.pack("<Q", 1) + b"not a checkpoint")
+    assert node.fetch("evil", st, dense=D) is None
+    assert node.snapshot_seq("evil") == 1  # header alone is still readable
+    with open(os.path.join(str(tmp_path), "delta-evil-00000001"), "wb") as f:
+        f.write(b"garbage")
+    assert node.fetch_delta("evil", 1, st) is None
+
+
+def test_gossip_store_back_compat(tmp_path):
+    """The historical constructor and attributes survive the net/ split."""
+    store = GossipStore(str(tmp_path), "w0")
+    assert store.root == str(tmp_path)
+    assert store.member == "w0"
+    assert os.path.exists(os.path.join(str(tmp_path), "hb-w0"))
+    assert store.members() == ["w0"]
+    assert store.alive_members(10.0) == ["w0"]
+
+
+def test_sim_transport_same_surface_as_fs():
+    """The simulated medium honors the same blob surface (snapshot
+    latest-wins via step header, delta keep-window pruning)."""
+    net = SimNet(seed=1)
+    a, b = net.join("a"), net.join("b")
+    blob5 = struct.pack("<Q", 5) + b"newer"
+    blob3 = struct.pack("<Q", 3) + b"older"
+    a.publish(blob5)
+    net.run_until(1.0)
+    assert b.fetch("a") == blob5
+    # A stale (reordered/duplicated) older anchor must not replace.
+    b._deliver(("snap", "a", blob3, {}))
+    assert b.fetch("a") == blob5
+    for s in range(6):
+        a.publish_delta(s, bytes([s]), keep=3)
+    net.run_until(2.0)
+    assert b.delta_seqs("a") == [3, 4, 5]
+    assert b.fetch_delta("a", 4) == b"\x04"

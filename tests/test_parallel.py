@@ -7,8 +7,10 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import PartitionSpec as P
+
+from antidote_ccrdt_tpu.utils.jaxcompat import shard_map
 
 from antidote_ccrdt_tpu.models.topk_rmv_dense import TopkRmvOps, make_dense
 from antidote_ccrdt_tpu.parallel.dist import lattice_all_reduce, make_mesh

@@ -16,8 +16,7 @@ TPU path depends on:
 import jax
 import numpy as np
 import pytest
-from hypothesis import HealthCheck, given, settings
-from hypothesis import strategies as st
+from conftest import HealthCheck, given, settings, st  # noqa: E402  (hypothesis or skip-stub)
 
 from antidote_ccrdt_tpu.core import wire
 from antidote_ccrdt_tpu.core.behaviour import registry

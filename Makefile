@@ -23,7 +23,7 @@ PY ?= python
 # meaningful.
 COVER_THRESHOLD ?= 88
 
-.PHONY: all compile test cover typecheck xref native bench benchall dryrun net-demo clean
+.PHONY: all compile test cover typecheck xref native bench benchall dryrun net-demo chaos crash-demo clean
 
 all: compile xref typecheck cover
 
@@ -66,6 +66,20 @@ dryrun:
 # and converge (tests/test_net_tcp.py::test_real_process_tcp_crash_recovery).
 net-demo:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_net_tcp.py -q -m slow -p no:cacheprovider
+
+# Deterministic fault-matrix run: every utils/faults.py injection point
+# (fsync failure, torn write, socket reset, read stalls) driven from a
+# seeded, replayable schedule — no real processes, tier-1 compatible
+# runtime, but kept out of tier-1 as its own gate.
+chaos:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py tests/test_wal.py tests/test_fault_matrix.py -q -p no:cacheprovider
+
+# The crash-consistency drill (slow, real processes): SIGKILL a
+# WAL-backed worker mid-run, restart it, and require bit-identical
+# convergence twice — once via WAL recovery (checkpoint + delta
+# suffix), once with the WAL deleted via peer adoption.
+crash-demo:
+	env JAX_PLATFORMS=cpu $(PY) scripts/crash_recovery_demo.py --mode both
 
 clean:
 	rm -rf native/build

@@ -1,11 +1,29 @@
-"""Blocking bridge client (the shape an erlport/gen_tcp client takes)."""
+"""Blocking bridge client (the shape an erlport/gen_tcp client takes).
+
+Failure semantics: a server-REPORTED error (the stream stays in sync)
+raises `BridgeError` and the client remains usable. A TRANSPORT-class
+failure — timeout, reset, corrupt frame, desynced request id — leaves
+the reply stream unusable; with `retries=0` (the default) the client is
+poisoned, exactly the pre-reconnect behavior. With `retries>0` the
+client reconnects with capped exponential backoff and RESENDS the same
+request under the idempotent `icall` form: a client-chosen random token
+plus the request id lets the server dedup, so a request whose reply was
+lost in the reset is not executed twice (grid_apply is not idempotent).
+
+The `timeout` applies end to end: to the initial connect, to every recv
+while waiting for a reply, and to every reconnect.
+"""
 
 from __future__ import annotations
 
+import os
 import socket
+import time
 from typing import Any, List, Optional, Tuple
 
 from ..core.etf import Atom
+from ..utils import faults
+from ..utils.metrics import Metrics
 from . import protocol as P
 
 
@@ -19,15 +37,50 @@ class _ServerError(Exception):
 
 
 class BridgeClient:
-    def __init__(self, host: str, port: int, timeout: float = 30.0):
-        self._sock = socket.create_connection((host, port), timeout=timeout)
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        timeout: float = 30.0,
+        retries: int = 0,
+        backoff_base: float = 0.05,
+        backoff_max: float = 1.0,
+        metrics: Optional[Metrics] = None,
+    ):
+        self._host, self._port = host, port
+        self._timeout = timeout
+        self._retries = int(retries)
+        self._backoff_base = backoff_base
+        self._backoff_max = backoff_max
+        self.metrics = metrics if metrics is not None else Metrics()
+        # Client-incarnation token for idempotent resends (icall dedup key
+        # on the server). Fresh per client object: a NEW client must not
+        # collide with a previous incarnation's cached replies.
+        self._token = os.urandom(8)
+        self._sock: Optional[socket.socket] = None
         self._buf = bytearray()
         self._req = 0
         self._closed = False
+        self._connect()
+
+    def _connect(self) -> None:
+        self._sock = socket.create_connection(
+            (self._host, self._port), timeout=self._timeout
+        )
+        self._buf = bytearray()
+
+    def _drop_sock(self) -> None:
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._buf = bytearray()
 
     def close(self) -> None:
         self._closed = True
-        self._sock.close()
+        self._drop_sock()
 
     def __enter__(self):
         return self
@@ -39,33 +92,46 @@ class BridgeClient:
         if self._closed:
             raise BridgeError("client is closed")
         self._req += 1
-        try:
-            self._sock.sendall(P.pack_frame(P.call(self._req, op)))
-            while True:
-                for term in P.unpack_frames(self._buf):
-                    req_id, ok, payload = P.parse_reply(term)
-                    if req_id != self._req:
-                        raise BridgeError(
-                            f"reply for {req_id}, expected {self._req}"
-                        )
-                    if not ok:
-                        # Server-reported error: the stream is still in
-                        # sync, the client stays usable.
-                        raise _ServerError(payload.decode("utf-8", "replace"))
-                    return payload
-                chunk = self._sock.recv(1 << 16)
-                if not chunk:
-                    raise BridgeError("connection closed")
-                self._buf += chunk
-        except _ServerError as e:
-            raise BridgeError(str(e)) from None
-        except Exception:
-            # Anything else — timeout, transport failure, corrupt or
-            # oversized frame, desynced request id — leaves the reply
-            # stream unusable: poison the client so the caller reconnects
-            # instead of parsing leftover bytes as the next reply.
-            self.close()
-            raise
+        req_id = self._req
+        attempt = 0
+        while True:
+            try:
+                if self._sock is None:
+                    self._connect()
+                return self._roundtrip(req_id, op)
+            except _ServerError as e:
+                raise BridgeError(str(e)) from None
+            except Exception:
+                # Transport-class failure: the reply stream is unusable.
+                self._drop_sock()
+                if attempt >= self._retries:
+                    self._closed = True
+                    raise
+                attempt += 1
+                self.metrics.count("bridge.reconnects")
+                time.sleep(
+                    min(self._backoff_max,
+                        self._backoff_base * (2.0 ** (attempt - 1)))
+                )
+
+    def _roundtrip(self, req_id: int, op: Any) -> Any:
+        # icall (not call): resends after a reconnect must dedup on the
+        # server — see module docstring.
+        self._sock.sendall(P.pack_frame(P.icall(self._token, req_id, op)))
+        while True:
+            for term in P.unpack_frames(self._buf):
+                rid, ok, payload = P.parse_reply(term)
+                if rid != req_id:
+                    raise BridgeError(f"reply for {rid}, expected {req_id}")
+                if not ok:
+                    raise _ServerError(P.error_text(payload))
+                return payload
+            if faults.ACTIVE:
+                faults.fire("bridge.read")
+            chunk = self._sock.recv(1 << 16)
+            if not chunk:
+                raise BridgeError("connection closed")
+            self._buf += chunk
 
     # -- scalar surface ----------------------------------------------------
 

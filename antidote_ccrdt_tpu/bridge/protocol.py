@@ -8,9 +8,21 @@ speaks natively:
     frame   := u32_be length ++ payload
     payload := term_to_binary(Request | Reply)
 
-Requests are tagged tuples `{call, ReqId, Op}`; replies are
-`{reply, ReqId, {ok, Result} | {error, Binary}}`. ReqIds let a client
-pipeline requests. Op shapes (atoms abbreviated as Python `Atom`):
+Requests are tagged tuples `{call, ReqId, Op}` or `{icall, Token, ReqId,
+Op}`; replies are `{reply, ReqId, {ok, Result} | {error, Binary |
+{Kind, Binary}}}`. ReqIds let a client pipeline requests.
+
+`icall` is the IDEMPOTENT request form: `Token` is a client-chosen
+random binary identifying the client incarnation, and the server keeps
+a bounded (Token, ReqId) -> Reply cache, so a request RESENT after a
+reconnect (the client cannot know whether the first send executed)
+returns the original reply instead of executing twice — required for
+non-idempotent ops like grid_apply. `call` stays for one-shot clients
+and BEAM hosts that manage their own retries.
+
+Error replies carry `{Kind, Message}` where Kind is an atom naming the
+exception class (`badarg`-style structured errors a host can switch
+on); the bare-binary form remains accepted on decode for old peers. Op shapes (atoms abbreviated as Python `Atom`):
 
     {new, Type, Args}                 -> {ok, Handle}      scalar instance
     {from_binary, Type, Bin}          -> {ok, Handle}      load BEAM snapshot
@@ -44,6 +56,7 @@ from ..core import etf
 from ..core.etf import Atom
 
 A_CALL = Atom("call")
+A_ICALL = Atom("icall")
 A_REPLY = Atom("reply")
 A_OK = Atom("ok")
 A_ERROR = Atom("error")
@@ -76,12 +89,37 @@ def call(req_id: int, op: Any) -> Any:
     return (A_CALL, req_id, op)
 
 
+def icall(token: bytes, req_id: int, op: Any) -> Any:
+    """Idempotent request: the server dedups on (token, req_id)."""
+    return (A_ICALL, token, req_id, op)
+
+
 def reply_ok(req_id: int, result: Any) -> Any:
     return (A_REPLY, req_id, (A_OK, result))
 
 
-def reply_error(req_id: Any, message: str) -> Any:
-    return (A_REPLY, req_id, (A_ERROR, message.encode("utf-8")))
+def reply_error(req_id: Any, message: str, kind: str = "error") -> Any:
+    """Structured error frame: {error, {Kind, Message}}. Kind is an atom
+    (typically the exception class name) a host can dispatch on without
+    parsing the human-readable message."""
+    return (A_REPLY, req_id, (A_ERROR, (Atom(kind), message.encode("utf-8"))))
+
+
+def error_text(payload: Any) -> str:
+    """Render an error payload — structured {Kind, Msg} or legacy bare
+    binary — as the "Kind: message" string clients raise."""
+    if (
+        isinstance(payload, tuple)
+        and len(payload) == 2
+        and isinstance(payload[0], Atom)
+    ):
+        kind, msg = payload
+        if isinstance(msg, bytes):
+            msg = msg.decode("utf-8", "replace")
+        return f"{kind}: {msg}"
+    if isinstance(payload, bytes):
+        return payload.decode("utf-8", "replace")
+    return repr(payload)
 
 
 # --- term <-> op conversion (shared by server and client) -----------------

@@ -35,6 +35,7 @@ from __future__ import annotations
 import socket
 import socketserver
 import threading
+from collections import OrderedDict
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
@@ -42,6 +43,7 @@ import numpy as np
 from ..core import wire
 from ..core.behaviour import registry
 from ..core.etf import Atom
+from ..utils.metrics import Metrics
 from . import protocol as P
 
 
@@ -1203,23 +1205,48 @@ class _Grid:
 
 
 class BridgeServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        read_deadline: Optional[float] = None,
+        metrics: Optional[Metrics] = None,
+        reply_cache_size: int = 1024,
+    ):
+        """`read_deadline` (seconds) bounds how long a connection may sit
+        idle between frames: a half-open or wedged client releases its
+        thread instead of leaking it forever (None = no deadline, the
+        historical behavior). `reply_cache_size` bounds the icall
+        idempotency cache (see protocol: (token, req_id) -> reply)."""
         self._handles: Dict[Any, Tuple[str, Any]] = {}
         self._grids: Dict[Any, _Grid] = {}
         self._next = 0
+        self.metrics = metrics if metrics is not None else Metrics()
+        self._read_deadline = read_deadline
         # Lock order: object locks (handles/grids) outrank _meta; _meta is
         # only ever taken alone or inside an already-held object lock.
         self._meta = threading.Lock()
         self._hlocks: Dict[Any, threading.Lock] = {}
         self._glocks: Dict[Any, threading.Lock] = {}
+        # icall idempotency: (token, req_id) -> full reply term, LRU.
+        # A resent request whose first execution's reply was lost in a
+        # reset must NOT execute twice (grid_apply is not idempotent).
+        self._replies: "OrderedDict[Tuple[bytes, Any], Any]" = OrderedDict()
+        self._replies_cap = reply_cache_size
+        self._replies_lock = threading.Lock()
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 buf = bytearray()
+                if outer._read_deadline is not None:
+                    self.request.settimeout(outer._read_deadline)
                 while True:
                     try:
                         chunk = self.request.recv(1 << 16)
+                    except socket.timeout:
+                        outer.metrics.count("bridge.read_deadline_drops")
+                        return
                     except OSError:
                         return
                     if not chunk:
@@ -1264,13 +1291,39 @@ class BridgeServer:
     }
 
     def _dispatch(self, term: Any) -> Any:
-        if not (isinstance(term, tuple) and len(term) == 3 and term[0] == P.A_CALL):
-            return P.reply_error(-1, f"bad request: {term!r}")
-        _, req_id, op = term
+        token: Optional[bytes] = None
+        if (
+            isinstance(term, tuple) and len(term) == 4
+            and term[0] == P.A_ICALL and isinstance(term[1], (bytes, bytearray))
+        ):
+            _, token, req_id, op = term
+            token = bytes(token)
+            with self._replies_lock:
+                cached = self._replies.get((token, req_id))
+                if cached is not None:
+                    self._replies.move_to_end((token, req_id))
+                    self.metrics.count("bridge.replays")
+                    return cached
+        elif isinstance(term, tuple) and len(term) == 3 and term[0] == P.A_CALL:
+            _, req_id, op = term
+        else:
+            self.metrics.count("bridge.errors")
+            return P.reply_error(-1, f"bad request: {term!r}", kind="bad_request")
         try:
-            return P.reply_ok(req_id, self._exec_routed(op))
-        except Exception as e:  # noqa: BLE001 - all errors go to the client
-            return P.reply_error(req_id, f"{type(e).__name__}: {e}")
+            reply = P.reply_ok(req_id, self._exec_routed(op))
+        except Exception as e:  # noqa: BLE001 - all errors go to the client,
+            # as a STRUCTURED {error, {Kind, Msg}} frame (never silently
+            # swallowed): Kind is the exception class for hosts to dispatch
+            # on, and the server-side counter makes error volume observable.
+            self.metrics.count("bridge.errors")
+            self.metrics.count(f"bridge.errors.{type(e).__name__}")
+            return P.reply_error(req_id, str(e), kind=type(e).__name__)
+        if token is not None:
+            with self._replies_lock:
+                self._replies[(token, req_id)] = reply
+                while len(self._replies) > self._replies_cap:
+                    self._replies.popitem(last=False)
+        return reply
 
     def _exec_routed(self, op: Any) -> Any:
         """Acquire exactly the locks the op needs, then run it."""

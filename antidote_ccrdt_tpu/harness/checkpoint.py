@@ -34,6 +34,7 @@ from typing import Any, BinaryIO, Iterator, List, Optional, Tuple
 from ..core import serial
 from ..core.behaviour import ScalarCCRDT
 from ..core.clock import LogicalClock, ReplicaContext
+from ..utils import faults
 from .replay import ScalarReplay
 
 SNAP_MAGIC = b"CCKP"
@@ -101,6 +102,45 @@ class Journal:
         if self.path is None:
             return len(self._mem)
         return sum(1 for _ in self.entries())
+
+    def repair(self) -> int:
+        """Crash-consistent open: truncate a torn tail in place.
+
+        A process killed mid-append leaves a partial final frame (the
+        header or record cut short). `entries()` stays STRICT — a torn
+        read in the middle of normal operation is a real error — but
+        recovery (`resume`) calls this first: scan frames from the
+        start, find the end of the last complete record, truncate the
+        file there, and return the number of bytes discarded. The intact
+        prefix is exactly what was durable (appends fsync per record),
+        and truncating — rather than skipping — matters because later
+        appends must land after the last good frame, not after garbage.
+        """
+        if self.path is None:
+            return 0
+        if self._fh is not None:
+            self._fh.flush()
+        size = os.path.getsize(self.path)
+        good = 0
+        with open(self.path, "rb") as f:
+            while True:
+                hdr = f.read(4)
+                if len(hdr) != 4:
+                    break
+                (n,) = struct.unpack("<I", hdr)
+                rec = f.read(n)
+                if len(rec) != n:
+                    break
+                good += 4 + n
+        torn = size - good
+        if torn:
+            os.truncate(self.path, good)
+            if self._fh is not None:
+                # Reopen the append handle: a buffered position past the
+                # truncation point would resurrect the torn bytes.
+                self._fh.close()
+                self._fh = open(self.path, "ab")
+        return torn
 
 
 class CheckpointingReplay(ScalarReplay):
@@ -189,7 +229,12 @@ def resume(
 ) -> CheckpointingReplay:
     """Restore from `snapshot` (or fresh state if None) and replay the
     journal suffix. Deterministic: replayed prepare ops re-derive the same
-    effect ops because the snapshot restored the logical clocks."""
+    effect ops because the snapshot restored the logical clocks.
+
+    Recovery is crash-consistent: a torn final journal record (the crash
+    landed mid-append) is truncated away first (`Journal.repair`) — the
+    intact prefix replays, the tail is discarded."""
+    journal.repair()
     if snapshot is None:
         if n_replicas is None:
             raise ValueError("n_replicas required when starting without a snapshot")
@@ -220,6 +265,11 @@ def save_dense_checkpoint(path: str, name: str, state: Any, step: int = 0) -> No
         f.write(blob)
         f.flush()
         os.fsync(f.fileno())
+    # Fault point `ckpt.replace`: a raise here is a crash between the
+    # durable tmp write and the commit — the previous checkpoint must
+    # survive untouched (the .tmp is harmless debris).
+    if faults.ACTIVE:
+        faults.fire("ckpt.replace")
     os.replace(tmp, path)
 
 

@@ -1,0 +1,320 @@
+"""Per-replica write-ahead delta log: crash-consistent worker recovery.
+
+The elastic tier (parallel/elastic.py) survives crashes by PEER adoption:
+op generation is deterministic, so survivors regenerate a dead member's
+whole history. That is the fallback of last resort — it costs a full
+re-apply of every adopted replica's stream and only works while op
+streams are regenerable. This module gives each worker its own durable
+recovery path, the way a database pairs WAL + checkpoint:
+
+* `WriteAheadLog` — generic append-only segmented log of (seq, payload)
+  records. Framing per record::
+
+      u32le frame_len | u32le crc32(frame) | frame
+      frame := u64le seq ++ payload
+
+  CRC covers seq+payload, so a torn OR bit-rotted tail is detected, not
+  replayed. Records fsync per append (`wal.fsync` fault point); segments
+  rotate at a byte threshold; `compact(watermark)` drops whole segments
+  whose records are all <= the watermark (the caller ties the watermark
+  to state already captured by a checkpoint AND acked by the gossip
+  medium). On open, a torn tail is truncated in place and any segments
+  after the tear are dropped — bytes after a torn frame were never
+  acknowledged to anyone.
+
+* `ElasticWal` — the elastic-worker discipline on top: each applied op
+  batch is logged as a join-decomposed delta (`parallel.delta
+  .make_delta`) BEFORE the state is published, and a periodic full
+  checkpoint (`save_dense_checkpoint` format) anchors compaction.
+  `recover` rebuilds state = checkpoint ⊔ WAL-delta suffix — safe by
+  exactly the delta-chaining argument from parallel/delta.py: every
+  record was cut against the direct ancestor lineage of the checkpoint,
+  so joining the expanded deltas in seq order reproduces the pre-crash
+  state (records older than the checkpoint re-join harmlessly).
+
+A `kill -9` mid-run therefore costs a worker nothing it had appended:
+it restores checkpoint ⊔ suffix, rejoins gossip, and continues at the
+step after its last durable record — peer adoption remains the fallback
+when the WAL itself is lost (tests pin both paths).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
+
+from ..core import serial
+from ..utils import faults
+from ..utils.metrics import Metrics
+from .checkpoint import load_dense_checkpoint, save_dense_checkpoint
+
+_HDR = struct.Struct("<II")  # frame_len, crc32
+_SEQ = struct.Struct("<Q")
+_SEG_PREFIX = "seg-"
+_SEG_SUFFIX = ".wal"
+
+
+def _seg_name(idx: int) -> str:
+    return f"{_SEG_PREFIX}{idx:08d}{_SEG_SUFFIX}"
+
+
+class WriteAheadLog:
+    """Segmented, CRC-framed, fsync-per-append write-ahead log."""
+
+    def __init__(
+        self,
+        root: str,
+        segment_bytes: int = 1 << 20,
+        sync: bool = True,
+        metrics: Optional[Metrics] = None,
+    ):
+        self.root = root
+        self.segment_bytes = int(segment_bytes)
+        self.sync = sync
+        self.metrics = metrics if metrics is not None else Metrics()
+        os.makedirs(root, exist_ok=True)
+        self._seg_max: Dict[int, int] = {}  # segment idx -> max seq in it
+        self.last_seq = -1
+        self.torn_bytes = 0
+        self._scan_and_repair()
+        self._cur = max(self._seg_max) if self._seg_max else 0
+        self._fh = open(self._path(self._cur), "ab")
+
+    # -- layout ------------------------------------------------------------
+
+    def _path(self, idx: int) -> str:
+        return os.path.join(self.root, _seg_name(idx))
+
+    def _segments(self) -> List[int]:
+        out = []
+        for f in os.listdir(self.root):
+            if f.startswith(_SEG_PREFIX) and f.endswith(_SEG_SUFFIX):
+                try:
+                    out.append(int(f[len(_SEG_PREFIX):-len(_SEG_SUFFIX)]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    # -- open-time repair --------------------------------------------------
+
+    def _scan_and_repair(self) -> None:
+        """Validate every segment in order; on the first torn/corrupt
+        frame, truncate that segment there and DELETE all later segments
+        (a record is only durable if every byte before it is — bytes
+        past a tear were never acknowledged)."""
+        segs = self._segments()
+        for pos, idx in enumerate(segs):
+            good, max_seq, n = self._scan_segment(self._path(idx))
+            size = os.path.getsize(self._path(idx))
+            if n:
+                self._seg_max[idx] = max_seq
+                self.last_seq = max(self.last_seq, max_seq)
+            if good < size:
+                self.torn_bytes += size - good
+                os.truncate(self._path(idx), good)
+                for later in segs[pos + 1:]:
+                    self.torn_bytes += os.path.getsize(self._path(later))
+                    os.remove(self._path(later))
+                break
+        if self.torn_bytes:
+            self.metrics.count("wal.torn_bytes", self.torn_bytes)
+
+    @staticmethod
+    def _scan_segment(path: str) -> Tuple[int, int, int]:
+        """-> (valid_prefix_bytes, max_seq, n_records)."""
+        good, max_seq, n = 0, -1, 0
+        with open(path, "rb") as f:
+            while True:
+                hdr = f.read(_HDR.size)
+                if len(hdr) != _HDR.size:
+                    break
+                ln, crc = _HDR.unpack(hdr)
+                frame = f.read(ln)
+                if len(frame) != ln or zlib.crc32(frame) != crc:
+                    break
+                max_seq = max(max_seq, _SEQ.unpack(frame[:_SEQ.size])[0])
+                n += 1
+                good += _HDR.size + ln
+        return good, max_seq, n
+
+    # -- append / rotate ---------------------------------------------------
+
+    def append(self, seq: int, payload: bytes) -> None:
+        frame = _SEQ.pack(seq) + payload
+        rec = _HDR.pack(len(frame), zlib.crc32(frame)) + frame
+        if self._fh.tell() + len(rec) > self.segment_bytes and self._fh.tell() > 0:
+            self._rotate()
+        self._fh.write(rec)
+        self._fh.flush()
+        if self.sync:
+            # Fault point `wal.fsync`: an injected EIO surfaces to the
+            # caller exactly like a dying disk — the record is NOT
+            # durable and the caller must not publish past it.
+            if faults.ACTIVE:
+                faults.fire("wal.fsync")
+            os.fsync(self._fh.fileno())
+        self._seg_max[self._cur] = max(self._seg_max.get(self._cur, -1), seq)
+        self.last_seq = max(self.last_seq, seq)
+        self.metrics.count("wal.appends")
+        self.metrics.count("wal.bytes", len(rec))
+
+    def _rotate(self) -> None:
+        self._fh.close()
+        self._cur += 1
+        self._fh = open(self._path(self._cur), "ab")
+        self.metrics.count("wal.rotations")
+
+    # -- read / compact ----------------------------------------------------
+
+    def records(self) -> Iterator[Tuple[int, bytes]]:
+        """All (seq, payload) records in segment+offset order. The open-
+        time repair already removed any tear; a frame going bad AFTER
+        open (concurrent corruption) stops iteration at the last valid
+        prefix, mirroring the open-time policy."""
+        self._fh.flush()
+        for idx in sorted(self._seg_max) if self._seg_max else []:
+            with open(self._path(idx), "rb") as f:
+                while True:
+                    hdr = f.read(_HDR.size)
+                    if len(hdr) != _HDR.size:
+                        break
+                    ln, crc = _HDR.unpack(hdr)
+                    frame = f.read(ln)
+                    if len(frame) != ln or zlib.crc32(frame) != crc:
+                        return
+                    yield _SEQ.unpack(frame[:_SEQ.size])[0], frame[_SEQ.size:]
+
+    def compact(self, watermark: int) -> int:
+        """Remove closed segments whose every record seq <= watermark.
+        The ACTIVE segment never goes (truncating the file under the
+        append handle is not crash-safe); rotation keeps it bounded.
+        Returns the number of segments removed."""
+        removed = 0
+        for idx in sorted(self._seg_max):
+            if idx == self._cur:
+                continue
+            if self._seg_max[idx] <= watermark:
+                os.remove(self._path(idx))
+                del self._seg_max[idx]
+                removed += 1
+        if removed:
+            self.metrics.count("wal.segments_compacted", removed)
+        return removed
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# -- elastic-worker discipline ---------------------------------------------
+
+
+class ElasticWal:
+    """Checkpoint + delta WAL for one elastic gossip worker.
+
+    Record payload: ``encode_term((step, owned_list)) is framed inside
+    the ETF term together with the delta blob`` — concretely
+    ``encode_term((step, owned, delta_blob))`` where ``delta_blob`` is
+    the same `dumps_dense(f"{name}_delta", delta)` encoding the gossip
+    tier ships, so WAL records and wire deltas stay one format.
+    """
+
+    SNAP = "snap.ckpt"
+
+    def __init__(
+        self,
+        root: str,
+        member: str,
+        dense: Any,
+        name: str,
+        segment_bytes: int = 256 << 10,
+        metrics: Optional[Metrics] = None,
+    ):
+        self.dir = os.path.join(root, f"wal-{member}")
+        self.member = member
+        self.dense = dense
+        self.name = name
+        self.metrics = metrics if metrics is not None else Metrics()
+        self.log = WriteAheadLog(
+            self.dir, segment_bytes=segment_bytes, metrics=self.metrics
+        )
+
+    # -- write path --------------------------------------------------------
+
+    def log_step(self, step: int, owned, prev_view: Any, view: Any) -> int:
+        """Append this step's join-decomposed delta (prev_view -> view)
+        plus its ownership record. MUST run before the step's publish:
+        write-ahead means the durable record precedes any externally
+        visible effect. Returns the appended payload size."""
+        from ..parallel.delta import make_delta
+
+        delta = make_delta(self.dense, prev_view, view)
+        blob = serial.dumps_dense(f"{self.name}_delta", delta)
+        payload = serial.encode_term((int(step), [int(r) for r in owned], blob))
+        self.log.append(step, payload)
+        return len(payload)
+
+    def checkpoint(self, view: Any, step: int) -> None:
+        """Anchor: durable full state at `step`, then compact every
+        closed segment fully covered by it. Call only for state already
+        PUBLISHED at this step — the watermark must never pass gossip
+        acks, or a crash between checkpoint and publish could discard
+        deltas peers have not seen."""
+        save_dense_checkpoint(
+            os.path.join(self.dir, self.SNAP), self.name, view, step=step
+        )
+        self.log.compact(step)
+        self.metrics.count("wal.checkpoints")
+
+    # -- recovery ----------------------------------------------------------
+
+    def recover(self, like_view: Any) -> Tuple[Optional[Any], int, Set[int]]:
+        """-> (recovered_view_or_None, last_step, owned_union).
+
+        recovered_view = checkpoint ⊔ WAL-delta suffix (joined in seq
+        order on top of `like_view`'s structure); last_step is the
+        highest durable step (-1 = nothing recovered); owned_union is
+        every replica id the lost incarnation logged ownership of."""
+        from ..parallel.delta import apply_any_delta, like_delta_for
+
+        state: Optional[Any] = None
+        last_step = -1
+        snap_path = os.path.join(self.dir, self.SNAP)
+        if os.path.exists(snap_path):
+            try:
+                step, _name, state = load_dense_checkpoint(
+                    snap_path, like_view, dense=self.dense
+                )
+                last_step = max(last_step, int(step))
+                self.metrics.count("wal.recovered_snapshot")
+            except Exception:  # noqa: BLE001 — a torn/foreign checkpoint
+                state = None   # must not block WAL replay (total recovery)
+        like_delta = like_delta_for(self.dense, like_view)
+        owned: Set[int] = set()
+        n = 0
+        for seq, payload in self.log.records():
+            try:
+                step, rec_owned, blob = serial.decode_term(payload)
+                _name, delta = serial.loads_dense(blob, like_delta)
+                base = like_view if state is None else state
+                state = apply_any_delta(self.dense, base, delta)
+            except Exception:  # noqa: BLE001 — skip undecodable record,
+                continue       # the join tolerates gaps (next snapshot wins)
+            owned.update(int(r) for r in rec_owned)
+            last_step = max(last_step, int(step))
+            n += 1
+        if n:
+            self.metrics.count("wal.recovered_records", n)
+        return state, last_step, owned
+
+    def close(self) -> None:
+        self.log.close()

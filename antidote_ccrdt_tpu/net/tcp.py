@@ -47,6 +47,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from ..bridge.protocol import pack_frame, unpack_frames
 from ..core.etf import Atom
+from ..utils import faults
 from ..utils.metrics import Metrics
 from .membership import Membership
 
@@ -162,8 +163,17 @@ class _PeerLink:
                         return
                 continue
             frame = build()
+            dropped = False
             try:
-                self._sock.sendall(frame)
+                # Fault point `tcp.send`: raise = connection reset mid-send
+                # (exercises the reconnect/backoff path exactly like a real
+                # ECONNRESET); drop = frame lost on the wire (the queue
+                # treats it as sent — receivers resync via anchors).
+                if faults.ACTIVE and faults.fire("tcp.send") == "drop":
+                    dropped = True
+                    self.metrics.count("net.fault_drops")
+                else:
+                    self._sock.sendall(frame)
             except OSError:
                 try:
                     self._sock.close()
@@ -180,8 +190,9 @@ class _PeerLink:
                     self._q.remove((kind, build))
                 except ValueError:
                     pass
-            self.metrics.count("net.frames_sent")
-            self.metrics.count("net.bytes_sent", len(frame))
+            if not dropped:
+                self.metrics.count("net.frames_sent")
+                self.metrics.count("net.bytes_sent", len(frame))
 
 
 class TcpTransport:

@@ -31,6 +31,7 @@ import struct
 import time
 from typing import Any, List, Optional, Protocol, Tuple, runtime_checkable
 
+from ..utils import faults
 from ..utils.metrics import Metrics
 
 
@@ -133,6 +134,11 @@ class FsTransport:
     # -- snapshots ---------------------------------------------------------
 
     def publish(self, blob: bytes) -> None:
+        if faults.ACTIVE:
+            mangled = faults.mangle("transport.publish", blob)
+            if mangled is None:
+                return  # injected drop: the publish silently never lands
+            blob = mangled
         path = os.path.join(self.root, f"snap-{self.member}")
         tmp = f"{path}.tmp"
         with open(tmp, "wb") as f:
@@ -166,10 +172,22 @@ class FsTransport:
     # -- deltas ------------------------------------------------------------
 
     def publish_delta(self, seq: int, blob: bytes, keep: int = 16) -> None:
+        if faults.ACTIVE:
+            mangled = faults.mangle("transport.publish_delta", blob)
+            if mangled is None:
+                return  # injected drop
+            blob = mangled
         path = os.path.join(self.root, f"delta-{self.member}-{seq:08d}")
         tmp = f"{path}.tmp"
         with open(tmp, "wb") as f:
             f.write(blob)
+            # fsync BEFORE the rename commits the name, matching `publish`:
+            # without it a crash can leave delta-<m>-<seq> present but
+            # empty/torn, which a peer reads as seq-present-but-garbage
+            # (fetch_delta decodes to None forever — a permanent chain
+            # break at that seq until the window prunes it).
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
         self.heartbeat()
         for s in self.delta_seqs(self.member):
@@ -183,10 +201,18 @@ class FsTransport:
 
     def fetch_delta(self, member: str, seq: int) -> Optional[bytes]:
         try:
+            # The fault point sits INSIDE the try: an injected OSError
+            # reads as None, preserving the Transport totality contract
+            # (exactly how a real EIO on this read must behave).
+            if faults.ACTIVE:
+                faults.fire("transport.fetch_delta")
             with open(
                 os.path.join(self.root, f"delta-{member}-{seq:08d}"), "rb"
             ) as f:
-                return f.read()
+                blob = f.read()
+            if faults.ACTIVE:
+                blob = faults.mangle("transport.fetch_delta.read", blob)
+            return blob
         except OSError:
             return None
 

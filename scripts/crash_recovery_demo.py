@@ -1,0 +1,168 @@
+"""Real-process crash/recovery drill: SIGKILL a WAL-backed worker mid-run.
+
+Supervises a 3-member elastic gossip fleet (scripts/elastic_demo.py
+workers, shared-directory transport) with the crash-consistent WAL
+enabled (--wal-dir). Once the victim has published a couple of steps it
+is SIGKILLed — no cleanup, torn WAL tail possible — then restarted, and
+every member's final digest must equal the sequential single-process
+reference (the no-fault ground truth pinned by tests/test_elastic.py).
+
+Two modes, both required by the robustness PR's acceptance bar:
+
+* ``wal``   — the victim restarts with its WAL intact: it must recover
+  state = checkpoint ⊔ WAL suffix (``wal.recovered_records > 0`` in its
+  final metrics) and resume AFTER its last durable step instead of
+  regenerating history.
+* ``adopt`` — same crash, but the victim's WAL directory is deleted
+  before the restart and the restart is delayed past failure detection:
+  recovery must fall back to the deterministic-regeneration/adoption
+  path (``wal.recovered_records`` absent) and still converge — the PR 1
+  invariant stays load-bearing when the durable path is gone.
+
+Run:  python scripts/crash_recovery_demo.py [--mode both] [--type topk_rmv]
+Make: make crash-demo
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import struct
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEMO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "elastic_demo.py")
+MEMBERS = ("w0", "w1", "w2")
+VICTIM = "w1"
+
+
+def _env() -> dict:
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # parent flags (device counts) break workers
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _launch(root: str, member: str, type_name: str, wal_dir: str):
+    return subprocess.Popen(
+        [sys.executable, DEMO, "--root", root, "--member", member,
+         "--n-members", str(len(MEMBERS)), "--type", type_name,
+         "--wal-dir", wal_dir],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=_env(), text=True,
+    )
+
+
+def _snap_seq(root: str, member: str):
+    """The 8-byte step header of `member`'s published snapshot, or None."""
+    try:
+        with open(os.path.join(root, f"snap-{member}"), "rb") as f:
+            hdr = f.read(8)
+    except OSError:
+        return None
+    if len(hdr) != 8:
+        return None
+    return struct.unpack("<Q", hdr)[0]
+
+
+def run_scenario(mode: str, type_name: str, timeout: float) -> dict:
+    """One kill/restart drill; returns a verdict dict (ok + evidence)."""
+    from scripts.elastic_demo import reference_digest
+
+    root = tempfile.mkdtemp(prefix=f"crash-{mode}-")
+    wal_dir = os.path.join(root, "wal")
+    procs = {m: _launch(root, m, type_name, wal_dir) for m in MEMBERS}
+
+    # Wait for the victim to have durable, published progress (a couple
+    # of steps in the WAL AND visible to peers), then SIGKILL it.
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        seq = _snap_seq(root, VICTIM)
+        if seq is not None and 2 <= seq < 8:
+            break
+        if procs[VICTIM].poll() is not None:
+            raise RuntimeError("victim exited before the kill point")
+        time.sleep(0.01)
+    else:
+        raise RuntimeError("victim never reached the kill window")
+    procs[VICTIM].kill()  # SIGKILL: no atexit, no flush, torn tail possible
+    procs[VICTIM].wait()
+
+    if mode == "adopt":
+        # Destroy the durable path entirely and hold the restart past
+        # failure detection: survivors must adopt, the restarted victim
+        # must self-regenerate — convergence without WAL recovery.
+        shutil.rmtree(os.path.join(wal_dir, f"wal-{VICTIM}"), ignore_errors=True)
+        time.sleep(1.0)
+    procs[VICTIM] = _launch(root, VICTIM, type_name, wal_dir)
+
+    rcs, outs = {}, {}
+    for m, p in procs.items():
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        rcs[m], outs[m] = p.returncode, out
+
+    # JSON round-trip: digests come back from the workers' final-*.json
+    # as lists, the in-process reference may hold tuples.
+    ref = json.loads(json.dumps(reference_digest(type_name)))
+    finals, bad = {}, []
+    for m in MEMBERS:
+        path = os.path.join(root, f"final-{m}.json")
+        if not os.path.exists(path):
+            bad.append(f"{m}: no final (rc={rcs[m]})\n{outs[m][-2000:]}")
+            continue
+        with open(path) as f:
+            finals[m] = json.load(f)
+        if finals[m]["digest"] != ref:
+            bad.append(f"{m}: digest != reference")
+
+    recovered = int(
+        finals.get(VICTIM, {}).get("metrics", {}).get("wal.recovered_records", 0)
+    )
+    if mode == "wal" and recovered <= 0:
+        bad.append("victim converged without WAL recovery (recovered_records=0)")
+    if mode == "adopt" and recovered > 0:
+        bad.append(f"adopt mode unexpectedly recovered {recovered} WAL records")
+
+    verdict = {
+        "mode": mode,
+        "type": type_name,
+        "ok": not bad,
+        "problems": bad,
+        "victim_recovered_records": recovered,
+        "victim_resume_step": finals.get(VICTIM, {})
+        .get("metrics", {})
+        .get("wal.resume_step"),
+        "returncodes": rcs,
+        "root": root,
+    }
+    if not bad:
+        shutil.rmtree(root, ignore_errors=True)
+        verdict.pop("root")
+    return verdict
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", default="both", choices=("wal", "adopt", "both"))
+    ap.add_argument("--type", default="topk_rmv")
+    ap.add_argument("--timeout", type=float, default=240.0)
+    args = ap.parse_args()
+
+    modes = ("wal", "adopt") if args.mode == "both" else (args.mode,)
+    verdicts = [run_scenario(m, args.type, args.timeout) for m in modes]
+    print(json.dumps(verdicts, indent=2), flush=True)
+    if not all(v["ok"] for v in verdicts):
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

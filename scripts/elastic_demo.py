@@ -282,11 +282,25 @@ def main() -> None:
         help="gossip chained deltas (DeltaPublisher) instead of full "
         "snapshots on every publish",
     )
+    ap.add_argument(
+        "--wal-dir", default="",
+        help="enable the crash-consistent write-ahead delta log "
+        "(harness/wal.py) under this directory: every applied op batch "
+        "is appended (CRC-framed, fsynced) BEFORE the publish, and a "
+        "restart recovers state = checkpoint ⊔ WAL suffix then resumes "
+        "at the step after the last durable record — instead of "
+        "regenerating its whole history via peer adoption",
+    )
+    ap.add_argument("--wal-segment-bytes", type=int, default=256 << 10)
     args = ap.parse_args()
 
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+    from antidote_ccrdt_tpu.utils import faults
+
+    faults.install_from_env()  # supervisor-injected deterministic faults
 
     from antidote_ccrdt_tpu.parallel.elastic import GossipStore
 
@@ -317,8 +331,43 @@ def run_worker(store, drill, dense, state, args, result_dir):
         sweep_deltas,
     )
 
+    from antidote_ccrdt_tpu.parallel.monoid import MonoidLift
+
     pub = None  # set below when --delta
     cursors: dict = {}
+    owned_prev: set = set()
+
+    # --- crash-consistent WAL (tentpole, PR 2): recover checkpoint ⊔
+    # delta suffix, resume AFTER the last durable step. Peer adoption
+    # stays the fallback: with no (or a deleted) WAL this block recovers
+    # nothing and the worker rebuilds via the ownership/adopt path below.
+    wal = None
+    start_step = 0
+    wal_dir = getattr(args, "wal_dir", "")
+    if wal_dir:
+        from antidote_ccrdt_tpu.harness.wal import ElasticWal
+
+        wal = ElasticWal(
+            wal_dir, args.member, dense, drill.publish_name,
+            segment_bytes=getattr(args, "wal_segment_bytes", 256 << 10),
+            metrics=store.metrics,
+        )
+        rec_state, last_step, rec_owned = wal.recover(
+            drill.pub_state(dense, state)
+        )
+        if last_step >= 0 and rec_state is not None:
+            state = drill.set_view(dense, state, rec_state)
+            start_step = last_step + 1
+            store.metrics.set("wal.resume_step", start_step)
+            if not isinstance(dense, MonoidLift):
+                # JOIN engines: the recovered state already holds these
+                # replicas' history, so they are NOT "gained" (no full
+                # regeneration — that is the WAL's whole point).
+                owned_prev = set(rec_owned)
+            # MONOID engines keep owned_prev empty: the recovered view is
+            # absorbed as peer rows (set_view), and the adopt path below
+            # regenerates the own-side contribution with versions identical
+            # to the lost incarnation's — row-replace dedups the overlap.
 
     def do_publish(store, seq_hint):
         view = drill.pub_state(dense, state)
@@ -337,6 +386,14 @@ def run_worker(store, drill, dense, state, args, result_dir):
 
     if args.delta:
         pub = DeltaPublisher(store, dense, name=drill.publish_name, full_every=4)
+        if start_step > 0:
+            # Resume the delta-seq lineage PAST anything the lost
+            # incarnation published (old seq <= old step < start_step):
+            # peers' per-member cursors sit at the old high seq, so a
+            # seq restart from 0 would read as already-seen and be
+            # dropped forever. A fresh incarnation's first publish is a
+            # full snapshot (no _prev), which resyncs every peer.
+            pub.seq = start_step
 
     # Background heartbeat: dies with the process, so a crash goes stale.
     def beat():
@@ -351,10 +408,10 @@ def run_worker(store, drill, dense, state, args, result_dir):
     while args.join_late == 0 and len(store.members()) < args.n_members:
         time.sleep(0.02)
 
-    owned_prev: set = set()
-    for step in range(STEPS):
+    for step in range(start_step, STEPS):
         if step == args.die_at:
             os._exit(1)  # crash: no cleanup, heartbeat goes stale
+        pre_view = drill.pub_state(dense, state) if wal is not None else None
         # Ownership only ever GROWS during a run: dropping a replica on a
         # membership change is unsafe under asymmetric views (member A may
         # drop r for new owner B before B has even seen the new map — r's
@@ -374,10 +431,24 @@ def run_worker(store, drill, dense, state, args, result_dir):
             state = drill.adopt(dense, state, sorted(gained), step)
         owned_prev = owned
         state = drill.apply(dense, state, step, sorted(owned))
+        if wal is not None:
+            # Write-ahead: this step's adopt+apply delta must be durable
+            # BEFORE the publish makes it externally visible — a crash
+            # after publish but before append could otherwise leave peers
+            # holding state the restarted worker cannot re-derive.
+            wal.log_step(
+                step, sorted(owned), pre_view, drill.pub_state(dense, state)
+            )
         if step % args.publish_every == 0:
             with store.metrics.timer("net.round"):
                 do_publish(store, step)
                 state, _ = do_sweep(store, state)
+            if wal is not None:
+                # Anchor AFTER the publish: the compaction watermark must
+                # never pass what gossip has seen (checkpoint durability
+                # substitutes for the compacted deltas only once peers
+                # could fetch the same state).
+                wal.checkpoint(drill.pub_state(dense, state), step)
         time.sleep(args.step_sleep)
 
     # Final convergence: publish/sweep until every member that ever
@@ -418,6 +489,8 @@ def run_worker(store, drill, dense, state, args, result_dir):
         time.sleep(0.1)
     swept, _ = sweep(store, dense, drill.pub_state(dense, state))
     state = drill.set_view(dense, state, swept)
+    if wal is not None:
+        wal.close()
 
     out = {
         "member": args.member,

@@ -743,3 +743,137 @@ def test_grid_compact_differential_through_grid_wire(client):
 
     with pytest.raises(Exception, match="no whole-log compactor"):
         client.grid_compact("mystery", [])
+
+
+# --- robustness PR: structured errors, deadlines, idempotent resends -------
+
+
+def test_structured_error_frame_on_the_wire(server):
+    """Errors ship as {error, {Kind, Msg}} — Kind an atom a BEAM host can
+    dispatch on — and every one bumps the server's error counters."""
+    before = server.metrics.counters.get("bridge.errors", 0)
+    with socket.create_connection(server.address, timeout=10) as sk:
+        payload = etf.encode((Atom("call"), 42, (Atom("bogus"), 1)))
+        sk.sendall(struct.pack(">I", len(payload)) + payload)
+        hdr = sk.recv(4, socket.MSG_WAITALL)
+        (n,) = struct.unpack(">I", hdr)
+        data = b""
+        while len(data) < n:
+            data += sk.recv(n - len(data))
+        term = etf.decode(data)
+    assert term[0] == Atom("reply") and term[1] == 42
+    err = term[2]
+    assert err[0] == Atom("error")
+    kind, msg = err[1]
+    assert kind == Atom("ValueError")
+    assert b"unknown op" in msg
+    assert server.metrics.counters.get("bridge.errors", 0) == before + 1
+    assert server.metrics.counters.get("bridge.errors.ValueError", 0) >= 1
+
+
+def test_malformed_request_gets_bad_request_kind(server):
+    with socket.create_connection(server.address, timeout=10) as sk:
+        payload = etf.encode((Atom("whatever"), 1, 2, 3, 4))
+        sk.sendall(struct.pack(">I", len(payload)) + payload)
+        hdr = sk.recv(4, socket.MSG_WAITALL)
+        (n,) = struct.unpack(">I", hdr)
+        data = b""
+        while len(data) < n:
+            data += sk.recv(n - len(data))
+        term = etf.decode(data)
+    rid, ok, payload = P.parse_reply(term)
+    assert not ok
+    assert "bad_request" in P.error_text(payload)
+
+
+def test_error_text_legacy_bare_binary():
+    """Old peers send {error, Binary}: the decode path must keep
+    rendering it (compat with pre-structured-error servers)."""
+    assert "boom" in P.error_text(b"boom")
+    assert "KeyError: 9" == P.error_text((Atom("KeyError"), b"9"))
+
+
+def test_icall_resend_replays_cached_reply(server):
+    """The idempotency contract, raw on the wire: the SAME (token, req
+    id) sent twice executes once — the second reply is served from the
+    cache (bridge.replays) and is byte-identical."""
+    replays_before = server.metrics.counters.get("bridge.replays", 0)
+    with socket.create_connection(server.address, timeout=10) as sk:
+        def rpc(term):
+            payload = etf.encode(term)
+            sk.sendall(struct.pack(">I", len(payload)) + payload)
+            hdr = sk.recv(4, socket.MSG_WAITALL)
+            (n,) = struct.unpack(">I", hdr)
+            data = b""
+            while len(data) < n:
+                data += sk.recv(n - len(data))
+            return etf.decode(data)
+
+        token = b"tok-test-1"
+        r1 = rpc((Atom("icall"), token, 1, (Atom("new"), Atom("average"), [])))
+        h = r1[2][1]
+        up = (Atom("icall"), token, 2, (Atom("update"), h, (Atom("add"), (5, 1))))
+        first = rpc(up)
+        second = rpc(up)  # resend: must NOT double-apply
+        assert first == second
+        r = rpc((Atom("icall"), token, 3, (Atom("to_binary"), h)))
+        state = wire.from_reference_binary("average", r[2][1])
+    assert state == (5, 1)  # one application, not (10, 2)
+    assert server.metrics.counters.get("bridge.replays", 0) == replays_before + 1
+
+
+def test_read_deadline_reaps_idle_connection():
+    """A half-open client holding a connection without sending frames is
+    dropped at the read deadline instead of pinning a thread forever."""
+    import time
+
+    with BridgeServer(read_deadline=0.3) as srv:
+        with socket.create_connection(srv.address, timeout=10) as sk:
+            deadline = time.time() + 8.0
+            dropped = False
+            while time.time() < deadline:
+                try:
+                    if sk.recv(1) == b"":
+                        dropped = True
+                        break
+                except OSError:
+                    dropped = True
+                    break
+            assert dropped, "idle connection was never reaped"
+        assert srv.metrics.counters.get("bridge.read_deadline_drops", 0) >= 1
+        # An ACTIVE client inside the deadline still works.
+        with BridgeClient(*srv.address, timeout=5.0) as c:
+            assert c.value(c.new("average")) == 0.0
+
+
+def test_client_timeout_is_constructor_configurable():
+    """The 30s hardwired timeout is gone: the constructor value applies
+    to connect AND to every reply read, end to end."""
+    import threading
+    import time
+
+    lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(1)
+    addr = lst.getsockname()
+    holes = []
+
+    def accept_and_hold():
+        conn, _ = lst.accept()
+        holes.append(conn)  # accept, then never reply
+
+    t = threading.Thread(target=accept_and_hold, daemon=True)
+    t.start()
+    try:
+        c = BridgeClient(*addr, timeout=0.4)
+        assert c._sock.gettimeout() == 0.4
+        t0 = time.time()
+        with pytest.raises(Exception):
+            c.call((Atom("value"), 1))
+        elapsed = time.time() - t0
+        assert elapsed < 5.0  # the old hardwired 30s would hang here
+        c.close()
+    finally:
+        for conn in holes:
+            conn.close()
+        lst.close()

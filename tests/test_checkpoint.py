@@ -155,3 +155,55 @@ def test_dense_checkpoint_roundtrip(tmp_path):
 
     for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(back)):
         assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_resume_truncates_torn_tail_and_replays_prefix(tmp_path):
+    """Crash mid-append: the final journal record is torn. `resume` must
+    repair first (truncate the tail in place), replay the intact prefix
+    bit-identically, and leave the journal appendable after the last
+    good record — while `entries()` alone stays strict."""
+    crdt = TopkRmvScalar()
+    ops = make_ops(n=20)
+    jpath = str(tmp_path / "wal.bin")
+    with Journal(jpath) as j:
+        rp = CheckpointingReplay(crdt, 3, new_args=(4,), journal=j)
+        drive(rp, ops)
+
+    # Reference: replay only the intact prefix (all but the last record).
+    ref = CheckpointingReplay(crdt, 3, new_args=(4,))
+    drive(ref, ops[:-1])
+
+    import os
+
+    size = os.path.getsize(jpath)
+    os.truncate(jpath, size - 3)  # tear the last record mid-payload
+
+    with Journal(jpath) as j2:
+        rec = resume(crdt, None, j2, n_replicas=3, new_args=(4,))
+        # The tail is gone, the prefix replayed exactly.
+        assert len(j2) == len(ops) - 1 + (len(ops) - 1) // 7
+        ref.sync()
+        rec.sync()
+        for a, b in zip(ref.states, rec.states):
+            assert crdt.equal(a, b)
+        # Post-repair appends land after the last good frame.
+        origin, op = ops[-1]
+        rec.submit(origin, op)
+    with Journal(jpath) as j3:
+        assert list(j3.entries())  # every frame decodes cleanly
+
+
+def test_resume_repairs_torn_header_tail(tmp_path):
+    """A crash can also tear mid-HEADER (fewer than 4 length bytes)."""
+    crdt = TopkRmvScalar()
+    ops = make_ops(n=6)
+    jpath = str(tmp_path / "wal.bin")
+    with Journal(jpath) as j:
+        rp = CheckpointingReplay(crdt, 3, new_args=(4,), journal=j)
+        for origin, op in ops:
+            rp.submit(origin, op)
+    with open(jpath, "ab") as f:
+        f.write(b"\xff\xff")  # two stray header bytes
+    with Journal(jpath) as j2:
+        assert j2.repair() == 2
+        assert len(list(j2.entries())) == len(ops)

@@ -1,0 +1,46 @@
+"""Real-process crash-consistency drill (slow; `make crash-demo`).
+
+Runs scripts/crash_recovery_demo.py: a 3-member shared-directory gossip
+fleet with the WAL enabled, the victim SIGKILLed mid-run and restarted.
+Asserted twice — recovery through the WAL (checkpoint ⊔ delta suffix,
+resume past the last durable step) and, with the WAL deleted, through
+the peer-adoption fallback — both converging bit-identically to the
+sequential reference.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEMO = os.path.join(REPO, "scripts", "crash_recovery_demo.py")
+
+
+def _run(mode):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    p = subprocess.run(
+        [sys.executable, DEMO, "--mode", mode],
+        capture_output=True, text=True, env=env, timeout=420,
+    )
+    assert p.returncode == 0, f"drill failed:\n{p.stdout[-4000:]}\n{p.stderr[-2000:]}"
+    return json.loads(p.stdout)
+
+
+@pytest.mark.slow
+def test_sigkill_victim_recovers_via_wal():
+    (v,) = _run("wal")
+    assert v["ok"], v
+    assert v["victim_recovered_records"] > 0
+    assert v["victim_resume_step"] is not None and v["victim_resume_step"] >= 1
+
+
+@pytest.mark.slow
+def test_sigkill_victim_without_wal_converges_via_adoption():
+    (v,) = _run("adopt")
+    assert v["ok"], v
+    assert v["victim_recovered_records"] == 0

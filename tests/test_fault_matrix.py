@@ -1,0 +1,278 @@
+"""The chaos matrix: utils/faults.py driven through the REAL code paths.
+
+`make chaos` runs this deterministically under JAX_PLATFORMS=cpu. Each
+test installs a seeded plan against the production injection points —
+FsTransport snapshot/delta I/O, the TCP peer link, the bridge client's
+reply read, WAL fsync, checkpoint replace — and asserts two things: the
+failure has the intended blast radius (totality, fallback, retry,
+exactly-once) and the schedule replays bit-identically from its seed.
+"""
+
+import struct
+
+import pytest
+
+from antidote_ccrdt_tpu.utils import faults
+from antidote_ccrdt_tpu.utils.metrics import Metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+# --- FsTransport -----------------------------------------------------------
+
+
+def test_torn_delta_write_is_never_visible(tmp_path):
+    """The satellite fix: publish_delta fsyncs the tmp file BEFORE the
+    rename commits the name. A torn payload (injected truncation) may
+    ship garbage bytes, but decode-level totality turns it into None —
+    and the windowed seq listing never shows a half-written .tmp."""
+    from antidote_ccrdt_tpu.net.transport import FsTransport, GossipNode
+    from scripts.elastic_demo import DRILLS
+
+    drill = DRILLS["topk_rmv"]
+    dense = drill.make_engine()
+    state = drill.init(dense)
+    state = drill.apply(dense, state, 0, [0])
+
+    from antidote_ccrdt_tpu.parallel.delta import (
+        like_delta_for, make_delta,
+    )
+    from antidote_ccrdt_tpu.core import serial
+
+    delta = make_delta(dense, drill.init(dense), state)
+    blob = serial.dumps_dense("topk_rmv_delta", delta)
+
+    node = GossipNode(FsTransport(str(tmp_path), "a"))
+    with faults.injected(
+        {"transport.publish_delta": [{"action": "truncate", "at": [0], "keep": 0.5}]}
+    ):
+        node.publish_delta(blob, seq=0)   # torn
+        node.publish_delta(blob, seq=1)   # clean
+    assert node.transport.delta_seqs("a") == [0, 1]  # no .tmp leakage
+    like = like_delta_for(dense, state)
+    assert node.fetch_delta("a", 0, like) is None      # torn -> total None
+    assert node.fetch_delta("a", 1, like) is not None  # clean one decodes
+
+
+def test_torn_snapshot_publish_reads_as_none(tmp_path):
+    from antidote_ccrdt_tpu.net.transport import FsTransport, GossipNode
+    from scripts.elastic_demo import DRILLS
+
+    drill = DRILLS["topk_rmv"]
+    dense = drill.make_engine()
+    state = drill.init(dense)
+    node = GossipNode(FsTransport(str(tmp_path), "a"))
+    with faults.injected(
+        {"transport.publish": [{"action": "truncate", "at": [0], "keep": 12}]}
+    ):
+        node.publish("topk_rmv", state, step=3)
+    # The 8-byte step header survives the tear; the payload does not:
+    # seq reads fine, the state fetch is total and returns None.
+    assert node.snapshot_seq("a") == 3
+    assert node.fetch("a", state, dense=dense) is None
+    node.publish("topk_rmv", state, step=4)
+    assert node.fetch("a", state, dense=dense) is not None
+
+
+def test_dropped_snapshot_publish_never_lands(tmp_path):
+    from antidote_ccrdt_tpu.net.transport import FsTransport
+
+    t = FsTransport(str(tmp_path), "a")
+    with faults.injected({"transport.publish": [{"action": "drop", "at": [0]}]}):
+        t.publish(struct.pack("<Q", 1) + b"x")
+        assert t.fetch("a") is None
+        t.publish(struct.pack("<Q", 2) + b"y")
+    assert t.fetch("a") == struct.pack("<Q", 2) + b"y"
+
+
+def test_fetch_delta_oserror_is_total(tmp_path):
+    from antidote_ccrdt_tpu.net.transport import FsTransport
+
+    t = FsTransport(str(tmp_path), "a")
+    t.publish_delta(0, b"d0")
+    with faults.injected(
+        {"transport.fetch_delta": [{"action": "raise", "at": [0]}]}
+    ):
+        assert t.fetch_delta("a", 0) is None  # injected EIO -> None, no raise
+        assert t.fetch_delta("a", 0) == b"d0"
+
+
+def test_fetch_delta_read_tear_breaks_chain_not_process(tmp_path):
+    from antidote_ccrdt_tpu.net.transport import FsTransport, GossipNode
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import make_dense
+    from antidote_ccrdt_tpu.parallel.delta import like_delta_for, make_delta
+    from antidote_ccrdt_tpu.core import serial
+
+    dense = make_dense(n_ids=16, n_dcs=2, size=4, slots_per_id=2)
+    st = dense.init(1, 1)
+    node = GossipNode(FsTransport(str(tmp_path), "a"))
+    node.publish_delta(serial.dumps_dense("d", make_delta(dense, st, st)), seq=0)
+    like = like_delta_for(dense, st)
+    with faults.injected(
+        {"transport.fetch_delta.read": [{"action": "truncate", "at": [0], "keep": 5}]}
+    ):
+        assert node.fetch_delta("a", 0, like) is None
+        assert node.fetch_delta("a", 0, like) is not None
+
+
+# --- TCP peer link ---------------------------------------------------------
+
+
+def test_tcp_send_drop_loses_frame_but_not_link():
+    """An injected send drop models a lost frame: the link survives, the
+    metrics record the drop, and later (re)publishes still deliver —
+    snapshot gossip is latest-wins, so the next anchor heals the gap."""
+    import time
+
+    from antidote_ccrdt_tpu.net.tcp import TcpTransport
+
+    a = TcpTransport("a")
+    b = TcpTransport("b")
+    a.add_peer("b", b.address)
+    b.add_peer("a", a.address)
+    try:
+        with faults.injected({"tcp.send": [{"action": "drop", "at": [0]}]}):
+            a.publish(struct.pack("<Q", 1) + b"first")   # eaten by the fault
+            # Wait for the sender thread to consume (and drop) the frame
+            # BEFORE enqueueing the next one: the snap queue slot is
+            # latest-wins, so publishing earlier would replace the frame
+            # and the drop would eat the second publish instead.
+            deadline = time.time() + 8.0
+            while (
+                time.time() < deadline
+                and a.metrics.counters.get("net.fault_drops", 0) < 1
+            ):
+                time.sleep(0.01)
+        assert b.fetch("a") is None  # the dropped anchor never arrived
+        a.publish(struct.pack("<Q", 2) + b"second")  # delivered
+        deadline = time.time() + 8.0
+        while time.time() < deadline and b.fetch("a") is None:
+            time.sleep(0.01)
+        got = b.fetch("a")
+        assert got == struct.pack("<Q", 2) + b"second"
+        assert a.metrics.counters.get("net.fault_drops", 0) >= 1
+        # The dropped frame was never counted as sent.
+        assert a.metrics.counters.get("net.frames_sent", 0) >= 1
+    finally:
+        a.close()
+        b.close()
+
+
+# --- WAL / checkpoint ------------------------------------------------------
+
+
+def test_wal_fsync_eio_blocks_durability_claim(tmp_path):
+    from antidote_ccrdt_tpu.harness.wal import WriteAheadLog
+
+    w = WriteAheadLog(str(tmp_path))
+    with faults.injected({"wal.fsync": [{"action": "raise", "at": [1]}]}):
+        w.append(0, b"ok")
+        with pytest.raises(faults.InjectedFault):
+            w.append(1, b"not durable")
+    w.close()
+
+
+def test_ckpt_replace_crash_keeps_old_checkpoint(tmp_path):
+    from antidote_ccrdt_tpu.harness.checkpoint import (
+        load_dense_checkpoint, save_dense_checkpoint,
+    )
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import make_dense
+
+    dense = make_dense(n_ids=16, n_dcs=2, size=4, slots_per_id=2)
+    st = dense.init(1, 1)
+    path = str(tmp_path / "c.ckpt")
+    save_dense_checkpoint(path, "topk_rmv", st, step=1)
+    with faults.injected({"ckpt.replace": [{"action": "raise", "at": [0]}]}):
+        with pytest.raises(faults.InjectedFault):
+            save_dense_checkpoint(path, "topk_rmv", st, step=2)
+    step, name, _ = load_dense_checkpoint(path, st)
+    assert (step, name) == (1, "topk_rmv")  # the old anchor survived
+
+
+# --- bridge ----------------------------------------------------------------
+
+
+def test_bridge_read_reset_retries_exactly_once_semantics():
+    """A reply lost to a connection reset is retried under icall: the
+    server dedups on (token, req_id), so a non-idempotent op (average
+    add: + is not a join) executes once even though it was sent twice."""
+    from antidote_ccrdt_tpu.bridge import BridgeClient, BridgeServer
+    from antidote_ccrdt_tpu.core.etf import Atom
+
+    with BridgeServer() as srv:
+        with BridgeClient(*srv.address, timeout=10.0, retries=3) as c:
+            h = c.new("average")
+            with faults.injected(
+                {"bridge.read": [{"action": "raise", "at": [0],
+                                  "message": "connection reset"}]}
+            ):
+                c.update(h, (Atom("add"), (10, 1)))
+            # Applied ONCE: state (10, 1), not (20, 2). The mean hides a
+            # double-apply (20/2 == 10/1), the raw state does not.
+            from antidote_ccrdt_tpu.core import wire
+
+            assert wire.from_reference_binary("average", c.to_binary(h)) == (10, 1)
+            assert c.metrics.counters.get("bridge.reconnects", 0) >= 1
+            assert srv.metrics.counters.get("bridge.replays", 0) >= 1
+
+
+def test_bridge_read_reset_without_retries_poisons():
+    from antidote_ccrdt_tpu.bridge import BridgeClient, BridgeServer
+
+    with BridgeServer() as srv:
+        c = BridgeClient(*srv.address, timeout=5.0)  # retries=0: legacy
+        try:
+            with faults.injected(
+                {"bridge.read": [{"action": "raise", "at": [0]}]}
+            ):
+                with pytest.raises(Exception):
+                    c.new("average")
+            with pytest.raises(Exception, match="closed"):
+                c.new("average")
+        finally:
+            c.close()
+
+
+# --- replay determinism ----------------------------------------------------
+
+
+def test_matrix_schedule_replays_bit_identically(tmp_path):
+    """The acceptance bar: a multi-point scenario replays the SAME fault
+    schedule from the same seed — (point, hit, action) trace equality,
+    not just same counts."""
+    from antidote_ccrdt_tpu.net.transport import FsTransport
+
+    plan = {
+        "transport.publish": [{"action": "drop", "rate": 0.3}],
+        "transport.fetch_delta": [{"action": "raise", "rate": 0.2}],
+        "wal.fsync": [{"action": "raise", "rate": 0.1}],
+    }
+
+    def scenario(root):
+        from antidote_ccrdt_tpu.harness.wal import WriteAheadLog
+
+        t = FsTransport(str(root), "a")
+        w = WriteAheadLog(str(root) + "-wal")
+        for i in range(25):
+            t.publish(struct.pack("<Q", i) + b"s")
+            t.publish_delta(i, b"d%d" % i)
+            t.fetch_delta("a", i)
+            try:
+                w.append(i, b"r%d" % i)
+            except faults.InjectedFault:
+                pass
+        w.close()
+        return faults.trace()
+
+    with faults.injected(plan, seed=31337):
+        t1 = scenario(tmp_path / "one")
+    with faults.injected(plan, seed=31337):
+        t2 = scenario(tmp_path / "two")
+    assert t1 == t2
+    assert len(t1) > 0
+    assert {p for p, _, _ in t1} >= {"transport.publish", "wal.fsync"}

@@ -1,0 +1,179 @@
+"""utils/faults.py: the deterministic fault-injection registry itself.
+
+Replayability is the load-bearing property: every schedule must be a
+pure function of (plan seed, per-point hit ordinal), so a failing chaos
+run can be replayed bit-identically from its seed. These tests pin that
+contract at the registry level; tests/test_fault_matrix.py drives the
+same registry through the real transport/bridge/WAL call sites.
+"""
+
+import json
+import os
+
+import pytest
+
+from antidote_ccrdt_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+def test_disabled_is_inert():
+    assert faults.ACTIVE is False
+    assert faults.fire("anything") == "ok"
+    assert faults.mangle("anything", b"abc") == b"abc"
+    assert faults.trace() == []
+    assert faults.hits("anything") == 0
+
+
+def test_at_fires_exact_hit_ordinals():
+    with faults.injected({"p": [{"action": "drop", "at": [1, 3]}]}):
+        got = [faults.fire("p") for _ in range(5)]
+    assert got == ["ok", "drop", "ok", "drop", "ok"]
+
+
+def test_unlisted_point_is_untouched():
+    with faults.injected({"p": [{"action": "raise", "at": [0]}]}):
+        assert faults.fire("other") == "ok"
+        assert faults.hits("other") == 0
+
+
+def test_raise_is_oserror_subclass():
+    with faults.injected({"p": [{"action": "raise", "at": [0], "message": "eio"}]}):
+        with pytest.raises(OSError, match="p: eio"):
+            faults.fire("p")
+
+
+def test_truncate_keep_int_and_fraction_and_drop():
+    plan = {
+        "a": [{"action": "truncate", "at": [0], "keep": 3}],
+        "b": [{"action": "truncate", "at": [0], "keep": 0.5}],
+        "c": [{"action": "drop", "at": [0]}],
+    }
+    with faults.injected(plan):
+        assert faults.mangle("a", b"abcdef") == b"abc"
+        assert faults.mangle("b", b"abcdef") == b"abc"
+        assert faults.mangle("c", b"abcdef") is None
+        # Past the `at` window the payload flows through untouched.
+        assert faults.mangle("a", b"abcdef") == b"abcdef"
+
+
+def test_max_fires_caps_a_rate_spec():
+    plan = {"p": [{"action": "drop", "rate": 1.0, "max_fires": 2}]}
+    with faults.injected(plan):
+        got = [faults.fire("p") for _ in range(5)]
+    assert got == ["drop", "drop", "ok", "ok", "ok"]
+
+
+def test_rate_schedule_replays_from_seed():
+    plan = {"p": [{"action": "drop", "rate": 0.4}]}
+
+    def run():
+        with faults.injected(plan, seed=1234):
+            out = [faults.fire("p") for _ in range(50)]
+            return out, faults.trace()
+
+    out1, tr1 = run()
+    out2, tr2 = run()
+    assert out1 == out2
+    assert tr1 == tr2
+    assert 0 < out1.count("drop") < 50  # actually probabilistic
+    # The trace carries (point, hit ordinal, action) for each fire.
+    for point, hit, action in tr1:
+        assert point == "p" and action == "drop" and out1[hit] == "drop"
+
+
+def test_point_schedules_are_independent():
+    """Hitting point B must not shift point A's schedule: each point's
+    RNG is seeded from (seed, name) and advanced by its own hits only."""
+    plan = {
+        "a": [{"action": "drop", "rate": 0.5}],
+        "b": [{"action": "drop", "rate": 0.5}],
+    }
+    with faults.injected(plan, seed=7):
+        solo = [faults.fire("a") for _ in range(30)]
+    with faults.injected(plan, seed=7):
+        interleaved = []
+        for _ in range(30):
+            faults.fire("b")
+            interleaved.append(faults.fire("a"))
+            faults.fire("b")
+    assert solo == interleaved
+
+
+def test_different_seed_different_schedule():
+    plan = {"p": [{"action": "drop", "rate": 0.5}]}
+    with faults.injected(plan, seed=1):
+        s1 = [faults.fire("p") for _ in range(64)]
+    with faults.injected(plan, seed=2):
+        s2 = [faults.fire("p") for _ in range(64)]
+    assert s1 != s2
+
+
+def test_first_matching_spec_wins_but_draws_are_consumed():
+    """Spec order resolves conflicts; the rate draw happens per rate-
+    bearing spec per hit regardless, keeping later specs' schedules
+    independent of earlier specs' `at` lists."""
+    plan = {"p": [
+        {"action": "delay", "at": [0], "delay_s": 0.0},
+        {"action": "drop", "rate": 1.0},
+    ]}
+    with faults.injected(plan):
+        assert faults.fire("p") == "delay"  # first spec shadows the rate spec
+        assert faults.fire("p") == "drop"
+
+
+def test_env_roundtrip(monkeypatch):
+    payload = faults.plan_to_env(
+        {"wal.fsync": [{"action": "raise", "at": [2]}]}, seed=99
+    )
+    json.loads(payload)  # valid JSON for a subprocess env
+    monkeypatch.setenv(faults.ENV_VAR, payload)
+    assert faults.install_from_env() is True
+    assert faults.ACTIVE
+    assert faults.fire("wal.fsync") == "ok"
+    assert faults.fire("wal.fsync") == "ok"
+    with pytest.raises(faults.InjectedFault):
+        faults.fire("wal.fsync")
+    faults.uninstall()
+    monkeypatch.delenv(faults.ENV_VAR)
+    assert faults.install_from_env() is False
+    assert not faults.ACTIVE
+
+
+def test_install_from_env_in_subprocess():
+    """The supervisor -> worker path: the env payload alone reproduces
+    the schedule in a fresh interpreter (no pickling, no imports of the
+    supervisor's state)."""
+    import subprocess
+    import sys
+
+    payload = faults.plan_to_env(
+        {"p": [{"action": "drop", "rate": 0.5}]}, seed=42
+    )
+    code = (
+        "from antidote_ccrdt_tpu.utils import faults\n"
+        "faults.install_from_env()\n"
+        "print(''.join('d' if faults.fire('p')=='drop' else '.' "
+        "for _ in range(40)))\n"
+    )
+    env = dict(os.environ, **{faults.ENV_VAR: payload})
+    env.pop("XLA_FLAGS", None)
+    outs = {
+        subprocess.run(
+            [sys.executable, "-c", code], env=env, text=True,
+            capture_output=True, check=True, timeout=120,
+        ).stdout
+        for _ in range(2)
+    }
+    assert len(outs) == 1  # identical schedule across processes
+    assert "d" in next(iter(outs))
+
+
+def test_bad_action_rejected():
+    with pytest.raises(ValueError, match="unknown fault action"):
+        faults.FaultSpec("explode")

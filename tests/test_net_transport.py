@@ -131,3 +131,21 @@ def test_sim_transport_same_surface_as_fs():
     net.run_until(2.0)
     assert b.delta_seqs("a") == [3, 4, 5]
     assert b.fetch_delta("a", 4) == b"\x04"
+
+
+def test_crashed_publish_tmp_files_are_invisible(tmp_path):
+    """A process dying between the tmp write and the atomic replace (the
+    window publish/publish_delta fsync in) leaves `.tmp` debris: none of
+    the listing surfaces may ever show it as a member/seq."""
+    t = FsTransport(str(tmp_path), "a")
+    t.publish(struct.pack("<Q", 1) + b"good")
+    t.publish_delta(0, b"d0")
+    # Simulated crash debris, both namespaces.
+    for leftover in ("snap-ghost.tmp", "delta-ghost-00000003.tmp", "hb-ghost.tmp-77"):
+        with open(os.path.join(str(tmp_path), leftover), "wb") as f:
+            f.write(b"partial")
+    assert t.snapshot_members() == ["a"]
+    assert t.delta_members() == ["a"]
+    assert t.delta_seqs("ghost") == []
+    assert t.members() == ["a"]
+    assert t.fetch("ghost") is None

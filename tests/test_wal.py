@@ -1,0 +1,267 @@
+"""harness/wal.py: the crash-consistent write-ahead delta log.
+
+Covers the generic segmented log (framing, rotation, torn-tail repair,
+compaction watermark) and the elastic-worker discipline on top
+(checkpoint ⊔ WAL-suffix recovery for both engine families). The
+real-process kill/restart drill lives in scripts/crash_recovery_demo.py
+(tests/test_crash_recovery.py, slow).
+"""
+
+import os
+import struct
+
+import pytest
+
+from antidote_ccrdt_tpu.harness.wal import ElasticWal, WriteAheadLog
+from antidote_ccrdt_tpu.utils import faults
+from antidote_ccrdt_tpu.utils.metrics import Metrics
+
+
+@pytest.fixture(autouse=True)
+def _no_faults():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+# --- WriteAheadLog ---------------------------------------------------------
+
+
+def test_append_records_roundtrip(tmp_path):
+    w = WriteAheadLog(str(tmp_path))
+    payloads = [(i, bytes([i]) * (i + 1)) for i in range(8)]
+    for seq, p in payloads:
+        w.append(seq, p)
+    assert list(w.records()) == payloads
+    assert w.last_seq == 7
+    w.close()
+    # A fresh open over the same directory sees the same records.
+    w2 = WriteAheadLog(str(tmp_path))
+    assert list(w2.records()) == payloads
+    assert w2.torn_bytes == 0
+    w2.close()
+
+
+def test_rotation_and_compaction_watermark(tmp_path):
+    w = WriteAheadLog(str(tmp_path), segment_bytes=64)
+    for i in range(10):
+        w.append(i, b"x" * 20)
+    segs = [f for f in os.listdir(tmp_path) if f.endswith(".wal")]
+    assert len(segs) > 1  # rotation happened
+    removed = w.compact(4)
+    assert removed > 0
+    # Every record ABOVE the watermark survives compaction.
+    assert [s for s, _ in w.records()] == list(range(5, 10))
+    # The active segment is never removed, even if fully covered.
+    assert w.compact(10_000) >= 0
+    assert any(s >= 9 for s, _ in w.records())
+    w.close()
+
+
+def test_torn_tail_truncated_on_open(tmp_path):
+    w = WriteAheadLog(str(tmp_path))
+    for i in range(5):
+        w.append(i, b"payload-%d" % i)
+    w.close()
+    seg = os.path.join(tmp_path, sorted(os.listdir(tmp_path))[-1])
+    size = os.path.getsize(seg)
+    os.truncate(seg, size - 3)  # torn mid-record, as a crash would leave it
+    w2 = WriteAheadLog(str(tmp_path))
+    assert w2.torn_bytes > 0
+    assert [s for s, _ in w2.records()] == [0, 1, 2, 3]
+    assert w2.last_seq == 3
+    # Appends land after the repaired tail, not after garbage.
+    w2.append(9, b"after-repair")
+    assert [s for s, _ in w2.records()] == [0, 1, 2, 3, 9]
+    w2.close()
+    w3 = WriteAheadLog(str(tmp_path))
+    assert [s for s, _ in w3.records()] == [0, 1, 2, 3, 9]
+    assert w3.torn_bytes == 0
+    w3.close()
+
+
+def test_corrupt_crc_truncates_like_a_tear(tmp_path):
+    w = WriteAheadLog(str(tmp_path))
+    for i in range(4):
+        w.append(i, b"r%d" % i)
+    w.close()
+    seg = os.path.join(tmp_path, sorted(os.listdir(tmp_path))[0])
+    data = bytearray(open(seg, "rb").read())
+    data[-1] ^= 0xFF  # bit rot in the last record's payload
+    with open(seg, "wb") as f:
+        f.write(data)
+    w2 = WriteAheadLog(str(tmp_path))
+    assert [s for s, _ in w2.records()] == [0, 1, 2]
+    w2.close()
+
+
+def test_mid_segment_tear_drops_later_segments(tmp_path):
+    w = WriteAheadLog(str(tmp_path), segment_bytes=64)
+    for i in range(10):
+        w.append(i, b"x" * 20)
+    w.close()
+    segs = sorted(f for f in os.listdir(tmp_path) if f.endswith(".wal"))
+    assert len(segs) >= 3
+    mid = os.path.join(tmp_path, segs[1])
+    os.truncate(mid, os.path.getsize(mid) - 3)
+    w2 = WriteAheadLog(str(tmp_path), segment_bytes=64)
+    # Everything from the torn record on is gone — bytes past a tear
+    # were never acknowledged, and seq order must stay contiguous.
+    recs = [s for s, _ in w2.records()]
+    assert recs == sorted(recs)
+    assert max(recs) < 9
+    w2.close()
+
+
+def test_fsync_fault_surfaces_to_caller(tmp_path):
+    w = WriteAheadLog(str(tmp_path))
+    w.append(0, b"ok")
+    with faults.injected({"wal.fsync": [{"action": "raise", "at": [0]}]}):
+        with pytest.raises(faults.InjectedFault):
+            w.append(1, b"doomed")
+        w.append(2, b"recovered")  # the log object stays usable
+    assert [s for s, _ in w.records()] == [0, 1, 2] or [
+        s for s, _ in w.records()
+    ] == [0, 2]
+    w.close()
+
+
+# --- ElasticWal ------------------------------------------------------------
+
+
+def _drill(type_name):
+    from scripts.elastic_demo import DRILLS
+
+    drill = DRILLS[type_name]
+    dense = drill.make_engine()
+    return drill, dense, drill.init(dense)
+
+
+def _log_steps(drill, dense, state, wal, steps, owned):
+    for step in range(steps):
+        pre = drill.pub_state(dense, state)
+        state = drill.apply(dense, state, step, owned)
+        wal.log_step(step, owned, pre, drill.pub_state(dense, state))
+    return state
+
+
+@pytest.mark.parametrize("type_name", ["topk_rmv", "average"])
+def test_recover_matches_uninterrupted_run(tmp_path, type_name):
+    drill, dense, state = _drill(type_name)
+    wal = ElasticWal(str(tmp_path), "w0", dense, drill.publish_name)
+    state = _log_steps(drill, dense, state, wal, 5, [0, 2])
+    wal.close()
+    ref = drill.digest(dense, state)
+
+    drill2, dense2, state2 = _drill(type_name)
+    m = Metrics()
+    wal2 = ElasticWal(str(tmp_path), "w0", dense2, drill2.publish_name, metrics=m)
+    rec, last_step, owned = wal2.recover(drill2.pub_state(dense2, state2))
+    wal2.close()
+    assert last_step == 4 and owned == {0, 2}
+    assert m.counters.get("wal.recovered_records", 0) == 5
+    state2 = drill2.set_view(dense2, state2, rec)
+    assert drill2.digest(dense2, state2) == ref
+
+
+def test_recover_checkpoint_join_wal_suffix(tmp_path):
+    """Compaction up to the checkpoint step discards those records; the
+    recovered state (checkpoint ⊔ remaining suffix) is still exact."""
+    drill, dense, state = _drill("topk_rmv")
+    wal = ElasticWal(str(tmp_path), "w0", dense, drill.publish_name,
+                     segment_bytes=1 << 12)
+    for step in range(6):
+        pre = drill.pub_state(dense, state)
+        state = drill.apply(dense, state, step, [1])
+        wal.log_step(step, [1], pre, drill.pub_state(dense, state))
+        if step == 3:
+            wal.checkpoint(drill.pub_state(dense, state), step)
+    wal.close()
+    ref = drill.digest(dense, state)
+
+    drill2, dense2, state2 = _drill("topk_rmv")
+    m = Metrics()
+    wal2 = ElasticWal(str(tmp_path), "w0", dense2, drill2.publish_name, metrics=m)
+    rec, last_step, _ = wal2.recover(drill2.pub_state(dense2, state2))
+    wal2.close()
+    assert last_step == 5
+    assert m.counters.get("wal.recovered_snapshot") == 1
+    state2 = drill2.set_view(dense2, state2, rec)
+    assert drill2.digest(dense2, state2) == ref
+
+
+def test_recover_with_torn_final_record(tmp_path):
+    """A crash mid-append loses exactly the torn record: recovery lands
+    on the previous step and the restarted worker redoes the lost one —
+    never replays garbage."""
+    drill, dense, state = _drill("topk_rmv")
+    wal = ElasticWal(str(tmp_path), "w0", dense, drill.publish_name)
+    state = _log_steps(drill, dense, state, wal, 4, [0])
+    wal.close()
+    wal_dir = os.path.join(tmp_path, "wal-w0")
+    seg = os.path.join(wal_dir, sorted(os.listdir(wal_dir))[-1])
+    os.truncate(seg, os.path.getsize(seg) - 7)
+
+    drill2, dense2, state2 = _drill("topk_rmv")
+    wal2 = ElasticWal(str(tmp_path), "w0", dense2, drill2.publish_name)
+    rec, last_step, _ = wal2.recover(drill2.pub_state(dense2, state2))
+    wal2.close()
+    assert last_step == 2  # step 3's record was the torn one
+
+    # Redoing step 3 on the recovered state reproduces the full run.
+    state2 = drill2.set_view(dense2, state2, rec)
+    state2 = drill2.apply(dense2, state2, 3, [0])
+    ref_state = _drill("topk_rmv")
+    ref = ref_state[0].apply(ref_state[1], ref_state[2], 0, [0])
+    for s in range(1, 4):
+        ref = ref_state[0].apply(ref_state[1], ref, s, [0])
+    assert drill2.digest(dense2, state2) == ref_state[0].digest(ref_state[1], ref)
+
+
+def test_recover_empty_dir_is_noop(tmp_path):
+    drill, dense, state = _drill("topk_rmv")
+    wal = ElasticWal(str(tmp_path), "w9", dense, drill.publish_name)
+    rec, last_step, owned = wal.recover(drill.pub_state(dense, state))
+    wal.close()
+    assert rec is None and last_step == -1 and owned == set()
+
+
+def test_ckpt_replace_fault_preserves_previous_checkpoint(tmp_path):
+    """An injected crash between the durable tmp write and the rename
+    must leave the PREVIOUS checkpoint readable — the atomic-replace
+    guarantee the recovery path depends on."""
+    drill, dense, state = _drill("topk_rmv")
+    wal = ElasticWal(str(tmp_path), "w0", dense, drill.publish_name)
+    state = _log_steps(drill, dense, state, wal, 2, [0])
+    wal.checkpoint(drill.pub_state(dense, state), 1)
+    state = drill.apply(dense, state, 2, [0])
+    with faults.injected({"ckpt.replace": [{"action": "raise", "at": [0]}]}):
+        with pytest.raises(faults.InjectedFault):
+            wal.checkpoint(drill.pub_state(dense, state), 2)
+    wal.close()
+
+    drill2, dense2, state2 = _drill("topk_rmv")
+    m = Metrics()
+    wal2 = ElasticWal(str(tmp_path), "w0", dense2, drill2.publish_name, metrics=m)
+    rec, last_step, _ = wal2.recover(drill2.pub_state(dense2, state2))
+    wal2.close()
+    assert m.counters.get("wal.recovered_snapshot") == 1  # the step-1 one
+    assert last_step == 1
+    assert rec is not None
+
+
+def test_garbage_snapshot_does_not_block_wal_replay(tmp_path):
+    drill, dense, state = _drill("topk_rmv")
+    wal = ElasticWal(str(tmp_path), "w0", dense, drill.publish_name)
+    state = _log_steps(drill, dense, state, wal, 3, [0])
+    wal.close()
+    snap = os.path.join(tmp_path, "wal-w0", ElasticWal.SNAP)
+    with open(snap, "wb") as f:
+        f.write(struct.pack("<Q", 7) + b"not a checkpoint")
+    drill2, dense2, state2 = _drill("topk_rmv")
+    wal2 = ElasticWal(str(tmp_path), "w0", dense2, drill2.publish_name)
+    rec, last_step, _ = wal2.recover(drill2.pub_state(dense2, state2))
+    wal2.close()
+    assert last_step == 2 and rec is not None
+    state2 = drill2.set_view(dense2, state2, rec)
+    assert drill2.digest(dense2, state2) == drill.digest(dense, state)

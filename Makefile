@@ -23,7 +23,7 @@ PY ?= python
 # meaningful.
 COVER_THRESHOLD ?= 88
 
-.PHONY: all compile test cover typecheck xref native bench benchall dryrun net-demo chaos crash-demo clean
+.PHONY: all compile test cover typecheck xref native bench benchall dryrun net-demo chaos crash-demo obs-demo clean
 
 all: compile xref typecheck cover
 
@@ -71,8 +71,14 @@ net-demo:
 # (fsync failure, torn write, socket reset, read stalls) driven from a
 # seeded, replayable schedule — no real processes, tier-1 compatible
 # runtime, but kept out of tier-1 as its own gate.
+# The second leg is the observability gate (scripts/chaos_gate.py): a
+# seeded sim drill whose Prometheus summary is printed and whose
+# load-bearing counters (sim faults, delta gossip, SWIM deaths) must be
+# nonzero — a refactor that silently stops counting fails here even if
+# convergence stays green.
 chaos:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py tests/test_wal.py tests/test_fault_matrix.py -q -p no:cacheprovider
+	env JAX_PLATFORMS=cpu $(PY) scripts/chaos_gate.py
 
 # The crash-consistency drill (slow, real processes): SIGKILL a
 # WAL-backed worker mid-run, restart it, and require bit-identical
@@ -80,6 +86,14 @@ chaos:
 # suffix), once with the WAL deleted via peer adoption.
 crash-demo:
 	env JAX_PLATFORMS=cpu $(PY) scripts/crash_recovery_demo.py --mode both
+
+# Observability demo (slow, real processes): a 3-worker delta-gossip
+# fleet with the full obs plane on — live dashboard frames, then the
+# fleet-merged Prometheus snapshot and a reconstructed end-to-end delta
+# propagation path (publish -> medium -> apply on every peer) from the
+# flight logs. Fails unless at least one delta's path is complete.
+obs-demo:
+	env JAX_PLATFORMS=cpu $(PY) scripts/obs_dashboard.py --demo
 
 clean:
 	rm -rf native/build

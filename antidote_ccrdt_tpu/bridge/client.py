@@ -22,6 +22,7 @@ import time
 from typing import Any, List, Optional, Tuple
 
 from ..core.etf import Atom
+from ..obs import events as obs_events
 from ..utils import faults
 from ..utils.metrics import Metrics
 from . import protocol as P
@@ -109,6 +110,9 @@ class BridgeClient:
                     raise
                 attempt += 1
                 self.metrics.count("bridge.reconnects")
+                obs_events.emit(
+                    "bridge.reconnect", req_id=req_id, attempt=attempt
+                )
                 time.sleep(
                     min(self._backoff_max,
                         self._backoff_base * (2.0 ** (attempt - 1)))

@@ -43,6 +43,7 @@ import numpy as np
 from ..core import wire
 from ..core.behaviour import registry
 from ..core.etf import Atom
+from ..obs import events as obs_events
 from ..utils.metrics import Metrics
 from . import protocol as P
 
@@ -1303,20 +1304,37 @@ class BridgeServer:
                 if cached is not None:
                     self._replies.move_to_end((token, req_id))
                     self.metrics.count("bridge.replays")
+                    obs_events.emit(
+                        "bridge.request", req_id=req_id, outcome="replay"
+                    )
                     return cached
         elif isinstance(term, tuple) and len(term) == 3 and term[0] == P.A_CALL:
             _, req_id, op = term
         else:
             self.metrics.count("bridge.errors")
+            obs_events.emit(
+                "bridge.request", req_id=-1, outcome="bad_request"
+            )
             return P.reply_error(-1, f"bad request: {term!r}", kind="bad_request")
+        op_tag = str(op[0]) if isinstance(op, tuple) and op else "?"
         try:
             reply = P.reply_ok(req_id, self._exec_routed(op))
+            obs_events.emit(
+                "bridge.request", req_id=req_id, op=op_tag, outcome="ok"
+            )
         except Exception as e:  # noqa: BLE001 - all errors go to the client,
             # as a STRUCTURED {error, {Kind, Msg}} frame (never silently
             # swallowed): Kind is the exception class for hosts to dispatch
             # on, and the server-side counter makes error volume observable.
             self.metrics.count("bridge.errors")
             self.metrics.count(f"bridge.errors.{type(e).__name__}")
+            obs_events.emit(
+                "bridge.request",
+                req_id=req_id,
+                op=op_tag,
+                outcome="error",
+                error_kind=type(e).__name__,
+            )
             return P.reply_error(req_id, str(e), kind=type(e).__name__)
         if token is not None:
             with self._replies_lock:

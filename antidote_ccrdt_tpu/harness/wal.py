@@ -46,6 +46,7 @@ import zlib
 from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
 
 from ..core import serial
+from ..obs import events as obs_events
 from ..utils import faults
 from ..utils.metrics import Metrics
 from .checkpoint import load_dense_checkpoint, save_dense_checkpoint
@@ -120,6 +121,9 @@ class WriteAheadLog:
                 break
         if self.torn_bytes:
             self.metrics.count("wal.torn_bytes", self.torn_bytes)
+            obs_events.emit(
+                "wal.torn", dir=self.root, bytes=self.torn_bytes
+            )
 
     @staticmethod
     def _scan_segment(path: str) -> Tuple[int, int, int]:
@@ -159,12 +163,18 @@ class WriteAheadLog:
         self.last_seq = max(self.last_seq, seq)
         self.metrics.count("wal.appends")
         self.metrics.count("wal.bytes", len(rec))
+        # Durable watermark gauge + event AFTER the fsync: the flight
+        # log's last wal.append IS the crash-recovery watermark (what
+        # `make crash-demo` cross-checks against the victim's resume).
+        self.metrics.set("wal.last_seq", float(self.last_seq))
+        obs_events.emit("wal.append", wseq=seq, bytes=len(rec))
 
     def _rotate(self) -> None:
         self._fh.close()
         self._cur += 1
         self._fh = open(self._path(self._cur), "ab")
         self.metrics.count("wal.rotations")
+        obs_events.emit("wal.rotate", segment=self._cur)
 
     # -- read / compact ----------------------------------------------------
 
@@ -274,6 +284,7 @@ class ElasticWal:
         )
         self.log.compact(step)
         self.metrics.count("wal.checkpoints")
+        obs_events.emit("wal.checkpoint", step=step)
 
     # -- recovery ----------------------------------------------------------
 
@@ -314,6 +325,13 @@ class ElasticWal:
             n += 1
         if n:
             self.metrics.count("wal.recovered_records", n)
+        obs_events.emit(
+            "wal.recover",
+            records=n,
+            last_step=last_step,
+            owned=sorted(owned),
+            had_checkpoint=os.path.exists(snap_path),
+        )
         return state, last_step, owned
 
     def close(self) -> None:

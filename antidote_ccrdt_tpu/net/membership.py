@@ -28,6 +28,7 @@ from __future__ import annotations
 import time
 from typing import Callable, Dict, List, Optional
 
+from ..obs import events as obs_events
 from ..utils.metrics import Metrics
 
 ALIVE = "alive"
@@ -75,8 +76,12 @@ class Membership:
                 # Only a *recent* sighting refutes: letting any newer-but-
                 # still-ancient gossip clear the flags would re-alive a
                 # confirmed-dead member on every piggyback exchange.
+                was = DEAD if member in self._dead else SUSPECT
                 self._suspected.discard(member)
                 self._dead.discard(member)
+                obs_events.emit(
+                    "peer.realive", peer=member, was=was, age=round(age, 6)
+                )
 
     def heard_ages(self) -> Dict[str, float]:
         """Piggyback payload: member -> seconds since last heard (self is
@@ -106,11 +111,25 @@ class Membership:
             if member not in self._suspected:
                 self._suspected.add(member)
                 self.metrics.count("net.suspect_events")
+                # Edge-triggered like the counter, but carrying the
+                # evidence: the heartbeat age that crossed the horizon.
+                obs_events.emit(
+                    "peer.suspect",
+                    peer=member,
+                    age=round(age, 6),
+                    timeout_s=timeout_s,
+                )
             return SUSPECT
         if member not in self._dead:
             self._dead.add(member)
             self._suspected.discard(member)
             self.metrics.count("net.dead_events")
+            obs_events.emit(
+                "peer.dead",
+                peer=member,
+                age=round(age, 6),
+                timeout_s=timeout_s,
+            )
         return DEAD
 
     def members(self) -> List[str]:

@@ -32,6 +32,7 @@ import heapq
 import random
 from typing import Dict, List, Optional, Tuple
 
+from ..obs import events as obs_events
 from ..utils.metrics import Metrics
 from .membership import Membership
 
@@ -70,14 +71,21 @@ class SimNet:
         """Split the network: members in different groups cannot exchange
         messages (members in no listed group are isolated)."""
         self._groups = [set(g) for g in groups]
+        obs_events.emit(
+            "sim.partition",
+            groups=[sorted(g) for g in self._groups],
+            vt=self.time,
+        )
 
     def heal(self) -> None:
         self._groups = None
+        obs_events.emit("sim.heal", vt=self.time)
 
     def crash(self, member: str) -> None:
         """Permanently silence `member`: no sends, no deliveries. Its
         queued in-flight messages are dropped at delivery time."""
         self._crashed.add(member)
+        obs_events.emit("sim.crash", peer=member, vt=self.time)
 
     def reachable(self, src: str, dst: str) -> bool:
         if src in self._crashed or dst in self._crashed:
@@ -95,10 +103,18 @@ class SimNet:
         packets); crash filtering repeats at delivery."""
         if not self.reachable(src, dst):
             self.metrics.count("net.sim_unreachable")
+            obs_events.emit(
+                "sim.drop", cause="unreachable", src=src, dst=dst,
+                fkind=str(msg[0]), vt=self.time,
+            )
             return
         copies = 1
         if self.rng.random() < self.loss:
             self.metrics.count("net.sim_lost")
+            obs_events.emit(
+                "sim.drop", cause="loss", src=src, dst=dst,
+                fkind=str(msg[0]), vt=self.time,
+            )
             copies = 0
         elif self.rng.random() < self.dup:
             self.metrics.count("net.sim_duplicated")

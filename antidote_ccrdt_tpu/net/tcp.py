@@ -47,6 +47,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from ..bridge.protocol import pack_frame, unpack_frames
 from ..core.etf import Atom
+from ..obs import events as obs_events
 from ..utils import faults
 from ..utils.metrics import Metrics
 from .membership import Membership
@@ -66,6 +67,7 @@ class _PeerLink:
 
     def __init__(
         self,
+        name: str,
         addr: Tuple[str, int],
         rng: random.Random,
         metrics: Metrics,
@@ -75,6 +77,7 @@ class _PeerLink:
         backoff_base: float,
         backoff_max: float,
     ):
+        self.name = name  # peer's member name (frame.send events, gauges)
         self.addr = addr
         self.rng = rng
         self.metrics = metrics
@@ -83,7 +86,9 @@ class _PeerLink:
         self.send_timeout = send_timeout
         self.backoff_base = backoff_base
         self.backoff_max = backoff_max
-        self._q: deque = deque()  # (kind, build_frame: () -> bytes)
+        # (kind, build_frame: () -> bytes, meta: trace context carried to
+        # the frame.send event — {origin, dseq} for deltas)
+        self._q: deque = deque()
         self._cv = threading.Condition()
         self._stop = False
         self._sock: Optional[socket.socket] = None
@@ -91,28 +96,39 @@ class _PeerLink:
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
-    def enqueue(self, kind: str, build_frame: Callable[[], bytes]) -> None:
+    def _gauge_depth(self) -> None:
+        # Called under self._cv: per-peer send-queue depth for the
+        # dashboard (a climbing gauge = this peer's link is stalling).
+        self.metrics.set(f"net.sendq.{self.name}", float(len(self._q)))
+
+    def enqueue(
+        self,
+        kind: str,
+        build_frame: Callable[[], bytes],
+        meta: Optional[Dict[str, object]] = None,
+    ) -> None:
         with self._cv:
             if self._stop:
                 return
             if kind == _SNAP:
                 # Latest-wins anchor: a queued older snapshot is dead weight.
-                stale = [i for i, (k, _) in enumerate(self._q) if k == _SNAP]
+                stale = [i for i, (k, _, _m) in enumerate(self._q) if k == _SNAP]
                 for i in reversed(stale):
                     del self._q[i]
-            elif kind == _PING and any(k == _PING for k, _ in self._q):
+            elif kind == _PING and any(k == _PING for k, _, _m in self._q):
                 return  # one pending ping is enough liveness signal
             if len(self._q) >= self.queue_max:
                 # Backpressure: shed the oldest DELTA (anchors resync the
                 # gap); only if no delta is queued shed the oldest frame.
-                for i, (k, _) in enumerate(self._q):
+                for i, (k, _, _m) in enumerate(self._q):
                     if k == _DELTA:
                         del self._q[i]
                         break
                 else:
                     self._q.popleft()
                 self.metrics.count("net.send_drops")
-            self._q.append((kind, build_frame))
+            self._q.append((kind, build_frame, meta or {}))
+            self._gauge_depth()
             self._cv.notify()
 
     def close(self) -> None:
@@ -155,7 +171,7 @@ class _PeerLink:
                     self._cv.wait()
                 if self._stop:
                     return
-                kind, build = self._q[0]
+                kind, build, meta = self._q[0]
             if not self._ensure_connected():
                 with self._cv:
                     self._cv.wait(timeout=self._backoff())
@@ -187,12 +203,23 @@ class _PeerLink:
                 # Sent: drop it (the queue head may have been reshuffled
                 # by the snap-replacement policy; remove by identity).
                 try:
-                    self._q.remove((kind, build))
+                    self._q.remove((kind, build, meta))
                 except ValueError:
                     pass
+                self._gauge_depth()
             if not dropped:
                 self.metrics.count("net.frames_sent")
                 self.metrics.count("net.bytes_sent", len(frame))
+                # Emitted when the frame actually left (not at enqueue):
+                # delta metas carry (origin, dseq) so the trace shows the
+                # true wire time of each propagation hop.
+                obs_events.emit(
+                    "frame.send",
+                    peer=self.name,
+                    fkind=kind,
+                    bytes=len(frame),
+                    **meta,
+                )
 
 
 class TcpTransport:
@@ -255,7 +282,7 @@ class TcpTransport:
             if name in self._links or self._closed:
                 return
             self._links[name] = _PeerLink(
-                tuple(addr), self._rng, self.metrics, *self._link_params
+                name, tuple(addr), self._rng, self.metrics, *self._link_params
             )
 
     # -- frame builders (called at send time, see module docstring) --------
@@ -316,6 +343,9 @@ class TcpTransport:
         if tag == A_SNAP:
             _, mb, blob, heard = term
             m = mb.decode("utf-8")
+            obs_events.emit(
+                "frame.recv", fkind=_SNAP, origin=m, bytes=len(blob)
+            )
             with self._lock:
                 # Ordered within one link, but reconnects can interleave:
                 # only a step-header >= the cached one replaces the anchor.
@@ -330,6 +360,15 @@ class TcpTransport:
         elif tag == A_DELTA:
             _, mb, seq, keep, blob, heard = term
             m = mb.decode("utf-8")
+            # Stage "recv" of the delta trace: the frame's own
+            # {delta, Member, Seq, ...} term IS the trace context.
+            obs_events.emit(
+                "frame.recv",
+                fkind=_DELTA,
+                origin=m,
+                dseq=int(seq),
+                bytes=len(blob),
+            )
             with self._lock:
                 window = self._deltas.setdefault(m, {})
                 window[int(seq)] = blob
@@ -370,7 +409,9 @@ class TcpTransport:
         with self._lock:
             self._snaps[self.member] = blob
         for link in self._links.values():
-            link.enqueue(_SNAP, self._snap_frame(blob))
+            link.enqueue(
+                _SNAP, self._snap_frame(blob), meta={"origin": self.member}
+            )
 
     def fetch(self, member: str) -> Optional[bytes]:
         with self._lock:
@@ -394,7 +435,11 @@ class TcpTransport:
             for s in [s for s in window if s <= seq - keep]:
                 del window[s]
         for link in self._links.values():
-            link.enqueue(_DELTA, self._delta_frame(seq, keep, blob))
+            link.enqueue(
+                _DELTA,
+                self._delta_frame(seq, keep, blob),
+                meta={"origin": self.member, "dseq": seq},
+            )
 
     def fetch_delta(self, member: str, seq: int) -> Optional[bytes]:
         with self._lock:

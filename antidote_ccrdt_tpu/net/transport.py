@@ -31,6 +31,7 @@ import struct
 import time
 from typing import Any, List, Optional, Protocol, Tuple, runtime_checkable
 
+from ..obs import events as obs_events
 from ..utils import faults
 from ..utils.metrics import Metrics
 
@@ -189,6 +190,16 @@ class FsTransport:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
+        # The fs-medium analog of a tcp frame.send: the moment this
+        # origin's delta became visible to peers. Same (origin, dseq)
+        # trace context, so delta_paths() sees one "write"/"send" stage
+        # regardless of medium.
+        obs_events.emit(
+            "transport.delta_write",
+            origin=self.member,
+            dseq=seq,
+            bytes=len(blob),
+        )
         self.heartbeat()
         for s in self.delta_seqs(self.member):
             if s <= seq - keep:
@@ -284,6 +295,9 @@ class GossipNode:
         blob = struct.pack("<Q", step) + serial.dumps_dense(name, state)
         self.metrics.count("net.snap_publishes")
         self.metrics.count("net.snap_bytes", len(blob))
+        obs_events.emit(
+            "snap.publish", origin=self.member, step=step, bytes=len(blob)
+        )
         self.transport.publish(blob)
 
     def fetch(
@@ -327,6 +341,14 @@ class GossipNode:
         full snapshot)."""
         self.metrics.count("net.delta_publishes")
         self.metrics.count("net.delta_bytes", len(delta_blob))
+        # Stage 1 of the delta propagation path: this replica minted
+        # (origin, dseq). Everything downstream carries the same pair.
+        obs_events.emit(
+            "delta.publish",
+            origin=self.member,
+            dseq=seq,
+            bytes=len(delta_blob),
+        )
         self.transport.publish_delta(seq, delta_blob, keep=keep)
 
     def fetch_delta(
@@ -349,6 +371,7 @@ class GossipNode:
         except Exception:  # noqa: BLE001 — see fetch
             return None
         self.metrics.count("net.delta_fetches")
+        obs_events.emit("delta.fetch", origin=member, dseq=seq)
         return delta
 
     def delta_seqs(self, member: str) -> List[int]:

@@ -1,0 +1,21 @@
+"""Cluster observability plane: tracing, lag, export.
+
+Three layers, threaded through every runtime subsystem:
+
+* `obs.events` — structured flight-recorder ring + crash-durable JSONL
+  spill (``CCRDT_OBS_DIR``); delta trace-context for end-to-end
+  propagation-path reconstruction.
+* `obs.lag` — per-peer replication lag (ops + seconds) from delta-seq
+  watermarks, and the fleet digest-agreement probe.
+* `obs.export` — Prometheus/JSONL rendering of `Metrics` snapshots and
+  cross-process aggregation (``CCRDT_METRICS_DIR``).
+
+`obs.events` stays stdlib-only so transports, WAL, bridge, and the
+fault registry can import it without cycles; `obs.lag`/`obs.export`
+may import package code and are pulled in lazily by the layers that
+need them.
+"""
+
+from . import events  # noqa: F401  (stdlib-only, safe for all importers)
+
+__all__ = ["events", "lag", "export"]

@@ -1,0 +1,299 @@
+"""Structured event tracing: a bounded flight-recorder ring + JSONL spill.
+
+The cluster's "what happened" plane. Every runtime layer (net transports,
+membership, WAL, bridge, fault registry) emits small typed events into a
+per-process `FlightRecorder`:
+
+    {"seq": 17, "t": 1754380000.123456, "member": "w1",
+     "kind": "delta.apply", "origin": "w0", "dseq": 4}
+
+* ``seq`` is a per-process monotonic ordinal (the recorder's own lamport
+  axis — wall clocks across workers need not agree);
+* ``member`` is the process identity (set once via `configure`);
+* ``kind`` is a dotted type name; the wired kinds are listed below;
+* remaining keys are the event's typed payload.
+
+Trace context: delta events carry ``(origin, dseq)`` — the publishing
+replica and its delta sequence number, the same pair the `{packet,4}`
+gossip frames already ship in their `{delta, Member, Seq, ...}` terms —
+so one logical delta can be followed end to end across every process's
+log: ``delta.publish`` (origin) -> ``frame.send``/``transport.delta_write``
+(medium) -> ``frame.recv``/``delta.fetch`` (receiver) -> ``delta.apply``
+(each peer). `scripts/obs_dashboard.py --demo` reconstructs exactly this
+path as its acceptance check.
+
+Durability model (the crash part of "flight recorder"):
+
+* the RING is always on: a bounded `collections.deque` (default 4096) —
+  cheap appends, never grows, inspectable in-process via `events()`;
+* when ``CCRDT_OBS_DIR`` is set (`install_from_env`, mirroring how
+  `utils.faults` propagates `CCRDT_FAULTS` to drill subprocesses), every
+  event is ALSO appended, line-buffered, to
+  ``<dir>/flight-<member>-<pid>.jsonl``. Line buffering flushes each
+  event to the kernel as it happens, so even a SIGKILL — which no
+  handler can observe — leaves every emitted event on disk; the `make
+  crash-demo` drill asserts the victim's dump ends just before its kill
+  point. One file per (member, pid): a restarted incarnation never
+  appends to its dead predecessor's log;
+* `atexit` + SIGTERM/SIGINT hooks write a final ``proc.exit`` event and
+  close the spill — its ABSENCE marks a log as a crash dump.
+
+Wired event kinds:
+
+    delta.publish / delta.fetch / delta.apply / snap.publish / snap.apply
+    frame.send / frame.recv            (tcp; origin+dseq trace context)
+    transport.delta_write              (fs medium; the frame-send analog)
+    peer.suspect / peer.dead / peer.realive   (SWIM transitions, with age)
+    wal.append / wal.rotate / wal.checkpoint / wal.recover / wal.torn
+    fault.hit                          (utils.faults firings)
+    bridge.request / bridge.reconnect
+    sim.drop / sim.crash / sim.partition / sim.heal
+    proc.start / proc.exit
+
+This module is stdlib-only and imported by nearly every runtime layer —
+it must never import back into the package.
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import json
+import os
+import signal
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+ENV_DIR = "CCRDT_OBS_DIR"
+DEFAULT_RING = 4096
+
+
+class FlightRecorder:
+    """One process's bounded event ring + optional line-buffered spill."""
+
+    def __init__(
+        self,
+        member: str = "?",
+        ring: int = DEFAULT_RING,
+        spill_path: Optional[str] = None,
+    ):
+        self.member = member
+        self.ring: collections.deque = collections.deque(maxlen=ring)
+        self.spill_path = spill_path
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._fh = None
+        if spill_path is not None:
+            os.makedirs(os.path.dirname(spill_path) or ".", exist_ok=True)
+            # buffering=1: line-buffered — each event reaches the kernel
+            # when its newline is written, which is what makes the spill
+            # a usable post-SIGKILL flight record.
+            self._fh = open(spill_path, "a", buffering=1)
+
+    def emit(self, kind: str, **fields: Any) -> Dict[str, Any]:
+        ev: Dict[str, Any] = {"kind": kind, "member": self.member}
+        ev.update(fields)
+        with self._lock:
+            ev["seq"] = self._seq
+            self._seq += 1
+            ev["t"] = round(time.time(), 6)
+            self.ring.append(ev)
+            if self._fh is not None:
+                try:
+                    self._fh.write(json.dumps(ev, default=str) + "\n")
+                except (OSError, ValueError):
+                    pass  # a full/closed spill must never crash the caller
+        return ev
+
+    def events(self, kind: Optional[str] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            evs = list(self.ring)
+        if kind is None:
+            return evs
+        return [e for e in evs if e["kind"] == kind]
+
+    def dump(self, path: str) -> int:
+        """Write the current ring contents as JSONL; returns event count.
+        (The spill file, when enabled, is already the durable record —
+        this is for explicit post-mortems and tests.)"""
+        evs = self.events()
+        with open(path, "w") as f:
+            for ev in evs:
+                f.write(json.dumps(ev, default=str) + "\n")
+        return len(evs)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+
+# -- module-level recorder (the surface the runtime layers use) -------------
+
+_recorder = FlightRecorder()
+_hooks_installed = False
+
+
+def recorder() -> FlightRecorder:
+    return _recorder
+
+
+def emit(kind: str, **fields: Any) -> Dict[str, Any]:
+    """Record one event on the process recorder (thread-safe, bounded)."""
+    return _recorder.emit(kind, **fields)
+
+
+def events(kind: Optional[str] = None) -> List[Dict[str, Any]]:
+    return _recorder.events(kind)
+
+
+def dump(path: str) -> int:
+    return _recorder.dump(path)
+
+
+def configure(
+    member: str,
+    ring: int = DEFAULT_RING,
+    spill_dir: Optional[str] = None,
+    crash_hooks: bool = True,
+) -> FlightRecorder:
+    """Replace the process recorder: set its identity, ring bound, and
+    (optionally) the spill directory. Emits ``proc.start`` so every log
+    opens with the incarnation's identity and pid."""
+    global _recorder
+    old, spill = _recorder, None
+    if spill_dir is not None:
+        spill = os.path.join(spill_dir, f"flight-{member}-{os.getpid()}.jsonl")
+    _recorder = FlightRecorder(member=member, ring=ring, spill_path=spill)
+    old.close()
+    if crash_hooks and spill is not None:
+        _install_exit_hooks()
+    _recorder.emit("proc.start", pid=os.getpid())
+    return _recorder
+
+
+def install_from_env(
+    member: str, env: Optional[Dict[str, str]] = None
+) -> bool:
+    """Enable the disk spill iff ``CCRDT_OBS_DIR`` is set (the same
+    supervisor->worker propagation pattern `utils.faults` uses for
+    ``CCRDT_FAULTS``). Returns whether a spill was enabled; without the
+    env var the in-memory ring still records under `member`'s name."""
+    d = (env if env is not None else os.environ).get(ENV_DIR)
+    configure(member, spill_dir=d or None)
+    return bool(d)
+
+
+def reset(member: str = "?", ring: int = DEFAULT_RING) -> FlightRecorder:
+    """Fresh in-memory recorder (tests)."""
+    return configure(member, ring=ring, crash_hooks=False)
+
+
+def _install_exit_hooks() -> None:
+    """atexit + SIGTERM/SIGINT: stamp ``proc.exit`` and close the spill.
+    A log WITHOUT a trailing proc.exit is a crash dump (SIGKILL / torn
+    process) — the discriminator `crash_recovery_demo` keys on. Handlers
+    chain to any previously-installed ones; installation is idempotent
+    and skipped off the main thread (signal module restriction)."""
+    global _hooks_installed
+    if _hooks_installed:
+        return
+    _hooks_installed = True
+
+    def _finalize() -> None:
+        _recorder.emit("proc.exit", pid=os.getpid())
+        _recorder.close()
+
+    atexit.register(_finalize)
+    if threading.current_thread() is not threading.main_thread():
+        return
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            prev = signal.getsignal(sig)
+
+            def _handler(signum, frame, _prev=prev):
+                _finalize()
+                if callable(_prev):
+                    _prev(signum, frame)
+                else:
+                    signal.signal(signum, signal.SIG_DFL)
+                    os.kill(os.getpid(), signum)
+
+            signal.signal(sig, _handler)
+        except (OSError, ValueError):
+            pass  # non-main interpreter contexts
+
+
+# -- log readers (dashboard / drills / tests) --------------------------------
+
+
+def read_log(path: str) -> List[Dict[str, Any]]:
+    """Parse one flight JSONL file, skipping any torn tail line (a
+    SIGKILL can land mid-write of the final event)."""
+    out: List[Dict[str, Any]] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue  # torn tail
+    except OSError:
+        pass
+    return out
+
+
+def scan_dir(obs_dir: str) -> Dict[str, List[Dict[str, Any]]]:
+    """All flight logs in a spill dir: {filename: [events...]}."""
+    out: Dict[str, List[Dict[str, Any]]] = {}
+    try:
+        names = sorted(os.listdir(obs_dir))
+    except OSError:
+        return out
+    for fn in names:
+        if fn.startswith("flight-") and fn.endswith(".jsonl"):
+            out[fn] = read_log(os.path.join(obs_dir, fn))
+    return out
+
+
+def delta_paths(
+    logs: Dict[str, List[Dict[str, Any]]]
+) -> Dict[tuple, Dict[str, List[Dict[str, Any]]]]:
+    """Group delta trace events across a fleet's logs by their trace
+    context: {(origin, dseq): {stage: [events]}} where stage is one of
+    publish/send/write/fetch/recv/apply — the cross-replica propagation
+    path of each logical delta."""
+    stages = {
+        "delta.publish": "publish",
+        "frame.send": "send",
+        "transport.delta_write": "write",
+        "frame.recv": "recv",
+        "delta.fetch": "fetch",
+        "delta.apply": "apply",
+    }
+    out: Dict[tuple, Dict[str, List[Dict[str, Any]]]] = {}
+    for evs in logs.values():
+        for ev in evs:
+            stage = stages.get(ev.get("kind", ""))
+            if stage is None or "dseq" not in ev or "origin" not in ev:
+                continue
+            key = (ev["origin"], int(ev["dseq"]))
+            out.setdefault(key, {}).setdefault(stage, []).append(ev)
+    return out
+
+
+def iter_kinds(
+    logs: Dict[str, List[Dict[str, Any]]], kind: str
+) -> Iterator[Dict[str, Any]]:
+    for evs in logs.values():
+        for ev in evs:
+            if ev.get("kind") == kind:
+                yield ev

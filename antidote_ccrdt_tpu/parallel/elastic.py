@@ -45,6 +45,7 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..net.transport import FsTransport, GossipNode
+from ..obs import events as obs_events
 from ..utils.metrics import Metrics
 from .delta import empty_delta  # noqa: F401 — part of this module's API
 
@@ -154,6 +155,9 @@ def sweep_deltas(
                 break
             stats["deltas"] += 1
             cur += 1
+            # Terminal stage of the delta trace: (origin, dseq) merged
+            # into THIS member's state.
+            obs_events.emit("delta.apply", origin=member, dseq=cur)
         return cur
 
     for m in sorted(set(store.snapshot_members()) | set(store.delta_members())):
@@ -174,6 +178,7 @@ def sweep_deltas(
                     stats["skipped"] += 1
                 else:
                     stats["fulls"] += 1
+                    obs_events.emit("snap.apply", origin=m, step=_seq)
                     cur = max(cur, _seq)
                     cur = chain(m, cur)
         cursors[m] = cur

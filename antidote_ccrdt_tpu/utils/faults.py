@@ -63,6 +63,8 @@ import time
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..obs import events as obs_events
+
 # The one-global-load hot-path gate. True iff a plan is installed.
 ACTIVE = False
 
@@ -178,6 +180,12 @@ class _Registry:
                 chosen.fires += 1
                 if len(self.trace) < _TRACE_MAX:
                     self.trace.append((name, hit, chosen.action))
+                # Flight-record every firing: seeing *which* injected
+                # fault preceded a failure is the whole point of pairing
+                # the chaos plan with the obs plane.
+                obs_events.emit(
+                    "fault.hit", point=name, hit=hit, action=chosen.action
+                )
             return chosen
 
 

@@ -81,7 +81,14 @@ class Metrics:
 
     @contextlib.contextmanager
     def timer(self, name: str) -> Iterator[None]:
-        rec = self.latencies.setdefault(name, LatencyRecorder())
+        # Recorder creation must hold the lock: two threads racing the
+        # setdefault could each observe "missing", and the loser's
+        # recorder (plus any samples already on it) would be dropped —
+        # and an exporter iterating `latencies` mid-insert would see a
+        # dict mutated during iteration. The `record` call itself stays
+        # outside (list.append is atomic under the GIL).
+        with self._lock:
+            rec = self.latencies.setdefault(name, LatencyRecorder())
         t0 = time.perf_counter()
         try:
             yield
@@ -97,9 +104,36 @@ class Metrics:
             total = time.perf_counter() - self._t0
         return n / total if total > 0 else 0.0
 
+    def snapshot(self) -> Dict[str, Any]:
+        """Consistent point-in-time copy: counters and raw latency
+        samples, taken under the lock. This is what exporters and the
+        cross-process aggregation (obs/export.py, CCRDT_METRICS_DIR)
+        read — never the live dicts, which sender/reader threads are
+        still mutating. JSON-serializable as-is."""
+        with self._lock:
+            return {
+                "counters": dict(self.counters),
+                "latencies": {n: list(r.samples) for n, r in self.latencies.items()},
+            }
+
+    def merge(self, snap: Dict[str, Any]) -> None:
+        """Fold another process's `snapshot()` into this registry:
+        counters sum, latency samples concatenate. Used by drill
+        supervisors to aggregate worker metrics dumps into one
+        fleet-wide view."""
+        with self._lock:
+            for name, v in snap.get("counters", {}).items():
+                self.counters[name] = self.counters.get(name, 0.0) + float(v)
+            for name, samples in snap.get("latencies", {}).items():
+                rec = self.latencies.setdefault(name, LatencyRecorder())
+                rec.samples.extend(float(s) for s in samples)
+
     def summary(self) -> Dict[str, Any]:
-        out: Dict[str, Any] = dict(self.counters)
-        for name, rec in self.latencies.items():
+        snap = self.snapshot()
+        out: Dict[str, Any] = dict(snap["counters"])
+        for name, samples in snap["latencies"].items():
+            rec = LatencyRecorder()
+            rec.samples = samples
             out[name] = rec.summary()
         return out
 

@@ -1,0 +1,74 @@
+"""Chaos metrics gate: fail `make chaos` if the fault machinery goes dark.
+
+Runs one seeded simulator chaos drill (the exact drill pinned by
+tests/test_net_chaos.py — loss + duplication + partition + crash over
+chained-delta gossip), then asserts that every load-bearing counter is
+nonzero and prints the run's Prometheus summary. The point is
+regression detection at the *observability* layer: a refactor that
+keeps convergence green but silently stops counting (metrics renamed,
+instrumentation dropped, sim faults disabled) regresses these counters
+to zero and must fail the gate, because every downstream consumer — the
+dashboard, the lag tracker, the flight-log cross-checks — reads them.
+
+Run:  python scripts/chaos_gate.py
+Make: part of `make chaos` (after the pytest leg).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+from antidote_ccrdt_tpu.obs import export as obs_export  # noqa: E402
+from antidote_ccrdt_tpu.utils.metrics import Metrics  # noqa: E402
+
+# Counters that the seeded drill MUST move — each one is the heartbeat
+# of a subsystem (sim fault engine, delta gossip, SWIM failure
+# detection). Zero means the machinery silently stopped firing.
+REQUIRED_NONZERO = (
+    "net.sim_msgs",        # traffic flowed through the simulator at all
+    "net.sim_lost",        # seeded loss actually dropped frames
+    "net.sim_duplicated",  # seeded duplication actually fired
+    "net.sim_unreachable", # partition/crash actually blocked routes
+    "net.delta_publishes", # chained-delta gossip produced deltas
+    "net.delta_fetches",   # ...and peers pulled them
+    "net.snap_publishes",  # anchor/full-snapshot path exercised
+    "net.dead_events",     # SWIM confirmed the crashed member
+)
+
+
+def main() -> int:
+    from test_net_chaos import run_chaos  # heavy import (JAX) kept in main
+    from elastic_demo import reference_digest
+
+    digests, counters = run_chaos("topk_rmv", seed=7, delta=True)
+
+    ref = reference_digest("topk_rmv")
+    diverged = sorted(m for m, d in digests.items() if d != ref)
+    zeroed = sorted(n for n in REQUIRED_NONZERO if not counters.get(n, 0))
+
+    m = Metrics()
+    m.merge({"counters": counters, "latencies": {}})
+    print("== chaos drill metrics summary (seed=7, topk_rmv, delta) ==")
+    print(obs_export.prometheus_text(m), end="")
+
+    if diverged:
+        print(f"FAIL: members diverged from the sequential reference: "
+              f"{diverged}")
+        return 1
+    if zeroed:
+        print("FAIL: chaos counters regressed to zero (instrumentation "
+              f"or fault machinery went dark): {zeroed}")
+        return 1
+    print(f"OK: all {len(REQUIRED_NONZERO)} required chaos counters "
+          f"nonzero; {len(digests)} survivors converged")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,10 +42,14 @@ MEMBERS = ("w0", "w1", "w2")
 VICTIM = "w1"
 
 
-def _env() -> dict:
+def _env(root: str) -> dict:
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # parent flags (device counts) break workers
     env["JAX_PLATFORMS"] = "cpu"
+    # Observability plane: continuous flight-recorder spill (survives the
+    # SIGKILL — that is the point) + exit-time metrics snapshots.
+    env["CCRDT_OBS_DIR"] = os.path.join(root, "obs")
+    env["CCRDT_METRICS_DIR"] = os.path.join(root, "metrics")
     return env
 
 
@@ -54,7 +58,8 @@ def _launch(root: str, member: str, type_name: str, wal_dir: str):
         [sys.executable, DEMO, "--root", root, "--member", member,
          "--n-members", str(len(MEMBERS)), "--type", type_name,
          "--wal-dir", wal_dir],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=_env(), text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=_env(root),
+        text=True,
     )
 
 
@@ -90,6 +95,8 @@ def run_scenario(mode: str, type_name: str, timeout: float) -> dict:
         time.sleep(0.01)
     else:
         raise RuntimeError("victim never reached the kill window")
+    kill_seq = seq
+    victim_pid = procs[VICTIM].pid
     procs[VICTIM].kill()  # SIGKILL: no atexit, no flush, torn tail possible
     procs[VICTIM].wait()
 
@@ -132,6 +139,30 @@ def run_scenario(mode: str, type_name: str, timeout: float) -> dict:
     if mode == "adopt" and recovered > 0:
         bad.append(f"adopt mode unexpectedly recovered {recovered} WAL records")
 
+    # Flight-recorder post-mortem: the SIGKILLed incarnation must have
+    # left a spill (the continuous JSONL write is what survives a kill
+    # that no signal handler can see), identifiable by the ABSENCE of a
+    # proc.exit trailer, and its last durable step must sit at/just past
+    # the kill point — never beyond what the victim could have reached.
+    from antidote_ccrdt_tpu.obs import events as obs_events
+
+    killed_log = obs_events.read_log(
+        os.path.join(root, "obs", f"flight-{VICTIM}-{victim_pid}.jsonl")
+    )
+    flight_last_step = max(
+        (int(e["wseq"]) for e in killed_log if e.get("kind") == "wal.append"),
+        default=None,
+    )
+    if not killed_log:
+        bad.append("no flight-recorder dump for the SIGKILLed incarnation")
+    elif any(e.get("kind") == "proc.exit" for e in killed_log):
+        bad.append("killed incarnation's flight log has a clean proc.exit")
+    elif flight_last_step is not None and flight_last_step > kill_seq + 2:
+        bad.append(
+            f"flight log claims step {flight_last_step}, but the victim "
+            f"was killed at published seq {kill_seq}"
+        )
+
     verdict = {
         "mode": mode,
         "type": type_name,
@@ -141,6 +172,9 @@ def run_scenario(mode: str, type_name: str, timeout: float) -> dict:
         "victim_resume_step": finals.get(VICTIM, {})
         .get("metrics", {})
         .get("wal.resume_step"),
+        "kill_seq": kill_seq,
+        "victim_flight_events": len(killed_log),
+        "victim_flight_last_step": flight_last_step,
         "returncodes": rcs,
         "root": root,
     }

@@ -324,6 +324,9 @@ def run_worker(store, drill, dense, state, args, result_dir):
     for owned replicas, ownership-grows adoption, publish/sweep rounds,
     and a final convergence barrier; writes final-<member>.json (digest +
     alive view + metrics counters) into `result_dir`."""
+    from antidote_ccrdt_tpu.obs import events as obs_events
+    from antidote_ccrdt_tpu.obs import export as obs_export
+    from antidote_ccrdt_tpu.obs.lag import LagTracker
     from antidote_ccrdt_tpu.parallel.elastic import (
         DeltaPublisher,
         my_replicas,
@@ -332,6 +335,14 @@ def run_worker(store, drill, dense, state, args, result_dir):
     )
 
     from antidote_ccrdt_tpu.parallel.monoid import MonoidLift
+
+    # Observability plane (both env-gated, like CCRDT_FAULTS): the flight
+    # recorder spills every event to $CCRDT_OBS_DIR as it happens (so a
+    # SIGKILL still leaves the full record), and a metrics snapshot lands
+    # in $CCRDT_METRICS_DIR at clean exit for the supervisor to merge.
+    obs_events.install_from_env(args.member)
+    obs_export.install_atexit_dump(store.metrics, args.member)
+    lag_tracker = LagTracker(args.member)
 
     pub = None  # set below when --delta
     cursors: dict = {}
@@ -383,6 +394,57 @@ def run_worker(store, drill, dense, state, args, result_dir):
         else:
             swept, stats = sweep(store, dense, view)
         return drill.set_view(dense, st, swept), stats
+
+    def feed_lag() -> None:
+        """Watermarks from the transport vs what this worker merged.
+        Delta mode: published = the peer's highest visible delta/anchor
+        seq, applied = sweep_deltas' cursor. Snapshot mode: both sides
+        are the snapshot header seq (sweep merges latest-wins whole
+        states, so once swept we hold everything the header covers)."""
+        for m in set(store.delta_members()) | set(store.snapshot_members()):
+            if m == args.member:
+                continue
+            snap = store.snapshot_seq(m)
+            seqs = store.delta_seqs(m)
+            hi = max(seqs + ([snap] if snap is not None else [-1]))
+            if hi >= 0:
+                lag_tracker.observe_published(m, hi)
+            # Every feed_lag call site directly follows a sweep, so the
+            # visible snapshot has just been merged: the applied
+            # watermark is the delta cursor OR that snapshot seq,
+            # whichever is ahead (the final convergence loop sweeps full
+            # snapshots without advancing delta cursors).
+            applied = max(
+                cursors.get(m, -1) if pub is not None else -1,
+                snap if snap is not None else -1,
+            )
+            if applied >= 0:
+                lag_tracker.observe_applied(m, applied)
+        lag_tracker.export_to(store.metrics)
+
+    def drop_status(step, owned) -> None:
+        """Periodic machine-readable status for the live dashboard:
+        obs-<member>.json in the result dir (atomic replace)."""
+        counters = store.metrics.snapshot()["counters"]
+        doc = {
+            "member": args.member,
+            "t": time.time(),
+            "step": step,
+            "owned": sorted(int(r) for r in owned),
+            "alive": store.alive_members(args.timeout),
+            "lag": lag_tracker.report(),
+            "sendq": {
+                k[len("net.sendq."):]: v
+                for k, v in counters.items()
+                if k.startswith("net.sendq.")
+            },
+            "wal_last_seq": counters.get("wal.last_seq"),
+        }
+        path = os.path.join(result_dir, f"obs-{args.member}.json")
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
 
     if args.delta:
         pub = DeltaPublisher(store, dense, name=drill.publish_name, full_every=4)
@@ -443,6 +505,8 @@ def run_worker(store, drill, dense, state, args, result_dir):
             with store.metrics.timer("net.round"):
                 do_publish(store, step)
                 state, _ = do_sweep(store, state)
+            feed_lag()
+            drop_status(step, owned)
             if wal is not None:
                 # Anchor AFTER the publish: the compaction watermark must
                 # never pass what gossip has seen (checkpoint durability
@@ -474,6 +538,8 @@ def run_worker(store, drill, dense, state, args, result_dir):
         swept, _ = sweep(store, dense, drill.pub_state(dense, state))
         state = drill.set_view(dense, state, swept)
         store.publish(drill.publish_name, drill.pub_state(dense, state), STEPS)
+        feed_lag()
+        drop_status(STEPS, owned)
         pending = []
         alive_now = set(store.alive_members(confident_stale))
         for m in store.snapshot_members():
@@ -496,7 +562,8 @@ def run_worker(store, drill, dense, state, args, result_dir):
         "member": args.member,
         "alive": store.alive_members(args.timeout),
         "digest": drill.digest(dense, state),
-        "metrics": dict(store.metrics.counters),
+        "metrics": store.metrics.snapshot()["counters"],
+        "lag": lag_tracker.report(),
     }
     with open(os.path.join(result_dir, f"final-{args.member}.json"), "w") as f:
         json.dump(out, f)

@@ -1,0 +1,323 @@
+"""Live terminal dashboard over a gossip fleet + propagation-path proof.
+
+Two ways to run it:
+
+* **Attach** to a running shared-directory fleet::
+
+      python scripts/obs_dashboard.py --root /tmp/gossip-root \
+          [--obs-dir $CCRDT_OBS_DIR] [--interval 0.5] [--frames N | --once]
+
+  Each frame shows, per member: heartbeat age and the derived
+  ALIVE/SUSPECT/DEAD state, published snapshot step, visible delta
+  window, replication lag (ops and seconds, from the worker's own
+  ``obs-<member>.json`` status drops), TCP send-queue depths, and the
+  WAL durable watermark.
+
+* **Demo** (`make obs-demo`): ``--demo`` spawns a 3-worker
+  `elastic_demo` fleet in delta mode with the full observability plane
+  enabled (``CCRDT_OBS_DIR`` + ``CCRDT_METRICS_DIR``), renders live
+  frames while it runs, then prints the fleet-merged Prometheus
+  snapshot and RECONSTRUCTS one delta's end-to-end propagation path
+  (publish -> medium write/send -> apply on every peer, by replica and
+  seq) from the flight logs — exiting nonzero unless at least one delta
+  shows the complete path. That reconstruction is the acceptance check
+  that the trace context survives every layer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import struct
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from antidote_ccrdt_tpu.obs import events as obs_events  # noqa: E402
+
+# SWIM-ish thresholds for the fs medium (display only — workers make
+# their own liveness calls; these just color the dashboard).
+SUSPECT_S = 0.4
+DEAD_S = 0.8
+
+
+# -- fs-medium scraping ------------------------------------------------------
+
+
+def hb_age(root: str, member: str) -> Optional[float]:
+    """Seconds since `member`'s heartbeat (FsTransport timestamp payload,
+    mtime fallback) — same read the transport itself performs."""
+    p = os.path.join(root, f"hb-{member}")
+    try:
+        with open(p, "rb") as f:
+            payload = f.read(8)
+        if len(payload) == 8:
+            return time.time() - struct.unpack("<d", payload)[0]
+        return time.time() - os.path.getmtime(p)
+    except OSError:
+        return None
+
+
+def scrape_root(root: str) -> Dict[str, Dict[str, Any]]:
+    """One pass over the shared gossip dir -> {member: row}."""
+    rows: Dict[str, Dict[str, Any]] = {}
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return rows
+
+    def row(m: str) -> Dict[str, Any]:
+        return rows.setdefault(m, {"snap": None, "deltas": []})
+
+    for fn in names:
+        if ".tmp" in fn:
+            continue
+        if fn.startswith("hb-"):
+            row(fn[3:])
+        elif fn.startswith("snap-"):
+            m = fn[5:]
+            try:
+                with open(os.path.join(root, fn), "rb") as f:
+                    hdr = f.read(8)
+                if len(hdr) == 8:
+                    row(m)["snap"] = struct.unpack("<Q", hdr)[0]
+            except OSError:
+                pass
+        elif fn.startswith("delta-"):
+            m, _, seq = fn[len("delta-"):].rpartition("-")
+            try:
+                row(m)["deltas"].append(int(seq))
+            except ValueError:
+                pass
+        elif fn.startswith("obs-") and fn.endswith(".json"):
+            try:
+                with open(os.path.join(root, fn)) as f:
+                    row(fn[4:-5])["status"] = json.load(f)
+            except (OSError, ValueError):
+                pass
+    for m, r in rows.items():
+        age = hb_age(root, m)
+        r["hb_age"] = age
+        r["state"] = (
+            "?" if age is None
+            else "alive" if age <= SUSPECT_S
+            else "suspect" if age <= DEAD_S
+            else "dead"
+        )
+        r["deltas"].sort()
+    return rows
+
+
+# -- rendering ---------------------------------------------------------------
+
+
+def _fmt_lag(status: Optional[Dict[str, Any]]) -> str:
+    if not status or not status.get("lag"):
+        return "-"
+    return " ".join(
+        f"{p}:{r['lag_ops']}/{r['lag_s']:.2f}s"
+        for p, r in sorted(status["lag"].items())
+    )
+
+
+def _fmt_sendq(status: Optional[Dict[str, Any]]) -> str:
+    q = (status or {}).get("sendq") or {}
+    if not q:
+        return "-"
+    return " ".join(f"{p}:{int(v)}" for p, v in sorted(q.items()))
+
+
+def render_frame(root: str, clear: bool = True) -> str:
+    rows = scrape_root(root)
+    lines = []
+    if clear:
+        lines.append("\x1b[2J\x1b[H")
+    lines.append(f"== ccrdt gossip dashboard  root={root}  t={time.time():.2f}")
+    hdr = (
+        f"{'member':<10}{'hb-age':>8} {'state':<9}{'snap':>5} "
+        f"{'delta-window':<14}{'wal':>5}  {'sendq':<16}{'lag (peer:ops/secs)'}"
+    )
+    lines.append(hdr)
+    lines.append("-" * len(hdr))
+    for m in sorted(rows):
+        r = rows[m]
+        st = r.get("status")
+        age = "-" if r["hb_age"] is None else f"{r['hb_age']:.2f}s"
+        d = r["deltas"]
+        window = f"{d[0]}..{d[-1]}" if d else "-"
+        wal = (st or {}).get("wal_last_seq")
+        lines.append(
+            f"{m:<10}{age:>8} {r['state']:<9}"
+            f"{'-' if r['snap'] is None else r['snap']:>5} "
+            f"{window:<14}{'-' if wal is None else int(wal):>5}  "
+            f"{_fmt_sendq(st):<16}{_fmt_lag(st)}"
+        )
+    return "\n".join(lines)
+
+
+# -- propagation-path reconstruction ----------------------------------------
+
+
+def reconstruct_paths(obs_dir: str) -> Dict[str, Any]:
+    """Group every traced delta by (origin, dseq) and classify coverage.
+    A path is COMPLETE when the delta shows a publish, reached the medium
+    (fs write or tcp frame send), and was applied by every OTHER member
+    seen in the flight logs."""
+    logs = obs_events.scan_dir(obs_dir)
+    members = {evs[0]["member"] for evs in logs.values() if evs}
+    paths = obs_events.delta_paths(logs)
+    out: Dict[str, Any] = {"members": sorted(members), "deltas": {}}
+    for (origin, dseq), stages in sorted(paths.items()):
+        appliers = sorted({e["member"] for e in stages.get("apply", [])})
+        expect = sorted(members - {origin})
+        out["deltas"][f"{origin}#{dseq}"] = {
+            "origin": origin,
+            "dseq": dseq,
+            "stages": sorted(stages),
+            "appliers": appliers,
+            "complete": (
+                "publish" in stages
+                and ("write" in stages or "send" in stages)
+                and bool(expect)
+                and appliers == expect
+            ),
+        }
+    return out
+
+
+def print_path_timeline(obs_dir: str, origin: str, dseq: int) -> None:
+    """Human-readable end-to-end timeline for one delta, merged across
+    every member's flight log, ordered by wall time."""
+    logs = obs_events.scan_dir(obs_dir)
+    hops = []
+    for evs in logs.values():
+        for e in evs:
+            if e.get("origin") == origin and e.get("dseq") == dseq:
+                hops.append(e)
+    hops.sort(key=lambda e: e["t"])
+    t0 = hops[0]["t"] if hops else 0.0
+    print(f"-- propagation of delta {origin}#{dseq} "
+          f"({len(hops)} events) --")
+    for e in hops:
+        extra = "".join(
+            f" {k}={e[k]}" for k in ("peer", "fkind", "bytes") if k in e
+        )
+        print(
+            f"  +{e['t'] - t0:8.4f}s  {e['member']:<8} {e['kind']:<22}{extra}"
+        )
+
+
+# -- demo mode ---------------------------------------------------------------
+
+
+def run_demo(frames_interval: float = 0.5) -> int:
+    """Spawn a 3-worker delta-gossip fleet with the obs plane on, watch
+    it live, then print the merged Prometheus snapshot and verify one
+    full propagation path. Returns the process exit code."""
+    from antidote_ccrdt_tpu.obs import export as obs_export
+
+    demo = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "elastic_demo.py")
+    root = tempfile.mkdtemp(prefix="obs-demo-")
+    obs_dir = os.path.join(root, "obs")
+    metrics_dir = os.path.join(root, "metrics")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["CCRDT_OBS_DIR"] = obs_dir
+    env["CCRDT_METRICS_DIR"] = metrics_dir
+    members = ["w0", "w1", "w2"]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, demo, "--root", root, "--member", m,
+             "--n-members", str(len(members)), "--delta"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            env=env, text=True,
+        )
+        for m in members
+    ]
+    try:
+        while any(p.poll() is None for p in procs):
+            print(render_frame(root))
+            time.sleep(frames_interval)
+    finally:
+        outs = {}
+        for m, p in zip(members, procs):
+            try:
+                out, _ = p.communicate(timeout=120)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+            outs[m] = out
+    print(render_frame(root, clear=False))
+    bad = [m for m, p in zip(members, procs) if p.returncode != 0]
+    if bad:
+        for m in bad:
+            print(f"-- worker {m} failed --\n{outs[m][-2000:]}")
+        return 1
+
+    print("\n== fleet-merged Prometheus snapshot ==")
+    merged, dumped = obs_export.merge_dir(metrics_dir)
+    print(obs_export.prometheus_text(merged), end="")
+    print(f"# merged from: {sorted(dumped)}")
+
+    print("\n== delta propagation paths (from flight logs) ==")
+    rec = reconstruct_paths(obs_dir)
+    complete = [d for d in rec["deltas"].values() if d["complete"]]
+    for key, d in rec["deltas"].items():
+        mark = "OK " if d["complete"] else "..."
+        print(f"  [{mark}] {key}: stages={d['stages']} "
+              f"applied-by={d['appliers']}")
+    if not complete:
+        print("FAIL: no delta shows a complete publish->medium->apply-"
+              "on-every-peer path")
+        return 1
+    pick = complete[0]
+    print()
+    print_path_timeline(obs_dir, pick["origin"], pick["dseq"])
+    print(f"\nOK: {len(complete)}/{len(rec['deltas'])} traced deltas "
+          f"fully propagated across {rec['members']}")
+    return 0
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--root", help="shared gossip dir of a running fleet")
+    ap.add_argument("--obs-dir", default=os.environ.get(obs_events.ENV_DIR),
+                    help="flight-log spill dir (for path reconstruction)")
+    ap.add_argument("--interval", type=float, default=0.5)
+    ap.add_argument("--frames", type=int, default=0,
+                    help="stop after N frames (0 = until interrupted)")
+    ap.add_argument("--once", action="store_true",
+                    help="render a single frame without clearing and exit")
+    ap.add_argument("--demo", action="store_true",
+                    help="spawn a 3-worker fleet and run the full check")
+    args = ap.parse_args()
+
+    if args.demo:
+        sys.exit(run_demo(frames_interval=args.interval))
+    if not args.root:
+        ap.error("--root is required unless --demo")
+    if args.once:
+        print(render_frame(args.root, clear=False))
+        return
+    n = 0
+    try:
+        while args.frames <= 0 or n < args.frames:
+            print(render_frame(args.root, clear=n > 0))
+            n += 1
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    if args.obs_dir:
+        rec = reconstruct_paths(args.obs_dir)
+        print(json.dumps(rec, indent=2))
+
+
+if __name__ == "__main__":
+    main()

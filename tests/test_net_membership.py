@@ -3,6 +3,7 @@ ALIVE -> SUSPECT -> CONFIRM-DEAD progression, re-alive on fresh evidence,
 stale-evidence rejection, and transitive piggybacked ages."""
 
 from antidote_ccrdt_tpu.net.membership import ALIVE, DEAD, SUSPECT, Membership
+from antidote_ccrdt_tpu.obs import events as obs_events
 from antidote_ccrdt_tpu.utils.metrics import Metrics
 
 
@@ -82,6 +83,53 @@ def test_transitive_piggyback():
     assert c.state_of("a", 1.0) == ALIVE  # sender's self-age is 0
     clk.t = 3.0
     assert c.state_of("b", 1.0) == DEAD
+
+
+def test_transition_events_are_edge_triggered_with_evidence():
+    """Each SWIM transition lands exactly one typed flight-recorder
+    event carrying the heartbeat age that crossed the horizon — the
+    operator-facing counterpart of the edge-triggered counters."""
+    obs_events.reset("a")
+    clk = Clock()
+    ms = Membership("a", now=clk, confirm_factor=2.0)
+    ms.observe("b")
+
+    clk.t = 1.5
+    ms.state_of("b", 1.0)
+    ms.state_of("b", 1.0)  # repeated poll: no second event
+    sus = obs_events.events("peer.suspect")
+    assert len(sus) == 1
+    assert sus[0]["peer"] == "b" and sus[0]["member"] == "a"
+    assert sus[0]["age"] == 1.5 and sus[0]["timeout_s"] == 1.0
+
+    clk.t = 2.5
+    ms.state_of("b", 1.0)
+    ms.state_of("b", 1.0)
+    dead = obs_events.events("peer.dead")
+    assert len(dead) == 1
+    assert dead[0]["peer"] == "b" and dead[0]["age"] == 2.5
+
+    # Fresh evidence refutes: one realive event, recording what the
+    # peer was (dead) when the refutation arrived.
+    ms.observe("b")
+    rea = obs_events.events("peer.realive")
+    assert len(rea) == 1
+    assert rea[0]["peer"] == "b" and rea[0]["was"] == "dead"
+    obs_events.reset()
+
+
+def test_realive_from_suspect_records_prior_state():
+    obs_events.reset("a")
+    clk = Clock()
+    ms = Membership("a", now=clk, confirm_factor=2.0)
+    ms.observe("b")
+    clk.t = 1.5
+    assert ms.state_of("b", 1.0) == SUSPECT
+    ms.observe("b")  # refuted while merely suspected
+    rea = obs_events.events("peer.realive")
+    assert len(rea) == 1 and rea[0]["was"] == "suspect"
+    assert obs_events.events("peer.dead") == []
+    obs_events.reset()
 
 
 def test_self_is_always_alive():

@@ -96,7 +96,7 @@ def test_queue_backpressure_drop_oldest_delta_keep_anchor():
     import random
 
     link = _PeerLink(
-        ("127.0.0.1", _closed_port()), random.Random(0), m,
+        "peer", ("127.0.0.1", _closed_port()), random.Random(0), m,
         queue_max=4, connect_timeout=0.1, send_timeout=0.1,
         backoff_base=10.0, backoff_max=10.0,  # effectively: never retry
     )
@@ -106,8 +106,8 @@ def test_queue_backpressure_drop_oldest_delta_keep_anchor():
         for i in range(6):
             link.enqueue("delta", mk(b"d%d" % i))
         with link._cv:
-            kinds = [k for k, _ in link._q]
-            builds = [f() for _, f in link._q]
+            kinds = [k for k, _, _meta in link._q]
+            builds = [f() for _, f, _meta in link._q]
         # The anchor survived; the OLDEST deltas were shed.
         assert "snap" in kinds
         assert b"d5" in builds and b"d0" not in builds
@@ -122,7 +122,7 @@ def test_queue_snap_latest_wins_and_ping_dedup():
     import random
 
     link = _PeerLink(
-        ("127.0.0.1", _closed_port()), random.Random(0), m,
+        "peer", ("127.0.0.1", _closed_port()), random.Random(0), m,
         queue_max=8, connect_timeout=0.1, send_timeout=0.1,
         backoff_base=10.0, backoff_max=10.0,
     )
@@ -133,8 +133,8 @@ def test_queue_snap_latest_wins_and_ping_dedup():
         link.enqueue("ping", mk(b"p1"))
         link.enqueue("ping", mk(b"p2"))
         with link._cv:
-            snaps = [f() for k, f in link._q if k == "snap"]
-            pings = [f() for k, f in link._q if k == "ping"]
+            snaps = [f() for k, f, _m in link._q if k == "snap"]
+            pings = [f() for k, f, _m in link._q if k == "ping"]
         assert snaps == [b"new-anchor"]  # queued older anchor replaced
         assert len(pings) == 1  # one pending ping is enough liveness
     finally:
@@ -148,7 +148,7 @@ def test_retry_backoff_bounded_and_never_hangs():
     import random
 
     link = _PeerLink(
-        ("127.0.0.1", _closed_port()), random.Random(0), m,
+        "peer", ("127.0.0.1", _closed_port()), random.Random(0), m,
         queue_max=8, connect_timeout=0.2, send_timeout=0.2,
         backoff_base=0.01, backoff_max=0.05,
     )

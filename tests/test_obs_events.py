@@ -1,0 +1,189 @@
+"""Flight recorder (obs/events.py): bounded ring semantics, the
+line-buffered JSONL spill, trace-context grouping (`delta_paths`), and
+the crash-durability contract — a SIGKILLed process leaves a readable
+dump with no ``proc.exit`` trailer (the same real-subprocess pattern
+tests/test_crash_recovery.py drills at fleet scale)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from antidote_ccrdt_tpu.obs import events as obs_events
+from antidote_ccrdt_tpu.obs.events import FlightRecorder
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_ring_is_bounded_and_seq_monotonic():
+    rec = FlightRecorder(member="m", ring=8)
+    for i in range(20):
+        rec.emit("tick", i=i)
+    evs = rec.events()
+    # Overflow evicts the OLDEST events; the ring never grows past bound.
+    assert len(evs) == 8
+    assert [e["i"] for e in evs] == list(range(12, 20))
+    assert [e["seq"] for e in evs] == list(range(12, 20))
+    assert all(e["member"] == "m" for e in evs)
+    # seq keeps counting across eviction — it is the process ordinal,
+    # not a ring index.
+    nxt = rec.emit("tick", i=20)
+    assert nxt["seq"] == 20
+
+
+def test_events_filter_and_dump(tmp_path):
+    rec = FlightRecorder(member="m")
+    rec.emit("a.x", v=1)
+    rec.emit("b.y", v=2)
+    rec.emit("a.x", v=3)
+    assert [e["v"] for e in rec.events("a.x")] == [1, 3]
+    out = str(tmp_path / "dump.jsonl")
+    assert rec.dump(out) == 3
+    assert [e["kind"] for e in obs_events.read_log(out)] == ["a.x", "b.y", "a.x"]
+
+
+def test_spill_is_continuous_and_torn_tail_skipped(tmp_path):
+    spill = str(tmp_path / "flight-m-1.jsonl")
+    rec = FlightRecorder(member="m", spill_path=spill)
+    rec.emit("one")
+    rec.emit("two")
+    # Line-buffered: both events are on disk BEFORE close — that is the
+    # property the post-SIGKILL dump depends on.
+    assert len(obs_events.read_log(spill)) == 2
+    rec.close()
+    # A kill can land mid-write of the final line; readers must skip it.
+    with open(spill, "a") as f:
+        f.write('{"kind": "torn-half')
+    evs = obs_events.read_log(spill)
+    assert [e["kind"] for e in evs] == ["one", "two"]
+
+
+def test_configure_reset_and_module_surface(tmp_path):
+    obs_events.reset("w9", ring=16)
+    obs_events.emit("hello", x=1)
+    assert obs_events.events("hello")[0]["member"] == "w9"
+    # configure() with a spill dir names the file per (member, pid) and
+    # opens the log with proc.start.
+    rec = obs_events.configure("w9", spill_dir=str(tmp_path), crash_hooks=False)
+    expect = str(tmp_path / f"flight-w9-{os.getpid()}.jsonl")
+    assert rec.spill_path == expect
+    obs_events.emit("after")
+    kinds = [e["kind"] for e in obs_events.read_log(expect)]
+    assert kinds == ["proc.start", "after"]
+    obs_events.reset()
+
+
+def test_install_from_env_gating(tmp_path):
+    # Without the env var: in-memory only, member identity still applied.
+    assert obs_events.install_from_env("w0", env={}) is False
+    assert obs_events.recorder().spill_path is None
+    # With it: spill enabled under the named dir.
+    d = str(tmp_path / "obs")
+    assert obs_events.install_from_env("w0", env={obs_events.ENV_DIR: d})
+    assert obs_events.recorder().spill_path.startswith(d)
+    obs_events.reset()
+
+
+def test_delta_paths_groups_by_trace_context():
+    logs = {
+        "flight-a.jsonl": [
+            {"kind": "delta.publish", "member": "a", "origin": "a", "dseq": 3},
+            {"kind": "transport.delta_write", "member": "a", "origin": "a",
+             "dseq": 3},
+            {"kind": "wal.append", "member": "a", "wseq": 3},  # no context
+        ],
+        "flight-b.jsonl": [
+            {"kind": "delta.fetch", "member": "b", "origin": "a", "dseq": 3},
+            {"kind": "delta.apply", "member": "b", "origin": "a", "dseq": 3},
+            {"kind": "delta.apply", "member": "b", "origin": "c", "dseq": 0},
+        ],
+    }
+    paths = obs_events.delta_paths(logs)
+    assert set(paths) == {("a", 3), ("c", 0)}
+    a3 = paths[("a", 3)]
+    assert sorted(a3) == ["apply", "fetch", "publish", "write"]
+    assert [e["member"] for e in a3["apply"]] == ["b"]
+    assert list(obs_events.iter_kinds(logs, "wal.append"))[0]["wseq"] == 3
+
+
+# -- real-subprocess crash durability ---------------------------------------
+
+_CHILD = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from antidote_ccrdt_tpu.obs import events as obs_events
+
+obs_events.install_from_env("victim")
+for i in range(5):
+    obs_events.emit("work.step", i=i)
+print("READY", flush=True)
+time.sleep({linger})
+"""
+
+
+def _spawn_child(tmp_path, obs_dir, linger):
+    env = dict(os.environ)
+    env[obs_events.ENV_DIR] = obs_dir
+    return subprocess.Popen(
+        [sys.executable, "-c", _CHILD.format(repo=REPO, linger=linger)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True,
+    )
+
+
+def _flight_path(obs_dir, pid):
+    return os.path.join(obs_dir, f"flight-victim-{pid}.jsonl")
+
+
+def test_sigkill_leaves_crash_dump_without_proc_exit(tmp_path):
+    """The acceptance contract of the crash flight recorder: kill -9 a
+    worker and its spill still holds every emitted event, with NO
+    proc.exit trailer marking it as a clean shutdown."""
+    obs_dir = str(tmp_path / "obs")
+    p = _spawn_child(tmp_path, obs_dir, linger=30)
+    try:
+        assert p.stdout.readline().strip() == "READY"
+        os.kill(p.pid, signal.SIGKILL)  # no handler can observe this
+        p.wait(timeout=10)
+    finally:
+        if p.poll() is None:
+            p.kill()
+    evs = obs_events.read_log(_flight_path(obs_dir, p.pid))
+    kinds = [e["kind"] for e in evs]
+    assert kinds[0] == "proc.start"
+    assert kinds.count("work.step") == 5  # every pre-kill event survived
+    assert "proc.exit" not in kinds  # the crash-dump discriminator
+    assert [e["seq"] for e in evs] == sorted(e["seq"] for e in evs)
+
+
+def test_clean_exit_writes_proc_exit_trailer(tmp_path):
+    obs_dir = str(tmp_path / "obs")
+    p = _spawn_child(tmp_path, obs_dir, linger=0)
+    out, _ = p.communicate(timeout=30)
+    assert p.returncode == 0, out
+    kinds = [e["kind"] for e in obs_events.read_log(_flight_path(obs_dir, p.pid))]
+    assert kinds[0] == "proc.start" and kinds[-1] == "proc.exit"
+
+
+def test_sigterm_also_stamps_proc_exit(tmp_path):
+    """TERM is catchable: the exit hooks stamp the trailer, then chain to
+    the default action (the process still dies by the signal)."""
+    obs_dir = str(tmp_path / "obs")
+    p = _spawn_child(tmp_path, obs_dir, linger=30)
+    try:
+        assert p.stdout.readline().strip() == "READY"
+        p.terminate()
+        p.wait(timeout=10)
+    finally:
+        if p.poll() is None:
+            p.kill()
+    deadline = time.time() + 5
+    kinds = []
+    while time.time() < deadline:
+        kinds = [e["kind"] for e in
+                 obs_events.read_log(_flight_path(obs_dir, p.pid))]
+        if "proc.exit" in kinds:
+            break
+        time.sleep(0.05)
+    assert "proc.exit" in kinds, kinds

@@ -1,0 +1,116 @@
+"""Metrics export (obs/export.py): Prometheus exposition golden format,
+JSONL rendering, and the cross-process dump/merge path — plus the
+`Metrics.snapshot()`/`merge()` semantics the aggregation depends on."""
+
+import json
+import os
+
+from antidote_ccrdt_tpu.obs import export as obs_export
+from antidote_ccrdt_tpu.utils.metrics import Metrics
+
+
+def _sample_metrics():
+    m = Metrics()
+    m.count("net.frames_sent", 3)
+    m.set("wal.last_seq", 17.0)
+    m.merge({"counters": {}, "latencies": {"sync": [0.010, 0.020, 0.030, 0.040]}})
+    return m
+
+
+def test_prometheus_golden_format():
+    text = obs_export.prometheus_text(_sample_metrics())
+    assert text.endswith("\n")
+    lines = text.splitlines()
+    # Counters: dots sanitized to underscores, ccrdt_ prefix, HELP/TYPE
+    # preceding each sample, int-valued floats rendered as ints.
+    assert lines[0] == "# HELP ccrdt_net_frames_sent ccrdt counter/gauge net.frames_sent"
+    assert lines[1] == "# TYPE ccrdt_net_frames_sent gauge"
+    assert lines[2] == "ccrdt_net_frames_sent 3"
+    assert "ccrdt_wal_last_seq 17" in lines
+    # Latencies: summary with p50/p90/p99 quantile samples + sum/count.
+    assert "# TYPE ccrdt_sync_seconds summary" in lines
+    assert 'ccrdt_sync_seconds{quantile="0.5"} 0.025' in lines
+    assert 'ccrdt_sync_seconds{quantile="0.9"}' in "\n".join(lines)
+    assert 'ccrdt_sync_seconds{quantile="0.99"}' in "\n".join(lines)
+    assert "ccrdt_sync_seconds_sum 0.1" in lines
+    assert "ccrdt_sync_seconds_count 4" in lines
+
+
+def test_prometheus_labels_and_prefix():
+    m = Metrics()
+    m.count("x")
+    text = obs_export.prometheus_text(m, prefix="app", labels={"member": "w0"})
+    assert 'app_x{member="w0"} 1' in text.splitlines()
+    # Labels merge with the quantile label on summary samples.
+    m.merge({"counters": {}, "latencies": {"t": [0.5]}})
+    text = obs_export.prometheus_text(m, labels={"member": "w0"})
+    assert 'ccrdt_t_seconds{member="w0",quantile="0.5"} 0.5' in text.splitlines()
+
+
+def test_prometheus_accepts_plain_snapshot_and_empty_series():
+    snap = {"counters": {"a.b": 2.5}, "latencies": {"empty": []}}
+    lines = obs_export.prometheus_text(snap).splitlines()
+    assert "ccrdt_a_b 2.5" in lines
+    # An empty latency series still exports well-formed sum/count.
+    assert "ccrdt_empty_seconds_sum 0" in lines
+    assert "ccrdt_empty_seconds_count 0" in lines
+    assert not any('quantile="' in ln and "empty" in ln for ln in lines)
+
+
+def test_jsonl_lines():
+    out = obs_export.jsonl_lines(_sample_metrics(), member="w1")
+    docs = [json.loads(ln) for ln in out]
+    by_metric = {d["metric"]: d for d in docs}
+    assert by_metric["net.frames_sent"] == {
+        "member": "w1", "metric": "net.frames_sent", "value": 3.0}
+    assert by_metric["sync"]["summary"]["n"] == 4
+    assert abs(by_metric["sync"]["summary"]["p50_ms"] - 25.0) < 1e-9
+
+
+def test_snapshot_merge_roundtrip():
+    a, b = Metrics(), Metrics()
+    a.count("ops", 2)
+    a.merge({"counters": {}, "latencies": {"t": [0.1]}})
+    b.count("ops", 3)
+    b.count("only_b")
+    b.merge({"counters": {}, "latencies": {"t": [0.3, 0.5]}})
+    merged = Metrics()
+    merged.merge(a.snapshot())
+    merged.merge(b.snapshot())
+    assert merged.counters["ops"] == 5.0
+    assert merged.counters["only_b"] == 1.0
+    # Samples concatenate: fleet percentiles run over the union, never
+    # over averaged per-worker percentiles.
+    assert sorted(merged.latencies["t"].samples) == [0.1, 0.3, 0.5]
+    # Snapshots are copies — mutating one never aliases the registry.
+    snap = merged.snapshot()
+    snap["counters"]["ops"] = 999
+    snap["latencies"]["t"].append(9.9)
+    assert merged.counters["ops"] == 5.0
+    assert len(merged.latencies["t"].samples) == 3
+
+
+def test_dump_load_merge_dir(tmp_path):
+    d = str(tmp_path / "metrics")
+    for member, n in (("w0", 2), ("w1", 5)):
+        m = Metrics()
+        m.count("net.frames_sent", n)
+        m.merge({"counters": {}, "latencies": {"sync": [0.01 * n]}})
+        path = obs_export.dump_snapshot(m, member, d)
+        assert os.path.basename(path) == f"metrics-{member}-{os.getpid()}.json"
+    # A torn/partial file must be skipped, not crash the merge.
+    with open(os.path.join(d, "metrics-broken-1.json"), "w") as f:
+        f.write('{"member": "bro')
+    docs = obs_export.load_snapshots(d)
+    assert len(docs) == 2
+    merged, members = obs_export.merge_dir(d)
+    assert sorted(members) == ["w0", "w1"]
+    assert merged.counters["net.frames_sent"] == 7.0
+    assert sorted(merged.latencies["sync"].samples) == [0.02, 0.05]
+
+
+def test_install_atexit_dump_gated_on_env(tmp_path):
+    m = Metrics()
+    assert obs_export.install_atexit_dump(m, "w0", env={}) is False
+    assert obs_export.install_atexit_dump(
+        m, "w0", env={obs_export.ENV_DIR: str(tmp_path / "md")}) is True

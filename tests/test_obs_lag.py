@@ -1,0 +1,141 @@
+"""Replication-lag tracker (obs/lag.py) on an injected fake clock:
+watermark/cursor arithmetic, first-sighting lag-seconds, watermark gaps
+(anchors skip seqs), peer death mid-window, gauge export, and the
+fleet digest-agreement probe."""
+
+import struct
+import zlib
+
+from antidote_ccrdt_tpu.obs.lag import (
+    LagTracker,
+    digest_agreement,
+    payload_digest,
+)
+from antidote_ccrdt_tpu.utils.metrics import Metrics
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_lag_ops_and_seconds_basic():
+    clk = Clock()
+    lt = LagTracker("me", clock=clk)
+    # Peer b has shipped seqs 0..2; we have applied none.
+    lt.observe_published("b", 2)
+    assert lt.lag("b") == (3, 0.0)
+    clk.t = 4.0
+    ops, secs = lt.lag("b")
+    assert ops == 3
+    assert secs == 4.0  # age of the oldest unapplied seq, from first sighting
+    # Applying 0..1 leaves one op; the oldest pending is now seq 2,
+    # first seen at t=0 — lag-seconds still measures from that sighting.
+    lt.observe_applied("b", 1)
+    ops, secs = lt.lag("b")
+    assert ops == 1 and secs == 4.0
+    lt.observe_applied("b", 2)
+    assert lt.lag("b") == (0, 0.0)
+
+
+def test_watermark_gaps_are_stamped_at_first_sighting():
+    """Anchors make the published seq jump (0 -> 4 with nothing between
+    on the transport): every seq in the gap is stamped when the jump is
+    seen, not retroactively."""
+    clk = Clock()
+    lt = LagTracker("me", clock=clk)
+    lt.observe_published("b", 0)
+    clk.t = 10.0
+    lt.observe_published("b", 4)  # gap: 1..4 first seen at t=10
+    ops, secs = lt.lag("b")
+    assert ops == 5
+    assert secs == 10.0  # oldest pending is seq 0 from t=0
+    lt.observe_applied("b", 0)
+    ops, secs = lt.lag("b")
+    assert ops == 4
+    assert secs == 0.0  # the survivors (1..4) were first seen just now
+    clk.t = 13.0
+    assert lt.lag("b") == (4, 3.0)
+
+
+def test_applied_beyond_published_advances_watermark():
+    """A full-snapshot adoption can apply past the last published seq we
+    saw (the snapshot embeds newer state): applied must drag published
+    forward, never report negative lag."""
+    clk = Clock()
+    lt = LagTracker("me", clock=clk)
+    lt.observe_published("b", 1)
+    lt.observe_applied("b", 7)
+    assert lt.lag("b") == (0, 0.0)
+    assert lt.report()["b"]["published"] == 7
+    # Stale re-observations of older watermarks are no-ops.
+    lt.observe_published("b", 3)
+    assert lt.lag("b") == (0, 0.0)
+
+
+def test_peer_death_mid_window_drop_freezes_and_forgets():
+    clk = Clock()
+    lt = LagTracker("me", clock=clk)
+    lt.observe_published("b", 5)
+    lt.observe_published("c", 1)
+    clk.t = 2.0
+    assert lt.lag("b") == (6, 2.0)
+    # SWIM confirms b DEAD mid-window: its frozen watermark must stop
+    # inflating fleet lag.
+    lt.drop("b")
+    assert lt.lag("b") == (0, 0.0)
+    assert set(lt.report()) == {"c"}
+    # A re-observed (restarted) b starts a fresh window.
+    clk.t = 3.0
+    lt.observe_published("b", 0)
+    assert lt.lag("b") == (1, 0.0)
+
+
+def test_self_is_never_tracked():
+    lt = LagTracker("me", clock=Clock())
+    lt.observe_published("me", 9)
+    lt.observe_applied("me", 9)
+    assert lt.report() == {}
+
+
+def test_export_to_metrics_gauges():
+    clk = Clock()
+    lt = LagTracker("me", clock=clk)
+    lt.observe_published("b", 3)
+    lt.observe_published("c", 0)
+    lt.observe_applied("c", 0)
+    clk.t = 1.5
+    m = Metrics()
+    lt.export_to(m)
+    assert m.counters["lag.b.ops"] == 4.0
+    assert m.counters["lag.b.seconds"] == 1.5
+    assert m.counters["lag.c.ops"] == 0.0
+    assert m.counters["lag.max_ops"] == 4.0
+    assert m.counters["lag.max_seconds"] == 1.5
+
+
+def test_payload_digest_skips_header():
+    blob = struct.pack("<Q", 42) + b"payload"
+    assert payload_digest(blob) == zlib.crc32(b"payload") & 0xFFFFFFFF
+    # Same payload under a different step header -> same digest.
+    assert payload_digest(struct.pack("<Q", 7) + b"payload") == payload_digest(blob)
+
+
+def test_digest_agreement_partitions():
+    agree = digest_agreement({"a": 1, "b": 1, "c": 1})
+    assert agree["agree"] and agree["n_digests"] == 1
+    assert agree["groups"] == {"00000001": ["a", "b", "c"]}
+
+    split = digest_agreement({"a": 1, "b": 2, "c": 1})
+    assert not split["agree"]
+    assert split["groups"]["00000001"] == ["a", "c"]
+    assert split["groups"]["00000002"] == ["b"]
+
+    # An unreadable member breaks agreement and is reported by name.
+    holey = digest_agreement({"a": 1, "b": 1, "c": None})
+    assert not holey["agree"]
+    assert holey["unreadable"] == ["c"]
+    assert holey["n_members"] == 3 and holey["n_digests"] == 1

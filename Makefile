@@ -23,7 +23,7 @@ PY ?= python
 # meaningful.
 COVER_THRESHOLD ?= 88
 
-.PHONY: all compile test cover typecheck xref native bench benchall dryrun net-demo chaos crash-demo obs-demo clean
+.PHONY: all compile test cover typecheck xref native bench benchall dryrun net-demo chaos crash-demo obs-demo bench-gate clean
 
 all: compile xref typecheck cover
 
@@ -75,10 +75,21 @@ net-demo:
 # seeded sim drill whose Prometheus summary is printed and whose
 # load-bearing counters (sim faults, delta gossip, SWIM deaths) must be
 # nonzero — a refactor that silently stops counting fails here even if
-# convergence stays green.
+# convergence stays green. The third leg adds the scrape-under-fault
+# matrix (tcp.send / bridge.read must degrade a live scrape, never hang)
+# and the trace-CLI unit surface; the fourth is the bench regression
+# gate over the committed BENCH_r*.json rounds.
 chaos:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py tests/test_wal.py tests/test_fault_matrix.py -q -p no:cacheprovider
 	env JAX_PLATFORMS=cpu $(PY) scripts/chaos_gate.py
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_scrape_faults.py tests/test_trace_cli.py -q -p no:cacheprovider
+	$(PY) scripts/bench_gate.py
+
+# Throughput regression gate: best merges_per_sec of the latest
+# BENCH_r*.json round must stay within 20% of the best prior round —
+# the same batched-dispatch throughput obs/profile.py measures live.
+bench-gate:
+	$(PY) scripts/bench_gate.py
 
 # The crash-consistency drill (slow, real processes): SIGKILL a
 # WAL-backed worker mid-run, restart it, and require bit-identical
@@ -87,11 +98,13 @@ chaos:
 crash-demo:
 	env JAX_PLATFORMS=cpu $(PY) scripts/crash_recovery_demo.py --mode both
 
-# Observability demo (slow, real processes): a 3-worker delta-gossip
-# fleet with the full obs plane on — live dashboard frames, then the
-# fleet-merged Prometheus snapshot and a reconstructed end-to-end delta
-# propagation path (publish -> medium -> apply on every peer) from the
-# flight logs. Fails unless at least one delta's path is complete.
+# Observability demo (slow, real processes): a 3-worker TCP gossip
+# fleet with the full obs plane on — live dashboard frames, LIVE scrapes
+# of the running workers over HTTP /metrics and the in-band TCP
+# {metrics_req} frame (must carry lag gauges + profile.dispatch
+# histogram buckets), then the fleet-merged Prometheus snapshot, a
+# reconstructed end-to-end delta propagation path from the flight logs,
+# and a trace-CLI smoke run (summary --require-complete + path).
 obs-demo:
 	env JAX_PLATFORMS=cpu $(PY) scripts/obs_dashboard.py --demo
 

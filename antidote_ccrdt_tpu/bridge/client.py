@@ -160,6 +160,12 @@ class BridgeClient:
     def equal(self, h1: Any, h2: Any) -> bool:
         return self.call((Atom("equal"), h1, h2))
 
+    def metrics_text(self) -> str:
+        """Scrape the server's live registry in-band: OpenMetrics text
+        over the data-plane connection (the {metrics} op)."""
+        out = self.call((Atom("metrics"),))
+        return bytes(out).decode("utf-8")
+
     def compact(self, handle: Any, effect_terms: List[Any]) -> List[Any]:
         return self.call((Atom("compact"), handle, effect_terms))
 

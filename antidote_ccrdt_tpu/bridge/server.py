@@ -1577,6 +1577,16 @@ class BridgeServer:
             grid = _Grid.from_binary(blob)  # built outside _meta
             self._replace_grid(gname, grid)
             return True
+        if tag == "metrics":
+            # {metrics} -> OpenMetrics exposition text (binary). In-band
+            # scrape over the same listener the data plane uses, so a
+            # BEAM host (or Prometheus via a tiny shim) can inspect a
+            # live worker without a side channel. Reads a snapshot, so a
+            # scrape can never corrupt the registry.
+            from ..obs import export as obs_export
+
+            self.metrics.count("bridge.scrapes")
+            return obs_export.prometheus_text(self.metrics).encode("utf-8")
         raise ValueError(f"unknown op: {tag}")
 
 

@@ -39,6 +39,7 @@ from typing import Any, Dict, List, Sequence
 import numpy as np
 
 from .behaviour import registry
+from ..obs import profile
 
 _I32_MIN, _I32_MAX = -(2**31 - 1), 2**31 - 1
 
@@ -65,10 +66,13 @@ def _batched_fold(merge, batch: Any):
     n = jax.tree.leaves(batch)[0].shape[0]
     while n > 1:
         half = n // 2
-        merged = merge(
-            jax.tree.map(lambda x: x[:half], batch),
-            jax.tree.map(lambda x: x[half : 2 * half], batch),
-        )
+        lhs = jax.tree.map(lambda x: x[:half], batch)
+        rhs = jax.tree.map(lambda x: x[half : 2 * half], batch)
+        if profile.ACTIVE:
+            with profile.dispatch("batch_merge.fold", fn=merge, operands=(lhs, rhs)):
+                merged = merge(lhs, rhs)
+        else:
+            merged = merge(lhs, rhs)
         if n % 2:
             batch = jax.tree.map(
                 lambda m, t: jnp.concatenate([m, t], axis=0),

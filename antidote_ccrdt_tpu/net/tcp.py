@@ -8,6 +8,13 @@ sender's piggybacked `Membership.heard_ages` map):
     {snap,  Member, Blob, Heard}
     {delta, Member, Seq, Keep, Blob, Heard}
     {ping,  Member, Heard}
+    {metrics_req}                      -> {metrics_resp, Member, Text}
+
+`metrics_req` is the one request/reply pair: a scraper (Prometheus shim,
+`scrape_metrics`, the dashboard) connects, sends the request, and gets
+this member's OpenMetrics text back on the SAME inbound connection — the
+only frame ever written back on an accepted socket. Scrapers are not
+members: the request bypasses membership observation entirely.
 
 Topology: full mesh over a static address book. Each member keeps ONE
 outgoing connection per peer (`_PeerLink`) feeding from a bounded send
@@ -42,6 +49,7 @@ import random
 import socket
 import struct
 import threading
+import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -55,8 +63,30 @@ from .membership import Membership
 A_SNAP = Atom("snap")
 A_DELTA = Atom("delta")
 A_PING = Atom("ping")
+A_METRICS_REQ = Atom("metrics_req")
+A_METRICS_RESP = Atom("metrics_resp")
 
 _SNAP, _DELTA, _PING = "snap", "delta", "ping"
+
+
+def scrape_metrics(addr: Tuple[str, int], timeout: float = 2.0) -> Tuple[str, str]:
+    """One-shot in-band scrape of a live `TcpTransport`: connect to its
+    gossip listener, send `{metrics_req}`, return (member, OpenMetrics
+    text). Bounded by `timeout` end-to-end — a wedged or fault-injected
+    worker yields `socket.timeout`/`ConnectionError`, never a hang."""
+    deadline = time.monotonic() + timeout
+    with socket.create_connection(addr, timeout=timeout) as s:
+        s.sendall(pack_frame((A_METRICS_REQ,)))
+        buf = bytearray()
+        while True:
+            s.settimeout(max(0.01, deadline - time.monotonic()))
+            data = s.recv(1 << 16)
+            if not data:
+                raise ConnectionError("scrape connection closed before reply")
+            buf.extend(data)
+            for term in unpack_frames(buf):
+                if term[0] == A_METRICS_RESP:
+                    return term[1].decode("utf-8"), term[2].decode("utf-8")
 
 
 class _PeerLink:
@@ -328,7 +358,7 @@ class TcpTransport:
                 buf.extend(data)
                 self.metrics.count("net.bytes_recv", len(data))
                 for term in unpack_frames(buf):
-                    self._handle(term)
+                    self._handle(term, conn)
         except (OSError, ValueError):
             return
         finally:
@@ -337,9 +367,16 @@ class TcpTransport:
             except OSError:
                 pass
 
-    def _handle(self, term) -> None:
+    def _handle(self, term, conn: Optional[socket.socket] = None) -> None:
         self.metrics.count("net.frames_recv")
         tag = term[0]
+        if tag == A_METRICS_REQ:
+            # In-band scrape: reply on the inbound connection (the only
+            # write-back frame) and return WITHOUT touching membership —
+            # the scraper is not a mesh member.
+            if conn is not None:
+                self._send_metrics_resp(conn)
+            return
         if tag == A_SNAP:
             _, mb, blob, heard = term
             m = mb.decode("utf-8")
@@ -387,6 +424,32 @@ class TcpTransport:
         self.membership.absorb(
             {k.decode("utf-8"): v for k, v in heard.items()}
         )
+
+    def _send_metrics_resp(self, conn: socket.socket) -> None:
+        """Answer one `{metrics_req}`: render a snapshot (never the live
+        dicts) and write it back. Degrade-never-hang: the `tcp.send`
+        fault point (drop or raised reset) and any real socket error
+        close the connection, so the scraper sees EOF/error within its
+        own timeout while the registry stays intact."""
+        from ..obs import export as obs_export
+
+        self.metrics.count("net.scrapes")
+        text = obs_export.prometheus_text(
+            self.metrics, labels={"member": self.member}
+        )
+        frame = pack_frame(
+            (A_METRICS_RESP, self.member.encode("utf-8"), text.encode("utf-8"))
+        )
+        try:
+            if faults.ACTIVE and faults.fire("tcp.send") == "drop":
+                self.metrics.count("net.fault_drops")
+                raise OSError("injected scrape-reply drop")
+            conn.sendall(frame)
+        except OSError:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     # -- Transport: liveness ----------------------------------------------
 

@@ -1,21 +1,28 @@
-"""Cluster observability plane: tracing, lag, export.
+"""Cluster observability plane: tracing, lag, export, live scraping.
 
-Three layers, threaded through every runtime subsystem:
+Five layers, threaded through every runtime subsystem:
 
 * `obs.events` — structured flight-recorder ring + crash-durable JSONL
   spill (``CCRDT_OBS_DIR``); delta trace-context for end-to-end
   propagation-path reconstruction.
 * `obs.lag` — per-peer replication lag (ops + seconds) from delta-seq
   watermarks, and the fleet digest-agreement probe.
-* `obs.export` — Prometheus/JSONL rendering of `Metrics` snapshots and
-  cross-process aggregation (``CCRDT_METRICS_DIR``).
+* `obs.export` — Prometheus/JSONL rendering of `Metrics` snapshots
+  (latencies as cumulative histograms) and cross-process aggregation
+  (``CCRDT_METRICS_DIR``).
+* `obs.http` — per-worker OpenMetrics HTTP endpoint (``/metrics`` +
+  ``/healthz``, gated on ``CCRDT_HTTP_PORT``) so a live fleet is
+  scrapeable without waiting for exit dumps.
+* `obs.profile` — XLA hot-path profiler for the batched update/merge
+  dispatch (wall time, jit compile/execute split, transfer bytes),
+  ACTIVE-gated to zero cost when off (``CCRDT_PROFILE``).
 
 `obs.events` stays stdlib-only so transports, WAL, bridge, and the
-fault registry can import it without cycles; `obs.lag`/`obs.export`
-may import package code and are pulled in lazily by the layers that
-need them.
+fault registry can import it without cycles; the other modules may
+import package code and are pulled in lazily by the layers that need
+them.
 """
 
 from . import events  # noqa: F401  (stdlib-only, safe for all importers)
 
-__all__ = ["events", "lag", "export"]
+__all__ = ["events", "lag", "export", "http", "profile"]

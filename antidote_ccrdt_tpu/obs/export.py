@@ -4,10 +4,14 @@
 many processes. This module closes both gaps:
 
 * **Formats** — `prometheus_text()` renders a `Metrics.snapshot()` in
-  Prometheus exposition format (names `ccrdt_`-prefixed, dots to
-  underscores, HELP/TYPE lines, latencies as summaries with p50/p90/p99
-  quantile samples plus `_sum`/`_count`); `jsonl_lines()` renders the
-  same snapshot one-metric-per-line for log pipelines.
+  Prometheus/OpenMetrics exposition format (names `ccrdt_`-prefixed,
+  dots to underscores, HELP/TYPE lines, latencies as CUMULATIVE
+  histograms: per-bucket `_bucket{le="..."}` counts over a fixed
+  exponential-ish bound ladder plus `_sum`/`_count`); `jsonl_lines()`
+  renders the same snapshot one-metric-per-line for log pipelines.
+  Histograms (not summaries) because a real Prometheus scraping many
+  workers must be able to AGGREGATE latency across the fleet — bucket
+  counts sum across scrape targets, per-worker quantiles do not.
 
 * **Aggregation** — workers dump a snapshot at exit to
   ``$CCRDT_METRICS_DIR/metrics-<member>-<pid>.json``
@@ -34,6 +38,15 @@ from ..utils.metrics import Metrics
 
 ENV_DIR = "CCRDT_METRICS_DIR"
 
+# Histogram bucket upper bounds, in seconds. Spans a sub-millisecond jit
+# cache hit through a multi-second convergence round; the ladder is fixed
+# (not data-derived) so bucket counts from different workers and different
+# scrapes of the same worker line up and can be summed by Prometheus.
+DEFAULT_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
 
@@ -56,12 +69,17 @@ def prometheus_text(
     src: Any,
     prefix: str = "ccrdt",
     labels: Optional[Dict[str, str]] = None,
+    buckets: Tuple[float, ...] = DEFAULT_BUCKETS,
 ) -> str:
     """Render a `Metrics` (or a `snapshot()` dict) as Prometheus
     exposition text. Counters/gauges share one value dict upstream, so
     every scalar is exported as a gauge (monotonic-by-construction names
     still read correctly; Prometheus treats TYPE as advisory). Latency
-    series become summaries."""
+    series become cumulative histograms: `_bucket{le="..."}` counts over
+    `buckets` (each bucket includes everything at or below its bound,
+    `+Inf` always equals `_count`), plus `_sum`/`_count` — derived from
+    the raw samples `Metrics` keeps, so fleet aggregation can sum bucket
+    counts across workers."""
     snap = _as_snapshot(src)
     lines: List[str] = []
     for name in sorted(snap.get("counters", {})):
@@ -73,16 +91,21 @@ def prometheus_text(
         samples = snap["latencies"][name]
         m = _san(name, prefix) + "_seconds"
         lines.append(f"# HELP {m} ccrdt latency {name}")
-        lines.append(f"# TYPE {m} summary")
+        lines.append(f"# TYPE {m} histogram")
         if samples:
-            a = np.asarray(samples, dtype=float)
-            for q in (0.5, 0.9, 0.99):
-                v = float(np.percentile(a, q * 100))
-                ql = 'quantile="%g"' % q
-                lines.append(f"{m}{_labels(labels, ql)} {_num(v)}")
+            a = np.sort(np.asarray(samples, dtype=float))
+            # Cumulative count at each bound: index of the first sample
+            # strictly above it (le is inclusive).
+            cum = np.searchsorted(a, np.asarray(buckets), side="right")
             total, count = float(a.sum()), int(a.size)
         else:
+            cum = np.zeros(len(buckets), dtype=int)
             total, count = 0.0, 0
+        for le, c in zip(buckets, cum):
+            ll = 'le="%g"' % le
+            lines.append(f"{m}_bucket{_labels(labels, ll)} {int(c)}")
+        inf = 'le="+Inf"'
+        lines.append(f"{m}_bucket{_labels(labels, inf)} {count}")
         lines.append(f"{m}_sum{_labels(labels)} {_num(total)}")
         lines.append(f"{m}_count{_labels(labels)} {count}")
     return "\n".join(lines) + "\n"

@@ -1,0 +1,163 @@
+"""XLA hot-path profiler: what the batched dispatch actually costs.
+
+The whole point of the TPU rebuild is that CRDT update/merge work runs
+as batched XLA dispatches (`core.batch_merge`, the elastic sweeps) —
+yet until now nothing measured them. This module wraps those dispatch
+sites and feeds `Metrics` with:
+
+* ``profile.dispatch.<site>`` latency histograms — wall time of each
+  dispatch, split into ``profile.compile.<site>`` (first trace of a new
+  shape: jit cache grew) vs ``profile.execute.<site>`` (cache hit), so
+  a scrape distinguishes steady-state throughput from recompile storms;
+* ``profile.jit_hits`` / ``profile.jit_misses`` counters — cache-size
+  deltas around each dispatch (a miss means XLA just compiled);
+* ``profile.h2d_bytes`` — bytes of host-resident operands handed to a
+  dispatch (the host→device transfer a TPU step pays for).
+
+Overhead discipline copies `utils.faults` exactly: a module-level
+``ACTIVE`` bool that call sites check FIRST (``if profile.ACTIVE:``), so
+the disabled path costs one global load and a branch — no function
+call, no context-manager allocation, nothing on the per-merge hot path.
+Enable per-process with `install(metrics)` / `installed()` /
+`install_from_env` (``CCRDT_PROFILE=1``, same supervisor->worker env
+propagation as ``CCRDT_FAULTS``/``CCRDT_OBS_DIR``/``CCRDT_HTTP_PORT``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Any, Iterable, Optional
+
+from ..utils.metrics import Metrics
+
+ENV_FLAG = "CCRDT_PROFILE"
+
+# Hot-path gate — call sites must check `if profile.ACTIVE:` before
+# touching anything else in this module.
+ACTIVE = False
+
+_METRICS: Optional[Metrics] = None
+
+
+def install(metrics: Metrics) -> None:
+    """Route profiler samples into `metrics` and flip the gate on."""
+    global ACTIVE, _METRICS
+    _METRICS = metrics
+    ACTIVE = True
+
+
+def uninstall() -> None:
+    global ACTIVE, _METRICS
+    ACTIVE = False
+    _METRICS = None
+
+
+@contextlib.contextmanager
+def installed(metrics: Metrics):
+    """Scoped enable for tests: always restores the previous state."""
+    prev = (ACTIVE, _METRICS)
+    install(metrics)
+    try:
+        yield metrics
+    finally:
+        uninstall()
+        if prev[0]:
+            install(prev[1])
+
+
+def install_from_env(
+    metrics: Metrics, env: Optional[dict] = None
+) -> bool:
+    """Enable iff ``CCRDT_PROFILE`` is set to a truthy value ("1",
+    "true", ...). Returns whether profiling was armed."""
+    raw = (env if env is not None else os.environ).get(ENV_FLAG, "")
+    if raw.strip().lower() not in ("1", "true", "yes", "on"):
+        return False
+    install(metrics)
+    return True
+
+
+# -- introspection helpers ----------------------------------------------------
+
+
+def _cache_size(fn: Any) -> Optional[int]:
+    """Size of a jitted callable's compilation cache, or None when the
+    callable doesn't expose one (plain functions, partials, older JAX).
+    Defensive on purpose: profiling must never break a dispatch."""
+    try:
+        sizer = fn._cache_size  # jax.jit-wrapped callables
+    except AttributeError:
+        return None
+    try:
+        return int(sizer())
+    except Exception:  # noqa: BLE001 — any introspection failure = unknown
+        return None
+
+
+def _leaf_nbytes(operands: Iterable[Any]) -> int:
+    """Total .nbytes across array leaves of `operands`. Dispatch sites
+    pass registered pytrees (the dense engine states), so flattening
+    goes through jax when available; without jax, plain containers
+    still traverse."""
+    try:
+        import jax
+
+        leaves = jax.tree.leaves(list(operands))
+    except Exception:  # noqa: BLE001 — profiling must never break a dispatch
+        leaves = []
+        stack = list(operands)
+        while stack:
+            x = stack.pop()
+            if isinstance(x, (tuple, list)):
+                stack.extend(x)
+            elif isinstance(x, dict):
+                stack.extend(x.values())
+            else:
+                leaves.append(x)
+    total = 0
+    for x in leaves:
+        nb = getattr(x, "nbytes", None)
+        if isinstance(nb, int):
+            total += nb
+    return total
+
+
+@contextlib.contextmanager
+def dispatch(name: str, fn: Any = None, operands: Iterable[Any] = ()):
+    """Time one dispatch of `name`. Guard the call site with
+    ``if profile.ACTIVE:`` — this context manager assumes profiling is
+    on (it records into the installed registry, or silently no-ops if
+    raced with `uninstall`).
+
+    With `fn` (the jitted callable), the jit cache size is sampled
+    before/after to classify the dispatch as compile (cache grew) or
+    execute, and counted as a jit hit/miss. With `operands`, host->
+    device bytes are accumulated from array leaves."""
+    m = _METRICS
+    if m is None:
+        yield
+        return
+    before = _cache_size(fn) if fn is not None else None
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        _record(m, f"profile.dispatch.{name}", dt)
+        if before is not None:
+            after = _cache_size(fn)
+            if after is not None and after > before:
+                m.count("profile.jit_misses")
+                _record(m, f"profile.compile.{name}", dt)
+            else:
+                m.count("profile.jit_hits")
+                _record(m, f"profile.execute.{name}", dt)
+        nbytes = _leaf_nbytes(operands)
+        if nbytes:
+            m.count("profile.h2d_bytes", nbytes)
+
+
+def _record(m: Metrics, name: str, dt: float) -> None:
+    m.merge({"counters": {}, "latencies": {name: [dt]}})

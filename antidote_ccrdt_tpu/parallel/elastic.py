@@ -46,6 +46,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..net.transport import FsTransport, GossipNode
 from ..obs import events as obs_events
+from ..obs import profile
 from ..utils.metrics import Metrics
 from .delta import empty_delta  # noqa: F401 — part of this module's API
 
@@ -149,7 +150,11 @@ def sweep_deltas(
             # but-malformed delta that slips past delta_in_bounds must not
             # crash the gossip loop — break the chain and resync next sweep.
             try:
-                state = apply_any_delta(dense, state, delta)
+                if profile.ACTIVE:
+                    with profile.dispatch("elastic.delta_apply", operands=(delta,)):
+                        state = apply_any_delta(dense, state, delta)
+                else:
+                    state = apply_any_delta(dense, state, delta)
             except Exception:  # noqa: BLE001 — deliberately total
                 stats["skipped"] += 1
                 break
@@ -173,7 +178,13 @@ def sweep_deltas(
             else:
                 _seq, peer = got
                 try:
-                    state = dense.merge(state, peer)
+                    if profile.ACTIVE:
+                        with profile.dispatch(
+                            "elastic.snap_merge", fn=dense.merge, operands=(peer,)
+                        ):
+                            state = dense.merge(state, peer)
+                    else:
+                        state = dense.merge(state, peer)
                 except Exception:  # noqa: BLE001 — deliberately total
                     stats["skipped"] += 1
                 else:
@@ -238,6 +249,12 @@ def sweep(store: GossipNode, dense: Any, state: Any) -> Tuple[Any, int]:
         if got is None:
             continue
         _step, peer = got
-        state = dense.merge(state, peer)
+        if profile.ACTIVE:
+            with profile.dispatch(
+                "elastic.sweep_merge", fn=dense.merge, operands=(peer,)
+            ):
+                state = dense.merge(state, peer)
+        else:
+            state = dense.merge(state, peer)
         n += 1
     return state, n

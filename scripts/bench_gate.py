@@ -1,0 +1,115 @@
+"""Throughput regression gate over the committed BENCH_*.json rounds.
+
+Each ``BENCH_r<NN>.json`` in the repo root is a benchmark round dump:
+one JSON object whose ``tail`` field holds the benchmark harness's raw
+stdout — including (for rounds that ran the batched-dispatch benchmark)
+``"merges_per_sec": <float>`` lines, JSON-escaped INSIDE the tail
+string. This gate:
+
+1. parses every round, taking the best ``merges_per_sec`` per round
+   (rounds without the metric — e.g. setup-only rounds — are skipped);
+2. compares the LATEST round that has the metric against the best of
+   all PRIOR rounds;
+3. fails (exit 1) when the latest regressed more than ``--tolerance``
+   (default 20%) below that best — the same batched-dispatch throughput
+   `obs.profile` now measures live, gated at CI time.
+
+With fewer than two metric-bearing rounds there is nothing to compare:
+the gate passes vacuously (exit 0) and says so.
+
+Run: ``python scripts/bench_gate.py [--bench-dir DIR] [--tolerance 0.2]``
+(also wired as ``make bench-gate`` and into ``make chaos``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_METRIC_RE = re.compile(r'"merges_per_sec":\s*([0-9][0-9_.eE+]*)')
+
+
+def round_number(path: str) -> int:
+    """BENCH_r07.json -> 7 (unparseable names sort first)."""
+    m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(path))
+    return int(m.group(1)) if m else -1
+
+
+def best_merges_per_sec(path: str) -> Optional[float]:
+    """Best merges_per_sec in one round dump, or None when the round
+    didn't run the dispatch benchmark (or the file is torn)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    # The metric lives inside the "tail" stdout capture; json.load has
+    # already unescaped it, so a plain regex over the text applies.
+    tail = str(doc.get("tail", ""))
+    vals = [float(v) for v in _METRIC_RE.findall(tail)]
+    return max(vals) if vals else None
+
+
+def load_rounds(bench_dir: str) -> List[Tuple[int, str, Optional[float]]]:
+    """[(round_no, path, best-or-None)] sorted by round number."""
+    paths = sorted(
+        glob.glob(os.path.join(bench_dir, "BENCH_r*.json")), key=round_number
+    )
+    return [(round_number(p), p, best_merges_per_sec(p)) for p in paths]
+
+
+def evaluate(
+    rounds: List[Tuple[int, str, Optional[float]]], tolerance: float
+) -> Tuple[int, str]:
+    """(exit_code, human verdict) for a parsed round list."""
+    with_metric = [(n, p, v) for n, p, v in rounds if v is not None]
+    if len(with_metric) < 2:
+        return 0, (
+            f"bench-gate: only {len(with_metric)} round(s) carry "
+            "merges_per_sec — nothing to compare, passing vacuously"
+        )
+    latest_n, latest_p, latest_v = with_metric[-1]
+    prior = with_metric[:-1]
+    best_n, _best_p, best_v = max(prior, key=lambda r: r[2])
+    floor = best_v * (1.0 - tolerance)
+    verdict = (
+        f"bench-gate: r{latest_n:02d} best merges_per_sec = {latest_v:,.0f} "
+        f"vs best prior r{best_n:02d} = {best_v:,.0f} "
+        f"(floor at -{tolerance:.0%}: {floor:,.0f})"
+    )
+    if latest_v < floor:
+        return 1, (
+            f"{verdict}\nFAIL: batched-dispatch throughput regressed "
+            f"{1 - latest_v / best_v:.1%} (> {tolerance:.0%} allowed)"
+        )
+    return 0, f"{verdict}\nOK: within tolerance"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fail on >tolerance regression of merges_per_sec "
+        "across BENCH_*.json rounds"
+    )
+    ap.add_argument(
+        "--bench-dir",
+        default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json (default: repo root)",
+    )
+    ap.add_argument("--tolerance", type=float, default=0.20)
+    args = ap.parse_args(argv)
+    rounds = load_rounds(args.bench_dir)
+    for n, p, v in rounds:
+        tag = "-" if v is None else f"{v:,.0f}"
+        print(f"  r{n:02d} {os.path.basename(p)}: {tag}")
+    code, verdict = evaluate(rounds, args.tolerance)
+    print(verdict)
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -336,13 +336,22 @@ def run_worker(store, drill, dense, state, args, result_dir):
 
     from antidote_ccrdt_tpu.parallel.monoid import MonoidLift
 
-    # Observability plane (both env-gated, like CCRDT_FAULTS): the flight
+    from antidote_ccrdt_tpu.obs import http as obs_http
+    from antidote_ccrdt_tpu.obs import profile as obs_profile
+
+    # Observability plane (all env-gated, like CCRDT_FAULTS): the flight
     # recorder spills every event to $CCRDT_OBS_DIR as it happens (so a
-    # SIGKILL still leaves the full record), and a metrics snapshot lands
-    # in $CCRDT_METRICS_DIR at clean exit for the supervisor to merge.
+    # SIGKILL still leaves the full record), a metrics snapshot lands in
+    # $CCRDT_METRICS_DIR at clean exit for the supervisor to merge, a
+    # live OpenMetrics endpoint serves /metrics when $CCRDT_HTTP_PORT is
+    # set (address dropped as http-<member> for the supervisor), and the
+    # XLA hot-path profiler arms on $CCRDT_PROFILE.
     obs_events.install_from_env(args.member)
     obs_export.install_atexit_dump(store.metrics, args.member)
+    obs_http.install_from_env(store.metrics, args.member, addr_dir=result_dir)
+    obs_profile.install_from_env(store.metrics)
     lag_tracker = LagTracker(args.member)
+    confident_stale = max(1.5 * args.timeout, 0.6)
 
     pub = None  # set below when --delta
     cursors: dict = {}
@@ -420,6 +429,13 @@ def run_worker(store, drill, dense, state, args, result_dir):
             )
             if applied >= 0:
                 lag_tracker.observe_applied(m, applied)
+        # A confidently-dead peer's frozen watermark must not read as
+        # ever-growing lag in the exported gauges (re-observing a
+        # revived peer re-creates its entry).
+        alive_now = set(store.alive_members(confident_stale))
+        for m in list(lag_tracker.report()):
+            if m != args.member and m not in alive_now:
+                lag_tracker.drop(m)
         lag_tracker.export_to(store.metrics)
 
     def drop_status(step, owned) -> None:
@@ -523,7 +539,6 @@ def run_worker(store, drill, dense, state, args, result_dir):
     # done) instead of being dropped mid-convergence; the crashed victim
     # is exempted by a stale-beyond-doubt heartbeat.
     store.publish(drill.publish_name, drill.pub_state(dense, state), STEPS)
-    confident_stale = max(1.5 * args.timeout, 0.6)
     deadline = time.time() + 10
     while time.time() < deadline:
         # Keep adopting here too: a victim whose death is only DETECTED

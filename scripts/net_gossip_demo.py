@@ -81,6 +81,12 @@ def main() -> None:
 
     jax.config.update("jax_platforms", "cpu")
 
+    from antidote_ccrdt_tpu.utils import faults
+
+    faults.install_from_env()  # supervisor-injected deterministic faults
+    # (parity with elastic_demo: the same CCRDT_FAULTS plans drive the
+    # tcp.send/bridge.read points this drill exercises)
+
     from antidote_ccrdt_tpu.net.tcp import TcpTransport
     from antidote_ccrdt_tpu.net.transport import GossipNode
 

@@ -14,14 +14,18 @@ Two ways to run it:
   WAL durable watermark.
 
 * **Demo** (`make obs-demo`): ``--demo`` spawns a 3-worker
-  `elastic_demo` fleet in delta mode with the full observability plane
-  enabled (``CCRDT_OBS_DIR`` + ``CCRDT_METRICS_DIR``), renders live
-  frames while it runs, then prints the fleet-merged Prometheus
-  snapshot and RECONSTRUCTS one delta's end-to-end propagation path
-  (publish -> medium write/send -> apply on every peer, by replica and
-  seq) from the flight logs — exiting nonzero unless at least one delta
-  shows the complete path. That reconstruction is the acceptance check
-  that the trace context survives every layer.
+  `net_gossip_demo` TCP fleet in delta mode with the full observability
+  plane enabled (``CCRDT_OBS_DIR`` + ``CCRDT_METRICS_DIR`` +
+  ``CCRDT_HTTP_PORT=0`` + ``CCRDT_PROFILE=1``), renders live frames
+  while it runs, and — while the workers are still alive — scrapes them
+  over BOTH live surfaces (each worker's HTTP ``/metrics`` endpoint and
+  the in-band TCP ``{metrics_req}`` frame), requiring lag gauges and
+  profile.dispatch histogram buckets in the response. After the fleet
+  exits it prints the merged Prometheus snapshot, RECONSTRUCTS one
+  delta's end-to-end propagation path (publish -> medium write/send ->
+  apply on every peer) from the flight logs, and smoke-runs the trace
+  CLI (``ccrdt_trace.py summary --require-complete`` + ``path``) over
+  the same spill dir — exiting nonzero if any check fails.
 """
 
 from __future__ import annotations
@@ -29,6 +33,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import struct
 import subprocess
 import sys
@@ -214,15 +219,61 @@ def print_path_timeline(obs_dir: str, origin: str, dseq: int) -> None:
 
 # -- demo mode ---------------------------------------------------------------
 
+# What a live scrape must prove (acceptance for `make obs-demo`): lag
+# gauges and profile.dispatch histogram buckets, in valid exposition
+# text, read from a RUNNING worker.
+_LAG_RE = re.compile(r"^ccrdt_lag_\w+(?:\{[^}]*\})? ", re.M)
+_BUCKET_RE = re.compile(
+    r'^ccrdt_profile_dispatch_\w+_seconds_bucket\{[^}]*le="', re.M
+)
+
+
+def _scrape_proves_live(text: str) -> bool:
+    return (
+        "# TYPE " in text
+        and bool(_LAG_RE.search(text))
+        and bool(_BUCKET_RE.search(text))
+    )
+
+
+def _http_metrics(addr, timeout: float = 2.0) -> str:
+    import urllib.request
+
+    with urllib.request.urlopen(
+        f"http://{addr[0]}:{addr[1]}/metrics", timeout=timeout
+    ) as resp:
+        return resp.read().decode("utf-8")
+
+
+def _gossip_addrs(root: str) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return out
+    for fn in names:
+        if not fn.startswith("addr-") or ".tmp" in fn:
+            continue
+        try:
+            with open(os.path.join(root, fn)) as f:
+                host, port = f.read().strip().rsplit(":", 1)
+            out[fn[len("addr-"):]] = (host, int(port))
+        except (OSError, ValueError):
+            continue
+    return out
+
 
 def run_demo(frames_interval: float = 0.5) -> int:
-    """Spawn a 3-worker delta-gossip fleet with the obs plane on, watch
-    it live, then print the merged Prometheus snapshot and verify one
-    full propagation path. Returns the process exit code."""
+    """Spawn a 3-worker TCP gossip fleet with the full obs plane on
+    (flight recorder, metrics dumps, live HTTP endpoints, profiler),
+    scrape it over BOTH surfaces while it runs, then verify the flight
+    logs with the trace CLI. Returns the process exit code."""
     from antidote_ccrdt_tpu.obs import export as obs_export
+    from antidote_ccrdt_tpu.obs import http as obs_http
 
-    demo = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "elastic_demo.py")
+    here = os.path.dirname(os.path.abspath(__file__))
+    demo = os.path.join(here, "net_gossip_demo.py")
+    trace_cli = os.path.join(here, "ccrdt_trace.py")
     root = tempfile.mkdtemp(prefix="obs-demo-")
     obs_dir = os.path.join(root, "obs")
     metrics_dir = os.path.join(root, "metrics")
@@ -231,20 +282,48 @@ def run_demo(frames_interval: float = 0.5) -> int:
     env["JAX_PLATFORMS"] = "cpu"
     env["CCRDT_OBS_DIR"] = obs_dir
     env["CCRDT_METRICS_DIR"] = metrics_dir
+    env["CCRDT_HTTP_PORT"] = "0"  # every worker serves /metrics (any port)
+    env["CCRDT_PROFILE"] = "1"  # arm the XLA hot-path profiler
     members = ["w0", "w1", "w2"]
     procs = [
         subprocess.Popen(
             [sys.executable, demo, "--root", root, "--member", m,
-             "--n-members", str(len(members)), "--delta"],
+             "--n-members", str(len(members)), "--delta",
+             "--step-sleep", "0.25"],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             env=env, text=True,
         )
         for m in members
     ]
+    http_live: Optional[tuple] = None  # (member, text) while fleet ran
+    tcp_live: Optional[tuple] = None
+    last_frame = 0.0
     try:
         while any(p.poll() is None for p in procs):
-            print(render_frame(root))
-            time.sleep(frames_interval)
+            if time.time() - last_frame >= frames_interval:
+                print(render_frame(root))
+                last_frame = time.time()
+            if http_live is None:
+                for m, addr in sorted(obs_http.read_addr_files(root).items()):
+                    try:
+                        text = _http_metrics(addr)
+                    except OSError:
+                        continue
+                    if _scrape_proves_live(text):
+                        http_live = (m, text)
+                        break
+            if tcp_live is None:
+                from antidote_ccrdt_tpu.net.tcp import scrape_metrics
+
+                for m, addr in sorted(_gossip_addrs(root).items()):
+                    try:
+                        member, text = scrape_metrics(addr, timeout=2.0)
+                    except (OSError, ValueError):
+                        continue
+                    if _scrape_proves_live(text):
+                        tcp_live = (member, text)
+                        break
+            time.sleep(0.2)
     finally:
         outs = {}
         for m, p in zip(members, procs):
@@ -261,7 +340,22 @@ def run_demo(frames_interval: float = 0.5) -> int:
             print(f"-- worker {m} failed --\n{outs[m][-2000:]}")
         return 1
 
-    print("\n== fleet-merged Prometheus snapshot ==")
+    print("\n== live scrapes (taken while the fleet was running) ==")
+    for label, got in (("HTTP /metrics", http_live),
+                       ("in-band TCP {metrics_req}", tcp_live)):
+        if got is None:
+            print(f"FAIL: no {label} scrape with lag gauges + "
+                  "profile.dispatch buckets succeeded while the fleet ran")
+            return 1
+        m, text = got
+        keep = [ln for ln in text.splitlines()
+                if _LAG_RE.match(ln) or _BUCKET_RE.match(ln)]
+        print(f"[{label}] worker {m}: {len(text.splitlines())} lines, "
+              f"proof series:")
+        for ln in keep[:6]:
+            print(f"    {ln}")
+
+    print("\n== fleet-merged Prometheus snapshot (exit dumps) ==")
     merged, dumped = obs_export.merge_dir(metrics_dir)
     print(obs_export.prometheus_text(merged), end="")
     print(f"# merged from: {sorted(dumped)}")
@@ -280,8 +374,23 @@ def run_demo(frames_interval: float = 0.5) -> int:
     pick = complete[0]
     print()
     print_path_timeline(obs_dir, pick["origin"], pick["dseq"])
-    print(f"\nOK: {len(complete)}/{len(rec['deltas'])} traced deltas "
-          f"fully propagated across {rec['members']}")
+
+    print("\n== trace CLI (scripts/ccrdt_trace.py) ==")
+    for cmd in (
+        [sys.executable, trace_cli, "summary", obs_dir, "--require-complete"],
+        [sys.executable, trace_cli, "path", obs_dir,
+         str(pick["origin"]), str(pick["dseq"])],
+    ):
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        print(r.stdout, end="")
+        if r.returncode != 0:
+            print(f"FAIL: {' '.join(cmd[1:])} exited {r.returncode}\n"
+                  f"{r.stderr[-2000:]}")
+            return 1
+
+    print(f"\nOK: {len(complete)}/{len(rec['deltas'])} traced deltas fully "
+          f"propagated across {rec['members']}; live HTTP + in-band TCP "
+          "scrapes carried lag gauges and profile.dispatch histograms")
     return 0
 
 

@@ -1,0 +1,78 @@
+"""Bench regression gate (scripts/bench_gate.py): tail-string metric
+extraction, latest-vs-best-prior comparison, and the vacuous pass when
+rounds lack the metric."""
+
+import importlib.util
+import json
+import os
+
+_SPEC = importlib.util.spec_from_file_location(
+    "bench_gate",
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+        "bench_gate.py",
+    ),
+)
+gate = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(gate)
+
+
+def _round(tmp_path, n, merges=None, torn=False):
+    path = str(tmp_path / f"BENCH_r{n:02d}.json")
+    if torn:
+        with open(path, "w") as f:
+            f.write('{"tail": "tor')
+        return path
+    tail = "setup only\n"
+    if merges is not None:
+        # The metric is JSON text INSIDE the tail capture — the shape the
+        # real BENCH dumps have (escaped when serialized, plain after load).
+        tail += "".join(f'{{"merges_per_sec": {v}}}\n' for v in merges)
+    with open(path, "w") as f:
+        json.dump({"n": n, "cmd": "bench", "rc": 0, "tail": tail}, f)
+    return path
+
+
+def test_extracts_best_from_tail(tmp_path):
+    p = _round(tmp_path, 4, merges=[100.0, 250.5, 30.0])
+    assert gate.best_merges_per_sec(p) == 250.5
+    assert gate.best_merges_per_sec(_round(tmp_path, 1)) is None
+    assert gate.best_merges_per_sec(_round(tmp_path, 2, torn=True)) is None
+
+
+def test_gate_passes_within_tolerance(tmp_path):
+    _round(tmp_path, 1)  # metric-less rounds are skipped, not zeros
+    _round(tmp_path, 2, merges=[1000.0])
+    _round(tmp_path, 3, merges=[850.0])  # -15% vs best prior: allowed
+    code, verdict = gate.evaluate(gate.load_rounds(str(tmp_path)), 0.20)
+    assert code == 0 and "OK" in verdict
+
+
+def test_gate_fails_on_regression(tmp_path):
+    _round(tmp_path, 1, merges=[1000.0])
+    _round(tmp_path, 2, merges=[700.0])  # -30%: beyond the 20% floor
+    code, verdict = gate.evaluate(gate.load_rounds(str(tmp_path)), 0.20)
+    assert code == 1 and "FAIL" in verdict
+
+
+def test_latest_compares_against_best_prior_not_last(tmp_path):
+    _round(tmp_path, 1, merges=[1000.0])
+    _round(tmp_path, 2, merges=[400.0])  # a dip in the middle
+    _round(tmp_path, 3, merges=[750.0])  # -25% vs r1 (the best), not r2
+    code, _ = gate.evaluate(gate.load_rounds(str(tmp_path)), 0.20)
+    assert code == 1
+    code, _ = gate.evaluate(gate.load_rounds(str(tmp_path)), 0.30)
+    assert code == 0
+
+
+def test_vacuous_pass_with_fewer_than_two_rounds(tmp_path):
+    code, verdict = gate.evaluate(gate.load_rounds(str(tmp_path)), 0.20)
+    assert code == 0 and "vacuous" in verdict
+    _round(tmp_path, 1, merges=[5.0])
+    code, _ = gate.evaluate(gate.load_rounds(str(tmp_path)), 0.20)
+    assert code == 0
+
+
+def test_main_against_repo_rounds():
+    assert gate.main([]) == 0  # the committed BENCH_r*.json must pass

@@ -27,13 +27,24 @@ def test_prometheus_golden_format():
     assert lines[1] == "# TYPE ccrdt_net_frames_sent gauge"
     assert lines[2] == "ccrdt_net_frames_sent 3"
     assert "ccrdt_wal_last_seq 17" in lines
-    # Latencies: summary with p50/p90/p99 quantile samples + sum/count.
-    assert "# TYPE ccrdt_sync_seconds summary" in lines
-    assert 'ccrdt_sync_seconds{quantile="0.5"} 0.025' in lines
-    assert 'ccrdt_sync_seconds{quantile="0.9"}' in "\n".join(lines)
-    assert 'ccrdt_sync_seconds{quantile="0.99"}' in "\n".join(lines)
+    # Latencies: CUMULATIVE histogram buckets (le inclusive) + sum/count.
+    assert "# TYPE ccrdt_sync_seconds histogram" in lines
+    assert 'ccrdt_sync_seconds_bucket{le="0.005"} 0' in lines
+    assert 'ccrdt_sync_seconds_bucket{le="0.01"} 1' in lines
+    assert 'ccrdt_sync_seconds_bucket{le="0.025"} 2' in lines
+    assert 'ccrdt_sync_seconds_bucket{le="0.05"} 4' in lines
+    assert 'ccrdt_sync_seconds_bucket{le="+Inf"} 4' in lines
     assert "ccrdt_sync_seconds_sum 0.1" in lines
     assert "ccrdt_sync_seconds_count 4" in lines
+    # The +Inf bucket always equals _count, and counts never decrease
+    # along the ladder (what makes them summable across workers).
+    bucket_counts = [
+        int(ln.rsplit(" ", 1)[1])
+        for ln in lines
+        if ln.startswith("ccrdt_sync_seconds_bucket")
+    ]
+    assert bucket_counts == sorted(bucket_counts)
+    assert bucket_counts[-1] == 4
 
 
 def test_prometheus_labels_and_prefix():
@@ -41,20 +52,33 @@ def test_prometheus_labels_and_prefix():
     m.count("x")
     text = obs_export.prometheus_text(m, prefix="app", labels={"member": "w0"})
     assert 'app_x{member="w0"} 1' in text.splitlines()
-    # Labels merge with the quantile label on summary samples.
+    # Labels merge with the le label on bucket samples (le last).
     m.merge({"counters": {}, "latencies": {"t": [0.5]}})
     text = obs_export.prometheus_text(m, labels={"member": "w0"})
-    assert 'ccrdt_t_seconds{member="w0",quantile="0.5"} 0.5' in text.splitlines()
+    lines = text.splitlines()
+    assert 'ccrdt_t_seconds_bucket{member="w0",le="0.5"} 1' in lines
+    assert 'ccrdt_t_seconds_bucket{member="w0",le="0.25"} 0' in lines
+    assert 'ccrdt_t_seconds_sum{member="w0"} 0.5' in lines
 
 
 def test_prometheus_accepts_plain_snapshot_and_empty_series():
     snap = {"counters": {"a.b": 2.5}, "latencies": {"empty": []}}
     lines = obs_export.prometheus_text(snap).splitlines()
     assert "ccrdt_a_b 2.5" in lines
-    # An empty latency series still exports well-formed sum/count.
+    # An empty latency series still exports well-formed buckets/sum/count.
+    assert 'ccrdt_empty_seconds_bucket{le="+Inf"} 0' in lines
     assert "ccrdt_empty_seconds_sum 0" in lines
     assert "ccrdt_empty_seconds_count 0" in lines
-    assert not any('quantile="' in ln and "empty" in ln for ln in lines)
+    assert not any('quantile="' in ln for ln in lines)
+
+
+def test_prometheus_custom_buckets():
+    m = Metrics()
+    m.merge({"counters": {}, "latencies": {"t": [0.5, 1.5, 9.0]}})
+    lines = obs_export.prometheus_text(m, buckets=(1.0, 2.0)).splitlines()
+    assert 'ccrdt_t_seconds_bucket{le="1"} 1' in lines
+    assert 'ccrdt_t_seconds_bucket{le="2"} 2' in lines
+    assert 'ccrdt_t_seconds_bucket{le="+Inf"} 3' in lines
 
 
 def test_jsonl_lines():

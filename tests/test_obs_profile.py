@@ -1,0 +1,128 @@
+"""XLA hot-path profiler (obs/profile.py): dispatch timing, jit
+compile-vs-execute classification, transfer-byte accounting, the
+zero-overhead disabled path, and the wired call sites in
+core/batch_merge and parallel/elastic."""
+
+import numpy as np
+import pytest
+
+from antidote_ccrdt_tpu.obs import profile
+from antidote_ccrdt_tpu.utils.metrics import Metrics
+
+
+@pytest.fixture(autouse=True)
+def _always_uninstalled():
+    # Module-global gate: never let one test's install leak into the
+    # rest of the suite.
+    profile.uninstall()
+    yield
+    profile.uninstall()
+
+
+def test_dispatch_records_wall_time_and_bytes():
+    m = Metrics()
+    with profile.installed(m):
+        assert profile.ACTIVE
+        x = np.zeros(1024, np.int32)
+        with profile.dispatch("unit.op", operands=(x, [x, {"k": x}])):
+            pass
+    assert not profile.ACTIVE
+    snap = m.snapshot()
+    assert len(snap["latencies"]["profile.dispatch.unit.op"]) == 1
+    assert snap["counters"]["profile.h2d_bytes"] == 3 * 1024 * 4
+
+
+def test_jit_hit_miss_classification():
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    fn = jax.jit(lambda a, b: a + b)
+    m = Metrics()
+    with profile.installed(m):
+        for shape in ((4,), (4,), (8,)):  # miss, hit, miss (new shape)
+            a = jnp.zeros(shape, jnp.int32)
+            with profile.dispatch("unit.add", fn=fn):
+                fn(a, a).block_until_ready()
+    snap = m.snapshot()
+    assert snap["counters"]["profile.jit_misses"] == 2
+    assert snap["counters"]["profile.jit_hits"] == 1
+    assert len(snap["latencies"]["profile.compile.unit.add"]) == 2
+    assert len(snap["latencies"]["profile.execute.unit.add"]) == 1
+    assert len(snap["latencies"]["profile.dispatch.unit.add"]) == 3
+
+
+def test_plain_function_still_times_without_classification():
+    m = Metrics()
+    with profile.installed(m):
+        with profile.dispatch("unit.plain", fn=lambda: None):
+            pass
+    snap = m.snapshot()
+    assert "profile.dispatch.unit.plain" in snap["latencies"]
+    assert "profile.jit_hits" not in snap["counters"]
+    assert "profile.jit_misses" not in snap["counters"]
+
+
+def test_disabled_leaves_no_trace_and_batch_merge_unaffected():
+    pytest.importorskip("jax")
+    from antidote_ccrdt_tpu.core.batch_merge import batch_merge
+    from antidote_ccrdt_tpu.models.topk import TopkState
+
+    states = [
+        TopkState({"a": 1, "b": 5}, 2),
+        TopkState({"a": 7}, 2),
+        TopkState({"c": 3}, 2),
+    ]
+    assert not profile.ACTIVE
+    merged = batch_merge("topk", states)
+    assert merged.entries == {"a": 7, "b": 5}
+
+
+def test_batch_merge_fold_is_profiled():
+    pytest.importorskip("jax")
+    from antidote_ccrdt_tpu.core.batch_merge import batch_merge
+    from antidote_ccrdt_tpu.models.topk import TopkState
+
+    m = Metrics()
+    states = [TopkState({chr(97 + i): i + 1}, 2) for i in range(5)]
+    with profile.installed(m):
+        merged = batch_merge("topk", states)
+    assert merged.entries == {"e": 5, "d": 4}
+    snap = m.snapshot()
+    # 5 rows fold in 3 rounds: 5 -> 3 -> 2 -> 1.
+    assert len(snap["latencies"]["profile.dispatch.batch_merge.fold"]) == 3
+    assert snap["counters"]["profile.h2d_bytes"] > 0
+    hits = snap["counters"].get("profile.jit_hits", 0)
+    misses = snap["counters"].get("profile.jit_misses", 0)
+    assert hits + misses == 3
+
+
+def test_install_from_env_gating():
+    m = Metrics()
+    assert profile.install_from_env(m, env={}) is False
+    assert not profile.ACTIVE
+    assert profile.install_from_env(m, env={profile.ENV_FLAG: "0"}) is False
+    assert profile.install_from_env(m, env={profile.ENV_FLAG: "1"}) is True
+    assert profile.ACTIVE
+    with profile.dispatch("unit.env"):
+        pass
+    assert "profile.dispatch.unit.env" in m.snapshot()["latencies"]
+
+
+def test_elastic_sweep_is_profiled(tmp_path):
+    pytest.importorskip("jax")
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import make_dense
+    from antidote_ccrdt_tpu.parallel.elastic import GossipStore, sweep
+
+    D = make_dense(n_ids=4, n_dcs=1, size=2, slots_per_id=1)
+    a = GossipStore(str(tmp_path), "a")
+    b = GossipStore(str(tmp_path), "b")
+    sa, sb = D.init(1, 1), D.init(1, 1)
+    a.publish("topk_rmv", sa, step=1)
+    b.publish("topk_rmv", sb, step=1)
+    m = Metrics()
+    with profile.installed(m):
+        _, n = sweep(a, D, sa)
+    assert n == 1
+    snap = m.snapshot()
+    assert len(snap["latencies"]["profile.dispatch.elastic.sweep_merge"]) == 1
+    assert snap["counters"]["profile.h2d_bytes"] > 0

@@ -1,0 +1,116 @@
+"""Live-scrape surfaces under fault injection: the in-band `{metrics}`
+bridge op and the gossip-TCP `{metrics_req}` frame must DEGRADE to an
+error the scraper sees within its own timeout — never hang, never
+corrupt the registry they were reading."""
+
+import pytest
+
+from antidote_ccrdt_tpu.net.tcp import TcpTransport, scrape_metrics
+from antidote_ccrdt_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_leak():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+# -- gossip TCP ({metrics_req} frame) ---------------------------------------
+
+
+def test_tcp_inband_scrape_happy_path():
+    t = TcpTransport("w0")
+    try:
+        t.metrics.count("net.frames_sent", 5)
+        member, text = scrape_metrics(t.address, timeout=5.0)
+        assert member == "w0"
+        lines = text.splitlines()
+        assert 'ccrdt_net_frames_sent{member="w0"} 5' in lines
+        assert t.metrics.counters["net.scrapes"] == 1
+        # Scraping is not membership traffic: no ghost member appeared.
+        assert "?" not in t.membership.heard_ages()
+    finally:
+        t.close()
+
+
+def test_tcp_scrape_under_send_drop_degrades_then_recovers():
+    t = TcpTransport("w0")
+    try:
+        t.metrics.count("net.frames_sent", 5)
+        with faults.injected(
+            {"tcp.send": [{"action": "drop", "at": [0]}]}
+        ):
+            # The reply frame is dropped and the connection closed: the
+            # scraper gets a bounded error, not a hang.
+            with pytest.raises((OSError, ValueError)):
+                scrape_metrics(t.address, timeout=2.0)
+        # Registry intact, transport still serving: the next scrape
+        # succeeds and reflects the failed attempt's counters.
+        member, text = scrape_metrics(t.address, timeout=5.0)
+        assert member == "w0"
+        assert 'ccrdt_net_frames_sent{member="w0"} 5' in text.splitlines()
+        assert t.metrics.counters["net.fault_drops"] >= 1
+        assert t.metrics.counters["net.scrapes"] == 2
+    finally:
+        t.close()
+
+
+def test_tcp_scrape_under_send_raise_degrades_then_recovers():
+    t = TcpTransport("w0")
+    try:
+        with faults.injected(
+            {"tcp.send": [{"action": "raise", "at": [0],
+                           "message": "connection reset"}]}
+        ):
+            with pytest.raises((OSError, ValueError)):
+                scrape_metrics(t.address, timeout=2.0)
+        member, _text = scrape_metrics(t.address, timeout=5.0)
+        assert member == "w0"
+    finally:
+        t.close()
+
+
+# -- bridge ({metrics} op) ---------------------------------------------------
+
+
+def test_bridge_metrics_op_happy_path():
+    from antidote_ccrdt_tpu.bridge import BridgeClient, BridgeServer
+
+    with BridgeServer() as srv:
+        with BridgeClient(*srv.address, timeout=10.0) as c:
+            c.new("average")
+            text = c.metrics_text()
+            lines = text.splitlines()
+            assert "ccrdt_bridge_scrapes 1" in lines
+            # Second scrape sees the first one counted: live registry.
+            assert "ccrdt_bridge_scrapes 2" in c.metrics_text().splitlines()
+
+
+def test_bridge_scrape_under_read_fault_degrades_then_recovers():
+    from antidote_ccrdt_tpu.bridge import BridgeClient, BridgeServer
+
+    with BridgeServer() as srv:
+        c = BridgeClient(*srv.address, timeout=5.0)  # retries=0: poisons
+        try:
+            with faults.injected(
+                {"bridge.read": [{"action": "raise", "at": [0],
+                                  "message": "connection reset"}]}
+            ):
+                with pytest.raises(Exception):
+                    c.metrics_text()
+        finally:
+            c.close()
+        # The failed scrape corrupted nothing server-side (the op ran;
+        # only the client's read of the reply died): a fresh client
+        # scrapes a healthy, still-consistent registry.
+        with BridgeClient(*srv.address, timeout=10.0) as c2:
+            h = c2.new("average")
+            lines = c2.metrics_text().splitlines()
+            scrapes = [
+                int(ln.rsplit(" ", 1)[1])
+                for ln in lines
+                if ln.startswith("ccrdt_bridge_scrapes ")
+            ]
+            assert scrapes and scrapes[0] >= 2  # faulted scrape + this one
+            assert c2.equal(h, h)  # data plane still works post-fault

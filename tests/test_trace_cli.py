@@ -1,0 +1,140 @@
+"""Trace query CLI (scripts/ccrdt_trace.py) over synthetic flight logs:
+path reconstruction with per-hop latency, peer-pair percentiles,
+never-applied detection, straggler flagging, and the CLI exit codes the
+obs-demo smoke gate relies on."""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+_SPEC = importlib.util.spec_from_file_location(
+    "ccrdt_trace",
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+        "ccrdt_trace.py",
+    ),
+)
+trace = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(trace)
+
+
+def _write_log(obs_dir, member, events):
+    os.makedirs(obs_dir, exist_ok=True)
+    path = os.path.join(obs_dir, f"flight-{member}-1.jsonl")
+    with open(path, "w") as f:
+        for seq, ev in enumerate(events):
+            f.write(json.dumps({"member": member, "seq": seq, **ev}) + "\n")
+
+
+@pytest.fixture
+def fleet_dir(tmp_path):
+    """Three-member synthetic fleet. Delta w0/1: full path, applied by
+    both peers (w2 slow: 300ms vs w1's 60ms). Delta w0/2: published but
+    never applied. Delta w1/1: normal."""
+    d = str(tmp_path / "obs")
+    _write_log(d, "w0", [
+        {"kind": "delta.publish", "origin": "w0", "dseq": 1, "t": 100.0,
+         "bytes": 64},
+        {"kind": "frame.send", "fkind": "delta", "origin": "w0", "dseq": 1,
+         "t": 100.01, "bytes": 64},
+        {"kind": "delta.publish", "origin": "w0", "dseq": 2, "t": 101.0,
+         "bytes": 32},
+        {"kind": "delta.apply", "origin": "w1", "dseq": 1, "t": 100.55},
+    ])
+    _write_log(d, "w1", [
+        {"kind": "frame.recv", "fkind": "delta", "origin": "w0", "dseq": 1,
+         "t": 100.05, "bytes": 64},
+        {"kind": "delta.apply", "origin": "w0", "dseq": 1, "t": 100.06},
+        {"kind": "delta.publish", "origin": "w1", "dseq": 1, "t": 100.5,
+         "bytes": 48},
+    ])
+    _write_log(d, "w2", [
+        {"kind": "frame.recv", "fkind": "delta", "origin": "w0", "dseq": 1,
+         "t": 100.28, "bytes": 64},
+        {"kind": "delta.apply", "origin": "w0", "dseq": 1, "t": 100.3},
+    ])
+    return d
+
+
+def test_path_timeline_hops_and_latency(fleet_dir):
+    paths = trace.load_paths(fleet_dir)
+    hops = trace.path_timeline(paths[("w0", 1)])
+    assert [h["stage"] for h in hops] == [
+        "publish", "send", "recv", "apply", "recv", "apply"]
+    assert [h["member"] for h in hops] == ["w0", "w0", "w1", "w1", "w2", "w2"]
+    assert hops[0]["hop_ms"] is None and hops[0]["total_ms"] == 0.0
+    assert abs(hops[1]["hop_ms"] - 10.0) < 1e-6   # publish -> send
+    assert abs(hops[2]["hop_ms"] - 40.0) < 1e-6   # send -> recv on w1
+    assert abs(hops[3]["total_ms"] - 60.0) < 1e-6  # publish -> apply on w1
+    assert abs(hops[5]["total_ms"] - 300.0) < 1e-6  # publish -> apply on w2
+
+
+def test_completeness_and_never_applied(fleet_dir):
+    paths = trace.load_paths(fleet_dir)
+    assert trace.is_complete(paths[("w0", 1)])
+    assert not trace.is_complete(paths[("w0", 2)])
+    assert trace.never_applied(paths) == [("w0", 2)]
+    assert trace.fleet_members(fleet_dir) == ["w0", "w1", "w2"]
+
+
+def test_pair_stats_percentiles(fleet_dir):
+    rows = trace.apply_latencies(trace.load_paths(fleet_dir))
+    stats = trace.pair_stats(rows)
+    assert abs(stats[("w0", "w1")]["p50_ms"] - 60.0) < 1e-6
+    assert abs(stats[("w0", "w2")]["p50_ms"] - 300.0) < 1e-6
+    assert abs(stats[("w1", "w0")]["p50_ms"] - 50.0) < 1e-6
+    assert stats[("w0", "w2")]["n"] == 1
+
+
+def test_stragglers(fleet_dir):
+    rows = trace.apply_latencies(trace.load_paths(fleet_dir))
+    med, slow = trace.find_stragglers(rows, factor=3.0)
+    assert abs(med - 60.0) < 1e-6  # sorted latencies: 50, 60, 300
+    assert [(r["origin"], r["dseq"], r["applier"]) for r in slow] == [
+        ("w0", 1, "w2")]
+    # Raise the bar: nothing is 10x the median.
+    assert trace.find_stragglers(rows, factor=10.0)[1] == []
+
+
+def test_cli_summary_and_exit_codes(fleet_dir, capsys):
+    assert trace.main(["summary", fleet_dir, "--require-complete"]) == 0
+    out = capsys.readouterr().out
+    assert "deltas traced   : 3" in out
+    assert "complete paths  : 2" in out
+    assert "never applied   : 1" in out
+    assert "w0 -> w2" in out.replace("      ", " ").replace("  ", " ") or \
+        "w2" in out  # pair table rendered
+    # Empty dir fails the gate but succeeds without it.
+    empty = fleet_dir + "-none"
+    os.makedirs(empty)
+    assert trace.main(["summary", empty, "--require-complete"]) == 1
+    assert trace.main(["summary", empty]) == 0
+
+
+def test_cli_path_and_stragglers(fleet_dir, capsys):
+    assert trace.main(["path", fleet_dir, "w0", "1"]) == 0
+    out = capsys.readouterr().out
+    assert "publish" in out and "apply" in out and "total=" in out
+    assert trace.main(["path", fleet_dir, "w0", "99"]) == 1
+    capsys.readouterr()
+    assert trace.main(["path", fleet_dir, "w0", "2"]) == 0
+    assert "path incomplete" in capsys.readouterr().out
+    assert trace.main(["stragglers", fleet_dir, "--factor", "3"]) == 0
+    assert "w0/1 -> w2" in capsys.readouterr().out
+
+
+def test_subprocess_entrypoint(fleet_dir):
+    import subprocess
+
+    script = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts", "ccrdt_trace.py")
+    r = subprocess.run(
+        [sys.executable, script, "summary", fleet_dir, "--require-complete"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "example complete path" in r.stdout

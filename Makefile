@@ -23,7 +23,7 @@ PY ?= python
 # meaningful.
 COVER_THRESHOLD ?= 88
 
-.PHONY: all compile test cover typecheck xref native bench benchall dryrun net-demo chaos crash-demo obs-demo bench-gate clean
+.PHONY: all compile test cover typecheck xref native bench benchall dryrun net-demo chaos crash-demo obs-demo topo-demo bench-gate clean
 
 all: compile xref typecheck cover
 
@@ -71,11 +71,12 @@ net-demo:
 # (fsync failure, torn write, socket reset, read stalls) driven from a
 # seeded, replayable schedule — no real processes, tier-1 compatible
 # runtime, but kept out of tier-1 as its own gate.
-# The second leg is the observability gate (scripts/chaos_gate.py): a
-# seeded sim drill whose Prometheus summary is printed and whose
-# load-bearing counters (sim faults, delta gossip, SWIM deaths) must be
-# nonzero — a refactor that silently stops counting fails here even if
-# convergence stays green. The third leg adds the scrape-under-fault
+# The second leg is the observability gate (scripts/chaos_gate.py): two
+# seeded sim drills — full-mesh, plus the topo/ zone drill (whole-zone
+# partition + za anchor crash) — whose load-bearing counters (sim
+# faults, delta gossip, SWIM deaths, cross-zone frames, anchor
+# relays/failover) must be nonzero — a refactor that silently stops
+# counting fails here even if convergence stays green. The third leg adds the scrape-under-fault
 # matrix (tcp.send / bridge.read must degrade a live scrape, never hang)
 # and the trace-CLI unit surface; the fourth is the bench regression
 # gate over the committed BENCH_r*.json rounds.
@@ -107,6 +108,14 @@ crash-demo:
 # and a trace-CLI smoke run (summary --require-complete + path).
 obs-demo:
 	env JAX_PLATFORMS=cpu $(PY) scripts/obs_dashboard.py --demo
+
+# DCN-topology demo (slow, real processes): a 2-zone x 3-worker TCP
+# fleet with the topo/ routers installed, the za anchor SIGKILLed
+# mid-run (rendezvous failover), converging bit-identically with a
+# full-mesh baseline while crossing the zone boundary O(zones) — the
+# printed ratio — instead of O(peers).
+topo-demo:
+	env JAX_PLATFORMS=cpu $(PY) scripts/topo_demo.py
 
 clean:
 	rm -rf native/build

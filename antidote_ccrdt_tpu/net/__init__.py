@@ -20,6 +20,11 @@ protocol:
 * `net.sim`        — a deterministic in-process simulator (seeded RNG,
   virtual clock; latency / loss / duplication / partitions / crashes)
   for replay-exact chaos tests.
+
+Both peer transports (`TcpTransport`, `SimTransport`) can trade the
+default full mesh for the DCN-aware zone topology in `topo/` via
+`install_router()` — leaves gossip intra-zone, per-zone rendezvous
+anchors relay across zones, frames compress per-link (see `topo/`).
 """
 
 from .membership import Membership

@@ -33,6 +33,7 @@ import random
 from typing import Dict, List, Optional, Tuple
 
 from ..obs import events as obs_events
+from ..topo import ZoneMap, ZoneRouter, zone_from_env
 from ..utils.metrics import Metrics
 from .membership import Membership
 
@@ -62,8 +63,16 @@ class SimNet:
 
     # -- topology ----------------------------------------------------------
 
-    def join(self, member: str) -> "SimTransport":
-        t = SimTransport(self, member)
+    def join(self, member: str, zone: Optional[str] = None) -> "SimTransport":
+        """Add a member; `zone` opts it into the topo/ layout. The shared
+        medium is the zone oracle: every existing member learns the
+        newcomer's zone and vice versa (config-file discovery collapses
+        to a dict in-process), so drills exercise routing, not gossip
+        of the zone map itself — `net.tcp` covers that via hellos."""
+        t = SimTransport(self, member, zone=zone)
+        for other in self._members.values():
+            other.zones.learn(member, t.zone)
+            t.zones.learn(other.member, other.zone)
         self._members[member] = t
         return t
 
@@ -147,15 +156,32 @@ class SimTransport:
     snapshot/delta dicts, fetches read them; liveness is a `Membership`
     on the virtual clock, fed by piggybacked ages on every message."""
 
-    def __init__(self, net: SimNet, member: str):
+    def __init__(self, net: SimNet, member: str, zone: Optional[str] = None):
         self.net = net
         self.member = member
         self.metrics = net.metrics
+        self.zone = zone if zone is not None else zone_from_env()
+        self.zones = ZoneMap(member, self.zone)
+        self.router: Optional[ZoneRouter] = None
         self.membership = Membership(
             member, now=lambda: net.time, metrics=net.metrics
         )
         self._snaps: Dict[str, bytes] = {}
         self._deltas: Dict[str, Dict[int, bytes]] = {}
+
+    def install_router(self, timeout_s: float = 2.0) -> ZoneRouter:
+        """Switch from full-mesh to the zone-aware topology, exactly as
+        `TcpTransport.install_router` — so chaos drills shake the SAME
+        routing policy the real sockets run."""
+        self.router = ZoneRouter(
+            self.member,
+            self.zone,
+            self.zones,
+            membership=self.membership,
+            timeout_s=timeout_s,
+            metrics=self.metrics,
+        )
+        return self.router
 
     # -- send side ---------------------------------------------------------
 
@@ -163,25 +189,39 @@ class SimTransport:
         if self.member in self.net._crashed:
             raise RuntimeError(f"{self.member} is crashed (driver bug)")
 
-    def _broadcast(self, msg_base: tuple) -> None:
-        for dst in sorted(self.net._members):
-            if dst == self.member:
-                continue
-            # heard_ages is per-send so every copy carries fresh evidence
-            # (matches tcp's encode-at-send-time rule).
-            self.net.send(
-                self.member, dst,
-                msg_base + (dict(self.membership.heard_ages()),),
-            )
+    def _targets(self) -> List[Tuple[str, bool]]:
+        peers = [m for m in sorted(self.net._members) if m != self.member]
+        if self.router is None:
+            return [(m, False) for m in peers]
+        return self.router.send_targets(peers)
+
+    def _send(self, dst: str, msg_base: tuple, cross: bool, nbytes: int) -> None:
+        if cross:
+            self.metrics.count("topo.cross_zone.frames")
+            self.metrics.count("topo.cross_zone.bytes", nbytes)
+        # heard_ages is per-send so every copy carries fresh evidence
+        # (matches tcp's encode-at-send-time rule).
+        self.net.send(
+            self.member, dst,
+            msg_base + (dict(self.membership.heard_ages()),),
+        )
 
     def heartbeat(self) -> None:
         self._check_live()
-        self._broadcast(("ping", self.member))
+        for dst, cross in self._targets():
+            self._send(dst, ("ping", self.member), cross, 0)
 
     def publish(self, blob: bytes) -> None:
         self._check_live()
         self._snaps[self.member] = blob
-        self._broadcast(("snap", self.member, blob))
+        path = [(self.member, self.zone)]
+        for dst, cross in self._targets():
+            if cross:
+                self._send(
+                    dst, ("rsnap", self.member, blob, path), True, len(blob)
+                )
+            else:
+                self._send(dst, ("snap", self.member, blob), False, 0)
 
     def publish_delta(self, seq: int, blob: bytes, keep: int = 16) -> None:
         self._check_live()
@@ -189,37 +229,133 @@ class SimTransport:
         window[seq] = blob
         for s in [s for s in window if s <= seq - keep]:
             del window[s]
-        self._broadcast(("delta", self.member, seq, keep, blob))
+        path = [(self.member, self.zone)]
+        for dst, cross in self._targets():
+            if cross:
+                self._send(
+                    dst,
+                    ("rdelta", self.member, seq, keep, blob, path),
+                    True,
+                    len(blob),
+                )
+            else:
+                self._send(
+                    dst, ("delta", self.member, seq, keep, blob), False, 0
+                )
 
     # -- receive side ------------------------------------------------------
+
+    def _store_snap(self, src: str, blob: bytes) -> bool:
+        old = self._snaps.get(src)
+        # Same reorder guard as tcp: only a >= step header replaces.
+        import struct as _struct
+
+        if (
+            old is None
+            or len(blob) < 8
+            or _struct.unpack("<Q", blob[:8])[0]
+            >= _struct.unpack("<Q", old[:8])[0]
+        ):
+            self._snaps[src] = blob
+            return True
+        return False
+
+    def _store_delta(self, src: str, seq: int, keep: int, blob: bytes) -> bool:
+        window = self._deltas.setdefault(src, {})
+        fresh = seq not in window
+        window[seq] = blob
+        # Prune against the window MAX, not this message's seq: a
+        # reordered old delta must not re-enter past the keep bound.
+        hi = max(window)
+        for s in [s for s in window if s <= hi - keep]:
+            del window[s]
+        return fresh and seq in window
 
     def _deliver(self, msg: tuple) -> None:
         kind, src = msg[0], msg[1]
         heard = msg[-1]
+        sender = src
         if kind == "snap":
             blob = msg[2]
-            old = self._snaps.get(src)
-            # Same reorder guard as tcp: only a >= step header replaces.
-            import struct as _struct
-
-            if (
-                old is None
-                or len(blob) < 8
-                or _struct.unpack("<Q", blob[:8])[0]
-                >= _struct.unpack("<Q", old[:8])[0]
+            if self._store_snap(src, blob) and (
+                self.zones.zone_of(src) == self.zone
             ):
-                self._snaps[src] = blob
+                self._relay("snap", src, [(src, self.zone)],
+                            lambda path: ("rsnap", src, blob, path), len(blob))
+        elif kind == "rsnap":
+            _k, origin, blob, path = msg[:4]
+            for pm, pz in path:
+                self.zones.learn(pm, pz)
+            sender = path[-1][0] if path else origin
+            if not ZoneRouter.loop_safe(path, self.member):
+                self.metrics.count("topo.relay_loops")
+                return
+            if self._store_snap(origin, blob):
+                self._relay("snap", origin, path,
+                            lambda p: ("rsnap", origin, blob, p), len(blob))
         elif kind == "delta":
             _k, _s, seq, keep, blob = msg[:5]
-            window = self._deltas.setdefault(src, {})
-            window[seq] = blob
-            # Prune against the window MAX, not this message's seq: a
-            # reordered old delta must not re-enter past the keep bound.
-            hi = max(window)
-            for s in [s for s in window if s <= hi - keep]:
-                del window[s]
-        self.membership.observe(src)
+            if self._store_delta(src, seq, keep, blob) and (
+                self.zones.zone_of(src) == self.zone
+            ):
+                self._relay(
+                    "delta", src, [(src, self.zone)],
+                    lambda p: ("rdelta", src, seq, keep, blob, p),
+                    len(blob), dseq=seq,
+                )
+        elif kind == "rdelta":
+            _k, origin, seq, keep, blob, path = msg[:6]
+            for pm, pz in path:
+                self.zones.learn(pm, pz)
+            sender = path[-1][0] if path else origin
+            if not ZoneRouter.loop_safe(path, self.member):
+                self.metrics.count("topo.relay_loops")
+                return
+            if self._store_delta(origin, seq, keep, blob):
+                self._relay(
+                    "delta", origin, path,
+                    lambda p: ("rdelta", origin, seq, keep, blob, p),
+                    len(blob), dseq=seq,
+                )
+        if sender != self.member:
+            self.membership.observe(sender)
         self.membership.absorb(heard)
+
+    def _relay(
+        self,
+        fkind: str,
+        origin: str,
+        path: list,
+        mk_msg,
+        nbytes: int,
+        dseq: Optional[int] = None,
+    ) -> None:
+        """Forward an accepted frame per `plan_relay` (no-op for leaves
+        and full-mesh transports). Mirrors tcp's relay: stamps self onto
+        the path, counts cross-zone traffic, emits `frame.relay`."""
+        router = self.router
+        if router is None:
+            return
+        candidates = [m for m in sorted(self.net._members) if m != self.member]
+        targets = router.plan_relay(origin, path, candidates)
+        if not targets:
+            return
+        stamped = list(path) + [(self.member, self.zone)]
+        for dst, cross in targets:
+            self._send(dst, mk_msg(stamped), cross, nbytes)
+        self.metrics.count("topo.relays")
+        ev: Dict[str, object] = {
+            "member": self.member,
+            "fkind": fkind,
+            "origin": origin,
+            "hops": len(path),
+            "n_targets": len(targets),
+            "cross_zone": any(c for _, c in targets),
+            "vt": self.net.time,
+        }
+        if dseq is not None:
+            ev["dseq"] = dseq
+        obs_events.emit("frame.relay", **ev)
 
     # -- Transport reads ---------------------------------------------------
 

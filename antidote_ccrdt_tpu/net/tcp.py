@@ -16,12 +16,26 @@ this member's OpenMetrics text back on the SAME inbound connection — the
 only frame ever written back on an accepted socket. Scrapers are not
 members: the request bypasses membership observation entirely.
 
-Topology: full mesh over a static address book. Each member keeps ONE
-outgoing connection per peer (`_PeerLink`) feeding from a bounded send
-queue; inbound connections are accept-and-read only. Received blobs land
-in local caches, so the `Transport` fetch surface is a local dict read —
-anti-entropy stays pull-shaped above (`sweep_deltas` chains whatever has
-arrived) while the medium is push-shaped below.
+Topology: full mesh over a static address book by default. Each member
+keeps ONE outgoing connection per peer (`_PeerLink`) feeding from a
+bounded send queue; inbound connections are accept-and-read only.
+Received blobs land in local caches, so the `Transport` fetch surface is
+a local dict read — anti-entropy stays pull-shaped above (`sweep_deltas`
+chains whatever has arrived) while the medium is push-shaped below.
+
+`install_router()` switches the mesh to the zone-aware topology from
+`topo/`: frames then go where `ZoneRouter.send_targets` says (leaves
+intra-zone, anchors also to remote-zone anchors), cross-zone frames
+travel as `{rsnap,...}`/`{rdelta,...}` carrying (member, zone) hop
+stamps, and receiving anchors relay per `plan_relay` — each relayed
+send shows up as a `frame.relay` event and in the
+`topo.cross_zone.{frames,bytes}` counters. Links also negotiate a codec
+at connect time via `{hello}`/`{hello_ack}` (codec byte 0=raw 1=zlib
+ahead of the ETF payload, `topo.codec`); a peer that never acks —
+an un-upgraded build — gets legacy bare-ETF frames forever, so mixed
+fleets interop. The default compress policy is zlib on cross-zone links
+only (`compress="cross_zone"`): intra-zone links are cheap, the DCN is
+not.
 
 Failure behavior (the design goal: DEGRADE, never hang):
 
@@ -54,8 +68,19 @@ from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..bridge.protocol import pack_frame, unpack_frames
+from ..core import etf
 from ..core.etf import Atom
 from ..obs import events as obs_events
+from ..topo import (
+    CODEC_RAW,
+    CODEC_ZLIB,
+    UNKNOWN_ZONE,
+    ZoneMap,
+    ZoneRouter,
+    encode_frame,
+    unpack_coded_frames,
+    zone_from_env,
+)
 from ..utils import faults
 from ..utils.metrics import Metrics
 from .membership import Membership
@@ -65,8 +90,15 @@ A_DELTA = Atom("delta")
 A_PING = Atom("ping")
 A_METRICS_REQ = Atom("metrics_req")
 A_METRICS_RESP = Atom("metrics_resp")
+A_HELLO = Atom("hello")
+A_HELLO_ACK = Atom("hello_ack")
+A_RSNAP = Atom("rsnap")
+A_RDELTA = Atom("rdelta")
 
 _SNAP, _DELTA, _PING = "snap", "delta", "ping"
+
+# (member, zone) hop stamps of a routed frame, origin first.
+_Path = List[Tuple[str, str]]
 
 
 def scrape_metrics(addr: Tuple[str, int], timeout: float = 2.0) -> Tuple[str, str]:
@@ -106,6 +138,7 @@ class _PeerLink:
         send_timeout: float,
         backoff_base: float,
         backoff_max: float,
+        negotiate: Optional[Callable[[socket.socket], Optional[int]]] = None,
     ):
         self.name = name  # peer's member name (frame.send events, gauges)
         self.addr = addr
@@ -116,6 +149,11 @@ class _PeerLink:
         self.send_timeout = send_timeout
         self.backoff_base = backoff_base
         self.backoff_max = backoff_max
+        # Hello exchange run on each fresh socket; returns the codec the
+        # peer accepts, or None for a legacy peer (bare-ETF frames).
+        # Re-runs on every reconnect — the peer may have been upgraded.
+        self.negotiate = negotiate
+        self.codec: Optional[int] = None
         # (kind, build_frame: () -> bytes, meta: trace context carried to
         # the frame.send event — {origin, dseq} for deltas)
         self._q: deque = deque()
@@ -185,6 +223,11 @@ class _PeerLink:
         try:
             s = socket.create_connection(self.addr, timeout=self.connect_timeout)
             s.settimeout(self.send_timeout)
+            if self.negotiate is not None:
+                try:
+                    self.codec = self.negotiate(s)
+                except Exception:
+                    self.codec = None  # any hello trouble -> legacy frames
             self._sock = s
             self._attempts = 0
             self.metrics.count("net.connects")
@@ -221,11 +264,14 @@ class _PeerLink:
                 else:
                     self._sock.sendall(frame)
             except OSError:
-                try:
-                    self._sock.close()
-                except OSError:
-                    pass
-                self._sock = None
+                # close() may have nulled _sock concurrently (it owns the
+                # socket teardown); swap-then-close so both orders are safe.
+                s, self._sock = self._sock, None
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
                 self._attempts += 1
                 self.metrics.count("net.retries")
                 continue  # same frame retries after reconnect
@@ -240,6 +286,12 @@ class _PeerLink:
             if not dropped:
                 self.metrics.count("net.frames_sent")
                 self.metrics.count("net.bytes_sent", len(frame))
+                if meta.get("cross_zone"):
+                    # Counted at actual wire time with post-codec sizes:
+                    # these two gauges ARE the DCN bill the topology is
+                    # meant to shrink (bench_gate reports them).
+                    self.metrics.count("topo.cross_zone.frames")
+                    self.metrics.count("topo.cross_zone.bytes", len(frame))
                 # Emitted when the frame actually left (not at enqueue):
                 # delta metas carry (origin, dseq) so the trace shows the
                 # true wire time of each propagation hop.
@@ -274,10 +326,21 @@ class TcpTransport:
         backoff_base: float = 0.05,
         backoff_max: float = 2.0,
         seed: Optional[int] = None,
+        zone: Optional[str] = None,
+        compress: str = "cross_zone",
+        hello_timeout: float = 1.0,
     ):
         self.member = member
         self.metrics = metrics if metrics is not None else Metrics()
         self.membership = Membership(member, metrics=self.metrics)
+        # Zone defaults to CCRDT_ZONE (one shared default zone when unset,
+        # so unconfigured fleets keep exact full-mesh behavior). Routing
+        # stays full-mesh until install_router() is called.
+        self.zone = zone if zone is not None else zone_from_env()
+        self.zones = ZoneMap(member, self.zone)
+        self.router: Optional[ZoneRouter] = None
+        self.compress = compress  # "off" | "cross_zone" | "all"
+        self.hello_timeout = hello_timeout
         self._rng = random.Random(
             seed if seed is not None else hash(member) & 0xFFFFFFFF
         )
@@ -312,10 +375,102 @@ class TcpTransport:
             if name in self._links or self._closed:
                 return
             self._links[name] = _PeerLink(
-                name, tuple(addr), self._rng, self.metrics, *self._link_params
+                name, tuple(addr), self._rng, self.metrics,
+                *self._link_params, negotiate=self._hello_exchange,
             )
 
+    def learn_zone(self, name: str, zone: str) -> None:
+        """Feed static zone config (address files, CLI) into the map —
+        hellos and relay stamps keep teaching it afterwards."""
+        self.zones.learn(name, zone)
+
+    def install_router(self, timeout_s: float = 2.0) -> ZoneRouter:
+        """Switch from full-mesh to the zone-aware topology (`topo/`).
+        `timeout_s` is the SWIM alive-horizon anchor elections use.
+        Peers with unknown zones keep full-mesh treatment, so calling
+        this before zones are learned only delays the traffic win."""
+        self.router = ZoneRouter(
+            self.member,
+            self.zone,
+            self.zones,
+            membership=self.membership,
+            timeout_s=timeout_s,
+            metrics=self.metrics,
+        )
+        return self.router
+
+    # -- per-link codec negotiation ----------------------------------------
+
+    def _hello_exchange(self, sock: socket.socket) -> Optional[int]:
+        """Run on the sender thread right after each connect: send
+        `{hello, Member, Zone, [Codecs]}` (legacy-framed — an old peer
+        decodes it as an unknown tag and ignores it) and wait, bounded,
+        for `{hello_ack, Member, Zone, Codec}` on the same socket — the
+        second of the two write-back frames inbound handlers may send.
+        Timeout/EOF/garbage all mean "legacy peer": frames to this link
+        stay bare ETF. The ack also teaches us the peer's zone."""
+        try:
+            sock.sendall(
+                pack_frame((
+                    A_HELLO,
+                    self.member.encode("utf-8"),
+                    self.zone.encode("utf-8"),
+                    [CODEC_RAW, CODEC_ZLIB],
+                ))
+            )
+            self.metrics.count("net.hellos")
+            buf = bytearray()
+            deadline = time.monotonic() + self.hello_timeout
+            while True:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return None
+                sock.settimeout(left)
+                data = sock.recv(1 << 16)
+                if not data:
+                    return None
+                buf.extend(data)
+                for term in unpack_coded_frames(buf):
+                    if term[0] == A_HELLO_ACK:
+                        _, mb, zb, codec = term
+                        self.zones.learn(
+                            mb.decode("utf-8"), zb.decode("utf-8")
+                        )
+                        self.metrics.count("net.hello_acks")
+                        return int(codec)
+        except (OSError, ValueError):
+            return None
+        finally:
+            try:
+                sock.settimeout(self._link_params[2])  # send_timeout
+            except OSError:
+                pass
+
+    def _link_codec(self, link: _PeerLink) -> Optional[int]:
+        """Effective send codec for one link, decided at build time:
+        min(what the peer accepts, what the compress policy wants)."""
+        negotiated = link.codec
+        if negotiated is None:
+            return None  # legacy peer
+        if negotiated >= CODEC_ZLIB and self._compress_to(link.name):
+            return CODEC_ZLIB
+        return CODEC_RAW
+
+    def _compress_to(self, peer: str) -> bool:
+        if self.compress == "all":
+            return True
+        if self.compress == "off":
+            return False
+        pz = self.zones.zone_of(peer)
+        return pz not in (self.zone, UNKNOWN_ZONE)
+
     # -- frame builders (called at send time, see module docstring) --------
+
+    def _wire(self, term, link: _PeerLink) -> bytes:
+        codec = self._link_codec(link)
+        if codec is None:
+            return pack_frame(term)
+        return encode_frame(etf.encode(term), codec, self.metrics)
 
     def _heard_term(self) -> Dict[bytes, float]:
         return {
@@ -323,17 +478,47 @@ class TcpTransport:
             for m, age in self.membership.heard_ages().items()
         }
 
-    def _snap_frame(self, blob: bytes) -> Callable[[], bytes]:
+    def _snap_frame(self, blob: bytes, link: _PeerLink) -> Callable[[], bytes]:
         mb = self.member.encode("utf-8")
-        return lambda: pack_frame((A_SNAP, mb, blob, self._heard_term()))
+        return lambda: self._wire((A_SNAP, mb, blob, self._heard_term()), link)
 
-    def _delta_frame(self, seq: int, keep: int, blob: bytes) -> Callable[[], bytes]:
+    def _delta_frame(
+        self, seq: int, keep: int, blob: bytes, link: _PeerLink
+    ) -> Callable[[], bytes]:
         mb = self.member.encode("utf-8")
-        return lambda: pack_frame((A_DELTA, mb, seq, keep, blob, self._heard_term()))
+        return lambda: self._wire(
+            (A_DELTA, mb, seq, keep, blob, self._heard_term()), link
+        )
 
-    def _ping_frame(self) -> Callable[[], bytes]:
+    def _ping_frame(self, link: _PeerLink) -> Callable[[], bytes]:
         mb = self.member.encode("utf-8")
-        return lambda: pack_frame((A_PING, mb, self._heard_term()))
+        return lambda: self._wire((A_PING, mb, self._heard_term()), link)
+
+    @staticmethod
+    def _path_term(path: _Path) -> List[Tuple[bytes, bytes]]:
+        return [(m.encode("utf-8"), z.encode("utf-8")) for m, z in path]
+
+    def _rsnap_frame(
+        self, origin: str, blob: bytes, path: _Path, link: _PeerLink
+    ) -> Callable[[], bytes]:
+        ob, pt = origin.encode("utf-8"), self._path_term(path)
+        return lambda: self._wire(
+            (A_RSNAP, ob, blob, pt, self._heard_term()), link
+        )
+
+    def _rdelta_frame(
+        self,
+        origin: str,
+        seq: int,
+        keep: int,
+        blob: bytes,
+        path: _Path,
+        link: _PeerLink,
+    ) -> Callable[[], bytes]:
+        ob, pt = origin.encode("utf-8"), self._path_term(path)
+        return lambda: self._wire(
+            (A_RDELTA, ob, seq, keep, blob, pt, self._heard_term()), link
+        )
 
     # -- receive path ------------------------------------------------------
 
@@ -357,7 +542,7 @@ class TcpTransport:
                     return
                 buf.extend(data)
                 self.metrics.count("net.bytes_recv", len(data))
-                for term in unpack_frames(buf):
+                for term in unpack_coded_frames(buf):
                     self._handle(term, conn)
         except (OSError, ValueError):
             return
@@ -367,15 +552,65 @@ class TcpTransport:
             except OSError:
                 pass
 
+    def _store_snap(self, m: str, blob: bytes) -> bool:
+        """Anchor cache write; True when the blob was accepted. Ordered
+        within one link, but reconnects can interleave: only a
+        step-header >= the cached one replaces the anchor."""
+        with self._lock:
+            old = self._snaps.get(m)
+            if (
+                old is None
+                or len(blob) < 8
+                or struct.unpack("<Q", blob[:8])[0]
+                >= struct.unpack("<Q", old[:8])[0]
+            ):
+                self._snaps[m] = blob
+                return True
+            return False
+
+    def _store_delta(self, m: str, seq: int, keep: int, blob: bytes) -> bool:
+        """Delta window write; True when `seq` is NEW and survived the
+        prune (a stale redelivery must not trigger a re-relay). Prune
+        against the window MAX: reconnect interleavings can deliver an
+        old delta late — it must not re-enter past the keep bound."""
+        with self._lock:
+            window = self._deltas.setdefault(m, {})
+            fresh = seq not in window
+            window[seq] = blob
+            hi = max(window)
+            for s in [s for s in window if s <= hi - keep]:
+                del window[s]
+            return fresh and seq in window
+
     def _handle(self, term, conn: Optional[socket.socket] = None) -> None:
         self.metrics.count("net.frames_recv")
         tag = term[0]
         if tag == A_METRICS_REQ:
-            # In-band scrape: reply on the inbound connection (the only
-            # write-back frame) and return WITHOUT touching membership —
-            # the scraper is not a mesh member.
+            # In-band scrape: reply on the inbound connection and return
+            # WITHOUT touching membership — the scraper is not a member.
             if conn is not None:
                 self._send_metrics_resp(conn)
+            return
+        if tag == A_HELLO:
+            # Link setup from a topo-aware peer: learn its zone, answer
+            # with ours and the best codec we can decode of its offer.
+            _, mb, zb, codecs = term
+            m = mb.decode("utf-8")
+            self.zones.learn(m, zb.decode("utf-8"))
+            chosen = CODEC_ZLIB if CODEC_ZLIB in list(codecs) else CODEC_RAW
+            if conn is not None:
+                try:
+                    conn.sendall(
+                        pack_frame((
+                            A_HELLO_ACK,
+                            self.member.encode("utf-8"),
+                            self.zone.encode("utf-8"),
+                            chosen,
+                        ))
+                    )
+                except OSError:
+                    pass
+            self.membership.observe(m)
             return
         if tag == A_SNAP:
             _, mb, blob, heard = term
@@ -383,17 +618,31 @@ class TcpTransport:
             obs_events.emit(
                 "frame.recv", fkind=_SNAP, origin=m, bytes=len(blob)
             )
-            with self._lock:
-                # Ordered within one link, but reconnects can interleave:
-                # only a step-header >= the cached one replaces the anchor.
-                old = self._snaps.get(m)
-                if (
-                    old is None
-                    or len(blob) < 8
-                    or struct.unpack("<Q", blob[:8])[0]
-                    >= struct.unpack("<Q", old[:8])[0]
-                ):
-                    self._snaps[m] = blob
+            if self._store_snap(m, blob) and self.zones.zone_of(m) == self.zone:
+                # A zone-mate's own anchor: if we are this zone's relay
+                # anchor, carry it across the DCN (no-op for leaves).
+                self._relay_snap(m, blob, [(m, self.zone)])
+        elif tag == A_RSNAP:
+            _, ob, blob, path_t, heard = term
+            origin = ob.decode("utf-8")
+            path = [
+                (pm.decode("utf-8"), pz.decode("utf-8")) for pm, pz in path_t
+            ]
+            for pm, pz in path:
+                self.zones.learn(pm, pz)
+            m = path[-1][0] if path else origin  # the actual wire sender
+            obs_events.emit(
+                "frame.recv",
+                fkind=_SNAP,
+                origin=origin,
+                bytes=len(blob),
+                hops=len(path),
+            )
+            if not ZoneRouter.loop_safe(path, self.member):
+                self.metrics.count("topo.relay_loops")
+                return
+            if self._store_snap(origin, blob):
+                self._relay_snap(origin, blob, path)
         elif tag == A_DELTA:
             _, mb, seq, keep, blob, heard = term
             m = mb.decode("utf-8")
@@ -406,23 +655,99 @@ class TcpTransport:
                 dseq=int(seq),
                 bytes=len(blob),
             )
-            with self._lock:
-                window = self._deltas.setdefault(m, {})
-                window[int(seq)] = blob
-                # Prune against the window MAX: reconnect interleavings can
-                # deliver an old delta late — it must not re-enter past the
-                # keep bound.
-                hi = max(window)
-                for s in [s for s in window if s <= hi - keep]:
-                    del window[s]
+            if (
+                self._store_delta(m, int(seq), int(keep), blob)
+                and self.zones.zone_of(m) == self.zone
+            ):
+                self._relay_delta(
+                    m, int(seq), int(keep), blob, [(m, self.zone)]
+                )
+        elif tag == A_RDELTA:
+            _, ob, seq, keep, blob, path_t, heard = term
+            origin = ob.decode("utf-8")
+            path = [
+                (pm.decode("utf-8"), pz.decode("utf-8")) for pm, pz in path_t
+            ]
+            for pm, pz in path:
+                self.zones.learn(pm, pz)
+            m = path[-1][0] if path else origin
+            obs_events.emit(
+                "frame.recv",
+                fkind=_DELTA,
+                origin=origin,
+                dseq=int(seq),
+                bytes=len(blob),
+                hops=len(path),
+            )
+            if not ZoneRouter.loop_safe(path, self.member):
+                self.metrics.count("topo.relay_loops")
+                return
+            if self._store_delta(origin, int(seq), int(keep), blob):
+                self._relay_delta(origin, int(seq), int(keep), blob, path)
         elif tag == A_PING:
             _, mb, heard = term
             m = mb.decode("utf-8")
         else:
             return  # unknown frame: ignore (forward compatibility)
-        self.membership.observe(m)
+        if m != self.member:
+            self.membership.observe(m)
         self.membership.absorb(
             {k.decode("utf-8"): v for k, v in heard.items()}
+        )
+
+    # -- relay (anchors only; plan_relay returns [] for leaves) ------------
+
+    def _relay_snap(self, origin: str, blob: bytes, path: _Path) -> None:
+        def enq(link: _PeerLink, stamped: _Path, meta: Dict[str, object]):
+            link.enqueue(
+                _SNAP, self._rsnap_frame(origin, blob, stamped, link), meta
+            )
+
+        self._relay(_SNAP, origin, path, enq)
+
+    def _relay_delta(
+        self, origin: str, seq: int, keep: int, blob: bytes, path: _Path
+    ) -> None:
+        def enq(link: _PeerLink, stamped: _Path, meta: Dict[str, object]):
+            link.enqueue(
+                _DELTA,
+                self._rdelta_frame(origin, seq, keep, blob, stamped, link),
+                meta,
+            )
+
+        self._relay(_DELTA, origin, path, enq, dseq=seq)
+
+    def _relay(
+        self,
+        fkind: str,
+        origin: str,
+        path: _Path,
+        enq: Callable[[_PeerLink, _Path, Dict[str, object]], None],
+        dseq: Optional[int] = None,
+    ) -> None:
+        router = self.router
+        if router is None:
+            return
+        targets = router.plan_relay(origin, path, sorted(self._links))
+        if not targets:
+            return
+        stamped = path + [(self.member, self.zone)]
+        trace: Dict[str, object] = {"origin": origin}
+        if dseq is not None:
+            trace["dseq"] = dseq
+        for peer, cross in targets:
+            link = self._links.get(peer)
+            if link is None:
+                continue
+            enq(link, stamped, dict(trace, cross_zone=cross, relay=True))
+        self.metrics.count("topo.relays")
+        obs_events.emit(
+            "frame.relay",
+            fkind=fkind,
+            hops=len(path),
+            n_targets=len(targets),
+            cross_zone=any(c for _, c in targets),
+            **trace,
         )
 
     def _send_metrics_resp(self, conn: socket.socket) -> None:
@@ -453,9 +778,21 @@ class TcpTransport:
 
     # -- Transport: liveness ----------------------------------------------
 
+    def _targets(self) -> List[Tuple[str, bool]]:
+        """Where self's own frames go: every link when full-mesh, the
+        router's (peer, cross_zone) picks once install_router() ran."""
+        names = sorted(self._links)
+        if self.router is None:
+            return [(n, False) for n in names]
+        return self.router.send_targets(names)
+
     def heartbeat(self) -> None:
-        for link in self._links.values():
-            link.enqueue(_PING, self._ping_frame())
+        for peer, cross in self._targets():
+            link = self._links.get(peer)
+            if link is None:
+                continue
+            meta = {"cross_zone": True} if cross else None
+            link.enqueue(_PING, self._ping_frame(link), meta=meta)
 
     def members(self) -> List[str]:
         return self.membership.members()
@@ -471,10 +808,25 @@ class TcpTransport:
     def publish(self, blob: bytes) -> None:
         with self._lock:
             self._snaps[self.member] = blob
-        for link in self._links.values():
-            link.enqueue(
-                _SNAP, self._snap_frame(blob), meta={"origin": self.member}
-            )
+        path = [(self.member, self.zone)]
+        for peer, cross in self._targets():
+            link = self._links.get(peer)
+            if link is None:
+                continue
+            if cross:
+                # Self is its zone's anchor sending straight across the
+                # DCN: stamp the path so the remote anchor can fan out.
+                link.enqueue(
+                    _SNAP,
+                    self._rsnap_frame(self.member, blob, path, link),
+                    meta={"origin": self.member, "cross_zone": True},
+                )
+            else:
+                link.enqueue(
+                    _SNAP,
+                    self._snap_frame(blob, link),
+                    meta={"origin": self.member},
+                )
 
     def fetch(self, member: str) -> Optional[bytes]:
         with self._lock:
@@ -497,12 +849,23 @@ class TcpTransport:
             window[seq] = blob
             for s in [s for s in window if s <= seq - keep]:
                 del window[s]
-        for link in self._links.values():
-            link.enqueue(
-                _DELTA,
-                self._delta_frame(seq, keep, blob),
-                meta={"origin": self.member, "dseq": seq},
-            )
+        path = [(self.member, self.zone)]
+        for peer, cross in self._targets():
+            link = self._links.get(peer)
+            if link is None:
+                continue
+            if cross:
+                link.enqueue(
+                    _DELTA,
+                    self._rdelta_frame(self.member, seq, keep, blob, path, link),
+                    meta={"origin": self.member, "dseq": seq, "cross_zone": True},
+                )
+            else:
+                link.enqueue(
+                    _DELTA,
+                    self._delta_frame(seq, keep, blob, link),
+                    meta={"origin": self.member, "dseq": seq},
+                )
 
     def fetch_delta(self, member: str, seq: int) -> Optional[bytes]:
         with self._lock:

@@ -269,6 +269,10 @@ class GossipNode:
     def __init__(self, transport: Transport, metrics: Optional[Metrics] = None):
         self.transport = transport
         self.member = transport.member
+        # Zone passthrough for transports running the topo/ layer (None
+        # for zone-less media like FsTransport) — drills and dashboards
+        # read it off the node instead of reaching into the transport.
+        self.zone = getattr(transport, "zone", None)
         self.metrics = (
             metrics
             if metrics is not None

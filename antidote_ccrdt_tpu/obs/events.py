@@ -41,7 +41,9 @@ Durability model (the crash part of "flight recorder"):
 Wired event kinds:
 
     delta.publish / delta.fetch / delta.apply / snap.publish / snap.apply
-    frame.send / frame.recv            (tcp; origin+dseq trace context)
+    frame.send / frame.recv / frame.relay  (tcp+sim; origin+dseq trace
+                                        context; relay = topo/ anchors)
+    topo.anchor_change                 (zone anchor election / failover)
     transport.delta_write              (fs medium; the frame-send analog)
     peer.suspect / peer.dead / peer.realive   (SWIM transitions, with age)
     wal.append / wal.rotate / wal.checkpoint / wal.recover / wal.torn
@@ -269,12 +271,14 @@ def delta_paths(
 ) -> Dict[tuple, Dict[str, List[Dict[str, Any]]]]:
     """Group delta trace events across a fleet's logs by their trace
     context: {(origin, dseq): {stage: [events]}} where stage is one of
-    publish/send/write/fetch/recv/apply — the cross-replica propagation
-    path of each logical delta."""
+    publish/send/write/relay/recv/fetch/apply — the cross-replica
+    propagation path of each logical delta (relay = a zone anchor
+    forwarding a routed frame, topo/)."""
     stages = {
         "delta.publish": "publish",
         "frame.send": "send",
         "transport.delta_write": "write",
+        "frame.relay": "relay",
         "frame.recv": "recv",
         "delta.fetch": "fetch",
         "delta.apply": "apply",

@@ -42,7 +42,7 @@ scripts/elastic_demo.py + tests/test_elastic.py.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..net.transport import FsTransport, GossipNode
 from ..obs import events as obs_events
@@ -75,6 +75,9 @@ class DeltaPublisher:
     def __init__(
         self, store: GossipNode, dense: Any, name: Optional[str] = None,
         full_every: int = 8, keep: int = 16,
+        lag_source: Optional[Callable[[], float]] = None,
+        lag_threshold: float = 8.0,
+        lag_full_every: int = 2,
     ):
         from ..core import serial
         from ..core.behaviour import MergeKind
@@ -92,6 +95,14 @@ class DeltaPublisher:
         )
         self.full_every = full_every
         self.keep = keep
+        # Lag-driven backpressure: when `lag_source` (typically max
+        # lag_ops over obs.lag.LagTracker.report()) says some peer is
+        # >= lag_threshold ops behind, anchor cadence tightens to
+        # lag_full_every so the laggard resyncs from a RECENT snapshot
+        # instead of replaying (or worse, missing) a long delta chain.
+        self.lag_source = lag_source
+        self.lag_threshold = lag_threshold
+        self.lag_full_every = max(1, lag_full_every)
         self.seq = -1
         self._prev: Any = None
         self._serial = serial
@@ -110,7 +121,17 @@ class DeltaPublisher:
                 "(parallel/monoid.py)"
             )
         self.seq += 1
-        if self._prev is None or self.seq % self.full_every == 0:
+        full_every = self.full_every
+        pressured = False
+        if self.lag_source is not None:
+            try:
+                pressured = float(self.lag_source()) >= self.lag_threshold
+            except Exception:
+                pressured = False  # a broken probe must not stop publishing
+        if pressured and self.lag_full_every < full_every:
+            full_every = self.lag_full_every
+            self.store.metrics.count("net.lag_anchor_cuts")
+        if self._prev is None or self.seq % full_every == 0:
             self.store.publish(self.name, state, self.seq)
             kind, nbytes = "full", -1
         else:

@@ -90,6 +90,25 @@ def evaluate(
     return 0, f"{verdict}\nOK: within tolerance"
 
 
+def load_topo_rounds(bench_dir: str) -> List[Tuple[int, str, Dict]]:
+    """[(round_no, path, cross_zone-dict)] for every ``TOPO_r<NN>.json``
+    round committed by scripts/topo_demo.py — the DCN byte bill of each
+    topology round, reported (not yet gated) alongside the throughput
+    rounds so cross-zone regressions are visible at the same place."""
+    out: List[Tuple[int, str, Dict]] = []
+    for p in sorted(glob.glob(os.path.join(bench_dir, "TOPO_r*.json"))):
+        m = re.search(r"TOPO_r(\d+)\.json$", os.path.basename(p))
+        if not m:
+            continue
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        out.append((int(m.group(1)), p, dict(doc.get("cross_zone") or {})))
+    return out
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description="fail on >tolerance regression of merges_per_sec "
@@ -106,6 +125,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     for n, p, v in rounds:
         tag = "-" if v is None else f"{v:,.0f}"
         print(f"  r{n:02d} {os.path.basename(p)}: {tag}")
+    for n, p, cz in load_topo_rounds(args.bench_dir):
+        print(
+            f"  topo r{n:02d} {os.path.basename(p)}: "
+            f"cross-zone {cz.get('bytes', 0):,.0f} B in "
+            f"{cz.get('frames', 0):,.0f} frames "
+            f"(vs mesh ratio {cz.get('ratio', float('nan')):.2f})"
+        )
     code, verdict = evaluate(rounds, args.tolerance)
     print(verdict)
     return code

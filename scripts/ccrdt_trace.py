@@ -37,8 +37,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from antidote_ccrdt_tpu.obs import events as obs_events  # noqa: E402
 
 # Display order of a delta's lifecycle stages (fs medium uses write/
-# fetch, tcp uses send/recv — a path holds whichever its medium emitted).
-STAGE_ORDER = ("publish", "write", "send", "recv", "fetch", "apply")
+# fetch, tcp uses send/recv — a path holds whichever its medium emitted;
+# relay = a topo/ zone anchor forwarding a routed frame across/inside a
+# zone, so hierarchical paths read leaf -> anchor -> anchor -> leaf).
+STAGE_ORDER = ("publish", "write", "send", "recv", "relay", "fetch", "apply")
 
 
 def load_paths(obs_dir: str) -> Dict[tuple, Dict[str, List[Dict[str, Any]]]]:
@@ -190,6 +192,21 @@ def cmd_summary(args: argparse.Namespace) -> int:
     print(f"apply samples   : {len(rows)}")
     print(f"never applied   : {len(lost)}"
           + (f"  {lost[:8]}" if lost else ""))
+    # topo/ hierarchy: anchor relays and the hop depth of routed frames
+    # (a flat mesh shows zero relays and no hop stamps).
+    relays = [e for st in paths.values() for e in st.get("relay", [])]
+    if relays:
+        cross = sum(1 for e in relays if e.get("cross_zone"))
+        hops = sorted(
+            int(e["hops"])
+            for st in paths.values()
+            for e in st.get("recv", [])
+            if e.get("hops") is not None
+        )
+        print(f"anchor relays   : {len(relays)} ({cross} cross-zone)")
+        if hops:
+            print(f"routed hop depth: max={hops[-1]} "
+                  f"p50={hops[len(hops) // 2]} over {len(hops)} frames")
     stats = pair_stats(rows)
     if stats:
         print("propagation latency per origin->applier pair:")

@@ -1,9 +1,12 @@
 """Chaos metrics gate: fail `make chaos` if the fault machinery goes dark.
 
-Runs one seeded simulator chaos drill (the exact drill pinned by
-tests/test_net_chaos.py — loss + duplication + partition + crash over
-chained-delta gossip), then asserts that every load-bearing counter is
-nonzero and prints the run's Prometheus summary. The point is
+Runs two seeded simulator chaos drills — the full-mesh drill pinned by
+tests/test_net_chaos.py (loss + duplication + partition + crash over
+chained-delta gossip) and the zone-topology drill pinned by
+tests/test_topo_chaos.py (two zones, whole-zone partition, the za
+anchor crashed; requires cross-zone traffic, anchor relays, AND an
+observed failover off the crashed anchor) — then asserts that every
+load-bearing counter is nonzero and prints the run's summary. The point is
 regression detection at the *observability* layer: a refactor that
 keeps convergence green but silently stops counting (metrics renamed,
 instrumentation dropped, sim faults disabled) regresses these counters
@@ -41,9 +44,22 @@ REQUIRED_NONZERO = (
     "net.dead_events",     # SWIM confirmed the crashed member
 )
 
+# Same contract for the zone-topology leg (tests/test_topo_chaos.py:
+# two zones, whole-zone partition, the za anchor crashed mid-run).
+TOPO_REQUIRED_NONZERO = (
+    "topo.cross_zone.frames",  # traffic actually crossed the DCN
+    "topo.cross_zone.bytes",   # ...with its byte bill counted
+    "topo.relays",             # anchors actually relayed
+    "topo.anchor_changes",     # elections (incl. the failover) observed
+    "net.sim_unreachable",     # the zone partition actually blocked routes
+    "net.dead_events",         # SWIM confirmed the crashed anchor
+)
+
 
 def main() -> int:
     from test_net_chaos import run_chaos  # heavy import (JAX) kept in main
+    from test_topo_chaos import ZONES, run_topo_chaos
+    from antidote_ccrdt_tpu.topo import rendezvous_anchor
     from elastic_demo import reference_digest
 
     digests, counters = run_chaos("topk_rmv", seed=7, delta=True)
@@ -67,6 +83,39 @@ def main() -> int:
         return 1
     print(f"OK: all {len(REQUIRED_NONZERO)} required chaos counters "
           f"nonzero; {len(digests)} survivors converged")
+
+    # -- leg 2: the zone topology (whole-zone partition + anchor crash) ----
+    t_digests, t_counters, anchor_events = run_topo_chaos("topk_rmv", seed=7)
+    t_diverged = sorted(m for m, d in t_digests.items() if d != ref)
+    t_zeroed = sorted(
+        n for n in TOPO_REQUIRED_NONZERO if not t_counters.get(n, 0)
+    )
+    victim = rendezvous_anchor(
+        "za", sorted(m for m, z in ZONES.items() if z == "za")
+    )
+    failovers = [
+        ev for ev in anchor_events
+        if ev["zone"] == "za" and ev["old"] == victim and ev["new"] != victim
+    ]
+    print("== topo chaos drill (seed=7, 2 zones, za anchor crashed) ==")
+    print("  " + " ".join(
+        f"{n}={int(t_counters.get(n, 0))}" for n in TOPO_REQUIRED_NONZERO
+    ))
+    if t_diverged:
+        print(f"FAIL: topo members diverged from the sequential reference: "
+              f"{t_diverged}")
+        return 1
+    if t_zeroed:
+        print("FAIL: topology counters regressed to zero (routing or "
+              f"instrumentation went dark): {t_zeroed}")
+        return 1
+    if not failovers:
+        print(f"FAIL: no anchor failover away from crashed {victim} "
+              f"observed (events: {anchor_events})")
+        return 1
+    print(f"OK: topo leg — {len(t_digests)} survivors converged via "
+          f"anchors, failover {victim} -> "
+          f"{sorted({ev['new'] for ev in failovers})} observed")
     return 0
 
 

@@ -283,6 +283,14 @@ def main() -> None:
         "snapshots on every publish",
     )
     ap.add_argument(
+        "--lag-anchor-ops", type=float, default=0.0,
+        help="lag-driven backpressure (needs --delta): when the lag "
+        "tracker shows any peer >= this many ops behind, the publisher "
+        "cuts full anchors every 2 publishes instead of every 4, so "
+        "laggards resync from a recent snapshot instead of replaying a "
+        "long delta chain; 0 disables",
+    )
+    ap.add_argument(
         "--wal-dir", default="",
         help="enable the crash-consistent write-ahead delta log "
         "(harness/wal.py) under this directory: every applied op batch "
@@ -444,6 +452,7 @@ def run_worker(store, drill, dense, state, args, result_dir):
         counters = store.metrics.snapshot()["counters"]
         doc = {
             "member": args.member,
+            "zone": getattr(store, "zone", None),
             "t": time.time(),
             "step": step,
             "owned": sorted(int(r) for r in owned),
@@ -463,7 +472,22 @@ def run_worker(store, drill, dense, state, args, result_dir):
         os.replace(tmp, path)
 
     if args.delta:
-        pub = DeltaPublisher(store, dense, name=drill.publish_name, full_every=4)
+        # Lag-driven backpressure: the drill's pressure signal is this
+        # worker's own worst peer lag — when convergence is straining
+        # (we are behind, or the fleet is churning), anchors come sooner
+        # so whoever is behind resyncs from a snapshot, not a chain.
+        lag_anchor_ops = float(getattr(args, "lag_anchor_ops", 0) or 0)
+        lag_source = None
+        if lag_anchor_ops > 0:
+            def lag_source():
+                return max(
+                    (r["lag_ops"] for r in lag_tracker.report().values()),
+                    default=0,
+                )
+        pub = DeltaPublisher(
+            store, dense, name=drill.publish_name, full_every=4,
+            lag_source=lag_source, lag_threshold=lag_anchor_ops,
+        )
         if start_step > 0:
             # Resume the delta-seq lineage PAST anything the lost
             # incarnation published (old seq <= old step < start_step):
@@ -575,6 +599,7 @@ def run_worker(store, drill, dense, state, args, result_dir):
 
     out = {
         "member": args.member,
+        "zone": getattr(store, "zone", None),
         "alive": store.alive_members(args.timeout),
         "digest": drill.digest(dense, state),
         "metrics": store.metrics.snapshot()["counters"],

@@ -37,11 +37,15 @@ install_child_cover()  # no-op outside `make cover` runs
 from scripts.elastic_demo import DRILLS, run_worker  # noqa: E402
 
 
-def _write_addr(root: str, member: str, addr) -> None:
+def _write_addr(root: str, member: str, addr, zone: str = "") -> None:
     path = os.path.join(root, f"addr-{member}")
     tmp = f"{path}.tmp-{os.getpid()}"
     with open(tmp, "w") as f:
-        f.write(f"{addr[0]}:{addr[1]}")
+        # "host:port" or "host:port zone" — the optional zone token rides
+        # the rendezvous file so peers learn topology before first contact
+        # (the hello exchange re-teaches it; this just avoids a full-mesh
+        # first round). Old readers split on ":" and never see the zone.
+        f.write(f"{addr[0]}:{addr[1]} {zone}".rstrip())
     os.replace(tmp, path)
 
 
@@ -52,8 +56,10 @@ def _read_addrs(root: str) -> dict:
             continue
         try:
             with open(os.path.join(root, fn)) as f:
-                host, port = f.read().strip().rsplit(":", 1)
-            out[fn[len("addr-"):]] = (host, int(port))
+                text = f.read().strip()
+            hostport, _, zone = text.partition(" ")
+            host, port = hostport.rsplit(":", 1)
+            out[fn[len("addr-"):]] = (host, int(port), zone.strip())
         except (OSError, ValueError):
             continue  # torn write: next poll sees it whole
     return out
@@ -75,6 +81,15 @@ def main() -> None:
     ap.add_argument("--publish-every", type=int, default=2)
     ap.add_argument("--delta", action="store_true")
     ap.add_argument("--queue-max", type=int, default=64)
+    ap.add_argument("--zone", default="",
+                    help="DCN zone label for topo/ routing (default: flat "
+                    "single-zone fleet)")
+    ap.add_argument("--topo", action="store_true",
+                    help="install the zone router: gossip intra-zone only, "
+                    "the per-zone rendezvous anchor relays across zones")
+    ap.add_argument("--lag-anchor-ops", type=float, default=0.0,
+                    help="lag-driven backpressure threshold in ops (needs "
+                    "--delta); 0 disables — see elastic_demo.py")
     args = ap.parse_args()
 
     import jax
@@ -95,19 +110,25 @@ def main() -> None:
     state = drill.init(dense)
 
     os.makedirs(args.root, exist_ok=True)
-    transport = TcpTransport(args.member, queue_max=args.queue_max)
+    transport = TcpTransport(
+        args.member, queue_max=args.queue_max, zone=args.zone or None
+    )
+    if args.topo:
+        transport.install_router(args.timeout)
 
     if args.join_late > 0:
         # Compile first, register (addr file + first pings) after the
         # delay — same late-join discipline as the fs drill.
         state = drill.apply(dense, state, 0, [])
         time.sleep(args.join_late)
-    _write_addr(args.root, args.member, transport.address)
+    _write_addr(args.root, args.member, transport.address, args.zone)
 
     def discover():
         while True:
-            for name, addr in _read_addrs(args.root).items():
-                transport.add_peer(name, addr)  # no-op for self/known
+            for name, (host, port, zone) in _read_addrs(args.root).items():
+                if zone:
+                    transport.learn_zone(name, zone)
+                transport.add_peer(name, (host, port))  # no-op for self/known
             time.sleep(0.05)
 
     threading.Thread(target=discover, daemon=True).start()

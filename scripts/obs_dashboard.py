@@ -144,20 +144,40 @@ def render_frame(root: str, clear: bool = True) -> str:
         lines.append("\x1b[2J\x1b[H")
     lines.append(f"== ccrdt gossip dashboard  root={root}  t={time.time():.2f}")
     hdr = (
-        f"{'member':<10}{'hb-age':>8} {'state':<9}{'snap':>5} "
+        f"{'member':<10}{'zone':<6}{'hb-age':>8} {'state':<9}{'snap':>5} "
         f"{'delta-window':<14}{'wal':>5}  {'sendq':<16}{'lag (peer:ops/secs)'}"
     )
     lines.append(hdr)
     lines.append("-" * len(hdr))
-    for m in sorted(rows):
+
+    def zone_of(m: str) -> str:
+        return str(((rows[m].get("status") or {}).get("zone")) or "?")
+
+    # Rows grouped by zone (topo/ fleets), members sorted within; a
+    # flat fleet is one "?" group with no visible change but the column.
+    ordered = sorted(rows, key=lambda m: (zone_of(m), m))
+    zones = sorted({zone_of(m) for m in rows})
+    multi_zone = len(zones) > 1
+    prev_zone = None
+    for m in ordered:
         r = rows[m]
+        z = zone_of(m)
+        if multi_zone and z != prev_zone:
+            states = [rows[n]["state"] for n in ordered if zone_of(n) == z]
+            tally = " ".join(
+                f"{states.count(s)} {s}"
+                for s in ("alive", "suspect", "dead", "?")
+                if states.count(s)
+            )
+            lines.append(f"-- zone {z}: {tally}")
+            prev_zone = z
         st = r.get("status")
         age = "-" if r["hb_age"] is None else f"{r['hb_age']:.2f}s"
         d = r["deltas"]
         window = f"{d[0]}..{d[-1]}" if d else "-"
         wal = (st or {}).get("wal_last_seq")
         lines.append(
-            f"{m:<10}{age:>8} {r['state']:<9}"
+            f"{m:<10}{z:<6}{age:>8} {r['state']:<9}"
             f"{'-' if r['snap'] is None else r['snap']:>5} "
             f"{window:<14}{'-' if wal is None else int(wal):>5}  "
             f"{_fmt_sendq(st):<16}{_fmt_lag(st)}"

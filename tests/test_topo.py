@@ -1,0 +1,411 @@
+"""topo/: zone maps, rendezvous anchors, routing, codecs, backpressure.
+
+Fast, mostly network-free unit surface for the DCN-aware topology tier:
+
+* rendezvous election — deterministic, order-independent, and STABLE
+  (property-style over seeded random views: removing a non-winner never
+  moves the winner, so churn among leaves causes zero anchor churn);
+* `ZoneRouter` — leaf vs anchor send targets, relay planning, and the
+  hop-stamp loop-freedom invariant;
+* the codec-byte framing — raw/zlib round-trips, the incompressible->raw
+  fallback, legacy bare-ETF interop, and the savings counter;
+* a real in-process 2-zone TCP fleet — hello/codec negotiation plus a
+  snapshot crossing the DCN via anchors only (`topo.cross_zone.*`);
+* `DeltaPublisher` lag backpressure — a synthetic laggard tightens the
+  anchor cadence, a broken lag probe never stops publishing.
+"""
+
+import random
+import struct
+import time
+import zlib
+
+from antidote_ccrdt_tpu.core import etf
+from antidote_ccrdt_tpu.net.tcp import TcpTransport
+from antidote_ccrdt_tpu.topo import (
+    CODEC_RAW,
+    CODEC_ZLIB,
+    UNKNOWN_ZONE,
+    ZoneMap,
+    ZoneRouter,
+    decode_body,
+    encode_frame,
+    rendezvous_anchor,
+    unpack_coded_frames,
+)
+from antidote_ccrdt_tpu.utils.metrics import Metrics
+
+
+# -- rendezvous election ------------------------------------------------------
+
+
+def test_rendezvous_deterministic_and_order_independent():
+    members = [f"m{i}" for i in range(8)]
+    a = rendezvous_anchor("za", members)
+    assert a in members
+    for _ in range(5):
+        shuffled = members[:]
+        random.Random(_).shuffle(shuffled)
+        assert rendezvous_anchor("za", shuffled) == a
+    assert rendezvous_anchor("za", []) is None
+    # Different zones draw independent rankings: with enough zones at
+    # least one elects a different member (sha1 mixing, not a constant).
+    assert len({rendezvous_anchor(f"z{i}", members) for i in range(16)}) > 1
+
+
+def test_rendezvous_stability_under_churn():
+    """The HRW property the topology leans on: removing any NON-winner
+    leaves the winner in place (leaf churn never reshuffles anchors),
+    and removing the winner promotes the runner-up for everyone.
+    Property-style over seeded random views."""
+    rng = random.Random(42)
+    for trial in range(50):
+        n = rng.randrange(2, 12)
+        members = sorted({f"w{rng.randrange(100)}" for _ in range(n)})
+        if len(members) < 2:
+            continue
+        zone = f"zone{trial % 5}"
+        winner = rendezvous_anchor(zone, members)
+        for leaver in members:
+            rest = [m for m in members if m != leaver]
+            survivor = rendezvous_anchor(zone, rest)
+            if leaver == winner:
+                assert survivor != winner  # failover, not resurrection
+            else:
+                assert survivor == winner, (
+                    f"non-winner {leaver} leaving moved the anchor "
+                    f"{winner} -> {survivor} (view {members}, zone {zone})"
+                )
+        # Joins only move the anchor when the joiner itself wins.
+        grown = rendezvous_anchor(zone, members + ["w-new"])
+        assert grown in (winner, "w-new")
+
+
+# -- zone map -----------------------------------------------------------------
+
+
+def test_zone_map_learning_and_grouping():
+    zm = ZoneMap("a0", "za")
+    assert zm.zone_of("a0") == "za"
+    assert zm.zone_of("stranger") == UNKNOWN_ZONE
+    assert zm.learn("b0", "zb") is True
+    assert zm.learn("b0", "zb") is False  # no new information
+    assert zm.learn("b0", "") is False
+    assert zm.learn("b0", UNKNOWN_ZONE) is False
+    assert zm.learn("a0", "zb") is False  # self's zone is pinned
+    assert zm.zone_of("a0") == "za"
+    zm.learn("a1", "za")
+    assert zm.members_of("za", ["a0", "a1", "b0", "x"]) == ["a0", "a1"]
+    assert zm.zones_of(["a1", "b0", "x"]) == ["za", "zb"]
+    assert zm.group(["a0", "a1", "b0", "x"]) == {
+        "za": ["a0", "a1"],
+        "zb": ["b0"],
+        UNKNOWN_ZONE: ["x"],
+    }
+
+
+# -- router -------------------------------------------------------------------
+
+
+def _router(member, zone, layout, membership=None, metrics=None):
+    zm = ZoneMap(member, zone)
+    for m, z in layout.items():
+        zm.learn(m, z)
+    return ZoneRouter(member, zone, zm, membership=membership, metrics=metrics)
+
+
+LAYOUT = {"a0": "za", "a1": "za", "a2": "za", "b0": "zb", "b1": "zb"}
+PEERS = sorted(LAYOUT)
+
+
+def test_send_targets_leaf_vs_anchor():
+    anchors = {z: rendezvous_anchor(z, [m for m, mz in LAYOUT.items() if mz == z])
+               for z in ("za", "zb")}
+    for member, zone in LAYOUT.items():
+        r = _router(member, zone, LAYOUT)
+        targets = r.send_targets([p for p in PEERS if p != member])
+        direct = {p for p, cross in targets if not cross}
+        cross = {p for p, cross in targets if cross}
+        zone_mates = {m for m, z in LAYOUT.items() if z == zone} - {member}
+        assert direct == zone_mates
+        if member == anchors[zone]:
+            assert cross == {anchors[z] for z in anchors if z != zone}
+        else:
+            assert cross == set()  # leaves never pay for the DCN
+
+
+def test_unknown_zone_peers_get_full_mesh_fallback():
+    r = _router("a0", "za", {"a1": "za"})
+    targets = dict(r.send_targets(["a1", "mystery"]))
+    assert targets == {"a1": False, "mystery": False}
+
+
+def test_plan_relay_origin_zone_vs_remote_zone():
+    anchors = {z: rendezvous_anchor(z, [m for m, mz in LAYOUT.items() if mz == z])
+               for z in ("za", "zb")}
+    az, bz = anchors["za"], anchors["zb"]
+    # Origin-zone anchor: a zone-mate's frame crosses to the remote anchor.
+    r = _router(az, "za", LAYOUT)
+    origin = next(m for m, z in LAYOUT.items() if z == "za" and m != az)
+    cands = [p for p in PEERS if p != az]
+    assert r.plan_relay(origin, [(origin, "za")], cands) == [(bz, True)]
+    # Remote-zone anchor: fans out locally, never back across.
+    rb = _router(bz, "zb", LAYOUT)
+    path = [(origin, "za"), (az, "za")]
+    fanout = rb.plan_relay(origin, path, [p for p in PEERS if p != bz])
+    assert fanout == [(m, False) for m, z in sorted(LAYOUT.items())
+                      if z == "zb" and m != bz]
+    # Non-anchors never relay.
+    leaf = next(m for m, z in LAYOUT.items() if z == "zb" and m != bz)
+    rl = _router(leaf, "zb", LAYOUT)
+    assert rl.plan_relay(origin, path, [p for p in PEERS if p != leaf]) == []
+
+
+def test_relay_path_stamps_prevent_loops():
+    anchors = {z: rendezvous_anchor(z, [m for m, mz in LAYOUT.items() if mz == z])
+               for z in ("za", "zb")}
+    az = anchors["za"]
+    r = _router(az, "za", LAYOUT)
+    origin = next(m for m, z in LAYOUT.items() if z == "za" and m != az)
+    # A path that already visited zb must not be sent there again.
+    path = [(origin, "za"), (az, "za"), (anchors["zb"], "zb")]
+    assert r.plan_relay(origin, path, [p for p in PEERS if p != az]) == []
+    # loop_safe: own stamp on the path -> drop on arrival.
+    assert ZoneRouter.loop_safe(path, "b1")
+    assert not ZoneRouter.loop_safe(path, az)
+
+
+class _FakeMembership:
+    def __init__(self, states):
+        self.states = states
+
+    def state_of(self, member, timeout_s):
+        return self.states.get(member, "dead")
+
+
+def test_anchor_failover_on_suspect_and_change_counter():
+    za_members = sorted(m for m, z in LAYOUT.items() if z == "za")
+    winner = rendezvous_anchor("za", za_members)
+    leaf = next(m for m in za_members if m != winner)  # observe as a leaf
+    m = Metrics()
+    states = {p: "alive" for p in LAYOUT}
+    r = _router(leaf, "za", LAYOUT,
+                membership=_FakeMembership(states), metrics=m)
+    cands = [p for p in PEERS if p != leaf]
+    assert r.anchor_of("za", cands) == winner
+    assert m.counters.get("topo.anchor_changes") == 1
+    # SUSPECT demotes the anchor out of the pool within one decision —
+    # the runner-up takes over without any coordination.
+    states[winner] = "suspect"
+    second = r.anchor_of("za", cands)
+    assert second != winner
+    assert m.counters["topo.anchor_changes"] == 2
+    # DEAD everyone: self is alive by definition, so the local pool
+    # degrades to exactly {self}; a fully-dead REMOTE zone still elects
+    # (pool falls through to all-known) so relays have a destination.
+    for p in LAYOUT:
+        states[p] = "dead"
+    assert r.anchor_of("za", cands) == leaf
+    assert r.anchor_of("zb", cands) is not None
+
+
+# -- codec --------------------------------------------------------------------
+
+
+def test_codec_roundtrip_raw_zlib_and_legacy():
+    term = ("delta", b"w0", 7, 16, b"x" * 512)
+    payload = etf.encode(term)
+    for codec in (CODEC_RAW, CODEC_ZLIB):
+        frame = encode_frame(payload, codec)
+        buf = bytearray(frame)
+        assert list(unpack_coded_frames(buf)) == [etf.decode(payload)]
+        assert not buf
+    # Legacy bare-ETF body (no codec byte) decodes identically.
+    assert decode_body(payload) == payload
+    legacy = struct.pack(">I", len(payload)) + payload
+    assert list(unpack_coded_frames(bytearray(legacy))) == [etf.decode(payload)]
+
+
+def test_codec_zlib_falls_back_to_raw_when_incompressible():
+    m = Metrics()
+    noise = random.Random(0).randbytes(64)
+    frame = encode_frame(noise, CODEC_ZLIB, metrics=m)
+    assert frame[4] == CODEC_RAW  # self-describing fallback
+    assert m.counters.get("net.codec_saved_bytes", 0) == 0
+    # Compressible payloads really do tag zlib and count the win.
+    fat = b"delta " * 400
+    frame = encode_frame(fat, CODEC_ZLIB, metrics=m)
+    assert frame[4] == CODEC_ZLIB
+    assert zlib.decompress(frame[5:]) == fat
+    assert m.counters["net.codec_saved_bytes"] > 0
+    assert m.counters["net.codec_zlib_frames"] == 1
+
+
+def test_codec_rejects_garbage():
+    import pytest
+
+    with pytest.raises(ValueError):
+        decode_body(b"")
+    with pytest.raises(ValueError):
+        decode_body(bytes([9]) + b"junk")
+    with pytest.raises(ValueError):
+        encode_frame(b"x", 9)
+
+
+# -- real sockets: 2-zone fleet via anchors -----------------------------------
+
+
+def _wait_for(pred, timeout=10.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_tcp_two_zone_fleet_crosses_dcn_via_anchors():
+    """Four real sockets, two zones. A snapshot published in za must
+    reach both zb members — but only anchor links may cross the zone
+    boundary, and the hello exchange must have negotiated codecs."""
+    layout = [("a0", "za"), ("a1", "za"), ("b0", "zb"), ("b1", "zb")]
+    ts = [TcpTransport(n, zone=z, hello_timeout=2.0) for n, z in layout]
+    try:
+        for t in ts:
+            for u in ts:
+                if u.member != t.member:
+                    t.learn_zone(u.member, u.zone)
+            t.install_router(timeout_s=1.0)
+        for t in ts:
+            for u in ts:
+                if u.member != t.member:
+                    t.add_peer(u.member, u.address)
+        # Compressible on purpose: cross-zone links default to zlib and
+        # the test asserts the codec actually fired (not just the hello).
+        blob = struct.pack("<Q", 1) + b"cross-zone-snapshot " * 64
+
+        def pump():
+            for t in ts:
+                t.heartbeat()
+            ts[0].publish(blob)
+            return all(t.fetch("a0") == blob for t in ts[1:])
+
+        assert _wait_for(pump), {
+            t.member: t.fetch("a0") is not None for t in ts
+        }
+        cross = sum(
+            t.metrics.counters.get("topo.cross_zone.frames", 0) for t in ts
+        )
+        assert cross > 0
+        assert sum(
+            t.metrics.counters.get("topo.relays", 0) for t in ts
+        ) > 0, "snapshot crossed without an anchor relay"
+        # Hello/codec negotiation ran AND produced a live zlib link: the
+        # compressible snapshot must have crossed the DCN deflated.
+        assert sum(
+            t.metrics.counters.get("net.hello_acks", 0) for t in ts
+        ) > 0
+        assert sum(
+            t.metrics.counters.get("net.codec_zlib_frames", 0) for t in ts
+        ) > 0, "cross-zone links never compressed a frame"
+        assert sum(
+            t.metrics.counters.get("net.codec_saved_bytes", 0) for t in ts
+        ) > 0
+    finally:
+        for t in ts:
+            t.close()
+
+
+# -- dashboard zone grouping --------------------------------------------------
+
+
+def test_dashboard_groups_members_by_zone(tmp_path):
+    """Member rows sort by (zone, member) with a per-zone SWIM tally
+    header; single-zone fleets keep the old flat layout (plus column)."""
+    import json as _json
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts"))
+    import obs_dashboard
+
+    now = time.time()
+    for m, zone in [("b9", "zb"), ("a1", "za"), ("a0", "za")]:
+        with open(tmp_path / f"hb-{m}", "wb") as f:
+            f.write(struct.pack("<d", now))
+        with open(tmp_path / f"obs-{m}.json", "w") as f:
+            _json.dump({"member": m, "zone": zone}, f)
+    frame = obs_dashboard.render_frame(str(tmp_path), clear=False)
+    lines = frame.splitlines()
+    order = [ln.split()[0] for ln in lines
+             if ln.split() and ln.split()[0] in ("a0", "a1", "b9")]
+    assert order == ["a0", "a1", "b9"]  # (zone, member), not plain name
+    za_hdr = next(i for i, ln in enumerate(lines) if "zone za" in ln)
+    zb_hdr = next(i for i, ln in enumerate(lines) if "zone zb" in ln)
+    assert za_hdr < zb_hdr
+    assert "alive" in lines[za_hdr]  # the SWIM tally rides the header
+
+
+# -- lag-driven backpressure --------------------------------------------------
+
+
+def test_delta_publisher_lag_backpressure():
+    """A synthetic laggard must tighten the anchor cadence from
+    full_every=4 to lag_full_every=2, counted in net.lag_anchor_cuts;
+    a broken lag probe must not stop publishing."""
+    import os
+    import sys
+    import tempfile
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts"))
+    from elastic_demo import DRILLS
+
+    from antidote_ccrdt_tpu.parallel.elastic import DeltaPublisher, GossipStore
+
+    drill = DRILLS["topk_rmv"]
+    dense = drill.make_engine()
+    state = drill.init(dense)
+    lag = {"ops": 0.0}
+    with tempfile.TemporaryDirectory() as root:
+        store = GossipStore(root, "w0")
+        pub = DeltaPublisher(
+            store, dense, name=drill.publish_name, full_every=4,
+            lag_source=lambda: lag["ops"], lag_threshold=5.0,
+        )
+
+        def drive(n):
+            nonlocal state
+            kinds = []
+            for _ in range(n):
+                step = pub.seq + 1
+                state = drill.apply(dense, state, step % 8, [0])
+                kinds.append(
+                    pub.publish(drill.pub_state(dense, state))["kind"])
+            return kinds
+
+        # Healthy fleet: anchors only at seq % 4 == 0.
+        kinds = drive(8)  # seqs 0..7
+        assert kinds[0] == "full" and kinds[4] == "full"
+        assert kinds.count("full") == 2
+        assert "net.lag_anchor_cuts" not in store.metrics.counters
+
+        # Laggard appears: cadence halves while the pressure lasts.
+        lag["ops"] = 12.0
+        kinds = drive(4)  # seqs 8..11
+        assert kinds.count("full") == 2  # seq 8 and 10
+        assert store.metrics.counters["net.lag_anchor_cuts"] > 0
+
+        # Laggard catches up: back to the relaxed cadence.
+        lag["ops"] = 0.0
+        cuts = store.metrics.counters["net.lag_anchor_cuts"]
+        kinds = drive(4)  # seqs 12..15
+        assert kinds.count("full") == 1  # seq 12 only
+        assert store.metrics.counters["net.lag_anchor_cuts"] == cuts
+
+        # A probe that raises is treated as "no pressure", never a crash.
+        pub.lag_source = lambda: (_ for _ in ()).throw(RuntimeError("probe"))
+        assert drive(2)  # publishes fine

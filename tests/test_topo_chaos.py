@@ -1,0 +1,222 @@
+"""Zone-topology chaos on the deterministic simulator (net/sim.py).
+
+The net/ chaos drills (tests/test_net_chaos.py) shake a FULL-MESH fleet.
+This file runs the same elastic drill — same op streams, adoption
+discipline, digests — over the `topo/` hierarchy instead: six members in
+two zones, routers installed, so every cross-zone byte rides the
+rendezvous anchors. The fault schedule is topology-shaped:
+
+* a WHOLE-ZONE partition (the DCN cut) that must heal via the anchors'
+  gap->full-snapshot resync;
+* the za anchor CRASHED mid-run — the rendezvous runner-up must take
+  over within a SWIM round (the failover the election cache makes
+  observable as anchor transitions).
+
+Acceptance is the strongest available: every survivor's digest equals
+the sequential single-process reference, which is the same digest the
+full-mesh chaos drill converges to — so topology changes the traffic
+shape, provably not the replicated state. `run_topo_chaos` returns
+(digests, counters, anchor_events) and is the engine behind the
+`scripts/chaos_gate.py` topology leg.
+"""
+
+import os
+import sys
+
+from antidote_ccrdt_tpu.net.sim import SimNet
+from antidote_ccrdt_tpu.net.transport import GossipNode
+from antidote_ccrdt_tpu.parallel.elastic import (
+    DeltaPublisher,
+    my_replicas,
+    sweep,
+    sweep_deltas,
+)
+from antidote_ccrdt_tpu.topo import rendezvous_anchor
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+from elastic_demo import DRILLS, R, STEPS, reference_digest  # noqa: E402
+
+DT = 0.1
+TIMEOUT = 0.35
+ZONES = {  # two zones x three members — the demo fleet's shape
+    "m0": "za", "m1": "za", "m2": "za",
+    "m3": "zb", "m4": "zb", "m5": "zb",
+}
+
+
+def run_topo_chaos(type_name, seed, *, loss=0.03, dup=0.03, delta=True):
+    """One zone-aware chaos run. Returns ({member: digest}, counters,
+    anchor_events) where anchor_events is the chronological list of
+    anchor transitions each member observed:
+    {"member", "zone", "old", "new", "vt"}."""
+    net = SimNet(seed=seed, latency=(0.001, 0.02), loss=loss, dup=dup)
+    drill = DRILLS[type_name]
+    dense = drill.make_engine()
+    names = sorted(ZONES)
+    transports = {m: net.join(m, zone=ZONES[m]) for m in names}
+    routers = {m: transports[m].install_router(TIMEOUT) for m in names}
+    nodes = {m: GossipNode(transports[m]) for m in names}
+    states = {m: drill.init(dense) for m in names}
+    cursors = {m: {} for m in names}
+    pubs = {
+        m: DeltaPublisher(nodes[m], dense, name=drill.publish_name, full_every=4)
+        for m in names
+    } if delta else {}
+    owned = {m: set() for m in names}
+    crashed = set()
+    anchor_events = []
+    anchor_view = {}  # (observer, zone) -> last seen anchor
+
+    def poll_anchors():
+        """Record every anchor transition as the members see it — the
+        failover evidence the chaos gate requires."""
+        for m in names:
+            if m in crashed:
+                continue
+            peers = [p for p in names if p != m]
+            for zone in ("za", "zb"):
+                a = routers[m].anchor_of(zone, peers)
+                key = (m, zone)
+                if a is not None and anchor_view.get(key) != a:
+                    anchor_events.append({
+                        "member": m, "zone": zone,
+                        "old": anchor_view.get(key), "new": a,
+                        "vt": net.time,
+                    })
+                    anchor_view[key] = a
+
+    def publish_and_sweep(m, seq_hint):
+        node = nodes[m]
+        view = drill.pub_state(dense, states[m])
+        if delta:
+            pubs[m].publish(view)
+            swept, _ = sweep_deltas(node, dense, view, cursors[m])
+        else:
+            node.publish(drill.publish_name, view, seq_hint)
+            swept, _ = sweep(node, dense, view)
+        states[m] = drill.set_view(dense, states[m], swept)
+
+    # Bootstrap: fault-free rounds until every member knows the roster
+    # (cross-zone rosters arrive via the anchors' piggybacked ages).
+    net.loss, net.dup, (loss0, dup0) = 0.0, 0.0, (net.loss, net.dup)
+    for _ in range(6):
+        for m in names:
+            nodes[m].heartbeat()
+        net.advance(DT)
+    for m in names:
+        assert set(nodes[m].members()) == set(names), (
+            m, nodes[m].members())
+    net.loss, net.dup = loss0, dup0
+    poll_anchors()
+
+    za_anchor = rendezvous_anchor("za", [m for m in names if ZONES[m] == "za"])
+
+    for step in range(STEPS):
+        if step == 3:  # the DCN cut: the whole of zb unreachable from za
+            net.partition(
+                {m for m in names if ZONES[m] == "za"},
+                {m for m in names if ZONES[m] == "zb"},
+            )
+        if step == 6:
+            net.heal()
+        if step == 7:  # kill the za ANCHOR, not a leaf
+            net.crash(za_anchor)
+            crashed.add(za_anchor)
+        for m in names:
+            if m in crashed:
+                continue
+            node = nodes[m]
+            node.heartbeat()
+            now_owned = owned[m] | set(my_replicas(node, R, TIMEOUT))
+            gained = now_owned - owned[m]
+            if gained:
+                states[m] = drill.adopt(dense, states[m], sorted(gained), step)
+            owned[m] = now_owned
+            states[m] = drill.apply(dense, states[m], step, sorted(owned[m]))
+            if step % 2 == 0:
+                publish_and_sweep(m, step)
+        net.advance(DT)
+        poll_anchors()
+
+    # Quiescent tail: keep gossiping — AND adopting, so replicas of any
+    # late-detected death keep their op streams — until convergence.
+    net.loss = net.dup = 0.0
+    ref = reference_digest(type_name)
+    live = [m for m in names if m not in crashed]
+    for _ in range(60):
+        for m in live:
+            node = nodes[m]
+            node.heartbeat()
+            now_owned = owned[m] | set(my_replicas(node, R, TIMEOUT))
+            gained = now_owned - owned[m]
+            if gained:
+                states[m] = drill.adopt(dense, states[m], sorted(gained), STEPS)
+            owned[m] = now_owned
+            publish_and_sweep(m, STEPS)
+        net.advance(DT)
+        poll_anchors()
+        if all(drill.digest(dense, states[m]) == ref for m in live):
+            break
+
+    digests = {m: drill.digest(dense, states[m]) for m in live}
+    return digests, dict(net.metrics.counters), anchor_events
+
+
+def test_topo_chaos_converges_to_reference():
+    """Zone partition + anchor crash: every survivor still reaches the
+    exact sequential reference — the same digest the full-mesh chaos
+    drill pins, so the topology is state-transparent."""
+    digests, counters, _ = run_topo_chaos("topk_rmv", seed=7)
+    ref = reference_digest("topk_rmv")
+    assert ref, "reference observable is empty — drill is vacuous"
+    for m, d in digests.items():
+        assert d == ref, f"{m} diverged\ngot: {d}\nref: {ref}"
+    # The topology actually carried the traffic: cross-zone frames flowed
+    # and anchors relayed; the zone partition actually blocked routes.
+    assert counters.get("topo.cross_zone.frames", 0) > 0, counters
+    assert counters.get("topo.cross_zone.bytes", 0) > 0, counters
+    assert counters.get("topo.relays", 0) > 0, counters
+    assert counters.get("net.sim_unreachable", 0) > 0, counters
+    assert counters.get("net.dead_events", 0) > 0, counters
+
+
+def test_topo_anchor_crash_fails_over():
+    """The za anchor is SIGKILLed (sim-crash) mid-run: some survivor in
+    za must observe an anchor transition AWAY from the victim."""
+    za_members = sorted(m for m in ZONES if ZONES[m] == "za")
+    victim = rendezvous_anchor("za", za_members)
+    _, _, anchor_events = run_topo_chaos("topk_rmv", seed=7)
+    failovers = [
+        ev for ev in anchor_events
+        if ev["zone"] == "za" and ev["old"] == victim
+        and ev["new"] != victim and ev["member"] != victim
+    ]
+    assert failovers, (
+        f"no survivor re-elected away from crashed anchor {victim}: "
+        f"{anchor_events}"
+    )
+    # Failover stays inside the zone (rendezvous pools are per-zone).
+    assert all(ZONES[ev["new"]] == "za" for ev in failovers)
+
+
+def test_topo_chaos_deterministic_replay():
+    """Same seed -> identical digests, counters, AND anchor histories:
+    elections are pure functions of the (replayed) membership view."""
+    r1 = run_topo_chaos("topk_rmv", seed=3)
+    r2 = run_topo_chaos("topk_rmv", seed=3)
+    assert r1 == r2
+
+
+def test_topo_matches_full_mesh_digests():
+    """Direct head-to-head on the same op streams: the topo fleet and
+    the classic full-mesh chaos fleet end at the same digest (both equal
+    the reference, compared explicitly for the avoidance of doubt)."""
+    from test_net_chaos import run_chaos
+
+    topo_digests, _, _ = run_topo_chaos("topk_rmv", seed=5)
+    mesh_digests, _ = run_chaos("topk_rmv", seed=5, delta=True)
+    ref = reference_digest("topk_rmv")
+    assert all(d == ref for d in topo_digests.values()), topo_digests
+    assert all(d == ref for d in mesh_digests.values()), mesh_digests

@@ -23,7 +23,7 @@ PY ?= python
 # meaningful.
 COVER_THRESHOLD ?= 88
 
-.PHONY: all compile test cover typecheck xref native bench benchall dryrun net-demo chaos crash-demo obs-demo topo-demo bench-gate clean
+.PHONY: all compile test cover typecheck xref native bench benchall dryrun net-demo chaos crash-demo obs-demo topo-demo spans-demo bench-gate clean
 
 all: compile xref typecheck cover
 
@@ -76,15 +76,20 @@ net-demo:
 # partition + za anchor crash) — whose load-bearing counters (sim
 # faults, delta gossip, SWIM deaths, cross-zone frames, anchor
 # relays/failover) must be nonzero — a refactor that silently stops
-# counting fails here even if convergence stays green. The third leg adds the scrape-under-fault
-# matrix (tcp.send / bridge.read must degrade a live scrape, never hang)
-# and the trace-CLI unit surface; the fourth is the bench regression
-# gate over the committed BENCH_r*.json rounds.
+# counting fails here even if convergence stays green; chaos_gate's
+# third leg does the same for the span plane (all round phases lit,
+# attribution reconciling against round.e2e). The third make leg adds
+# the scrape-under-fault matrix (tcp.send / bridge.read must degrade a
+# live scrape, never hang) and the trace-CLI unit surface; the fourth
+# is the bench regression gate over the committed BENCH_r*.json rounds;
+# the last is the real-process span demo (3 TCP workers, one merged
+# Perfetto timeline, dispatch-gap attribution gated).
 chaos:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py tests/test_wal.py tests/test_fault_matrix.py -q -p no:cacheprovider
 	env JAX_PLATFORMS=cpu $(PY) scripts/chaos_gate.py
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_scrape_faults.py tests/test_trace_cli.py -q -p no:cacheprovider
 	$(PY) scripts/bench_gate.py
+	env JAX_PLATFORMS=cpu $(PY) scripts/spans_demo.py
 
 # Throughput regression gate: best merges_per_sec of the latest
 # BENCH_r*.json round must stay within 20% of the best prior round —
@@ -116,6 +121,15 @@ obs-demo:
 # printed ratio — instead of O(peers).
 topo-demo:
 	env JAX_PLATFORMS=cpu $(PY) scripts/topo_demo.py
+
+# Span-tracing demo (slow, real processes): a 3-worker TCP fleet with
+# the round-phase span plane armed (CCRDT_SPANS=1) — every worker's
+# spans merged onto ONE clock-aligned Perfetto timeline (NTP-style
+# offsets piggybacked on hello/metrics frames), plus the dispatch-gap
+# attribution report, gated on all phases lit and the phase sums
+# reconciling against the measured round.e2e wall time.
+spans-demo:
+	env JAX_PLATFORMS=cpu $(PY) scripts/spans_demo.py
 
 clean:
 	rm -rf native/build

@@ -40,6 +40,7 @@ import numpy as np
 
 from .behaviour import registry
 from ..obs import profile
+from ..obs import spans as obs_spans
 
 _I32_MIN, _I32_MAX = -(2**31 - 1), 2**31 - 1
 
@@ -68,11 +69,19 @@ def _batched_fold(merge, batch: Any):
         half = n // 2
         lhs = jax.tree.map(lambda x: x[:half], batch)
         rhs = jax.tree.map(lambda x: x[half : 2 * half], batch)
-        if profile.ACTIVE:
-            with profile.dispatch("batch_merge.fold", fn=merge, operands=(lhs, rhs)):
+        tok = (
+            obs_spans.begin("round.device_dispatch", site="batch_merge.fold", n=n)
+            if obs_spans.ACTIVE
+            else None
+        )
+        try:
+            if profile.ACTIVE:
+                with profile.dispatch("batch_merge.fold", fn=merge, operands=(lhs, rhs)):
+                    merged = merge(lhs, rhs)
+            else:
                 merged = merge(lhs, rhs)
-        else:
-            merged = merge(lhs, rhs)
+        finally:
+            obs_spans.end(tok)
         if n % 2:
             batch = jax.tree.map(
                 lambda m, t: jnp.concatenate([m, t], axis=0),

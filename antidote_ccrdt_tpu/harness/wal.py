@@ -47,6 +47,7 @@ from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
 
 from ..core import serial
 from ..obs import events as obs_events
+from ..obs import spans as obs_spans
 from ..utils import faults
 from ..utils.metrics import Metrics
 from .checkpoint import load_dense_checkpoint, save_dense_checkpoint
@@ -267,6 +268,17 @@ class ElasticWal:
         visible effect. Returns the appended payload size."""
         from ..parallel.delta import make_delta
 
+        if obs_spans.ACTIVE:
+            # The whole write-ahead cost — delta extraction, encode,
+            # CRC framing, fsync — is one serial round phase.
+            with obs_spans.span("round.wal_append", step=int(step)):
+                delta = make_delta(self.dense, prev_view, view)
+                blob = serial.dumps_dense(f"{self.name}_delta", delta)
+                payload = serial.encode_term(
+                    (int(step), [int(r) for r in owned], blob)
+                )
+                self.log.append(step, payload)
+            return len(payload)
         delta = make_delta(self.dense, prev_view, view)
         blob = serial.dumps_dense(f"{self.name}_delta", delta)
         payload = serial.encode_term((int(step), [int(r) for r in owned], blob))

@@ -33,6 +33,7 @@ import random
 from typing import Dict, List, Optional, Tuple
 
 from ..obs import events as obs_events
+from ..obs import spans as obs_spans
 from ..topo import ZoneMap, ZoneRouter, zone_from_env
 from ..utils.metrics import Metrics
 from .membership import Membership
@@ -48,9 +49,16 @@ class SimNet:
         loss: float = 0.0,
         dup: float = 0.0,
         metrics: Optional[Metrics] = None,
+        link_latency: Optional[
+            Dict[Tuple[str, str], Tuple[float, float]]
+        ] = None,
     ):
         self.rng = random.Random(seed)
         self.latency = latency
+        # Per-DIRECTION latency override {(src, dst): (lo, hi)}: lets a
+        # drill make A->B slow and B->A fast (asymmetric RTT — exactly
+        # the error term of the NTP-style offset estimate in obs/spans).
+        self.link_latency = dict(link_latency or {})
         self.loss = loss
         self.dup = dup
         self.metrics = metrics if metrics is not None else Metrics()
@@ -128,7 +136,7 @@ class SimNet:
         elif self.rng.random() < self.dup:
             self.metrics.count("net.sim_duplicated")
             copies = 2
-        lo, hi = self.latency
+        lo, hi = self.link_latency.get((src, dst), self.latency)
         for _ in range(copies):
             at = self.time + lo + (hi - lo) * self.rng.random()
             self._counter += 1
@@ -168,6 +176,25 @@ class SimTransport:
         )
         self._snaps: Dict[str, bytes] = {}
         self._deltas: Dict[str, Dict[int, bytes]] = {}
+        # Clock model for offset-estimation drills: each member reads
+        # the shared virtual clock through its own constant skew, and
+        # `clock_exchange` runs the same T1/T2/T3 protocol the tcp hello
+        # piggybacks — deterministically, so tests can bound the offset
+        # error by the configured RTT asymmetry.
+        self.clock_skew = 0.0
+        self.clock = obs_spans.ClockSync()
+
+    def local_clock(self) -> float:
+        """This member's view of time: virtual clock + its skew."""
+        return self.net.time + self.clock_skew
+
+    def clock_exchange(self, peer: str) -> None:
+        """Start one NTP-style exchange with `peer`; the estimate lands
+        in `self.clock` when the reply is delivered."""
+        self._check_live()
+        self._send(
+            peer, ("clock_req", self.member, self.local_clock()), False, 0
+        )
 
     def install_router(self, timeout_s: float = 2.0) -> ZoneRouter:
         """Switch from full-mesh to the zone-aware topology, exactly as
@@ -272,10 +299,34 @@ class SimTransport:
         return fresh and seq in window
 
     def _deliver(self, msg: tuple) -> None:
+        if obs_spans.ACTIVE:
+            # Same phase name as the tcp reader thread: frame ingest.
+            with obs_spans.span(
+                "round.gossip_recv", wire=True, fkind=str(msg[0]),
+                sim_member=self.member,
+            ):
+                self._deliver_inner(msg)
+        else:
+            self._deliver_inner(msg)
+
+    def _deliver_inner(self, msg: tuple) -> None:
         kind, src = msg[0], msg[1]
         heard = msg[-1]
         sender = src
-        if kind == "snap":
+        if kind == "clock_req":
+            # Reply with (echoed T1, our clock at receipt): the
+            # requester completes the offset estimate at delivery.
+            t1 = msg[2]
+            self._send(
+                src,
+                ("clock_resp", self.member, t1, self.local_clock()),
+                False,
+                0,
+            )
+        elif kind == "clock_resp":
+            t1, t2 = msg[2], msg[3]
+            self.clock.note(src, t1, t2, self.local_clock())
+        elif kind == "snap":
             blob = msg[2]
             if self._store_snap(src, blob) and (
                 self.zones.zone_of(src) == self.zone

@@ -9,6 +9,16 @@ sender's piggybacked `Membership.heard_ages` map):
     {delta, Member, Seq, Keep, Blob, Heard}
     {ping,  Member, Heard}
     {metrics_req}                      -> {metrics_resp, Member, Text}
+    {metrics_req, T1}                  -> {metrics_resp, Member, Text, T1, T2}
+
+Clock piggyback (obs/spans.py): `{hello}` may carry a 5th element — the
+sender's `time.monotonic()` at send (T1) — and the matching
+`{hello_ack}` then carries (T1, T2) where T2 is the receiver's
+monotonic clock at receipt; likewise `{metrics_req, T1}`. At the reply
+the sender computes the NTP-style estimate ``offset = T2 - (T1+T3)/2``
+(T3 = reply receipt) and feeds `obs.spans.ClockSync`, which is how a
+fleet's span timelines align. Both handlers index tolerantly, so mixed
+old/new fleets interop: short tuples mean "no clock data".
 
 `metrics_req` is the one request/reply pair: a scraper (Prometheus shim,
 `scrape_metrics`, the dashboard) connects, sends the request, and gets
@@ -71,6 +81,7 @@ from ..bridge.protocol import pack_frame, unpack_frames
 from ..core import etf
 from ..core.etf import Atom
 from ..obs import events as obs_events
+from ..obs import spans as obs_spans
 from ..topo import (
     CODEC_RAW,
     CODEC_ZLIB,
@@ -108,7 +119,8 @@ def scrape_metrics(addr: Tuple[str, int], timeout: float = 2.0) -> Tuple[str, st
     worker yields `socket.timeout`/`ConnectionError`, never a hang."""
     deadline = time.monotonic() + timeout
     with socket.create_connection(addr, timeout=timeout) as s:
-        s.sendall(pack_frame((A_METRICS_REQ,)))
+        t1 = time.monotonic()
+        s.sendall(pack_frame((A_METRICS_REQ, t1)))
         buf = bytearray()
         while True:
             s.settimeout(max(0.01, deadline - time.monotonic()))
@@ -118,7 +130,49 @@ def scrape_metrics(addr: Tuple[str, int], timeout: float = 2.0) -> Tuple[str, st
             buf.extend(data)
             for term in unpack_frames(buf):
                 if term[0] == A_METRICS_RESP:
-                    return term[1].decode("utf-8"), term[2].decode("utf-8")
+                    member = term[1].decode("utf-8")
+                    if len(term) >= 5:
+                        # Echoed (T1, T2): a scraper running the span
+                        # plane refines its offset to this worker.
+                        obs_spans.observe_exchange(
+                            member,
+                            float(term[3]),
+                            float(term[4]),
+                            time.monotonic(),
+                        )
+                    return member, term[2].decode("utf-8")
+
+
+def probe_clock(
+    addr: Tuple[str, int], timeout: float = 2.0
+) -> Tuple[str, float, float]:
+    """One NTP-style exchange against a live worker over the in-band
+    `{metrics_req, T1}` frame: returns (member, offset, rtt) where
+    ``offset ~= worker_monotonic - local_monotonic``. Raises like
+    `scrape_metrics` on a dead/legacy worker (a 3-element reply means
+    the peer predates the clock piggyback)."""
+    deadline = time.monotonic() + timeout
+    with socket.create_connection(addr, timeout=timeout) as s:
+        t1 = time.monotonic()
+        s.sendall(pack_frame((A_METRICS_REQ, t1)))
+        buf = bytearray()
+        while True:
+            s.settimeout(max(0.01, deadline - time.monotonic()))
+            data = s.recv(1 << 16)
+            if not data:
+                raise ConnectionError("probe connection closed before reply")
+            buf.extend(data)
+            for term in unpack_frames(buf):
+                if term[0] == A_METRICS_RESP:
+                    if len(term) < 5:
+                        raise ConnectionError(
+                            "peer replied without clock echo (legacy build)"
+                        )
+                    t3 = time.monotonic()
+                    t2 = float(term[4])
+                    member = term[1].decode("utf-8")
+                    obs_spans.observe_exchange(member, t1, t2, t3)
+                    return member, t2 - (t1 + t3) / 2.0, t3 - t1
 
 
 class _PeerLink:
@@ -251,30 +305,46 @@ class _PeerLink:
                     if self._stop:
                         return
                 continue
-            frame = build()
-            dropped = False
+            # Wire-time span on the SENDER thread: attribution counts it
+            # as overlappable — the worker round never waited for it.
+            tok = (
+                obs_spans.begin(
+                    "round.gossip_send", wire=True, peer=self.name,
+                    fkind=kind,
+                    **{k: meta[k] for k in ("origin", "dseq") if k in meta},
+                )
+                if obs_spans.ACTIVE
+                else None
+            )
             try:
-                # Fault point `tcp.send`: raise = connection reset mid-send
-                # (exercises the reconnect/backoff path exactly like a real
-                # ECONNRESET); drop = frame lost on the wire (the queue
-                # treats it as sent — receivers resync via anchors).
-                if faults.ACTIVE and faults.fire("tcp.send") == "drop":
-                    dropped = True
-                    self.metrics.count("net.fault_drops")
-                else:
-                    self._sock.sendall(frame)
-            except OSError:
-                # close() may have nulled _sock concurrently (it owns the
-                # socket teardown); swap-then-close so both orders are safe.
-                s, self._sock = self._sock, None
-                if s is not None:
-                    try:
-                        s.close()
-                    except OSError:
-                        pass
-                self._attempts += 1
-                self.metrics.count("net.retries")
-                continue  # same frame retries after reconnect
+                frame = build()
+                dropped = False
+                try:
+                    # Fault point `tcp.send`: raise = connection reset
+                    # mid-send (exercises the reconnect/backoff path
+                    # exactly like a real ECONNRESET); drop = frame lost
+                    # on the wire (the queue treats it as sent —
+                    # receivers resync via anchors).
+                    if faults.ACTIVE and faults.fire("tcp.send") == "drop":
+                        dropped = True
+                        self.metrics.count("net.fault_drops")
+                    else:
+                        self._sock.sendall(frame)
+                except OSError:
+                    # close() may have nulled _sock concurrently (it owns
+                    # the socket teardown); swap-then-close so both
+                    # orders are safe.
+                    s, self._sock = self._sock, None
+                    if s is not None:
+                        try:
+                            s.close()
+                        except OSError:
+                            pass
+                    self._attempts += 1
+                    self.metrics.count("net.retries")
+                    continue  # same frame retries after reconnect
+            finally:
+                obs_spans.end(tok)
             with self._cv:
                 # Sent: drop it (the queue head may have been reshuffled
                 # by the snap-replacement policy; remove by identity).
@@ -410,12 +480,14 @@ class TcpTransport:
         Timeout/EOF/garbage all mean "legacy peer": frames to this link
         stay bare ETF. The ack also teaches us the peer's zone."""
         try:
+            t1 = time.monotonic()
             sock.sendall(
                 pack_frame((
                     A_HELLO,
                     self.member.encode("utf-8"),
                     self.zone.encode("utf-8"),
                     [CODEC_RAW, CODEC_ZLIB],
+                    t1,  # clock piggyback; old peers ignore the extra slot
                 ))
             )
             self.metrics.count("net.hellos")
@@ -432,10 +504,18 @@ class TcpTransport:
                 buf.extend(data)
                 for term in unpack_coded_frames(buf):
                     if term[0] == A_HELLO_ACK:
-                        _, mb, zb, codec = term
-                        self.zones.learn(
-                            mb.decode("utf-8"), zb.decode("utf-8")
-                        )
+                        # Index tolerantly: a legacy ack is 4 elements, a
+                        # clock-bearing one appends (T1, T2).
+                        mb, zb, codec = term[1], term[2], term[3]
+                        peer = mb.decode("utf-8")
+                        self.zones.learn(peer, zb.decode("utf-8"))
+                        if len(term) >= 6:
+                            obs_spans.observe_exchange(
+                                peer,
+                                float(term[4]),
+                                float(term[5]),
+                                time.monotonic(),
+                            )
                         self.metrics.count("net.hello_acks")
                         return int(codec)
         except (OSError, ValueError):
@@ -543,7 +623,16 @@ class TcpTransport:
                 buf.extend(data)
                 self.metrics.count("net.bytes_recv", len(data))
                 for term in unpack_coded_frames(buf):
-                    self._handle(term, conn)
+                    if obs_spans.ACTIVE:
+                        # Reader-thread span: frame decode + cache write
+                        # (overlappable — the round never blocks on it).
+                        with obs_spans.span(
+                            "round.gossip_recv", wire=True,
+                            fkind=str(term[0]) if term else "?",
+                        ):
+                            self._handle(term, conn)
+                    else:
+                        self._handle(term, conn)
         except (OSError, ValueError):
             return
         finally:
@@ -588,26 +677,34 @@ class TcpTransport:
         if tag == A_METRICS_REQ:
             # In-band scrape: reply on the inbound connection and return
             # WITHOUT touching membership — the scraper is not a member.
+            # A 2-element request carries the scraper's T1 (clock
+            # piggyback); echo it with our T2 so the scraper can align.
             if conn is not None:
-                self._send_metrics_resp(conn)
+                t1 = term[1] if len(term) > 1 else None
+                self._send_metrics_resp(conn, t1=t1)
             return
         if tag == A_HELLO:
             # Link setup from a topo-aware peer: learn its zone, answer
             # with ours and the best codec we can decode of its offer.
-            _, mb, zb, codecs = term
+            # Tolerant indexing: element 5 (T1) arrived with the clock
+            # piggyback; older peers send 4 elements, and a hard unpack
+            # here would close the whole read connection on mismatch.
+            mb, zb, codecs = term[1], term[2], term[3]
+            t1 = term[4] if len(term) > 4 else None
             m = mb.decode("utf-8")
             self.zones.learn(m, zb.decode("utf-8"))
             chosen = CODEC_ZLIB if CODEC_ZLIB in list(codecs) else CODEC_RAW
             if conn is not None:
+                ack = [
+                    A_HELLO_ACK,
+                    self.member.encode("utf-8"),
+                    self.zone.encode("utf-8"),
+                    chosen,
+                ]
+                if t1 is not None:
+                    ack.extend([float(t1), time.monotonic()])
                 try:
-                    conn.sendall(
-                        pack_frame((
-                            A_HELLO_ACK,
-                            self.member.encode("utf-8"),
-                            self.zone.encode("utf-8"),
-                            chosen,
-                        ))
-                    )
+                    conn.sendall(pack_frame(tuple(ack)))
                 except OSError:
                     pass
             self.membership.observe(m)
@@ -750,21 +847,25 @@ class TcpTransport:
             **trace,
         )
 
-    def _send_metrics_resp(self, conn: socket.socket) -> None:
+    def _send_metrics_resp(self, conn: socket.socket, t1=None) -> None:
         """Answer one `{metrics_req}`: render a snapshot (never the live
         dicts) and write it back. Degrade-never-hang: the `tcp.send`
         fault point (drop or raised reset) and any real socket error
         close the connection, so the scraper sees EOF/error within its
-        own timeout while the registry stays intact."""
+        own timeout while the registry stays intact. When the request
+        carried T1, the reply appends (T1, T2) for the clock piggyback."""
         from ..obs import export as obs_export
 
         self.metrics.count("net.scrapes")
         text = obs_export.prometheus_text(
             self.metrics, labels={"member": self.member}
         )
-        frame = pack_frame(
-            (A_METRICS_RESP, self.member.encode("utf-8"), text.encode("utf-8"))
-        )
+        resp = [
+            A_METRICS_RESP, self.member.encode("utf-8"), text.encode("utf-8"),
+        ]
+        if t1 is not None:
+            resp.extend([float(t1), time.monotonic()])
+        frame = pack_frame(tuple(resp))
         try:
             if faults.ACTIVE and faults.fire("tcp.send") == "drop":
                 self.metrics.count("net.fault_drops")

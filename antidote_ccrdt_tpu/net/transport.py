@@ -32,6 +32,7 @@ import time
 from typing import Any, List, Optional, Protocol, Tuple, runtime_checkable
 
 from ..obs import events as obs_events
+from ..obs import spans as obs_spans
 from ..utils import faults
 from ..utils.metrics import Metrics
 
@@ -302,7 +303,15 @@ class GossipNode:
         obs_events.emit(
             "snap.publish", origin=self.member, step=step, bytes=len(blob)
         )
-        self.transport.publish(blob)
+        if obs_spans.ACTIVE:
+            # Handing the blob to the medium (fs write / tcp enqueue /
+            # sim heap push) — the host cost of putting it in flight.
+            with obs_spans.span(
+                "round.gossip_send", kind="snap", step=step, bytes=len(blob)
+            ):
+                self.transport.publish(blob)
+        else:
+            self.transport.publish(blob)
 
     def fetch(
         self, member: str, like: Any, dense: Any = None
@@ -311,20 +320,28 @@ class GossipNode:
         or validation failure reads as None — see class docstring."""
         from ..core import serial
 
-        blob = self.transport.fetch(member)
-        if blob is None:
-            return None
+        tok = (
+            obs_spans.begin("round.gossip_recv", kind="snap", origin=member)
+            if obs_spans.ACTIVE
+            else None
+        )
         try:
-            (step,) = struct.unpack("<Q", blob[:8])
-            _name, state = serial.loads_dense(blob[8:], like)
-            if dense is not None:
-                from ..utils.validate import check_state
+            blob = self.transport.fetch(member)
+            if blob is None:
+                return None
+            try:
+                (step,) = struct.unpack("<Q", blob[:8])
+                _name, state = serial.loads_dense(blob[8:], like)
+                if dense is not None:
+                    from ..utils.validate import check_state
 
-                check_state(dense, state)
-        except Exception:  # noqa: BLE001 — deliberately total, see docstring
-            return None
-        self.metrics.count("net.snap_fetches")
-        return step, state
+                    check_state(dense, state)
+            except Exception:  # noqa: BLE001 — deliberately total, see docstring
+                return None
+            self.metrics.count("net.snap_fetches")
+            return step, state
+        finally:
+            obs_spans.end(tok)
 
     def snapshot_seq(self, member: str) -> Optional[int]:
         """Seq/step of `member`'s snapshot from its 8-byte header —
@@ -353,7 +370,14 @@ class GossipNode:
             dseq=seq,
             bytes=len(delta_blob),
         )
-        self.transport.publish_delta(seq, delta_blob, keep=keep)
+        if obs_spans.ACTIVE:
+            with obs_spans.span(
+                "round.gossip_send", kind="delta", origin=self.member,
+                dseq=seq, bytes=len(delta_blob),
+            ):
+                self.transport.publish_delta(seq, delta_blob, keep=keep)
+        else:
+            self.transport.publish_delta(seq, delta_blob, keep=keep)
 
     def fetch_delta(
         self, member: str, seq: int, like_delta: Any, validate=None
@@ -365,18 +389,28 @@ class GossipNode:
         range downstream."""
         from ..core import serial
 
-        blob = self.transport.fetch_delta(member, seq)
-        if blob is None:
-            return None
+        tok = (
+            obs_spans.begin(
+                "round.gossip_recv", kind="delta", origin=member, dseq=seq
+            )
+            if obs_spans.ACTIVE
+            else None
+        )
         try:
-            _name, delta = serial.loads_dense(blob, like_delta)
-            if validate is not None and not validate(delta):
+            blob = self.transport.fetch_delta(member, seq)
+            if blob is None:
                 return None
-        except Exception:  # noqa: BLE001 — see fetch
-            return None
-        self.metrics.count("net.delta_fetches")
-        obs_events.emit("delta.fetch", origin=member, dseq=seq)
-        return delta
+            try:
+                _name, delta = serial.loads_dense(blob, like_delta)
+                if validate is not None and not validate(delta):
+                    return None
+            except Exception:  # noqa: BLE001 — see fetch
+                return None
+            self.metrics.count("net.delta_fetches")
+            obs_events.emit("delta.fetch", origin=member, dseq=seq)
+            return delta
+        finally:
+            obs_spans.end(tok)
 
     def delta_seqs(self, member: str) -> List[int]:
         return self.transport.delta_seqs(member)

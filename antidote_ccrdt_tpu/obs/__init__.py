@@ -16,13 +16,18 @@ Five layers, threaded through every runtime subsystem:
 * `obs.profile` — XLA hot-path profiler for the batched update/merge
   dispatch (wall time, jit compile/execute split, transfer bytes),
   ACTIVE-gated to zero cost when off (``CCRDT_PROFILE``).
+* `obs.spans` — round-phase span tracer (``CCRDT_SPANS``): begin/end
+  monotonic spans over the nine worker-round phases, NTP-style per-peer
+  clock offsets for fleet-wide timeline alignment, Perfetto/Chrome
+  trace-event export, and dispatch-gap attribution.
 
-`obs.events` stays stdlib-only so transports, WAL, bridge, and the
-fault registry can import it without cycles; the other modules may
-import package code and are pulled in lazily by the layers that need
-them.
+`obs.events` and `obs.spans` stay stdlib-only so transports, WAL,
+bridge, and the fault registry can import them without cycles; the
+other modules may import package code and are pulled in lazily by the
+layers that need them.
 """
 
 from . import events  # noqa: F401  (stdlib-only, safe for all importers)
+from . import spans  # noqa: F401  (stdlib-only, safe for all importers)
 
-__all__ = ["events", "lag", "export", "http", "profile"]
+__all__ = ["events", "lag", "export", "http", "profile", "spans"]

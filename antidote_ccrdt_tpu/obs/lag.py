@@ -16,7 +16,13 @@ operator cares about:
   not yet merged;
 * **seconds**: age of the oldest unapplied seq, measured from when WE
   first saw it published (single-clock, so cross-host clock skew cannot
-  manufacture lag).
+  manufacture lag);
+* **staleness**: seconds since the peer last showed ANY progress
+  evidence (a new published watermark or an apply) on OUR monotonic
+  clock. Lag can read zero while a peer is silently wedged — caught up,
+  then stopped publishing; staleness is the signal that catches that,
+  and it is monotonic-clock-based so a wall-clock step (NTP slew,
+  manual reset) cannot fake or hide a stall.
 
 Peer death mid-window is explicit: `drop(peer)` freezes-and-forgets a
 DEAD peer so its stale watermark stops inflating fleet lag (SWIM's DEAD
@@ -42,14 +48,23 @@ class LagTracker:
     Not thread-safe by design: it is fed from the single sweep loop of
     one worker (the same thread that owns the delta cursors)."""
 
-    def __init__(self, member: str, clock: Callable[[], float] = time.time):
+    def __init__(
+        self,
+        member: str,
+        clock: Callable[[], float] = time.time,
+        mono: Callable[[], float] = time.monotonic,
+    ):
         self.member = member
         self._clock = clock
+        self._mono = mono  # staleness clock; injectable for tests
         self._published: Dict[str, int] = {}   # peer -> highest seq seen shipped
         self._applied: Dict[str, int] = {}     # peer -> highest seq merged here
         # peer -> {seq: first-seen t} for seqs published but not yet applied;
         # bounded: entries leave as soon as the applied cursor passes them.
         self._pending: Dict[str, Dict[int, float]] = {}
+        # peer -> monotonic stamp of the last progress evidence (publish
+        # watermark advance or apply) — the staleness baseline.
+        self._last_update: Dict[str, float] = {}
 
     # -- feeding ------------------------------------------------------------
 
@@ -63,6 +78,7 @@ class LagTracker:
         if seq <= old:
             return
         self._published[peer] = seq
+        self._last_update[peer] = self._mono()
         pend = self._pending.setdefault(peer, {})
         now = self._clock()
         lo = max(old, self._applied.get(peer, -1))
@@ -78,6 +94,7 @@ class LagTracker:
         if seq <= old:
             return
         self._applied[peer] = seq
+        self._last_update[peer] = self._mono()
         # published can never trail applied (an applied delta was shipped)
         if seq > self._published.get(peer, -1):
             self._published[peer] = seq
@@ -92,6 +109,7 @@ class LagTracker:
         self._published.pop(peer, None)
         self._applied.pop(peer, None)
         self._pending.pop(peer, None)
+        self._last_update.pop(peer, None)
 
     # -- reporting ----------------------------------------------------------
 
@@ -102,6 +120,17 @@ class LagTracker:
         secs = (self._clock() - min(pend.values())) if pend else 0.0
         return ops, max(0.0, secs)
 
+    def staleness(self, peer: str) -> float:
+        """Seconds since `peer` last showed progress evidence (watermark
+        advance or apply), on this process's monotonic clock. A peer that
+        is caught up but has gone silent reads increasingly stale here
+        while its lag reads zero — the wedged-peer signal. 0.0 for a
+        peer never observed."""
+        stamp = self._last_update.get(peer)
+        if stamp is None:
+            return 0.0
+        return max(0.0, self._mono() - stamp)
+
     def report(self) -> Dict[str, Dict[str, Any]]:
         out: Dict[str, Dict[str, Any]] = {}
         for peer in sorted(self._published):
@@ -111,22 +140,29 @@ class LagTracker:
                 "applied": self._applied.get(peer, -1),
                 "lag_ops": ops,
                 "lag_s": round(secs, 6),
+                "staleness_s": round(self.staleness(peer), 6),
             }
         return out
 
     def export_to(self, metrics: Any) -> None:
         """Mirror the current lag view into `Metrics` gauges so the
         Prometheus exporter picks it up: ``lag.<peer>.ops`` /
-        ``lag.<peer>.seconds`` plus fleet maxima."""
+        ``lag.<peer>.seconds`` / ``lag.<peer>.staleness_seconds`` plus
+        fleet maxima."""
         rep = self.report()
-        worst_ops, worst_s = 0, 0.0
+        worst_ops, worst_s, worst_stale = 0, 0.0, 0.0
         for peer, r in rep.items():
             metrics.set(f"lag.{peer}.ops", float(r["lag_ops"]))
             metrics.set(f"lag.{peer}.seconds", float(r["lag_s"]))
+            metrics.set(
+                f"lag.{peer}.staleness_seconds", float(r["staleness_s"])
+            )
             worst_ops = max(worst_ops, r["lag_ops"])
             worst_s = max(worst_s, r["lag_s"])
+            worst_stale = max(worst_stale, r["staleness_s"])
         metrics.set("lag.max_ops", float(worst_ops))
         metrics.set("lag.max_seconds", float(worst_s))
+        metrics.set("lag.max_staleness_seconds", float(worst_stale))
 
 
 # -- fleet digest agreement --------------------------------------------------

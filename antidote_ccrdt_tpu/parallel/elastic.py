@@ -47,6 +47,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ..net.transport import FsTransport, GossipNode
 from ..obs import events as obs_events
 from ..obs import profile
+from ..obs import spans as obs_spans
 from ..utils.metrics import Metrics
 from .delta import empty_delta  # noqa: F401 — part of this module's API
 
@@ -132,11 +133,26 @@ class DeltaPublisher:
             full_every = self.lag_full_every
             self.store.metrics.count("net.lag_anchor_cuts")
         if self._prev is None or self.seq % full_every == 0:
-            self.store.publish(self.name, state, self.seq)
+            if obs_spans.ACTIVE:
+                # Full-snapshot anchor: serialize + hand to the medium.
+                with obs_spans.span("round.snapshot", seq=self.seq):
+                    self.store.publish(self.name, state, self.seq)
+            else:
+                self.store.publish(self.name, state, self.seq)
             kind, nbytes = "full", -1
         else:
-            delta = make_delta(self.dense, self._prev, state)
-            blob = self._serial.dumps_dense(f"{self.name}_delta", delta)
+            if obs_spans.ACTIVE:
+                with obs_spans.span(
+                    "round.delta_encode", origin=self.store.member,
+                    dseq=self.seq,
+                ):
+                    delta = make_delta(self.dense, self._prev, state)
+                    blob = self._serial.dumps_dense(
+                        f"{self.name}_delta", delta
+                    )
+            else:
+                delta = make_delta(self.dense, self._prev, state)
+                blob = self._serial.dumps_dense(f"{self.name}_delta", delta)
             self.store.publish_delta(blob, self.seq, keep=self.keep)
             kind, nbytes = "delta", len(blob)
         self._prev = state
@@ -171,11 +187,21 @@ def sweep_deltas(
             # but-malformed delta that slips past delta_in_bounds must not
             # crash the gossip loop — break the chain and resync next sweep.
             try:
-                if profile.ACTIVE:
-                    with profile.dispatch("elastic.delta_apply", operands=(delta,)):
+                tok = (
+                    obs_spans.begin(
+                        "round.delta_apply", origin=member, dseq=cur + 1
+                    )
+                    if obs_spans.ACTIVE
+                    else None
+                )
+                try:
+                    if profile.ACTIVE:
+                        with profile.dispatch("elastic.delta_apply", operands=(delta,)):
+                            state = apply_any_delta(dense, state, delta)
+                    else:
                         state = apply_any_delta(dense, state, delta)
-                else:
-                    state = apply_any_delta(dense, state, delta)
+                finally:
+                    obs_spans.end(tok)
             except Exception:  # noqa: BLE001 — deliberately total
                 stats["skipped"] += 1
                 break
@@ -199,13 +225,24 @@ def sweep_deltas(
             else:
                 _seq, peer = got
                 try:
-                    if profile.ACTIVE:
-                        with profile.dispatch(
-                            "elastic.snap_merge", fn=dense.merge, operands=(peer,)
-                        ):
+                    tok = (
+                        obs_spans.begin(
+                            "round.delta_apply", origin=m, step=_seq,
+                            via="snap",
+                        )
+                        if obs_spans.ACTIVE
+                        else None
+                    )
+                    try:
+                        if profile.ACTIVE:
+                            with profile.dispatch(
+                                "elastic.snap_merge", fn=dense.merge, operands=(peer,)
+                            ):
+                                state = dense.merge(state, peer)
+                        else:
                             state = dense.merge(state, peer)
-                    else:
-                        state = dense.merge(state, peer)
+                    finally:
+                        obs_spans.end(tok)
                 except Exception:  # noqa: BLE001 — deliberately total
                     stats["skipped"] += 1
                 else:
@@ -270,12 +307,20 @@ def sweep(store: GossipNode, dense: Any, state: Any) -> Tuple[Any, int]:
         if got is None:
             continue
         _step, peer = got
-        if profile.ACTIVE:
-            with profile.dispatch(
-                "elastic.sweep_merge", fn=dense.merge, operands=(peer,)
-            ):
+        tok = (
+            obs_spans.begin("round.delta_apply", origin=m, step=_step, via="sweep")
+            if obs_spans.ACTIVE
+            else None
+        )
+        try:
+            if profile.ACTIVE:
+                with profile.dispatch(
+                    "elastic.sweep_merge", fn=dense.merge, operands=(peer,)
+                ):
+                    state = dense.merge(state, peer)
+            else:
                 state = dense.merge(state, peer)
-        else:
-            state = dense.merge(state, peer)
+        finally:
+            obs_spans.end(tok)
         n += 1
     return state, n

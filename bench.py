@@ -598,6 +598,97 @@ def bench_scalar_baseline(R, I, D_DCS, K, n_ops):
     return n_ops / dt
 
 
+def bench_round_phases(R, I, D_DCS, K, M, B, Br, rounds=6):
+    """Round-phase span drill (obs/spans.py): run a real two-member
+    gossip round loop — apply + device sync + WAL append + delta publish
+    + peer sweep + lag update — at the operating point with the span
+    plane armed, then attribute each round's wall time to phases.
+
+    This is where the dispatch-gap question gets a number: the summary's
+    e2e round latency says how long a round takes; this block says which
+    phase owns that time, how much is serial host work vs overlappable
+    I/O, and how much no span accounts for (the gap). chaos_gate.py runs
+    the same drill tiny and fails if any load-bearing phase goes dark.
+    """
+    import tempfile
+
+    import jax
+
+    from antidote_ccrdt_tpu.core.behaviour import registry
+    from antidote_ccrdt_tpu.harness.opgen import TopkRmvEffectGen, Workload
+    from antidote_ccrdt_tpu.harness.wal import ElasticWal
+    from antidote_ccrdt_tpu.obs import lag as obs_lag
+    from antidote_ccrdt_tpu.obs import spans
+    from antidote_ccrdt_tpu.parallel.elastic import (
+        DeltaPublisher,
+        GossipStore,
+        sweep_deltas,
+    )
+
+    D = registry.make_dense(
+        "topk_rmv", n_ids=I, n_dcs=D_DCS, size=K, slots_per_id=M
+    )
+    gen = TopkRmvEffectGen(
+        Workload(n_replicas=R, n_ids=I, zipf_a=1.2, score_max=100_000, seed=23)
+    )
+    batches = [gen.next_batch(B, Br) for _ in range(rounds + 1)]
+
+    @jax.jit
+    def run_one(state, ops):
+        st2, _ = D.apply_ops(state, ops, collect_dominated=False)
+        return st2
+
+    state = D.init(n_replicas=R, n_keys=1)
+    state = run_one(state, batches[0])  # compile outside the spanned rounds
+    _sync(state)
+
+    with tempfile.TemporaryDirectory(prefix="ccrdt_spanbench_") as root:
+        with spans.installed("bench0"):
+            node = GossipStore(root, "bench0")
+            peer = GossipStore(root, "bench1")
+            wal = ElasticWal(root, "bench0", D, "topk_rmv")
+            pub = DeltaPublisher(node, D, name="topk_rmv")
+            tracker = obs_lag.LagTracker("bench1")
+            peer_state = D.init(n_replicas=R, n_keys=1)
+            cursors = {}
+            owned = list(range(R))
+            for r in range(rounds):
+                e2e = spans.begin("round.e2e", step=r)
+                prev = state
+                with spans.span(
+                    "round.device_dispatch", site="bench.apply_ops", n=B + Br
+                ):
+                    state = run_one(state, batches[1 + r])
+                with spans.span("round.device_sync", step=r):
+                    _sync(state)
+                wal.log_step(r, owned, prev, state)
+                pub.publish(state)
+                peer_state, _stats = sweep_deltas(peer, D, peer_state, cursors)
+                with spans.span("round.lag_update"):
+                    tracker.observe_published("bench0", pub.seq)
+                    tracker.observe_applied(
+                        "bench0", cursors.get("bench0", -1)
+                    )
+                    tracker.export_to(node.metrics)
+                spans.end(e2e)
+            wal.close()
+            recs = spans.drain()
+    att = spans.attribute({"bench0": recs})
+    fleet = att["fleet"]
+    return {
+        "rounds": fleet["rounds"],
+        "e2e_ms_p50": round(fleet["e2e_ms_p50"], 3),
+        "serial_ms_p50": round(fleet["serial_ms_p50"], 3),
+        "overlap_ms_p50": round(fleet["overlap_ms_p50"], 3),
+        "dispatch_gap_ms_p50": round(fleet["gap_ms_p50"], 3),
+        "span_coverage_p50": round(fleet["coverage_p50"], 4),
+        "phases_ms_total": {
+            n: round(v, 3) for n, v in fleet["phases_ms_total"].items()
+        },
+        "critical_path": fleet["critical_path"],
+    }
+
+
 def main():
     import jax
 
@@ -678,6 +769,11 @@ def main():
         ),
     }
     baseline_rate = bench_scalar_baseline(R, I, D_DCS, K, base_ops)
+    round_phases = bench_round_phases(
+        R, I, D_DCS, K, M, B, Br,
+        rounds=3 if (backend == "cpu" or os.environ.get("CCRDT_BENCH_TINY"))
+        else 6,
+    )
 
     # The driver records only the TAIL of stdout (<=2,000 chars) as
     # BENCH_r{N}.json and parses the LAST line; round 4's single fat line
@@ -698,6 +794,11 @@ def main():
         "merges_per_sec_with_extras": round(extras_rate),
         "merges_per_sec_with_extras_op_aligned": round(extras_ops_rate),
         "curve": {"points": curve, "operating_point": chosen},
+        # Per-phase buckets from the spanned gossip round drill
+        # (bench_round_phases): where a full round's wall time goes, and
+        # the dispatch gap no phase owns. The summary line carries only
+        # the two headline numbers (gap p50 + coverage).
+        "round_phases": round_phases,
         "dispatch_overhead_ms_p50": round(dispatch_overhead_ms, 2),
         "batch_per_replica_round": f"{B} adds + {Br} rmvs",
         "backend": backend,
@@ -729,6 +830,8 @@ def main():
         "operating_point_batch_adds": B,
         "replica_state_merges_per_sec": round(state_merge_rate, 1),
         "baseline_cpu_merges_per_sec": round(baseline_rate),
+        "dispatch_gap_ms_p50": round_phases["dispatch_gap_ms_p50"],
+        "span_coverage_p50": round_phases["span_coverage_p50"],
         "backend": backend,
         "details_file": "benchmarks/bench_details.json" if sidecar else "stdout",
     }

@@ -109,6 +109,62 @@ def load_topo_rounds(bench_dir: str) -> List[Tuple[int, str, Dict]]:
     return out
 
 
+_GAP_RE = re.compile(r'"dispatch_gap_ms_p50":\s*([0-9][0-9_.eE+-]*)')
+_COV_RE = re.compile(r'"span_coverage_p50":\s*([0-9][0-9_.eE+-]*)')
+
+
+def load_attribution_rounds(
+    bench_dir: str,
+) -> List[Tuple[int, str, float, float]]:
+    """[(round_no, path, dispatch_gap_ms_p50, span_coverage_p50)] for
+    every BENCH round whose summary line carries the span-attribution
+    headline (bench.bench_round_phases, r6+). Report-only, like the topo
+    rows: the drift that matters here is ATTRIBUTION drift — coverage
+    sliding down means spans stopped explaining where round time goes,
+    gap sliding up means unowned host time is growing — and both deserve
+    eyes before they deserve a hard gate."""
+    out: List[Tuple[int, str, float, float]] = []
+    for p in sorted(
+        glob.glob(os.path.join(bench_dir, "BENCH_r*.json")), key=round_number
+    ):
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        tail = str(doc.get("tail", ""))
+        gaps = _GAP_RE.findall(tail)
+        covs = _COV_RE.findall(tail)
+        if gaps and covs:
+            out.append(
+                (round_number(p), p, float(gaps[-1]), float(covs[-1]))
+            )
+    return out
+
+
+def attribution_drift(
+    rounds: List[Tuple[int, str, float, float]]
+) -> List[str]:
+    """Human drift report across attribution-bearing rounds (empty with
+    fewer than one such round)."""
+    lines: List[str] = []
+    prev: Optional[Tuple[int, float, float]] = None
+    for n, p, gap, cov in rounds:
+        note = ""
+        if prev is not None:
+            pn, pgap, pcov = prev
+            note = (
+                f"  (vs r{pn:02d}: gap {gap - pgap:+.2f}ms, "
+                f"coverage {cov - pcov:+.1%})"
+            )
+        lines.append(
+            f"  spans r{n:02d} {os.path.basename(p)}: dispatch gap "
+            f"{gap:.2f}ms p50, coverage {cov:.1%}{note}"
+        )
+        prev = (n, gap, cov)
+    return lines
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description="fail on >tolerance regression of merges_per_sec "
@@ -132,6 +188,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"{cz.get('frames', 0):,.0f} frames "
             f"(vs mesh ratio {cz.get('ratio', float('nan')):.2f})"
         )
+    for line in attribution_drift(load_attribution_rounds(args.bench_dir)):
+        print(line)
     code, verdict = evaluate(rounds, args.tolerance)
     print(verdict)
     return code

@@ -16,9 +16,19 @@ questions an operator actually asks of a ``CCRDT_OBS_DIR`` full of
     # Deltas whose propagation took >= factor x the fleet median.
     python scripts/ccrdt_trace.py stragglers /path/to/obs-dir --factor 3
 
+    # Causal-order audit: per (process incarnation, origin), delta.apply
+    # dseqs must advance contiguously from the first-seen baseline, with
+    # snap.apply the only legitimate jump. A gap-skip or double-apply
+    # here means the sweep cursor machinery broke.
+    python scripts/ccrdt_trace.py audit /path/to/obs-dir
+
+`summary` and `stragglers` take ``--json`` for machine-readable output
+(the obs-demo and tests consume it).
+
 Exit codes: 0 on success; `summary --require-complete` exits 1 when no
 delta shows a complete publish->apply path (the obs-demo smoke gate);
-`path` exits 1 when the requested delta left no events.
+`path` exits 1 when the requested delta left no events; `audit` exits 1
+on any ordering violation.
 
 All timestamps are the emitting process's wall clock (`time.time()`),
 so cross-host latencies inherit clock skew — on one box (the drills)
@@ -28,6 +38,7 @@ they are exact; across hosts read them as approximate.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 from typing import Any, Dict, List, Optional, Tuple
@@ -172,6 +183,70 @@ def find_stragglers(
     return med, [r for r in rows if r["latency_ms"] >= factor * med]
 
 
+def audit_apply_order(
+    logs: Dict[str, List[Dict[str, Any]]]
+) -> List[Dict[str, Any]]:
+    """Causal-order violations in the apply streams, one row each.
+
+    Within ONE flight log (= one process incarnation) the `delta.apply`
+    events for a given origin must carry contiguous ascending dseqs:
+    `sweep_deltas` only emits the event after advancing its cursor by
+    exactly one, and a `snap.apply` at step S is the only legitimate
+    jump (the cursor resumes from max(cur, S)). The baseline is the
+    FIRST dseq seen in the log, not 0 — the ring truncates and a worker
+    may join mid-stream, so absolute position proves nothing; ordering
+    within the log does. Events replay in the recorder's own `seq`
+    order (per-process lamport axis), so wall-clock skew cannot
+    manufacture violations. A `gap-skip` (dseq jumped past cur+1 with no
+    snapshot) means ops were silently lost; a `double-apply` (dseq at or
+    below the cursor) means the cursor went backwards. Different
+    incarnations of the same member audit independently: recovery
+    legitimately re-applies."""
+    violations: List[Dict[str, Any]] = []
+    for fname, evs in sorted(logs.items()):
+        applier = next(
+            (str(e["member"]) for e in evs if e.get("member")), fname
+        )
+        ordered = sorted(
+            (
+                e for e in evs
+                if e.get("kind") in ("delta.apply", "snap.apply")
+                and e.get("origin") is not None
+            ),
+            key=lambda e: int(e.get("seq", 0)),
+        )
+        cur: Dict[str, int] = {}
+        for ev in ordered:
+            origin = str(ev["origin"])
+            if ev["kind"] == "snap.apply":
+                s = ev.get("step")
+                if s is not None:
+                    prev = cur.get(origin)
+                    cur[origin] = int(s) if prev is None else max(prev, int(s))
+                continue
+            d = ev.get("dseq")
+            if d is None:
+                continue
+            d = int(d)
+            prev = cur.get(origin)
+            if prev is None or d == prev + 1:
+                cur[origin] = d
+                continue
+            violations.append(
+                {
+                    "log": fname,
+                    "applier": applier,
+                    "origin": origin,
+                    "kind": "double-apply" if d <= prev else "gap-skip",
+                    "prev_dseq": prev,
+                    "dseq": d,
+                    "seq": int(ev.get("seq", -1)),
+                }
+            )
+            cur[origin] = max(prev, d)
+    return violations
+
+
 # -- rendering ---------------------------------------------------------------
 
 
@@ -182,11 +257,28 @@ def _fmt_ms(v: Optional[float]) -> str:
 def cmd_summary(args: argparse.Namespace) -> int:
     paths = load_paths(args.obs_dir)
     if not paths:
-        print(f"no delta trace events under {args.obs_dir}")
+        if args.json:
+            print(json.dumps({"deltas_traced": 0, "complete_paths": 0}))
+        else:
+            print(f"no delta trace events under {args.obs_dir}")
         return 1 if args.require_complete else 0
     complete = sorted(k for k, st in paths.items() if is_complete(st))
     rows = apply_latencies(paths)
     lost = never_applied(paths)
+    if args.json:
+        doc = {
+            "deltas_traced": len(paths),
+            "complete_paths": len(complete),
+            "apply_samples": len(rows),
+            "never_applied": [[o, d] for o, d in lost],
+            "pairs": {
+                f"{o}->{a}": s for (o, a), s in pair_stats(rows).items()
+            },
+        }
+        print(json.dumps(doc))
+        if args.require_complete and not complete:
+            return 1
+        return 0
     print(f"deltas traced   : {len(paths)}")
     print(f"complete paths  : {len(complete)} (publish -> apply)")
     print(f"apply samples   : {len(rows)}")
@@ -249,6 +341,16 @@ def cmd_path(args: argparse.Namespace) -> int:
 def cmd_stragglers(args: argparse.Namespace) -> int:
     rows = apply_latencies(load_paths(args.obs_dir))
     med, slow = find_stragglers(rows, factor=args.factor)
+    if args.json:
+        print(json.dumps(
+            {
+                "apply_samples": len(rows),
+                "median_ms": med,
+                "factor": args.factor,
+                "stragglers": slow,
+            }
+        ))
+        return 0
     print(f"apply samples: {len(rows)}, fleet median {med:.3f}ms, "
           f"threshold {args.factor:g}x")
     if not slow:
@@ -260,6 +362,39 @@ def cmd_stragglers(args: argparse.Namespace) -> int:
             f"{r['latency_ms']:.3f}ms ({r['latency_ms'] / med:.1f}x median)"
         )
     return 0
+
+
+def cmd_audit(args: argparse.Namespace) -> int:
+    logs = obs_events.scan_dir(args.obs_dir)
+    n_apply = sum(
+        1 for evs in logs.values() for e in evs
+        if e.get("kind") == "delta.apply"
+    )
+    violations = audit_apply_order(logs)
+    if args.json:
+        print(json.dumps(
+            {
+                "logs": len(logs),
+                "apply_events": n_apply,
+                "violations": violations,
+            }
+        ))
+        return 1 if violations else 0
+    print(f"audited {n_apply} delta.apply events across {len(logs)} "
+          f"flight logs")
+    if not violations:
+        print("OK: every apply stream is contiguous per (incarnation, "
+              "origin) — no gap-skips, no double-applies")
+        return 0
+    for v in violations:
+        print(
+            f"  {v['kind']:>12}: {v['applier']} applied {v['origin']}/"
+            f"{v['dseq']} after cursor {v['prev_dseq']} "
+            f"(seq={v['seq']}, {v['log']})"
+        )
+    print(f"FAIL: {len(violations)} apply-order violation(s) — the sweep "
+          f"cursor machinery broke causal delivery")
+    return 1
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -275,6 +410,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         action="store_true",
         help="exit 1 unless at least one complete publish->apply path exists",
     )
+    s.add_argument("--json", action="store_true", help="machine-readable")
     s.set_defaults(fn=cmd_summary)
 
     p = sub.add_parser("path", help="one delta's hop-by-hop journey")
@@ -286,7 +422,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     g = sub.add_parser("stragglers", help="slow applies vs fleet median")
     g.add_argument("obs_dir")
     g.add_argument("--factor", type=float, default=3.0)
+    g.add_argument("--json", action="store_true", help="machine-readable")
     g.set_defaults(fn=cmd_stragglers)
+
+    a = sub.add_parser(
+        "audit", help="per-origin dseq apply-order audit (exit 1 on violation)"
+    )
+    a.add_argument("obs_dir")
+    a.add_argument("--json", action="store_true", help="machine-readable")
+    a.set_defaults(fn=cmd_audit)
 
     args = ap.parse_args(argv)
     return args.fn(args)

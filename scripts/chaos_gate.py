@@ -13,6 +13,14 @@ instrumentation dropped, sim faults disabled) regresses these counters
 to zero and must fail the gate, because every downstream consumer — the
 dashboard, the lag tracker, the flight-log cross-checks — reads them.
 
+A third leg guards the span plane (obs/spans.py): it runs the tiny
+round-phase drill (`bench.bench_round_phases`) with tracing armed and
+fails if any load-bearing phase recorded zero time — the span analogue
+of a counter going dark — or if the phases' serial union stops
+reconciling against the measured `round.e2e` wall time (attribution
+coverage collapse means the instrumentation no longer explains where
+rounds spend their time).
+
 Run:  python scripts/chaos_gate.py
 Make: part of `make chaos` (after the pytest leg).
 """
@@ -43,6 +51,11 @@ REQUIRED_NONZERO = (
     "net.snap_publishes",  # anchor/full-snapshot path exercised
     "net.dead_events",     # SWIM confirmed the crashed member
 )
+
+# Spans leg: minimum fleet-p50 fraction of round.e2e wall time the
+# serial phase union must explain. The tiny drill measures ~99% when
+# healthy; 0.6 is the "instrumentation collapsed" line, not a perf SLO.
+SPAN_MIN_COVERAGE = 0.6
 
 # Same contract for the zone-topology leg (tests/test_topo_chaos.py:
 # two zones, whole-zone partition, the za anchor crashed mid-run).
@@ -116,6 +129,32 @@ def main() -> int:
     print(f"OK: topo leg — {len(t_digests)} survivors converged via "
           f"anchors, failover {victim} -> "
           f"{sorted({ev['new'] for ev in failovers})} observed")
+
+    # -- leg 3: the span plane (round-phase tracing + attribution) ---------
+    from bench import bench_round_phases
+    from antidote_ccrdt_tpu.obs import spans as obs_spans
+
+    rp = bench_round_phases(2, 256, 2, 100, 4, 32, 8, rounds=3)
+    dark = sorted(
+        n for n in obs_spans.PHASES
+        if rp["phases_ms_total"].get(n, 0.0) <= 0.0
+    )
+    print("== span drill (2 members, 3 rounds, all phases armed) ==")
+    print(f"  e2e p50 {rp['e2e_ms_p50']:.2f}ms serial "
+          f"{rp['serial_ms_p50']:.2f}ms gap {rp['dispatch_gap_ms_p50']:.2f}ms "
+          f"coverage {rp['span_coverage_p50']:.1%}")
+    if dark:
+        print("FAIL: load-bearing round phases recorded no time (span "
+              f"instrumentation went dark): {dark}")
+        return 1
+    if rp["span_coverage_p50"] < SPAN_MIN_COVERAGE:
+        print(f"FAIL: span attribution no longer reconciles against the "
+              f"round.e2e wall (coverage p50 {rp['span_coverage_p50']:.1%} < "
+              f"{SPAN_MIN_COVERAGE:.0%})")
+        return 1
+    print(f"OK: span leg — all {len(obs_spans.PHASES)} phases lit, "
+          f"serial union explains {rp['span_coverage_p50']:.1%} of round "
+          f"wall (critical path: {' > '.join(rp['critical_path'][:3])})")
     return 0
 
 

@@ -358,6 +358,18 @@ def run_worker(store, drill, dense, state, args, result_dir):
     obs_export.install_atexit_dump(store.metrics, args.member)
     obs_http.install_from_env(store.metrics, args.member, addr_dir=result_dir)
     obs_profile.install_from_env(store.metrics)
+    # Span plane (CCRDT_SPANS): round-phase spans spill next to the
+    # flight log and mirror into metrics as span.* latency series, so
+    # both live scrape surfaces prove the plane is lit.
+    from antidote_ccrdt_tpu.obs import spans as obs_spans
+
+    if obs_spans.ACTIVE:
+        # The tcp entrypoint arms the plane before building its
+        # transport (hello-exchange clock offsets must be recorded);
+        # just attach the metrics mirror it could not have yet.
+        obs_spans.set_metrics(store.metrics)
+    else:
+        obs_spans.install_from_env(args.member, store.metrics)
     lag_tracker = LagTracker(args.member)
     confident_stale = max(1.5 * args.timeout, 0.6)
 
@@ -413,6 +425,13 @@ def run_worker(store, drill, dense, state, args, result_dir):
         return drill.set_view(dense, st, swept), stats
 
     def feed_lag() -> None:
+        if obs_spans.ACTIVE:
+            with obs_spans.span("round.lag_update"):
+                _feed_lag()
+        else:
+            _feed_lag()
+
+    def _feed_lag() -> None:
         """Watermarks from the transport vs what this worker merged.
         Delta mode: published = the peer's highest visible delta/anchor
         seq, applied = sweep_deltas' cursor. Snapshot mode: both sides
@@ -513,6 +532,14 @@ def run_worker(store, drill, dense, state, args, result_dir):
     for step in range(start_step, STEPS):
         if step == args.die_at:
             os._exit(1)  # crash: no cleanup, heartbeat goes stale
+        # The attribution denominator: everything the step does except
+        # the pacing sleep. ccrdt_spans.py `attribute` reconciles the
+        # phase spans inside this window against its duration.
+        e2e_tok = (
+            obs_spans.begin("round.e2e", step=step)
+            if obs_spans.ACTIVE
+            else None
+        )
         pre_view = drill.pub_state(dense, state) if wal is not None else None
         # Ownership only ever GROWS during a run: dropping a replica on a
         # membership change is unsafe under asymmetric views (member A may
@@ -532,7 +559,24 @@ def run_worker(store, drill, dense, state, args, result_dir):
         if gained:
             state = drill.adopt(dense, state, sorted(gained), step)
         owned_prev = owned
-        state = drill.apply(dense, state, step, sorted(owned))
+        if obs_spans.ACTIVE:
+            # Honest split of the device side of the round: dispatch =
+            # handing the batched op application to XLA, sync = waiting
+            # for the result arrays. The sync point exists only when the
+            # span plane is on — the untraced path is untouched.
+            with obs_spans.span(
+                "round.device_dispatch", step=step, site="drill.apply"
+            ):
+                state = drill.apply(dense, state, step, sorted(owned))
+            with obs_spans.span("round.device_sync", step=step):
+                try:
+                    import jax
+
+                    jax.block_until_ready(state)
+                except Exception:  # noqa: BLE001 — non-array states are fine
+                    pass
+        else:
+            state = drill.apply(dense, state, step, sorted(owned))
         if wal is not None:
             # Write-ahead: this step's adopt+apply delta must be durable
             # BEFORE the publish makes it externally visible — a crash
@@ -553,6 +597,7 @@ def run_worker(store, drill, dense, state, args, result_dir):
                 # substitutes for the compacted deltas only once peers
                 # could fetch the same state).
                 wal.checkpoint(drill.pub_state(dense, state), step)
+        obs_spans.end(e2e_tok)
         time.sleep(args.step_sleep)
 
     # Final convergence: publish/sweep until every member that ever

@@ -90,6 +90,10 @@ def main() -> None:
     ap.add_argument("--lag-anchor-ops", type=float, default=0.0,
                     help="lag-driven backpressure threshold in ops (needs "
                     "--delta); 0 disables — see elastic_demo.py")
+    ap.add_argument("--wal-dir", default="",
+                    help="arm the per-worker crash WAL (harness/wal.py) "
+                    "under this directory — see elastic_demo.py")
+    ap.add_argument("--wal-segment-bytes", type=int, default=256 << 10)
     args = ap.parse_args()
 
     import jax
@@ -104,6 +108,13 @@ def main() -> None:
 
     from antidote_ccrdt_tpu.net.tcp import TcpTransport
     from antidote_ccrdt_tpu.net.transport import GossipNode
+    from antidote_ccrdt_tpu.obs import spans as obs_spans
+
+    # Arm the span plane BEFORE the transport exists: the hello exchange
+    # on each fresh peer socket carries the NTP-style clock echo, and
+    # those first offsets are what aligns this worker's timeline in the
+    # merged trace (run_worker attaches the metrics mirror later).
+    obs_spans.install_from_env(args.member)
 
     drill = DRILLS[args.type]
     dense = drill.make_engine()

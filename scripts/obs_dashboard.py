@@ -16,11 +16,13 @@ Two ways to run it:
 * **Demo** (`make obs-demo`): ``--demo`` spawns a 3-worker
   `net_gossip_demo` TCP fleet in delta mode with the full observability
   plane enabled (``CCRDT_OBS_DIR`` + ``CCRDT_METRICS_DIR`` +
-  ``CCRDT_HTTP_PORT=0`` + ``CCRDT_PROFILE=1``), renders live frames
-  while it runs, and — while the workers are still alive — scrapes them
-  over BOTH live surfaces (each worker's HTTP ``/metrics`` endpoint and
-  the in-band TCP ``{metrics_req}`` frame), requiring lag gauges and
-  profile.dispatch histogram buckets in the response. After the fleet
+  ``CCRDT_HTTP_PORT=0`` + ``CCRDT_PROFILE=1`` + ``CCRDT_SPANS=1``),
+  renders live frames while it runs, and — while the workers are still
+  alive — scrapes them over BOTH live surfaces (each worker's HTTP
+  ``/metrics`` endpoint and the in-band TCP ``{metrics_req}`` frame),
+  requiring lag gauges, profile.dispatch histogram buckets, AND
+  round-phase span histograms (`obs.spans`' ``span.round.*`` latency
+  mirror) in the response. After the fleet
   exits it prints the merged Prometheus snapshot, RECONSTRUCTS one
   delta's end-to-end propagation path (publish -> medium write/send ->
   apply on every peer) from the flight logs, and smoke-runs the trace
@@ -240,11 +242,15 @@ def print_path_timeline(obs_dir: str, origin: str, dseq: int) -> None:
 # -- demo mode ---------------------------------------------------------------
 
 # What a live scrape must prove (acceptance for `make obs-demo`): lag
-# gauges and profile.dispatch histogram buckets, in valid exposition
-# text, read from a RUNNING worker.
+# gauges, profile.dispatch histogram buckets, and round-phase span
+# histograms (obs/spans.py's metrics mirror), in valid exposition text,
+# read from a RUNNING worker.
 _LAG_RE = re.compile(r"^ccrdt_lag_\w+(?:\{[^}]*\})? ", re.M)
 _BUCKET_RE = re.compile(
     r'^ccrdt_profile_dispatch_\w+_seconds_bucket\{[^}]*le="', re.M
+)
+_SPAN_RE = re.compile(
+    r'^ccrdt_span_round_\w+_seconds_bucket\{[^}]*le="', re.M
 )
 
 
@@ -253,6 +259,7 @@ def _scrape_proves_live(text: str) -> bool:
         "# TYPE " in text
         and bool(_LAG_RE.search(text))
         and bool(_BUCKET_RE.search(text))
+        and bool(_SPAN_RE.search(text))
     )
 
 
@@ -304,6 +311,7 @@ def run_demo(frames_interval: float = 0.5) -> int:
     env["CCRDT_METRICS_DIR"] = metrics_dir
     env["CCRDT_HTTP_PORT"] = "0"  # every worker serves /metrics (any port)
     env["CCRDT_PROFILE"] = "1"  # arm the XLA hot-path profiler
+    env["CCRDT_SPANS"] = "1"  # arm round-phase span tracing (obs/spans.py)
     members = ["w0", "w1", "w2"]
     procs = [
         subprocess.Popen(
@@ -365,11 +373,13 @@ def run_demo(frames_interval: float = 0.5) -> int:
                        ("in-band TCP {metrics_req}", tcp_live)):
         if got is None:
             print(f"FAIL: no {label} scrape with lag gauges + "
-                  "profile.dispatch buckets succeeded while the fleet ran")
+                  "profile.dispatch buckets + round-phase span buckets "
+                  "succeeded while the fleet ran")
             return 1
         m, text = got
         keep = [ln for ln in text.splitlines()
-                if _LAG_RE.match(ln) or _BUCKET_RE.match(ln)]
+                if _LAG_RE.match(ln) or _BUCKET_RE.match(ln)
+                or _SPAN_RE.match(ln)]
         print(f"[{label}] worker {m}: {len(text.splitlines())} lines, "
               f"proof series:")
         for ln in keep[:6]:
@@ -410,7 +420,8 @@ def run_demo(frames_interval: float = 0.5) -> int:
 
     print(f"\nOK: {len(complete)}/{len(rec['deltas'])} traced deltas fully "
           f"propagated across {rec['members']}; live HTTP + in-band TCP "
-          "scrapes carried lag gauges and profile.dispatch histograms")
+          "scrapes carried lag gauges, profile.dispatch histograms, and "
+          "round-phase span histograms")
     return 0
 
 

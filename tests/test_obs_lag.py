@@ -117,6 +117,48 @@ def test_export_to_metrics_gauges():
     assert m.counters["lag.max_seconds"] == 1.5
 
 
+def test_staleness_catches_caught_up_but_wedged_peer():
+    """Lag reads zero for a peer that merged everything then went
+    silent; staleness is the signal that keeps growing."""
+    clk, mono = Clock(), Clock()
+    lt = LagTracker("me", clock=clk, mono=mono)
+    assert lt.staleness("b") == 0.0  # never observed
+    lt.observe_published("b", 2)
+    lt.observe_applied("b", 2)
+    assert lt.lag("b") == (0, 0.0)
+    mono.t = 7.5  # b goes quiet; wall clock irrelevant
+    assert lt.staleness("b") == 7.5
+    assert lt.report()["b"]["staleness_s"] == 7.5
+    # Any fresh progress evidence resets the baseline — a watermark
+    # advance here, an apply equally would.
+    lt.observe_published("b", 3)
+    assert lt.staleness("b") == 0.0
+    mono.t = 9.0
+    lt.observe_applied("b", 3)
+    assert lt.staleness("b") == 0.0
+    # Re-observing an OLD watermark is not progress: no reset.
+    mono.t = 11.0
+    lt.observe_published("b", 1)
+    assert lt.staleness("b") == 2.0
+    lt.drop("b")
+    assert lt.staleness("b") == 0.0
+
+
+def test_export_includes_staleness_gauges():
+    clk, mono = Clock(), Clock()
+    lt = LagTracker("me", clock=clk, mono=mono)
+    lt.observe_published("b", 0)
+    lt.observe_applied("b", 0)
+    lt.observe_published("c", 0)
+    mono.t = 4.0
+    lt.observe_applied("c", 0)  # c just progressed; b is 4s stale
+    m = Metrics()
+    lt.export_to(m)
+    assert m.counters["lag.b.staleness_seconds"] == 4.0
+    assert m.counters["lag.c.staleness_seconds"] == 0.0
+    assert m.counters["lag.max_staleness_seconds"] == 4.0
+
+
 def test_payload_digest_skips_header():
     blob = struct.pack("<Q", 42) + b"payload"
     assert payload_digest(blob) == zlib.crc32(b"payload") & 0xFFFFFFFF

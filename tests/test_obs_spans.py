@@ -1,0 +1,365 @@
+"""Round-phase span tracer (obs/spans.py): ring bound, the disabled
+zero-cost gate, crash-durable spill (SIGKILL drill mirroring
+tests/test_obs_events.py), clock-offset estimation under asymmetric RTT
+on the sim medium, timeline alignment (BFS over offset edges), Chrome
+trace export, and the dispatch-gap attribution math."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from antidote_ccrdt_tpu.obs import spans as obs_spans
+from antidote_ccrdt_tpu.obs.spans import ClockSync, _union
+from antidote_ccrdt_tpu.utils.metrics import Metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _plane_down():
+    """Every test starts and ends with the span plane disarmed."""
+    obs_spans.uninstall()
+    yield
+    obs_spans.uninstall()
+
+
+# -- ring + gate --------------------------------------------------------------
+
+
+def test_ring_is_bounded_and_sids_keep_counting():
+    with obs_spans.installed("m", ring=8):
+        for i in range(20):
+            with obs_spans.span("round.snapshot", i=i):
+                pass
+        recs = obs_spans.drain()
+    # Overflow evicts the OLDEST records — including the clock anchor
+    # written at install time; the ring never grows past bound.
+    assert len(recs) == 8
+    assert all(r["k"] == "span" for r in recs)
+    assert [r["i"] for r in recs] == list(range(12, 20))
+    # sid is the process ordinal, not a ring index: it keeps counting
+    # across eviction.
+    assert [r["sid"] for r in recs] == list(range(13, 21))
+
+
+def test_disabled_plane_is_a_no_op():
+    assert obs_spans.ACTIVE is False
+    # begin returns None; end(None) is tolerated; span yields; the
+    # exchange feed and drain are no-ops. This is the zero-cost contract
+    # call sites rely on behind `if spans.ACTIVE:`.
+    tok = obs_spans.begin("round.snapshot")
+    assert tok is None
+    obs_spans.end(tok)
+    with obs_spans.span("round.snapshot"):
+        pass
+    obs_spans.observe_exchange("peer", 0.0, 1.0, 2.0)
+    assert obs_spans.drain() == []
+
+
+def test_install_from_env_gating(tmp_path):
+    assert obs_spans.install_from_env("w0", env={}) is False
+    assert obs_spans.ACTIVE is False
+    assert obs_spans.install_from_env("w0", env={obs_spans.ENV_FLAG: "1"})
+    assert obs_spans.ACTIVE is True
+    obs_spans.uninstall()
+    d = str(tmp_path / "obs")
+    assert obs_spans.install_from_env(
+        "w0", env={obs_spans.ENV_FLAG: "true", obs_spans.ENV_DIR: d}
+    )
+    with obs_spans.span("round.snapshot"):
+        pass
+    spill = os.path.join(d, f"spans-w0-{os.getpid()}.jsonl")
+    # Line-buffered: the completed span is on disk before any close.
+    recs = obs_spans.read_spans(spill)
+    assert [r["k"] for r in recs] == ["clock", "span"]
+
+
+# -- record shape -------------------------------------------------------------
+
+
+def test_nesting_parent_links_and_anchor():
+    with obs_spans.installed("m"):
+        with obs_spans.span("round.e2e", step=3):
+            with obs_spans.span("round.device_dispatch", n=7):
+                pass
+        recs = obs_spans.drain()
+    anchor, inner, outer = recs  # children END (and record) first
+    assert anchor["k"] == "clock"
+    assert anchor["member"] == "m" and anchor["pid"] == os.getpid()
+    assert {"wall", "mono"} <= set(anchor)
+    assert outer["name"] == "round.e2e" and outer["step"] == 3
+    assert outer["parent"] is None
+    assert inner["name"] == "round.device_dispatch" and inner["n"] == 7
+    assert inner["parent"] == outer["sid"]
+    for r in (inner, outer):
+        assert r["member"] == "m" and r["m1"] >= r["m0"]
+        assert isinstance(r["tid"], int)
+
+
+def test_non_lexical_end_pops_abandoned_frames():
+    with obs_spans.installed("m"):
+        a = obs_spans.begin("round.e2e")
+        obs_spans.begin("round.gossip_send")  # abandoned (e.g. exception)
+        obs_spans.end(a)  # must pop the abandoned child too
+        with obs_spans.span("round.snapshot"):
+            pass
+        recs = obs_spans.drain()
+    by_name = {r["name"]: r for r in recs if r["k"] == "span"}
+    assert by_name["round.e2e"]["parent"] is None
+    # The stack is clean again: the next span is NOT parented under the
+    # abandoned frame.
+    assert by_name["round.snapshot"]["parent"] is None
+
+
+def test_installed_restores_previous_tracer():
+    obs_spans.install("outer")
+    with obs_spans.installed("inner"):
+        with obs_spans.span("round.snapshot"):
+            pass
+        assert obs_spans.drain()[-1]["member"] == "inner"
+    # The outer plane is back — armed, with its own ring intact.
+    assert obs_spans.ACTIVE is True
+    with obs_spans.span("round.lag_update"):
+        pass
+    assert obs_spans.drain()[-1]["member"] == "outer"
+
+
+def test_set_metrics_attaches_latency_mirror():
+    obs_spans.set_metrics(Metrics())  # plane down: must not raise
+    m = Metrics()
+    with obs_spans.installed("m"):
+        obs_spans.set_metrics(m)  # the tcp-drill arm-early path
+        with obs_spans.span("round.wal_append"):
+            pass
+        obs_spans.observe_exchange("peer", 1.0, 2.0, 1.1)
+    snap = m.snapshot()
+    assert len(snap["latencies"]["span.round.wal_append"]) == 1
+    assert snap["counters"]["clock.exchanges"] == 1
+    # set() stores gauges in the counter namespace (last-write-wins).
+    assert snap["counters"]["clock.offset_seconds.peer"] == pytest.approx(0.95)
+
+
+# -- clock sync ---------------------------------------------------------------
+
+
+def test_clock_sync_keeps_min_rtt_and_discards_negative():
+    cs = ClockSync()
+    assert cs.note("p", t1=1.0, t2=9.0, t3=0.5) is None  # negative rtt
+    assert cs.snapshot() == {}
+    cs.note("p", t1=0.0, t2=5.1, t3=0.2)  # offset 5.0, rtt 0.2
+    cs.note("p", t1=0.0, t2=5.6, t3=1.0)  # worse rtt: ignored
+    off, rtt = cs.snapshot()["p"]
+    assert off == pytest.approx(5.0) and rtt == pytest.approx(0.2)
+    cs.note("p", t1=0.0, t2=5.05, t3=0.1)  # better rtt: replaces
+    off, rtt = cs.snapshot()["p"]
+    assert off == pytest.approx(5.0) and rtt == pytest.approx(0.1)
+
+
+def test_sim_offset_error_bounded_by_rtt_asymmetry():
+    """The NTP estimate's error term IS the RTT asymmetry / 2: drive the
+    T1/T2/T3 protocol over a sim link that is 10ms one way and 2ms back,
+    against a peer skewed +0.75s — then tighten the link and watch the
+    min-RTT filter converge on the true skew."""
+    from antidote_ccrdt_tpu.net.sim import SimNet
+
+    net = SimNet(
+        seed=7,
+        link_latency={("a", "b"): (0.010, 0.010), ("b", "a"): (0.002, 0.002)},
+    )
+    a = net.join("a")
+    b = net.join("b")
+    b.clock_skew = 0.75
+    a.clock_exchange("b")
+    net.run_until(1.0)
+    off, rtt = a.clock.snapshot()["b"]
+    # error = (d_fwd - d_back)/2 = (10ms - 2ms)/2 = +4ms, exactly.
+    assert off == pytest.approx(0.75 + 0.004, abs=1e-9)
+    assert rtt == pytest.approx(0.012, abs=1e-9)
+    # A symmetric low-latency window opens: the min-RTT filter upgrades
+    # to the asymmetry-free exchange.
+    net.link_latency[("a", "b")] = (0.001, 0.001)
+    net.link_latency[("b", "a")] = (0.001, 0.001)
+    a.clock_exchange("b")
+    net.run_until(2.0)
+    off, rtt = a.clock.snapshot()["b"]
+    assert off == pytest.approx(0.75, abs=1e-9)
+    assert rtt == pytest.approx(0.002, abs=1e-9)
+
+
+# -- alignment + export -------------------------------------------------------
+
+
+def test_align_offsets_bfs_sign_conventions():
+    # offsets[x][y] = mono_y - mono_x. a observed b directly; c observed
+    # b — reaching c from b needs the sign-flipped reverse edge.
+    offsets = {
+        "a": {"b": (0.5, 0.001)},
+        "c": {"b": (0.2, 0.001)},
+    }
+    shifts = obs_spans.align_offsets(offsets, ["a", "b", "c", "d"])
+    assert shifts["a"] == 0.0  # lexicographic ref
+    assert shifts["b"] == pytest.approx(-0.5)  # shift[b] = shift[a] - off
+    assert shifts["c"] == pytest.approx(-0.3)  # via b: -0.5 - (-0.2)
+    assert shifts["d"] == 0.0  # unreachable: renders unaligned
+
+
+def test_clock_offsets_takes_min_rtt_per_edge():
+    recs = [
+        {"k": "offset", "peer": "b", "offset": 0.9, "rtt": 0.05},
+        {"k": "offset", "peer": "b", "offset": 0.8, "rtt": 0.01},
+        {"k": "span", "name": "round.e2e", "m0": 0.0, "m1": 1.0},
+    ]
+    off = obs_spans.clock_offsets({"a": recs})
+    assert off == {"a": {"b": (0.8, 0.01)}}
+
+
+def test_to_chrome_trace_aligns_and_labels_processes():
+    by_member = {
+        "b": [{"k": "span", "name": "round.e2e", "sid": 1, "parent": None,
+               "member": "b", "tid": 0, "m0": 10.0, "m1": 10.5}],
+        "a": [{"k": "span", "name": "round.e2e", "sid": 1, "parent": None,
+               "member": "a", "tid": 0, "m0": 100.0, "m1": 100.2}],
+    }
+    # shift maps local mono onto the reference timeline: b's 10.0 lands
+    # at aligned 110.0 — 10s AFTER a's span, not 90s before.
+    trace = obs_spans.to_chrome_trace(by_member, shifts={"a": 0.0, "b": 100.0})
+    names = {e["args"]["name"]: e["pid"] for e in trace["traceEvents"]
+             if e["ph"] == "M"}
+    assert names == {"a": 1, "b": 2}  # pids follow sorted member order
+    xs = {e["pid"]: e for e in trace["traceEvents"] if e["ph"] == "X"}
+    assert xs[1]["ts"] == 0.0  # zero-based at the earliest aligned span
+    assert xs[2]["ts"] == pytest.approx(10.0 * 1e6)  # microseconds
+    assert xs[1]["dur"] == pytest.approx(0.2 * 1e6)
+    assert trace["otherData"]["aligned_members"] == ["a", "b"]
+    assert trace["displayTimeUnit"] == "ms"
+
+
+# -- attribution --------------------------------------------------------------
+
+
+def test_union_merges_overlaps_and_skips_empty():
+    assert _union([]) == 0.0
+    assert _union([(0.0, 1.0), (2.0, 3.0)]) == pytest.approx(2.0)
+    assert _union([(0.0, 2.0), (1.0, 3.0), (3.0, 4.0)]) == pytest.approx(4.0)
+    assert _union([(1.0, 1.0), (2.0, 1.0)]) == 0.0  # empty/inverted
+
+
+def _span(name, m0, m1, tid=0, **fields):
+    return {"k": "span", "name": name, "sid": 0, "parent": None,
+            "member": "m", "tid": tid, "m0": m0, "m1": m1, **fields}
+
+
+def test_attribute_serial_overlap_gap_and_clipping():
+    recs = [
+        _span("round.e2e", 0.0, 1.0, tid=0),
+        # Same-thread phases: serial, interval-UNION (the overlap between
+        # these two must not double-count).
+        _span("round.wal_append", 0.0, 0.3, tid=0),
+        _span("round.device_dispatch", 0.2, 0.5, tid=0),
+        # Other-thread phase: overlappable — work the round did not wait on.
+        _span("round.gossip_send", 0.0, 0.4, tid=1),
+        # Phase straddling the window end: clipped to it.
+        _span("round.snapshot", 0.9, 1.5, tid=0),
+        # Entirely outside the round: ignored.
+        _span("round.delta_apply", 2.0, 2.1, tid=0),
+    ]
+    att = obs_spans.attribute({"m": recs})
+    row = att["members"]["m"]
+    assert row["rounds"] == 1
+    assert row["e2e_ms_p50"] == pytest.approx(1000.0)
+    # serial union: [0,0.5) ∪ [0.9,1.0) = 0.6s
+    assert row["serial_ms_p50"] == pytest.approx(600.0)
+    assert row["overlap_ms_p50"] == pytest.approx(400.0)
+    assert row["gap_ms_p50"] == pytest.approx(400.0)
+    assert row["coverage_p50"] == pytest.approx(0.6)
+    totals = row["phases_ms_total"]
+    assert totals["round.snapshot"] == pytest.approx(100.0)  # clipped
+    assert "round.delta_apply" not in totals
+    # critical path ranks by attributed time: dispatch+wal 300ms each.
+    assert row["critical_path"][-1] == "round.snapshot"
+    fleet = att["fleet"]
+    assert fleet["rounds"] == 1
+    assert fleet["coverage_p50"] == pytest.approx(0.6)
+    # The report renders without blowing up on the same structure.
+    assert "coverage" in obs_spans.format_report(att)
+
+
+def test_attribute_skips_members_without_rounds():
+    recs = [_span("round.wal_append", 0.0, 0.1)]
+    att = obs_spans.attribute({"m": recs})
+    assert att["members"] == {}
+    assert att["fleet"]["rounds"] == 0
+
+
+# -- spill + scan -------------------------------------------------------------
+
+
+def test_spill_torn_tail_skipped_and_scan_dir_groups(tmp_path):
+    d = str(tmp_path / "obs")
+    with obs_spans.installed("w0", spill_dir=d):
+        with obs_spans.span("round.e2e", step=0):
+            pass
+    spill = os.path.join(d, f"spans-w0-{os.getpid()}.jsonl")
+    with open(spill, "a") as f:
+        f.write('{"k": "span", "name": "torn-ha')
+    # A second incarnation of the same member: scan_dir concatenates.
+    with open(os.path.join(d, "spans-w0-99999.jsonl"), "w") as f:
+        f.write(json.dumps(
+            {"k": "span", "name": "round.e2e", "sid": 1, "parent": None,
+             "member": "w0", "tid": 0, "m0": 5.0, "m1": 5.1}) + "\n")
+    by_member = obs_spans.scan_dir(d)
+    assert list(by_member) == ["w0"]
+    names = [r.get("name") for r in by_member["w0"] if r["k"] == "span"]
+    assert names == ["round.e2e", "round.e2e"]  # torn tail dropped
+
+
+# -- real-subprocess crash durability ----------------------------------------
+
+_CHILD = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from antidote_ccrdt_tpu.obs import spans as obs_spans
+
+assert obs_spans.install_from_env("victim")
+for i in range(5):
+    with obs_spans.span("round.e2e", step=i):
+        pass
+obs_spans.observe_exchange("peer", 1.0, 2.0, 1.5)
+print("READY", flush=True)
+time.sleep(30)
+"""
+
+
+def test_sigkill_leaves_readable_span_spill(tmp_path):
+    """The crash-durability contract the merged timeline depends on:
+    kill -9 a worker and its spill still holds the clock anchor, every
+    completed span, and the offset record — nothing buffered is lost."""
+    obs_dir = str(tmp_path / "obs")
+    env = dict(os.environ)
+    env[obs_spans.ENV_FLAG] = "1"
+    env[obs_spans.ENV_DIR] = obs_dir
+    p = subprocess.Popen(
+        [sys.executable, "-c", _CHILD.format(repo=REPO)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True,
+    )
+    try:
+        assert p.stdout.readline().strip() == "READY"
+        os.kill(p.pid, signal.SIGKILL)  # no handler can observe this
+        p.wait(timeout=10)
+    finally:
+        if p.poll() is None:
+            p.kill()
+    recs = obs_spans.read_spans(
+        os.path.join(obs_dir, f"spans-victim-{p.pid}.jsonl")
+    )
+    assert recs[0]["k"] == "clock" and recs[0]["pid"] == p.pid
+    spans_ = [r for r in recs if r["k"] == "span"]
+    assert [r["step"] for r in spans_] == list(range(5))
+    offs = [r for r in recs if r["k"] == "offset"]
+    assert len(offs) == 1 and offs[0]["peer"] == "peer"
+    # And the merge side reads it as a one-member fleet.
+    assert list(obs_spans.scan_dir(obs_dir)) == ["victim"]

@@ -127,6 +127,102 @@ def test_cli_path_and_stragglers(fleet_dir, capsys):
     assert "w0/1 -> w2" in capsys.readouterr().out
 
 
+def test_cli_json_output(fleet_dir, capsys):
+    assert trace.main(["summary", fleet_dir, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["deltas_traced"] == 3
+    assert doc["complete_paths"] == 2
+    assert doc["never_applied"] == [["w0", 2]]
+    assert abs(doc["pairs"]["w0->w2"]["p50_ms"] - 300.0) < 1e-6
+    assert trace.main(["stragglers", fleet_dir, "--factor", "3", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert abs(doc["median_ms"] - 60.0) < 1e-6
+    assert [(r["origin"], r["dseq"], r["applier"])
+            for r in doc["stragglers"]] == [("w0", 1, "w2")]
+
+
+# -- apply-order audit --------------------------------------------------------
+
+
+def _apply(origin, dseq, seq):
+    return {"kind": "delta.apply", "origin": origin, "dseq": dseq, "seq": seq}
+
+
+def test_audit_contiguous_streams_pass():
+    logs = {
+        # Baseline is the FIRST dseq seen (ring truncation / mid-stream
+        # join), not 0.
+        "flight-a-1.jsonl": [
+            {"member": "a", **_apply("o", 5, 0)},
+            {"member": "a", **_apply("o", 6, 1)},
+            {"member": "a", **_apply("p", 0, 2)},
+            {"member": "a", **_apply("o", 7, 3)},
+        ],
+        # A snap.apply at step S is the one legitimate jump.
+        "flight-b-1.jsonl": [
+            {"member": "b", **_apply("o", 1, 0)},
+            {"member": "b", "kind": "snap.apply", "origin": "o", "step": 9,
+             "seq": 1},
+            {"member": "b", **_apply("o", 10, 2)},
+        ],
+    }
+    assert trace.audit_apply_order(logs) == []
+
+
+def test_audit_orders_by_recorder_seq_not_list_position():
+    # Events listed out of order; the per-process seq axis restores the
+    # true apply order, so no violation.
+    logs = {"flight-a-1.jsonl": [
+        {"member": "a", **_apply("o", 2, 1)},
+        {"member": "a", **_apply("o", 1, 0)},
+    ]}
+    assert trace.audit_apply_order(logs) == []
+
+
+def test_audit_flags_gap_skip_and_double_apply():
+    logs = {"flight-a-1.jsonl": [
+        {"member": "a", **_apply("o", 1, 0)},
+        {"member": "a", **_apply("o", 2, 1)},
+        {"member": "a", **_apply("o", 5, 2)},   # gap: 3,4 silently lost
+        {"member": "a", **_apply("o", 6, 3)},   # cursor resumed at 5: fine
+        {"member": "a", **_apply("o", 6, 4)},   # cursor went backwards
+    ]}
+    vs = trace.audit_apply_order(logs)
+    assert [(v["kind"], v["prev_dseq"], v["dseq"]) for v in vs] == [
+        ("gap-skip", 2, 5), ("double-apply", 6, 6)]
+    assert all(v["applier"] == "a" and v["origin"] == "o" for v in vs)
+
+
+def test_audit_incarnations_are_independent():
+    # Recovery re-applies the delta suffix: the restarted pid's log
+    # restarts the stream and must NOT read as a double-apply.
+    logs = {
+        "flight-a-100.jsonl": [{"member": "a", **_apply("o", 3, 0)},
+                               {"member": "a", **_apply("o", 4, 1)}],
+        "flight-a-200.jsonl": [{"member": "a", **_apply("o", 3, 0)},
+                               {"member": "a", **_apply("o", 4, 1)}],
+    }
+    assert trace.audit_apply_order(logs) == []
+
+
+def test_cli_audit_exit_codes_and_json(fleet_dir, capsys):
+    # The synthetic fleet's apply streams are clean.
+    assert trace.main(["audit", fleet_dir]) == 0
+    assert "OK" in capsys.readouterr().out
+    # Corrupt one stream: a worker skips dseq 2 of origin w9.
+    _write_log(fleet_dir, "w3", [
+        {"kind": "delta.apply", "origin": "w9", "dseq": 1, "t": 1.0},
+        {"kind": "delta.apply", "origin": "w9", "dseq": 3, "t": 2.0},
+    ])
+    assert trace.main(["audit", fleet_dir]) == 1
+    out = capsys.readouterr().out
+    assert "gap-skip" in out and "FAIL" in out
+    assert trace.main(["audit", fleet_dir, "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["violations"][0]["kind"] == "gap-skip"
+    assert doc["violations"][0]["applier"] == "w3"
+
+
 def test_subprocess_entrypoint(fleet_dir):
     import subprocess
 

@@ -23,7 +23,7 @@ PY ?= python
 # meaningful.
 COVER_THRESHOLD ?= 88
 
-.PHONY: all compile test cover typecheck xref native bench benchall dryrun net-demo chaos crash-demo obs-demo topo-demo spans-demo bench-gate clean
+.PHONY: all compile test cover typecheck xref native bench benchall dryrun net-demo chaos crash-demo obs-demo topo-demo spans-demo overlap-demo bench-gate clean
 
 all: compile xref typecheck cover
 
@@ -90,6 +90,7 @@ chaos:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_scrape_faults.py tests/test_trace_cli.py -q -p no:cacheprovider
 	$(PY) scripts/bench_gate.py
 	env JAX_PLATFORMS=cpu $(PY) scripts/spans_demo.py
+	env JAX_PLATFORMS=cpu $(PY) scripts/overlap_demo.py
 
 # Throughput regression gate: best merges_per_sec of the latest
 # BENCH_r*.json round must stay within 20% of the best prior round —
@@ -121,6 +122,14 @@ obs-demo:
 # printed ratio — instead of O(peers).
 topo-demo:
 	env JAX_PLATFORMS=cpu $(PY) scripts/topo_demo.py
+
+# Overlap demo/gate (slow, real processes): the same 3-worker TCP fleet
+# run twice — serial round loop vs the overlapped pipeline
+# (parallel/overlap.py) — gated on bit-identical digests across modes,
+# the pipeline counters nonzero, and a >=30% fleet-p50 round.e2e
+# reduction with publish-every-1 host load. Also part of `make chaos`.
+overlap-demo:
+	env JAX_PLATFORMS=cpu $(PY) scripts/overlap_demo.py
 
 # Span-tracing demo (slow, real processes): a 3-worker TCP fleet with
 # the round-phase span plane armed (CCRDT_SPANS=1) — every worker's

@@ -57,13 +57,104 @@ def _check_i32(x: int) -> int:
     return int(x)
 
 
-def _batched_fold(merge, batch: Any):
-    """Fold a [N, ...] state pytree down to [1, ...]: each round merges the
-    first half against the second half in ONE dispatch (log2(N) dispatches
-    total), carrying the odd row."""
+# Jitted merge entry points keyed per engine merge fn. Bound-method ids
+# are unstable (a fresh wrapper per attribute access), so the key is the
+# underlying (__func__, __self__) identity pair and the cache value pins
+# the bound method itself to keep those ids live.
+_SLOTS: Dict[Any, Any] = {}
+
+
+def merge_slots(merge):
+    """The double-buffer device slots of the overlap pipeline (PR 7):
+    three cached jitted compilations of one engine merge —
+
+      plain        no aliasing (the serial path's semantics, jitted)
+      donate_rhs   arg1's buffers alias into the output: for
+                   state ⊔ incoming where `incoming` is a freshly
+                   materialized window the pipeline owns. arg0 (the
+                   carried state) is NEVER donated — DeltaPublisher
+                   keeps `_prev` and the WAL keeps pre-images aliased
+                   to it.
+      donate_both  both operands donated: only for `_batched_fold`'s
+                   internal rounds, where lhs/rhs are fresh slices of a
+                   stack this module just built.
+
+    Donation is what lets window N+1's merge dispatch while window N's
+    result is still being read back: XLA reuses the dead operand's
+    buffers instead of allocating + waiting. On backends that cannot
+    alias (CPU) donation is a silent no-op — semantics are unchanged
+    either way, which tests/test_overlap.py pins bit-identically."""
+    import jax
+
+    key = (
+        id(getattr(merge, "__func__", merge)),
+        id(getattr(merge, "__self__", None)),
+    )
+    hit = _SLOTS.get(key)
+    if hit is None:
+        hit = (
+            merge,  # pinned: the key's ids must outlive the cache entry
+            {
+                "plain": jax.jit(merge),
+                "donate_rhs": jax.jit(merge, donate_argnums=(1,)),
+                "donate_both": jax.jit(merge, donate_argnums=(0, 1)),
+            },
+        )
+        _SLOTS[key] = hit
+    return hit[1]
+
+
+def merge_into(merge, state, incoming, donate_incoming: bool = True):
+    """One window's merge through the donated slot: `state ⊔ incoming`,
+    with `incoming`'s buffers donated to the result. The caller must own
+    `incoming` outright (an expanded peer delta / fetched snapshot it
+    will never touch again); `state` is left intact."""
+    slot = merge_slots(merge)["donate_rhs" if donate_incoming else "plain"]
+    tok = (
+        obs_spans.begin("round.device_dispatch", site="batch_merge.into", n=2)
+        if obs_spans.ACTIVE
+        else None
+    )
+    try:
+        if profile.ACTIVE:
+            with profile.dispatch(
+                "batch_merge.into", fn=merge, operands=(incoming,)
+            ):
+                return slot(state, incoming)
+        return slot(state, incoming)
+    finally:
+        obs_spans.end(tok)
+
+
+def fold_states(merge, states: Sequence[Any]):
+    """Multi-window batched dispatch: fold N same-shape state pytrees
+    (e.g. the carried state plus every mergeable window in the overlap
+    apply queue) in log2(N) batched dispatches instead of N-1 serial
+    ones. Stacks to [N, ...] — engine merges are rank-polymorphic over
+    the leading axis — folds with donation (the stack and its slices are
+    fresh buffers this function owns), and unstacks the single row."""
     import jax
     import jax.numpy as jnp
 
+    if not states:
+        raise ValueError("fold_states needs at least one state")
+    if len(states) == 1:
+        return states[0]
+    batch = jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *states)
+    folded = _batched_fold(merge, batch, donate=True)
+    return jax.tree.map(lambda x: x[0], folded)
+
+
+def _batched_fold(merge, batch: Any, donate: bool = False):
+    """Fold a [N, ...] state pytree down to [1, ...]: each round merges the
+    first half against the second half in ONE dispatch (log2(N) dispatches
+    total), carrying the odd row. With `donate`, rounds run through the
+    donate-both jit slot — safe here because lhs/rhs are eagerly
+    materialized slices nothing else references."""
+    import jax
+    import jax.numpy as jnp
+
+    step = merge_slots(merge)["donate_both"] if donate else merge
     n = jax.tree.leaves(batch)[0].shape[0]
     while n > 1:
         half = n // 2
@@ -77,9 +168,9 @@ def _batched_fold(merge, batch: Any):
         try:
             if profile.ACTIVE:
                 with profile.dispatch("batch_merge.fold", fn=merge, operands=(lhs, rhs)):
-                    merged = merge(lhs, rhs)
+                    merged = step(lhs, rhs)
             else:
-                merged = merge(lhs, rhs)
+                merged = step(lhs, rhs)
         finally:
             obs_spans.end(tok)
         if n % 2:

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import os
 import struct
+import threading
 import time
 from typing import Any, List, Optional, Protocol, Tuple, runtime_checkable
 
@@ -90,7 +91,11 @@ class FsTransport:
 
     def heartbeat(self) -> None:
         p = os.path.join(self.root, f"hb-{self.member}")
-        tmp = f"{p}.tmp-{os.getpid()}"
+        # Thread-unique tmp name: with the overlap pipeline, the
+        # heartbeat daemon and the host-stage thread (publish →
+        # heartbeat) beat concurrently — a shared tmp would let one
+        # thread's replace() delete the other's file mid-write.
+        tmp = f"{p}.tmp-{os.getpid()}-{threading.get_ident()}"
         with open(tmp, "wb") as f:
             f.write(struct.pack("<d", time.time()))
         os.replace(tmp, p)
@@ -142,7 +147,7 @@ class FsTransport:
                 return  # injected drop: the publish silently never lands
             blob = mangled
         path = os.path.join(self.root, f"snap-{self.member}")
-        tmp = f"{path}.tmp"
+        tmp = f"{path}.tmp-{os.getpid()}-{threading.get_ident()}"
         with open(tmp, "wb") as f:
             f.write(blob)
             f.flush()
@@ -168,7 +173,7 @@ class FsTransport:
         return sorted(
             f[5:]
             for f in os.listdir(self.root)
-            if f.startswith("snap-") and not f.endswith(".tmp")
+            if f.startswith("snap-") and ".tmp" not in f
         )
 
     # -- deltas ------------------------------------------------------------
@@ -180,7 +185,7 @@ class FsTransport:
                 return  # injected drop
             blob = mangled
         path = os.path.join(self.root, f"delta-{self.member}-{seq:08d}")
-        tmp = f"{path}.tmp"
+        tmp = f"{path}.tmp-{os.getpid()}-{threading.get_ident()}"
         with open(tmp, "wb") as f:
             f.write(blob)
             # fsync BEFORE the rename commits the name, matching `publish`:
@@ -232,7 +237,7 @@ class FsTransport:
         pre = f"delta-{member}-"
         out = []
         for f in os.listdir(self.root):
-            if f.startswith(pre) and not f.endswith(".tmp"):
+            if f.startswith(pre) and ".tmp" not in f:
                 try:
                     out.append(int(f[len(pre):]))
                 except ValueError:
@@ -246,7 +251,7 @@ class FsTransport:
             {
                 f[len("delta-"):].rsplit("-", 1)[0]
                 for f in os.listdir(self.root)
-                if f.startswith("delta-") and not f.endswith(".tmp")
+                if f.startswith("delta-") and ".tmp" not in f
             }
         )
 

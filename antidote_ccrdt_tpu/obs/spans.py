@@ -603,13 +603,20 @@ def attribute(
 
     For every ``round.e2e`` span E on a worker: phase spans of the same
     member are clipped to E's window; those on E's own thread are the
-    SERIAL host time (their interval-union must reconcile against E's
-    duration — the residue is the unattributed gap), phases on other
-    threads (tcp sender/reader) are OVERLAPPABLE — work the round did
-    not have to wait for. Returns per-member and fleet aggregates:
-    per-phase totals/p50s, serial/overlap/gap p50s, coverage (serial
-    union / e2e, p50 across rounds), and the critical-path ranking
-    (phases by total serial time)."""
+    SERIAL host time, phases on other threads (tcp sender/reader, the
+    overlap pipeline's host stage and prefetcher) are OVERLAPPABLE —
+    work the round did not have to wait for. Coverage and the
+    unattributed gap are measured against the union of BOTH classes
+    (covered = serial ∪ overlappable clipped to E): an overlapped round
+    is explained by phases regardless of which thread owns them, and
+    the residue is time no instrumented phase accounts for. Per-phase
+    TOTALS are summed over the phases' full (unclipped) extents — in
+    overlap mode host stages run between e2e windows too, and totals
+    must show where wall time went, not just the slice inside a window
+    — while per-round ``phases_ms_p50`` samples stay clipped. Returns
+    per-member and fleet aggregates: per-phase totals/p50s,
+    serial/overlap/gap p50s, coverage p50, and the critical-path
+    ranking (phases by total time)."""
     members_out: Dict[str, Any] = {}
     fleet_cov: List[float] = []
     fleet_phase_totals: Dict[str, float] = {}
@@ -647,20 +654,27 @@ def attribute(
                     overlap_iv.append((plo, phi))
             serial = _union(serial_iv)
             overlap = _union(overlap_iv)
-            gap = max(0.0, dur - serial)
+            covered = _union(serial_iv + overlap_iv)
+            gap = max(0.0, dur - covered)
             rounds.append(
                 {
                     "e2e": dur,
                     "serial": serial,
                     "overlap": overlap,
                     "gap": gap,
-                    "coverage": serial / dur,
+                    "coverage": covered / dur,
                     "phases": by_phase,
                 }
             )
             for name, v in by_phase.items():
-                phase_totals[name] = phase_totals.get(name, 0.0) + v
                 phase_samples.setdefault(name, []).append(v)
+        # Totals over the phases' full extents (NOT clipped to e2e
+        # windows): overlapped host stages run during the inter-round
+        # sleep as well, and that work must still show in the ledger.
+        for p in phases:
+            d = p["m1"] - p["m0"]
+            if d > 0:
+                phase_totals[p["name"]] = phase_totals.get(p["name"], 0.0) + d
         if not rounds:
             continue
         cov = [r["coverage"] for r in rounds]
@@ -733,7 +747,7 @@ def format_report(att: Dict[str, Any]) -> str:
     totals = fleet.get("phases_ms_total", {})
     path = fleet.get("critical_path", [])
     if path:
-        lines.append("critical path (by total serial+overlap time):")
+        lines.append("critical path (by total phase time):")
         for name in path:
             lines.append(f"  {name:<22} {totals.get(name, 0.0):10.2f} ms")
     for member, row in sorted(att.get("members", {}).items()):

@@ -35,6 +35,14 @@ Pieces:
 * `sweep` — fold every peer's latest snapshot into the local state with
   the engine join.
 
+The serial sweep path here is also the contract the overlapped round
+pipeline (`parallel/overlap.py`, PR 7) decomposes: its `DeltaPrefetcher`
+runs this module's fetch+validate+decode half (`sweep_deltas`' chain
+walk, `_resolve_monoid`'s lift discipline) ahead of the round on its own
+thread, and the round thread folds the pre-expanded results through
+`core.batch_merge`. Convergence is mode-independent — both paths apply
+the same joins — which tests/test_overlap.py pins bit-identically.
+
 The real-process drill (3 workers, one killed mid-run, survivors detect,
 adopt its replicas, converge to the sequential reference) lives in
 scripts/elastic_demo.py + tests/test_elastic.py.

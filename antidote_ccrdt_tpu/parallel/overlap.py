@@ -1,0 +1,562 @@
+"""Overlapped round pipeline: host I/O off the round thread (PR 7).
+
+The span plane (obs/spans.py, PR 6) measured what BENCH_r02-r05 kept
+attributing: the e2e gossip round runs ~2.7x the windowed device compute
+because WAL append, delta encode/decode, and gossip I/O sit SERIALLY on
+the same thread as device dispatch. This module is the restructure —
+three mechanisms, each mapped onto the pieces the rest of the repo
+already has:
+
+1. **Double-buffered device state.** Device merges go through cached
+   jitted entry points with DONATED arguments
+   (`core.batch_merge.merge_slots`): the incoming side (a freshly
+   expanded peer delta the pipeline owns) aliases its buffers into the
+   output, so window N+1's merge dispatches while window N's result is
+   still being read back/encoded on the host stage.
+   `jax.block_until_ready` happens only at the publish boundary — inside
+   the host stage's publish task, off the round thread.
+
+2. **Async host stages.** One `HostStage` worker thread owns WAL append,
+   delta encode, and gossip send. Its bounded FIFO queue is the ordering
+   guarantee: append(step) is submitted before publish(step), so
+   durability still precedes visibility (the PR-2 write-ahead contract)
+   — just not on the round thread. A full queue blocks the submitter
+   (backpressure, billed `overlap.stalls`); a task exception fail-stops
+   the stage (re-raised at the next submit/drain — async must not
+   swallow durability failures). Inbound, a `DeltaPrefetcher` thread
+   runs the fetch+decode half of `elastic.sweep_deltas` ahead of the
+   round, pre-expanding topk_rmv deltas to mergeable full states
+   (`delta.expand_delta` — host scatter cost paid off-thread) into a
+   bounded `ApplyQueue`.
+
+3. **Multi-window batched dispatch.** When the apply queue holds >=2
+   mergeable windows, `drain_into` folds them — current state riding
+   along — in one `core.batch_merge.fold_states` call (log2 N batched
+   dispatches) instead of one dispatch per window.
+
+Overflow policy (`ApplyQueue`): drop-oldest-delta-keep-anchor, mirroring
+`net/tcp.py`'s send-queue shed. Dropping delta seq k breaks the chained
+contiguity obligation for that member, so the shed also drops its later
+queued deltas, records a per-member HOLE (`overlap.dropped_deltas`
+billed per drop), and refuses further deltas from that member until the
+prefetcher lands a full-snapshot anchor with seq >= the hole — the
+anchor covers the gap by construction (a snapshot is the whole history).
+Snapshots themselves are latest-wins per member, exactly like the tcp
+send queue.
+
+Correctness is unchanged from the serial path because ALL gossiped
+payloads are joins: JOIN-engine deltas expand to full states whose
+untouched rows are the join identity, and MONOID engines always gossip
+through the versioned-row lift (row-replace is idempotent and
+commutative). Apply order and duplication are therefore free —
+bit-identical convergence is pinned by tests/test_overlap.py and
+`make overlap-demo`.
+
+Env knobs (all read at pipeline construction):
+  CCRDT_OVERLAP        on unless set to 0/false/no/off (default ON)
+  CCRDT_OVERLAP_QUEUE  apply-queue depth (default 32)
+  CCRDT_OVERLAP_BATCH  max windows folded per batched dispatch (default 8)
+  CCRDT_OVERLAP_HOSTQ  host-stage queue depth (default 8)
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import warnings
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from ..obs import events as obs_events
+from ..obs import spans as obs_spans
+
+# CPU/older backends cannot alias donated buffers and warn about it per
+# compile. The donation contract is honored regardless (the pipeline
+# never reuses a donated operand), so the warning is noise on the CI
+# backend; scoped by message, not category-wide.
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable"
+)
+
+ENV_FLAG = "CCRDT_OVERLAP"
+ENV_QUEUE = "CCRDT_OVERLAP_QUEUE"
+ENV_BATCH = "CCRDT_OVERLAP_BATCH"
+ENV_HOSTQ = "CCRDT_OVERLAP_HOSTQ"
+
+_FALSE = ("0", "false", "no", "off")
+
+
+def enabled(flag: Optional[bool] = None) -> bool:
+    """Resolve the overlap switch: an explicit CLI value wins, else
+    CCRDT_OVERLAP (ON unless set to 0/false/no/off)."""
+    if flag is not None:
+        return bool(flag)
+    return os.environ.get(ENV_FLAG, "1").strip().lower() not in _FALSE
+
+
+def _env_int(name: str, default: int, floor: int = 1) -> int:
+    try:
+        return max(floor, int(os.environ.get(name, "") or default))
+    except ValueError:
+        return default
+
+
+def queue_depth() -> int:
+    return _env_int(ENV_QUEUE, 32)
+
+
+def batch_cap() -> int:
+    return _env_int(ENV_BATCH, 8, floor=2)
+
+
+def host_queue_depth() -> int:
+    return _env_int(ENV_HOSTQ, 8)
+
+
+# -- the background host stage ------------------------------------------------
+
+
+class HostStage:
+    """One worker thread owning the round's host-side I/O (WAL append,
+    delta encode, gossip send). A SINGLE thread on purpose: the bounded
+    FIFO is the write-ahead ordering guarantee — append(step) submitted
+    before publish(step) runs before it. submit() blocks when the queue
+    is full (backpressure toward the round thread, billed
+    `overlap.stalls`); a task exception fail-stops the stage and
+    re-raises at the next submit/drain/close, so a durability failure
+    cannot be silently swallowed by asynchrony. Phase spans inside tasks
+    (wal_append, delta_encode, gossip_send, snapshot) land on this
+    thread's tid and are therefore classified OVERLAPPABLE by
+    `obs.spans.attribute`."""
+
+    def __init__(self, metrics: Any = None, depth: int = 8, name: str = "host"):
+        self.metrics = metrics
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        self._exc: Optional[BaseException] = None
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"overlap-{name}"
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                return
+            fn, args, kwargs = item
+            try:
+                if self._exc is None:  # fail-stop: drop work after a failure
+                    fn(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001 — re-raised at submit
+                self._exc = e
+            finally:
+                self._q.task_done()
+
+    def _check(self) -> None:
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            self._closed = True
+            raise exc
+
+    def submit(self, fn, *args, **kwargs) -> None:
+        self._check()
+        if self._closed:
+            raise RuntimeError("HostStage is closed")
+        if self.metrics is not None and self._q.full():
+            self.metrics.count("overlap.stalls")
+        self._q.put((fn, args, kwargs))  # blocks when full: backpressure
+        if self.metrics is not None:
+            self.metrics.count("overlap.host_tasks")
+
+    def drain(self) -> None:
+        """Block until every submitted task has run (the flush barrier
+        before a publish boundary the caller must observe, and before
+        the final convergence loop)."""
+        self._q.join()
+        self._check()
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._q.put(None)
+            self._q.join()
+            self._thread.join(timeout=10)
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            raise exc
+
+
+# -- the bounded inbound apply queue ------------------------------------------
+
+
+class _Entry:
+    __slots__ = ("kind", "member", "seq", "payload", "merged")
+
+    def __init__(self, kind: str, member: str, seq: int, payload: Any,
+                 merged: Any):
+        self.kind = kind          # "delta" | "snap"
+        self.member = member
+        self.seq = seq
+        self.payload = payload    # decoded delta / fetched peer state
+        self.merged = merged      # pre-expanded mergeable state, or None
+
+
+class ApplyQueue:
+    """Bounded queue of pre-decoded inbound payloads, shed with the
+    net/tcp.py send-queue policy: oldest DELTA first, anchors kept,
+    snapshots latest-wins per member. Shedding a delta opens a per-member
+    HOLE (chained deltas are valid only gap-free): the member's later
+    queued deltas are purged with it, further deltas are refused, and
+    only a full snapshot with seq >= the hole heals it."""
+
+    def __init__(self, depth: int = 32, metrics: Any = None):
+        self.depth = max(1, depth)
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._q: "deque[_Entry]" = deque()
+        self._holes: Dict[str, int] = {}  # member -> min healing snap seq
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def dirty_floor(self, member: str) -> Optional[int]:
+        """The member's open hole (lowest snapshot seq that heals it),
+        or None when its delta chain is intact."""
+        with self._lock:
+            return self._holes.get(member)
+
+    def _count(self, name: str, n: int = 1) -> None:
+        if self.metrics is not None:
+            self.metrics.count(name, n)
+
+    def _shed_locked(self) -> None:
+        """Make room (lock held): drop the oldest delta plus the same
+        member's later queued deltas (contiguity), recording the hole; a
+        queue of only snapshots drops its oldest (a hole marks it for
+        refetch — the newer anchor on the store still covers it)."""
+        victim = next((e for e in self._q if e.kind == "delta"), None)
+        if victim is not None:
+            dropped = [
+                e for e in self._q
+                if e.kind == "delta" and e.member == victim.member
+                and e.seq >= victim.seq
+            ]
+            for e in dropped:
+                self._q.remove(e)
+            hole = max(e.seq for e in dropped)
+            self._holes[victim.member] = max(
+                self._holes.get(victim.member, -1), hole
+            )
+            self._count("overlap.dropped_deltas", len(dropped))
+            return
+        e = self._q.popleft()  # all snaps: oldest snap goes
+        self._holes[e.member] = max(self._holes.get(e.member, -1), e.seq)
+        self._count("overlap.dropped_snaps")
+
+    def put_delta(self, member: str, seq: int, payload: Any,
+                  merged: Any = None) -> bool:
+        """Enqueue delta `seq` of `member`; False when refused (open
+        hole — the caller must stop chaining until an anchor lands)."""
+        with self._lock:
+            if member in self._holes:
+                return False
+            if len(self._q) >= self.depth:
+                self._shed_locked()
+            if member in self._holes:
+                # The shed just holed THIS member's chain; the incoming
+                # delta is past the hole and useless until the anchor.
+                self._count("overlap.dropped_deltas")
+                return False
+            self._q.append(_Entry("delta", member, seq, payload, merged))
+            return True
+
+    def put_snap(self, member: str, seq: int, payload: Any,
+                 merged: Any = None) -> bool:
+        """Enqueue a full-snapshot anchor (latest-wins per member). Heals
+        the member's hole when seq covers it; an anchor BELOW an open
+        hole is refused (it cannot cover the gap)."""
+        with self._lock:
+            hole = self._holes.get(member)
+            if hole is not None and seq < hole:
+                return False
+            stale = [
+                e for e in self._q if e.kind == "snap" and e.member == member
+            ]
+            for e in stale:
+                self._q.remove(e)
+            if len(self._q) >= self.depth:
+                self._shed_locked()
+            if self._holes.get(member, -1) > seq:
+                return False  # the shed re-holed us above this anchor
+            self._q.append(_Entry("snap", member, seq, payload, merged))
+            self._holes.pop(member, None)
+            return True
+
+    def pop_all(self) -> List[_Entry]:
+        with self._lock:
+            out = list(self._q)
+            self._q.clear()
+            return out
+
+
+# -- the inbound prefetcher ---------------------------------------------------
+
+
+class DeltaPrefetcher:
+    """The fetch+validate+decode half of `elastic.sweep_deltas`, run
+    AHEAD of the round: chain contiguous deltas per peer from a fetch
+    cursor, fall back to the full-snapshot anchor on a gap (or an
+    ApplyQueue hole), and pre-expand topk_rmv deltas to mergeable full
+    states so the round thread's fold is pure device work. `poll()` is
+    the thread-free core — the sim chaos test drives it synchronously
+    for determinism; `start()` wraps it in a daemon thread whose
+    `round.gossip_recv` spans (emitted inside the transport fetch paths)
+    land on their own tid and read as OVERLAPPABLE."""
+
+    def __init__(self, store: Any, dense: Any, like_state: Any,
+                 apq: ApplyQueue, metrics: Any = None):
+        from .delta import like_delta_for
+        from .elastic import _resolve_monoid
+        from .monoid import MonoidLift
+
+        dense, like_state = _resolve_monoid(dense, like_state, "DeltaPrefetcher")
+        self.store = store
+        self.dense = dense
+        self.like_state = like_state
+        self.apq = apq
+        self.metrics = metrics if metrics is not None else store.metrics
+        self._like_delta = like_delta_for(dense, like_state)
+        # Lifted monoid states carry host-side row versions; they apply
+        # through apply_monoid_row_delta / MonoidLift.merge sequentially,
+        # never through the batched device fold.
+        self._foldable = not isinstance(dense, MonoidLift)
+        self.cursors: Dict[str, int] = {}  # highest seq ENQUEUED per member
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _fetch_snap(self, member: str, floor: int) -> int:
+        """Fetch `member`'s latest anchor if it advances past `floor`;
+        returns the new cursor position (or `floor` unchanged)."""
+        got = self.store.fetch(member, self.like_state, dense=self.dense)
+        if got is None:
+            return floor
+        seq, peer = got
+        if seq <= floor and self.apq.dirty_floor(member) is None:
+            return floor
+        if self.apq.put_snap(
+            member, seq, peer, peer if self._foldable else None
+        ):
+            self.metrics.count("overlap.prefetched_snaps")
+            return max(floor, seq)
+        return floor
+
+    def poll(self) -> int:
+        """One prefetch pass over every peer; returns entries enqueued."""
+        from .delta import TopkRmvDelta, delta_in_bounds, expand_delta
+
+        store = self.store
+        n = 0
+        members = sorted(
+            set(store.snapshot_members()) | set(store.delta_members())
+        )
+        for m in members:
+            if m == store.member:
+                continue
+            cur = self.cursors.get(m, -1)
+            hole = self.apq.dirty_floor(m)
+            if hole is not None:
+                # Anchor-only until the hole is covered: deltas past a
+                # dropped seq can never restore chain contiguity.
+                snap_seq = store.snapshot_seq(m)
+                if snap_seq is not None and snap_seq >= hole:
+                    new = self._fetch_snap(m, cur)
+                    n += int(new > cur)
+                    cur = new
+                self.cursors[m] = cur
+                continue
+            avail = set(store.delta_seqs(m))
+            if cur + 1 not in avail:
+                # First contact (or a pruned tail): the chain cannot
+                # start from here, so land the anchor FIRST — one poll
+                # then yields anchor + the deltas chained behind it,
+                # instead of burning a second pass. When the chain IS
+                # walkable the anchor is skipped: deltas are cheaper.
+                snap_seq = store.snapshot_seq(m)
+                if snap_seq is not None and snap_seq > cur:
+                    new = self._fetch_snap(m, cur)
+                    n += int(new > cur)
+                    cur = new
+            while cur + 1 in avail:
+                delta = store.fetch_delta(
+                    m, cur + 1, self._like_delta,
+                    validate=lambda d: delta_in_bounds(
+                        self.dense, self.like_state, d
+                    ),
+                )
+                if delta is None:
+                    break  # torn/mismatched write: retry next poll
+                merged = None
+                if self._foldable and isinstance(delta, TopkRmvDelta):
+                    try:
+                        merged = expand_delta(self.dense, delta)
+                    except Exception:  # noqa: BLE001 — fold is best-effort
+                        merged = None
+                if not self.apq.put_delta(m, cur + 1, delta, merged):
+                    break  # queue holed this member: anchor path next poll
+                cur += 1
+                n += 1
+                self.metrics.count("overlap.prefetched_deltas")
+            snap_seq = store.snapshot_seq(m)
+            if snap_seq is not None and snap_seq > cur:
+                new = self._fetch_snap(m, cur)
+                n += int(new > cur)
+                cur = new
+            self.cursors[m] = cur
+        return n
+
+    def start(self, interval: float = 0.002) -> None:
+        self._thread = threading.Thread(
+            target=self._run, args=(interval,), daemon=True,
+            name="overlap-prefetch",
+        )
+        self._thread.start()
+
+    def _run(self, interval: float) -> None:
+        while not self._stop.is_set():
+            try:
+                n = self.poll()
+            except Exception:  # noqa: BLE001 — a flaky peer must not
+                # kill prefetching for the rest; transports are already
+                # total, so this counts real bugs loudly in metrics.
+                self.metrics.count("overlap.prefetch_errors")
+                n = 0
+            if not n:
+                self._stop.wait(interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+
+# -- the pipeline facade ------------------------------------------------------
+
+
+class OverlapPipeline:
+    """What `run_worker` holds in overlap mode: the host stage (outbound
+    WAL/encode/send), the prefetcher+apply queue (inbound), the
+    fold-and-apply drain, and the APPLIED per-peer watermarks
+    (`cursors`) that the lag tracker and status drops read."""
+
+    def __init__(self, store: Any, dense: Any, like_state: Any, *,
+                 metrics: Any = None, depth: Optional[int] = None,
+                 fold_cap: Optional[int] = None,
+                 host_depth: Optional[int] = None,
+                 start_thread: bool = True):
+        self.metrics = metrics if metrics is not None else store.metrics
+        self.apq = ApplyQueue(
+            depth if depth is not None else queue_depth(),
+            metrics=self.metrics,
+        )
+        self.prefetch = DeltaPrefetcher(
+            store, dense, like_state, self.apq, metrics=self.metrics
+        )
+        self.dense = self.prefetch.dense
+        self.host = HostStage(
+            metrics=self.metrics,
+            depth=host_depth if host_depth is not None else host_queue_depth(),
+        )
+        self.fold_cap = fold_cap if fold_cap is not None else batch_cap()
+        self.cursors: Dict[str, int] = {}  # highest seq APPLIED per member
+        if start_thread:
+            self.prefetch.start()
+
+    def submit(self, fn, *args, **kwargs) -> None:
+        self.host.submit(fn, *args, **kwargs)
+
+    def _apply_sequential(self, state: Any, entries: List[_Entry]) -> Any:
+        """Fallback / non-foldable application, entry by entry with the
+        sweep_deltas total-failure policy (a malformed payload must not
+        crash the round)."""
+        from .delta import apply_any_delta
+
+        for e in entries:
+            try:
+                if e.kind == "snap":
+                    state = self.dense.merge(state, e.payload)
+                else:
+                    state = apply_any_delta(self.dense, state, e.payload)
+            except Exception:  # noqa: BLE001 — deliberately total
+                self.metrics.count("overlap.apply_errors")
+        return state
+
+    def drain_into(self, state: Any) -> Any:
+        """Fold every queued window into `state` on the ROUND thread:
+        mergeable entries (pre-expanded deltas + JOIN snapshots) go
+        through `core.batch_merge.fold_states` in chunks of `fold_cap`
+        — >=2 windows become ONE batched dispatch chain — the rest apply
+        sequentially. Join algebra makes the order irrelevant; the
+        flight-recorder apply events are emitted in queue order, which
+        preserves per-member seq contiguity for `ccrdt_trace audit`."""
+        entries = self.apq.pop_all()
+        if not entries:
+            return state
+        from ..core.batch_merge import fold_states, merge_into
+
+        mergeable = [e for e in entries if e.merged is not None]
+        rest = [e for e in entries if e.merged is None]
+        tok = (
+            obs_spans.begin(
+                "round.delta_apply", via="overlap", n=len(entries)
+            )
+            if obs_spans.ACTIVE
+            else None
+        )
+        try:
+            merge = self.dense.merge
+            i = 0
+            while i < len(mergeable):
+                chunk = mergeable[i:i + self.fold_cap]
+                i += len(chunk)
+                try:
+                    if len(chunk) >= 2:
+                        state = fold_states(
+                            merge, [state] + [e.merged for e in chunk]
+                        )
+                        self.metrics.count("overlap.folds")
+                        self.metrics.count(
+                            "overlap.folded_windows", len(chunk)
+                        )
+                    else:
+                        state = merge_into(merge, state, chunk[0].merged)
+                except Exception:  # noqa: BLE001 — fall back per entry
+                    state = self._apply_sequential(state, chunk)
+            state = self._apply_sequential(state, rest)
+        finally:
+            obs_spans.end(tok)
+        for e in entries:
+            if e.kind == "delta":
+                obs_events.emit("delta.apply", origin=e.member, dseq=e.seq)
+            else:
+                obs_events.emit("snap.apply", origin=e.member, step=e.seq)
+            if e.seq > self.cursors.get(e.member, -1):
+                self.cursors[e.member] = e.seq
+        self.metrics.count("overlap.windows", len(entries))
+        return state
+
+    def close(self, state: Any) -> Any:
+        """Flush at end of the step loop: host tasks durable (WAL tail +
+        last publishes), prefetcher stopped, queue remnants folded in.
+        The caller then runs the ordinary SERIAL final-convergence loop
+        — it must keep adopting late-detected deaths and needs no
+        pipeline."""
+        self.host.drain()
+        self.prefetch.stop()
+        state = self.drain_into(state)
+        self.host.close()
+        return state

@@ -177,6 +177,34 @@ def bench_dense(R, I, D_DCS, K, M, B, Br, windows, rounds_per_window):
     p99_e2e_ms = float(np.percentile(singles, 99) * 1e3)
     dispatch_overhead_ms = float(np.percentile(overheads, 50) * 1e3)
 
+    # Overlapped single-dispatch e2e (PR 7): the parallel/overlap.py
+    # round shape. Every round dispatches immediately; the host readback
+    # — the publish boundary's block_until_ready — rides a HostStage
+    # worker every PUB_EVERY rounds instead of blocking the round thread
+    # each dispatch. The final drain + readback sits INSIDE the timed
+    # region (folded into the last sample), so queued device work cannot
+    # masquerade as throughput; the stage's bounded queue provides
+    # backpressure if the device ever falls behind the submissions.
+    from antidote_ccrdt_tpu.parallel.overlap import HostStage
+
+    PUB_EVERY = 4
+    stage = HostStage(Metrics(), name="bench-readback")
+    st2 = run_one(st1, single_ops[0])
+    _sync(st2)
+    marks = [time.perf_counter()]
+    for i, ops in enumerate(single_ops):
+        st2 = run_one(st2, ops)
+        if (i + 1) % PUB_EVERY == 0:
+            stage.submit(_sync, st2)
+        marks.append(time.perf_counter())
+    stage.drain()
+    _sync(st2)
+    marks[-1] = time.perf_counter()  # last sample swallows the flush
+    stage.close()
+    olap = [b - a for a, b in zip(marks, marks[1:])]
+    p50_e2e_overlap_ms = float(np.percentile(olap, 50) * 1e3)
+    p99_e2e_overlap_ms = float(np.percentile(olap, 99) * 1e3)
+
     # Batched replica-state merge: all R pairwise merges in ONE dispatch
     # (state row r joined with peer row (r+1) mod R) — the literal north-
     # star "merge thousands of replica states in one vectorized step". The
@@ -285,8 +313,8 @@ def bench_dense(R, I, D_DCS, K, M, B, Br, windows, rounds_per_window):
 
     return (
         apply_rate, extras_rate, extras_ops_rate, p50_ms, p99_ms,
-        p50_e2e_ms, p99_e2e_ms, dispatch_overhead_ms,
-        state_merges_per_sec, hbm, compute,
+        p50_e2e_ms, p99_e2e_ms, p50_e2e_overlap_ms, p99_e2e_overlap_ms,
+        dispatch_overhead_ms, state_merges_per_sec, hbm, compute,
     )
 
 
@@ -598,7 +626,7 @@ def bench_scalar_baseline(R, I, D_DCS, K, n_ops):
     return n_ops / dt
 
 
-def bench_round_phases(R, I, D_DCS, K, M, B, Br, rounds=6):
+def bench_round_phases(R, I, D_DCS, K, M, B, Br, rounds=6, overlap=None):
     """Round-phase span drill (obs/spans.py): run a real two-member
     gossip round loop — apply + device sync + WAL append + delta publish
     + peer sweep + lag update — at the operating point with the span
@@ -609,6 +637,15 @@ def bench_round_phases(R, I, D_DCS, K, M, B, Br, rounds=6):
     phase owns that time, how much is serial host work vs overlappable
     I/O, and how much no span accounts for (the gap). chaos_gate.py runs
     the same drill tiny and fails if any load-bearing phase goes dark.
+
+    `overlap` routes the round through parallel/overlap.py (None = the
+    CCRDT_OVERLAP default, ON): device sync + WAL append + publish ride
+    the pipeline's HostStage thread, the peer side prefetches through a
+    threadless `DeltaPrefetcher.poll` + `drain_into`. The wal_append /
+    delta_encode / gossip spans then land on the host-stage tid and
+    attribute() reclassifies them serial -> overlappable — the measured
+    proof that the dispatch gap collapses. The `overlap` counter block in
+    the result is what chaos_gate.py asserts nonzero when the mode is on.
     """
     import tempfile
 
@@ -619,11 +656,14 @@ def bench_round_phases(R, I, D_DCS, K, M, B, Br, rounds=6):
     from antidote_ccrdt_tpu.harness.wal import ElasticWal
     from antidote_ccrdt_tpu.obs import lag as obs_lag
     from antidote_ccrdt_tpu.obs import spans
+    from antidote_ccrdt_tpu.parallel import overlap as overlap_mod
     from antidote_ccrdt_tpu.parallel.elastic import (
         DeltaPublisher,
         GossipStore,
         sweep_deltas,
     )
+
+    ovl_on = overlap_mod.enabled(overlap)
 
     D = registry.make_dense(
         "topk_rmv", n_ids=I, n_dcs=D_DCS, size=K, slots_per_id=M
@@ -652,6 +692,22 @@ def bench_round_phases(R, I, D_DCS, K, M, B, Br, rounds=6):
             peer_state = D.init(n_replicas=R, n_keys=1)
             cursors = {}
             owned = list(range(R))
+            ovl = None
+            if ovl_on:
+                # Threadless prefetch (poll() driven inline, deadline-
+                # bounded) keeps the drill deterministic; the HostStage
+                # is the real worker thread — its spans land off-tid.
+                ovl = overlap_mod.OverlapPipeline(
+                    peer, D, peer_state, metrics=peer.metrics,
+                    start_thread=False,
+                )
+
+            def _boundary(prev, snap, r):
+                with spans.span("round.device_sync", step=r, via="overlap"):
+                    _sync(snap)
+                wal.log_step(r, owned, prev, snap)
+                pub.publish(snap)
+
             for r in range(rounds):
                 e2e = spans.begin("round.e2e", step=r)
                 prev = state
@@ -659,23 +715,52 @@ def bench_round_phases(R, I, D_DCS, K, M, B, Br, rounds=6):
                     "round.device_dispatch", site="bench.apply_ops", n=B + Br
                 ):
                     state = run_one(state, batches[1 + r])
-                with spans.span("round.device_sync", step=r):
-                    _sync(state)
-                wal.log_step(r, owned, prev, state)
-                pub.publish(state)
-                peer_state, _stats = sweep_deltas(peer, D, peer_state, cursors)
+                if ovl is not None:
+                    ovl.submit(_boundary, prev, state, r)
+                    deadline = time.perf_counter() + 0.25
+                    while (
+                        not ovl.prefetch.poll()
+                        and len(ovl.apq) == 0
+                        and time.perf_counter() < deadline
+                    ):
+                        time.sleep(0.001)
+                    peer_state = ovl.drain_into(peer_state)
+                else:
+                    with spans.span("round.device_sync", step=r):
+                        _sync(state)
+                    wal.log_step(r, owned, prev, state)
+                    pub.publish(state)
+                    peer_state, _stats = sweep_deltas(
+                        peer, D, peer_state, cursors
+                    )
                 with spans.span("round.lag_update"):
                     tracker.observe_published("bench0", pub.seq)
+                    applied = (ovl.cursors if ovl is not None else cursors)
                     tracker.observe_applied(
-                        "bench0", cursors.get("bench0", -1)
+                        "bench0", applied.get("bench0", -1)
                     )
                     tracker.export_to(node.metrics)
                 spans.end(e2e)
+            if ovl is not None:
+                ovl.host.drain()  # last publish visible before final poll
+                # Poll to quiescence: one pass only advances a fresh
+                # member past its anchor — the delta chain behind it
+                # needs the next pass (threaded mode loops for free).
+                while ovl.prefetch.poll():
+                    pass
+                peer_state = ovl.close(peer_state)
             wal.close()
             recs = spans.drain()
     att = spans.attribute({"bench0": recs})
     fleet = att["fleet"]
+    ovl_counters = {
+        k: v
+        for src in (node.metrics, peer.metrics)
+        for k, v in src.snapshot()["counters"].items()
+        if k.startswith("overlap.")
+    }
     return {
+        "overlap": {"enabled": ovl_on, **ovl_counters},
         "rounds": fleet["rounds"],
         "e2e_ms_p50": round(fleet["e2e_ms_p50"], 3),
         "serial_ms_p50": round(fleet["serial_ms_p50"], 3),
@@ -739,8 +824,8 @@ def main():
 
     (
         apply_rate, extras_rate, extras_ops_rate, p50_ms, p99_ms,
-        p50_e2e_ms, p99_e2e_ms, dispatch_overhead_ms,
-        state_merge_rate, hbm, compute,
+        p50_e2e_serial_ms, p99_e2e_serial_ms, p50_e2e_ms, p99_e2e_ms,
+        dispatch_overhead_ms, state_merge_rate, hbm, compute,
     ) = bench_dense(R, I, D_DCS, K, M, B, Br, windows, W)
     curve = bench_curve(R, I, D_DCS, K, M, curve_points, **curve_cfg)
     curve.append(
@@ -750,8 +835,16 @@ def main():
             "merges_per_sec": round(apply_rate),
             "p50_round_ms_windowed": round(p50_ms, 2),
             "p99_round_ms_windowed": round(p99_ms, 2),
+            # The headline e2e is the OVERLAPPED pipeline (PR 7: readback
+            # rides the HostStage; the round thread only dispatches). The
+            # serial numbers stay alongside so the mode switch can never
+            # read as a silent speedup — the sweep points above are all
+            # serial-mode.
             "p50_round_ms_e2e": round(p50_e2e_ms, 2),
             "p99_round_ms_e2e": round(p99_e2e_ms, 2),
+            "p50_round_ms_e2e_serial": round(p50_e2e_serial_ms, 2),
+            "p99_round_ms_e2e_serial": round(p99_e2e_serial_ms, 2),
+            "e2e_mode": "overlapped(pub_every=4)",
             "source": "headline",
         }
     )
@@ -827,6 +920,8 @@ def main():
         "p99_round_ms_windowed": round(p99_ms, 2),
         "p50_round_ms_e2e": round(p50_e2e_ms, 2),
         "p99_round_ms_e2e": round(p99_e2e_ms, 2),
+        "p50_round_ms_e2e_serial": round(p50_e2e_serial_ms, 2),
+        "e2e_mode": "overlapped",
         "operating_point_batch_adds": B,
         "replica_state_merges_per_sec": round(state_merge_rate, 1),
         "baseline_cpu_merges_per_sec": round(baseline_rate),

@@ -7,15 +7,25 @@ stdout — including (for rounds that ran the batched-dispatch benchmark)
 string. This gate:
 
 1. parses every round, taking the best ``merges_per_sec`` per round
-   (rounds without the metric — e.g. setup-only rounds — are skipped);
-2. compares the LATEST round that has the metric against the best of
-   all PRIOR rounds;
-3. fails (exit 1) when the latest regressed more than ``--tolerance``
-   (default 20%) below that best — the same batched-dispatch throughput
-   `obs.profile` now measures live, gated at CI time.
+   (rounds without the metric — e.g. setup-only rounds — are skipped)
+   plus the ``backend`` tag from the summary line;
+2. compares, WITHIN each backend group, the latest round that has the
+   metric against the best of its prior rounds — a CPU-fallback round
+   must not be graded against TPU numbers (nor launder a TPU regression
+   by resetting the baseline); rounds with no backend tag group
+   together;
+3. fails (exit 1) when any group's latest regressed more than
+   ``--tolerance`` (default 20%) below its best prior — the same
+   batched-dispatch throughput `obs.profile` now measures live, gated
+   at CI time;
+4. gates ``dispatch_gap_ms_p50`` the same way (PR 7 promoted it from
+   report-only): the latest attribution-bearing round fails when its
+   gap grew more than ``--gap-tolerance`` (default 20%) AND more than
+   0.25 ms absolute over the best (lowest) prior carrier — the
+   absolute floor keeps near-zero gaps from tripping on noise.
 
-With fewer than two metric-bearing rounds there is nothing to compare:
-the gate passes vacuously (exit 0) and says so.
+With fewer than two comparable rounds a gate passes vacuously (exit 0)
+and says so. The overall exit code is the worst of both gates.
 
 Run: ``python scripts/bench_gate.py [--bench-dir DIR] [--tolerance 0.2]``
 (also wired as ``make bench-gate`` and into ``make chaos``).
@@ -32,6 +42,7 @@ import sys
 from typing import Dict, List, Optional, Tuple
 
 _METRIC_RE = re.compile(r'"merges_per_sec":\s*([0-9][0-9_.eE+]*)')
+_BACKEND_RE = re.compile(r'"backend":\s*"([A-Za-z0-9_]+)"')
 
 
 def round_number(path: str) -> int:
@@ -40,54 +51,89 @@ def round_number(path: str) -> int:
     return int(m.group(1)) if m else -1
 
 
-def best_merges_per_sec(path: str) -> Optional[float]:
-    """Best merges_per_sec in one round dump, or None when the round
-    didn't run the dispatch benchmark (or the file is torn)."""
+def round_metrics(path: str) -> Tuple[Optional[float], Optional[str]]:
+    """(best merges_per_sec, backend tag) of one round dump — (None,
+    None) when the round didn't run the dispatch benchmark (or the file
+    is torn). The backend rides the summary line so a CPU-fallback run
+    is never graded against accelerator numbers."""
     try:
         with open(path) as f:
             doc = json.load(f)
     except (OSError, ValueError):
-        return None
-    # The metric lives inside the "tail" stdout capture; json.load has
+        return None, None
+    # The metrics live inside the "tail" stdout capture; json.load has
     # already unescaped it, so a plain regex over the text applies.
     tail = str(doc.get("tail", ""))
     vals = [float(v) for v in _METRIC_RE.findall(tail)]
-    return max(vals) if vals else None
+    backends = _BACKEND_RE.findall(tail)
+    return (max(vals) if vals else None), (backends[-1] if backends else None)
 
 
-def load_rounds(bench_dir: str) -> List[Tuple[int, str, Optional[float]]]:
-    """[(round_no, path, best-or-None)] sorted by round number."""
+def best_merges_per_sec(path: str) -> Optional[float]:
+    """Best merges_per_sec in one round dump, or None when the round
+    didn't run the dispatch benchmark (or the file is torn)."""
+    return round_metrics(path)[0]
+
+
+def load_rounds(
+    bench_dir: str,
+) -> List[Tuple[int, str, Optional[float], Optional[str]]]:
+    """[(round_no, path, best-or-None, backend-or-None)] sorted by
+    round number."""
     paths = sorted(
         glob.glob(os.path.join(bench_dir, "BENCH_r*.json")), key=round_number
     )
-    return [(round_number(p), p, best_merges_per_sec(p)) for p in paths]
+    return [(round_number(p), p, *round_metrics(p)) for p in paths]
 
 
 def evaluate(
-    rounds: List[Tuple[int, str, Optional[float]]], tolerance: float
+    rounds: List[Tuple[int, str, Optional[float], Optional[str]]],
+    tolerance: float,
 ) -> Tuple[int, str]:
-    """(exit_code, human verdict) for a parsed round list."""
-    with_metric = [(n, p, v) for n, p, v in rounds if v is not None]
+    """(exit_code, human verdict) for a parsed round list. Rounds are
+    compared within their backend group only (None groups with None):
+    throughput on the CPU CI fallback and on a real accelerator are
+    different experiments, and cross-grading would either fail every
+    CPU round or let a later CPU round reset the accelerator baseline."""
+    with_metric = [r for r in rounds if r[2] is not None]
     if len(with_metric) < 2:
         return 0, (
             f"bench-gate: only {len(with_metric)} round(s) carry "
             "merges_per_sec — nothing to compare, passing vacuously"
         )
-    latest_n, latest_p, latest_v = with_metric[-1]
-    prior = with_metric[:-1]
-    best_n, _best_p, best_v = max(prior, key=lambda r: r[2])
-    floor = best_v * (1.0 - tolerance)
-    verdict = (
-        f"bench-gate: r{latest_n:02d} best merges_per_sec = {latest_v:,.0f} "
-        f"vs best prior r{best_n:02d} = {best_v:,.0f} "
-        f"(floor at -{tolerance:.0%}: {floor:,.0f})"
-    )
-    if latest_v < floor:
-        return 1, (
-            f"{verdict}\nFAIL: batched-dispatch throughput regressed "
-            f"{1 - latest_v / best_v:.1%} (> {tolerance:.0%} allowed)"
+    code = 0
+    lines: List[str] = []
+    seen: List[Optional[str]] = []
+    for be in (r[3] for r in with_metric):
+        if be not in seen:
+            seen.append(be)
+    for be in seen:
+        grp = [r for r in with_metric if r[3] == be]
+        tag = f"[{be}]" if be is not None else ""
+        if len(grp) < 2:
+            lines.append(
+                f"bench-gate{tag}: only {len(grp)} round(s) on this "
+                "backend — nothing to compare, passing vacuously"
+            )
+            continue
+        latest_n, _latest_p, latest_v, _ = grp[-1]
+        prior = grp[:-1]
+        best_n, _best_p, best_v, _ = max(prior, key=lambda r: r[2])
+        floor = best_v * (1.0 - tolerance)
+        verdict = (
+            f"bench-gate{tag}: r{latest_n:02d} best merges_per_sec = "
+            f"{latest_v:,.0f} vs best prior r{best_n:02d} = {best_v:,.0f} "
+            f"(floor at -{tolerance:.0%}: {floor:,.0f})"
         )
-    return 0, f"{verdict}\nOK: within tolerance"
+        if latest_v < floor:
+            code = 1
+            lines.append(
+                f"{verdict}\nFAIL: batched-dispatch throughput regressed "
+                f"{1 - latest_v / best_v:.1%} (> {tolerance:.0%} allowed)"
+            )
+        else:
+            lines.append(f"{verdict}\nOK: within tolerance")
+    return code, "\n".join(lines)
 
 
 def load_topo_rounds(bench_dir: str) -> List[Tuple[int, str, Dict]]:
@@ -118,11 +164,10 @@ def load_attribution_rounds(
 ) -> List[Tuple[int, str, float, float]]:
     """[(round_no, path, dispatch_gap_ms_p50, span_coverage_p50)] for
     every BENCH round whose summary line carries the span-attribution
-    headline (bench.bench_round_phases, r6+). Report-only, like the topo
-    rows: the drift that matters here is ATTRIBUTION drift — coverage
-    sliding down means spans stopped explaining where round time goes,
-    gap sliding up means unowned host time is growing — and both deserve
-    eyes before they deserve a hard gate."""
+    headline (bench.bench_round_phases, r6+). Coverage stays report-only
+    (coverage sliding down means spans stopped explaining where round
+    time goes — that deserves eyes, not an exit code); the GAP is gated
+    by `evaluate_gap` since PR 7 made it a load-bearing perf claim."""
     out: List[Tuple[int, str, float, float]] = []
     for p in sorted(
         glob.glob(os.path.join(bench_dir, "BENCH_r*.json")), key=round_number
@@ -140,6 +185,41 @@ def load_attribution_rounds(
                 (round_number(p), p, float(gaps[-1]), float(covs[-1]))
             )
     return out
+
+
+def evaluate_gap(
+    rounds: List[Tuple[int, str, float, float]],
+    tolerance: float = 0.20,
+    abs_floor_ms: float = 0.25,
+) -> Tuple[int, str]:
+    """(exit_code, verdict) for the dispatch-gap gate: the latest
+    attribution-bearing round fails when its ``dispatch_gap_ms_p50``
+    grew more than `tolerance` relative AND more than `abs_floor_ms`
+    absolute over the best (lowest) prior carrier. Both thresholds must
+    trip: the overlap pipeline drives the gap toward zero, where a pure
+    percentage gate would fail on microseconds of scheduler noise
+    (0.01ms -> 0.02ms is "+100%" and means nothing). Fewer than two
+    carriers pass vacuously."""
+    if len(rounds) < 2:
+        return 0, (
+            f"gap-gate: only {len(rounds)} round(s) carry "
+            "dispatch_gap_ms_p50 — nothing to compare, passing vacuously"
+        )
+    latest_n, _p, latest_gap, _cov = rounds[-1]
+    best_n, _bp, best_gap, _bcov = min(rounds[:-1], key=lambda r: r[2])
+    ceiling = max(best_gap * (1.0 + tolerance), best_gap + abs_floor_ms)
+    verdict = (
+        f"gap-gate: r{latest_n:02d} dispatch_gap_ms_p50 = {latest_gap:.2f} "
+        f"vs best prior r{best_n:02d} = {best_gap:.2f} "
+        f"(ceiling +{tolerance:.0%} and +{abs_floor_ms}ms: {ceiling:.2f})"
+    )
+    if latest_gap > ceiling:
+        return 1, (
+            f"{verdict}\nFAIL: the dispatch gap regressed "
+            f"{latest_gap - best_gap:+.2f}ms — host phases are sliding "
+            "back onto the round thread"
+        )
+    return 0, f"{verdict}\nOK: within tolerance"
 
 
 def attribution_drift(
@@ -176,11 +256,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="directory holding BENCH_r*.json (default: repo root)",
     )
     ap.add_argument("--tolerance", type=float, default=0.20)
+    ap.add_argument(
+        "--gap-tolerance", type=float, default=0.20,
+        help="relative ceiling for the dispatch_gap_ms_p50 gate "
+        "(a 0.25ms absolute floor always applies on top)",
+    )
     args = ap.parse_args(argv)
     rounds = load_rounds(args.bench_dir)
-    for n, p, v in rounds:
+    for n, p, v, be in rounds:
         tag = "-" if v is None else f"{v:,.0f}"
-        print(f"  r{n:02d} {os.path.basename(p)}: {tag}")
+        print(f"  r{n:02d} {os.path.basename(p)} [{be or '?'}]: {tag}")
     for n, p, cz in load_topo_rounds(args.bench_dir):
         print(
             f"  topo r{n:02d} {os.path.basename(p)}: "
@@ -188,11 +273,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"{cz.get('frames', 0):,.0f} frames "
             f"(vs mesh ratio {cz.get('ratio', float('nan')):.2f})"
         )
-    for line in attribution_drift(load_attribution_rounds(args.bench_dir)):
+    attr = load_attribution_rounds(args.bench_dir)
+    for line in attribution_drift(attr):
         print(line)
     code, verdict = evaluate(rounds, args.tolerance)
     print(verdict)
-    return code
+    gap_code, gap_verdict = evaluate_gap(attr, args.gap_tolerance)
+    print(gap_verdict)
+    return max(code, gap_code)
 
 
 if __name__ == "__main__":

@@ -16,10 +16,14 @@ dashboard, the lag tracker, the flight-log cross-checks — reads them.
 A third leg guards the span plane (obs/spans.py): it runs the tiny
 round-phase drill (`bench.bench_round_phases`) with tracing armed and
 fails if any load-bearing phase recorded zero time — the span analogue
-of a counter going dark — or if the phases' serial union stops
-reconciling against the measured `round.e2e` wall time (attribution
-coverage collapse means the instrumentation no longer explains where
-rounds spend their time).
+of a counter going dark — or if the phases' union (serial AND
+host-stage-overlapped: PR 7 moved wal_append/delta_encode/gossip onto
+the overlap pipeline's threads, which re-threads their spans without
+unrecording them) stops reconciling against the measured `round.e2e`
+wall time. When the overlap pipeline is on (CCRDT_OVERLAP, default)
+the leg also requires the pipeline's own counters nonzero —
+`overlap.host_tasks` and `overlap.windows` at zero mean the drill
+silently fell back to the serial path.
 
 Run:  python scripts/chaos_gate.py
 Make: part of `make chaos` (after the pytest leg).
@@ -133,13 +137,18 @@ def main() -> int:
     # -- leg 3: the span plane (round-phase tracing + attribution) ---------
     from bench import bench_round_phases
     from antidote_ccrdt_tpu.obs import spans as obs_spans
+    from antidote_ccrdt_tpu.parallel import overlap as overlap_mod
 
-    rp = bench_round_phases(2, 256, 2, 100, 4, 32, 8, rounds=3)
+    ovl_enabled = overlap_mod.enabled(None)
+    rp = bench_round_phases(2, 256, 2, 100, 4, 32, 8, rounds=3,
+                            overlap=ovl_enabled)
     dark = sorted(
         n for n in obs_spans.PHASES
         if rp["phases_ms_total"].get(n, 0.0) <= 0.0
     )
-    print("== span drill (2 members, 3 rounds, all phases armed) ==")
+    mode = "overlap" if ovl_enabled else "serial"
+    print(f"== span drill (2 members, 3 rounds, {mode} mode, all phases "
+          "armed) ==")
     print(f"  e2e p50 {rp['e2e_ms_p50']:.2f}ms serial "
           f"{rp['serial_ms_p50']:.2f}ms gap {rp['dispatch_gap_ms_p50']:.2f}ms "
           f"coverage {rp['span_coverage_p50']:.1%}")
@@ -152,8 +161,17 @@ def main() -> int:
               f"round.e2e wall (coverage p50 {rp['span_coverage_p50']:.1%} < "
               f"{SPAN_MIN_COVERAGE:.0%})")
         return 1
-    print(f"OK: span leg — all {len(obs_spans.PHASES)} phases lit, "
-          f"serial union explains {rp['span_coverage_p50']:.1%} of round "
+    if ovl_enabled:
+        ovl_zeroed = sorted(
+            n for n in ("overlap.host_tasks", "overlap.windows")
+            if not rp["overlap"].get(n, 0)
+        )
+        if ovl_zeroed:
+            print("FAIL: overlap pipeline counters at zero — the drill "
+                  f"silently fell back to the serial path: {ovl_zeroed}")
+            return 1
+    print(f"OK: span leg — all {len(obs_spans.PHASES)} phases lit, the "
+          f"phase union explains {rp['span_coverage_p50']:.1%} of round "
           f"wall (critical path: {' > '.join(rp['critical_path'][:3])})")
     return 0
 

@@ -283,6 +283,19 @@ def main() -> None:
         "snapshots on every publish",
     )
     ap.add_argument(
+        "--overlap", dest="overlap", action="store_true", default=None,
+        help="overlapped round pipeline (parallel/overlap.py): WAL "
+        "append, delta encode and gossip send run on a background host "
+        "stage, inbound peer deltas are prefetched+pre-decoded into a "
+        "bounded apply queue, and queued windows fold in one batched "
+        "dispatch. Default: on unless CCRDT_OVERLAP=0",
+    )
+    ap.add_argument(
+        "--no-overlap", dest="overlap", action="store_false",
+        help="force the serial round loop (every phase on the round "
+        "thread) regardless of CCRDT_OVERLAP",
+    )
+    ap.add_argument(
         "--lag-anchor-ops", type=float, default=0.0,
         help="lag-driven backpressure (needs --delta): when the lag "
         "tracker shows any peer >= this many ops behind, the publisher "
@@ -516,6 +529,55 @@ def run_worker(store, drill, dense, state, args, result_dir):
             # full snapshot (no _prev), which resyncs every peer.
             pub.seq = start_step
 
+    # --- overlapped round pipeline (tentpole, PR 7): take WAL append,
+    # delta encode and gossip send off the round thread (one FIFO host
+    # stage preserves durable-before-visible), prefetch + pre-decode
+    # inbound peer deltas into a bounded apply queue, and fold queued
+    # windows in one batched dispatch. Default ON (CCRDT_OVERLAP=0 or
+    # --no-overlap forces the serial loop). Convergence is bit-identical
+    # either way — everything gossiped is a join.
+    from antidote_ccrdt_tpu.parallel import overlap as overlap_mod
+
+    ovl = None
+    if overlap_mod.enabled(getattr(args, "overlap", None)):
+        ovl = overlap_mod.OverlapPipeline(
+            store, dense, drill.pub_state(dense, state)
+        )
+        # feed_lag's applied watermarks are now the pipeline's (what
+        # drain_into actually folded), not sweep_deltas' cursor dict.
+        cursors = ovl.cursors
+
+    def _overlap_boundary(view, step, owned_snapshot):
+        """The publish boundary as ONE host-stage task, FIFO after this
+        step's WAL append: block_until_ready at the boundary only (the
+        round thread never waits for readback), then publish, lag/status
+        bookkeeping, and the post-publish compaction checkpoint."""
+        with store.metrics.timer("net.round"):
+            tok = (
+                obs_spans.begin("round.device_sync", step=step, via="overlap")
+                if obs_spans.ACTIVE
+                else None
+            )
+            try:
+                import jax
+
+                jax.block_until_ready(view)
+            except Exception:  # noqa: BLE001 — non-array states are fine
+                pass
+            finally:
+                obs_spans.end(tok)
+            if pub is not None:
+                pub.publish(view)
+            else:
+                store.publish(drill.publish_name, view, step)
+        feed_lag()
+        drop_status(step, owned_snapshot)
+        if wal is not None:
+            # Anchor AFTER the publish (same rule as the serial path):
+            # the compaction watermark must never pass what gossip has
+            # seen — FIFO on this thread gives exactly that order.
+            wal.checkpoint(view, step)
+
     # Background heartbeat: dies with the process, so a crash goes stale.
     def beat():
         while True:
@@ -577,39 +639,96 @@ def run_worker(store, drill, dense, state, args, result_dir):
                     pass
         else:
             state = drill.apply(dense, state, step, sorted(owned))
-        if wal is not None:
-            # Write-ahead: this step's adopt+apply delta must be durable
-            # BEFORE the publish makes it externally visible — a crash
-            # after publish but before append could otherwise leave peers
-            # holding state the restarted worker cannot re-derive.
-            wal.log_step(
-                step, sorted(owned), pre_view, drill.pub_state(dense, state)
-            )
-        if step % args.publish_every == 0:
-            with store.metrics.timer("net.round"):
-                do_publish(store, step)
-                state, _ = do_sweep(store, state)
-            feed_lag()
-            drop_status(step, owned)
+        if ovl is not None:
+            # Overlapped round: fold whatever peer windows the prefetcher
+            # queued (device work — the round thread's only job), then
+            # hand every host phase to the pipeline. WAL append is
+            # submitted FIRST, so on the FIFO host stage this step's
+            # delta is durable before the publish makes it visible —
+            # the same write-ahead order as the serial path, minus the
+            # round thread waiting for it.
+            view = drill.pub_state(dense, state)
+            swept = ovl.drain_into(view)
+            if swept is not view:
+                state = drill.set_view(dense, state, swept)
             if wal is not None:
-                # Anchor AFTER the publish: the compaction watermark must
-                # never pass what gossip has seen (checkpoint durability
-                # substitutes for the compacted deltas only once peers
-                # could fetch the same state).
-                wal.checkpoint(drill.pub_state(dense, state), step)
+                ovl.submit(
+                    wal.log_step, step, sorted(owned), pre_view,
+                    drill.pub_state(dense, state),
+                )
+            if step % args.publish_every == 0:
+                ovl.submit(
+                    _overlap_boundary, drill.pub_state(dense, state),
+                    step, sorted(owned),
+                )
+        else:
+            if wal is not None:
+                # Write-ahead: this step's adopt+apply delta must be
+                # durable BEFORE the publish makes it externally visible
+                # — a crash after publish but before append could
+                # otherwise leave peers holding state the restarted
+                # worker cannot re-derive.
+                wal.log_step(
+                    step, sorted(owned), pre_view,
+                    drill.pub_state(dense, state),
+                )
+            if step % args.publish_every == 0:
+                with store.metrics.timer("net.round"):
+                    do_publish(store, step)
+                    state, _ = do_sweep(store, state)
+                feed_lag()
+                drop_status(step, owned)
+                if wal is not None:
+                    # Anchor AFTER the publish: the compaction watermark
+                    # must never pass what gossip has seen (checkpoint
+                    # durability substitutes for the compacted deltas
+                    # only once peers could fetch the same state).
+                    wal.checkpoint(drill.pub_state(dense, state), step)
         obs_spans.end(e2e_tok)
         time.sleep(args.step_sleep)
 
+    if ovl is not None:
+        # Flush the pipeline before settling: host tasks durable (WAL
+        # tail + last publishes), prefetcher stopped, queued windows
+        # folded in. The convergence loop below is the ordinary SERIAL
+        # path on purpose — it must keep adopting late-detected deaths,
+        # and it sweeps full snapshots without needing the pipeline.
+        view = drill.pub_state(dense, state)
+        swept = ovl.close(view)
+        if swept is not view:
+            state = drill.set_view(dense, state, swept)
+
     # Final convergence: publish/sweep until every member that ever
-    # published has either published its FINAL state (step >= STEPS) or is
-    # confidently dead. Gating on snapshots rather than instantaneous
-    # liveness means a live peer whose heartbeat thread stalls for one
-    # timeout window is still waited for (its snapshot step says it isn't
-    # done) instead of being dropped mid-convergence; the crashed victim
-    # is exempted by a stale-beyond-doubt heartbeat.
-    store.publish(drill.publish_name, drill.pub_state(dense, state), STEPS)
+    # published has either published its FINAL state or is confidently
+    # dead. Gating on snapshots rather than instantaneous liveness means
+    # a live peer whose heartbeat thread stalls for one timeout window is
+    # still waited for (its snapshot step says it isn't done) instead of
+    # being dropped mid-convergence; the crashed victim is exempted by a
+    # stale-beyond-doubt heartbeat.
+    #
+    # "Final" is STEPS + the number of members THIS worker believes
+    # confidently dead, published only AFTER an adopt pass under that
+    # belief. A bare seq==STEPS barrier has a race: a survivor that
+    # detects the victim's death only after its step loop could publish
+    # STEPS (pre-adoption), a peer sees "finished", sweeps that
+    # pre-adoption snapshot and exits — the victim's trailing steps
+    # reach no one. Tying the advertised seq to the death count means a
+    # peer that has itself seen the death keeps sweeping until some
+    # snapshot POSTDATES an adoption pass that accounted for it.
+    # Death is STICKY here: a member once confirmed stale-beyond-doubt
+    # has had its replicas adopted (ownership only grows), so a late
+    # heartbeat from it — a starved-but-doomed victim flapping back
+    # within the timeout window — must not resurrect it into the pending
+    # set or the exit-time alive report. The deadline extends while the
+    # barrier observes progress (pending membership or peer seqs
+    # changing),
+    # so a victim running slow under load gets waited out instead of
+    # abandoned at a flat cutoff; a truly wedged fleet still exits.
     deadline = time.time() + 10
-    while time.time() < deadline:
+    hard_deadline = time.time() + 60
+    confirmed_dead: set = set()
+    last_progress = None
+    while time.time() < min(deadline, hard_deadline):
         # Keep adopting here too: a victim whose death is only DETECTED
         # after the step loop ended (slow failure detection under load)
         # would otherwise leave its trailing steps applied by no one —
@@ -621,21 +740,38 @@ def run_worker(store, drill, dense, state, args, result_dir):
         owned_prev = owned
         swept, _ = sweep(store, dense, drill.pub_state(dense, state))
         state = drill.set_view(dense, state, swept)
-        store.publish(drill.publish_name, drill.pub_state(dense, state), STEPS)
+        alive_now = set(store.alive_members(confident_stale))
+        confirmed_dead |= {
+            m for m in store.members()
+            if m != args.member and m not in alive_now
+        }
+        dead_n = len(confirmed_dead)
+        store.publish(
+            drill.publish_name, drill.pub_state(dense, state), STEPS + dead_n
+        )
         feed_lag()
         drop_status(STEPS, owned)
         pending = []
-        alive_now = set(store.alive_members(confident_stale))
-        for m in store.snapshot_members():
+        seqs = {}
+        # Registered members count even before their first snapshot: a
+        # fast worker can reach this barrier while peers are still
+        # compiling — with snapshot_members() alone the pending set is
+        # vacuously empty and it exits without sweeping anyone.
+        for m in set(store.members()) | set(store.snapshot_members()):
             if m == args.member:
                 continue
             # Poll the 8-byte seq header, not the whole (large) snapshot.
             seq = store.snapshot_seq(m)
-            finished = seq is not None and seq >= STEPS
-            if not finished and m in alive_now:
+            seqs[m] = seq
+            finished = seq is not None and seq >= STEPS + dead_n
+            if not finished and m in alive_now and m not in confirmed_dead:
                 pending.append(m)
         if not pending:
             break
+        progress = (frozenset(pending), tuple(sorted(seqs.items())))
+        if progress != last_progress:
+            last_progress = progress
+            deadline = time.time() + 10
         time.sleep(0.1)
     swept, _ = sweep(store, dense, drill.pub_state(dense, state))
     state = drill.set_view(dense, state, swept)
@@ -645,7 +781,13 @@ def run_worker(store, drill, dense, state, args, result_dir):
     out = {
         "member": args.member,
         "zone": getattr(store, "zone", None),
-        "alive": store.alive_members(args.timeout),
+        # Confirmed deaths stay dead in the exit report: replicas were
+        # already adopted irreversibly, so a post-confirmation heartbeat
+        # flap must not read as a revival.
+        "alive": [
+            m for m in store.alive_members(args.timeout)
+            if m not in confirmed_dead
+        ],
         "digest": drill.digest(dense, state),
         "metrics": store.metrics.snapshot()["counters"],
         "lag": lag_tracker.report(),

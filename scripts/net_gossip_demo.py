@@ -80,6 +80,13 @@ def main() -> None:
     ap.add_argument("--step-sleep", type=float, default=0.15)
     ap.add_argument("--publish-every", type=int, default=2)
     ap.add_argument("--delta", action="store_true")
+    ap.add_argument("--overlap", dest="overlap", action="store_true",
+                    default=None,
+                    help="overlapped round pipeline (parallel/overlap.py); "
+                    "default on unless CCRDT_OVERLAP=0 — see "
+                    "elastic_demo.py")
+    ap.add_argument("--no-overlap", dest="overlap", action="store_false",
+                    help="force the serial round loop")
     ap.add_argument("--queue-max", type=int, default=64)
     ap.add_argument("--zone", default="",
                     help="DCN zone label for topo/ routing (default: flat "

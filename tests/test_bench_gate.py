@@ -18,7 +18,8 @@ gate = importlib.util.module_from_spec(_SPEC)
 _SPEC.loader.exec_module(gate)
 
 
-def _round(tmp_path, n, merges=None, torn=False):
+def _round(tmp_path, n, merges=None, torn=False, backend=None, gap=None,
+           coverage=None):
     path = str(tmp_path / f"BENCH_r{n:02d}.json")
     if torn:
         with open(path, "w") as f:
@@ -29,6 +30,14 @@ def _round(tmp_path, n, merges=None, torn=False):
         # The metric is JSON text INSIDE the tail capture — the shape the
         # real BENCH dumps have (escaped when serialized, plain after load).
         tail += "".join(f'{{"merges_per_sec": {v}}}\n' for v in merges)
+    summary = {}
+    if backend is not None:
+        summary["backend"] = backend
+    if gap is not None:
+        summary["dispatch_gap_ms_p50"] = gap
+        summary["span_coverage_p50"] = 0.9 if coverage is None else coverage
+    if summary:
+        tail += json.dumps(summary) + "\n"
     with open(path, "w") as f:
         json.dump({"n": n, "cmd": "bench", "rc": 0, "tail": tail}, f)
     return path
@@ -72,6 +81,54 @@ def test_vacuous_pass_with_fewer_than_two_rounds(tmp_path):
     _round(tmp_path, 1, merges=[5.0])
     code, _ = gate.evaluate(gate.load_rounds(str(tmp_path)), 0.20)
     assert code == 0
+
+
+def test_backend_groups_compare_independently(tmp_path):
+    # A CPU-fallback round must not be graded against TPU numbers (it
+    # would always "regress"), nor reset the TPU baseline.
+    _round(tmp_path, 1, merges=[1_000_000.0], backend="tpu")
+    _round(tmp_path, 2, merges=[990_000.0], backend="tpu")
+    _round(tmp_path, 3, merges=[5_000.0], backend="cpu")
+    code, verdict = gate.evaluate(gate.load_rounds(str(tmp_path)), 0.20)
+    assert code == 0
+    assert "vacuous" in verdict  # the lone cpu round has no peer
+    # ...but a regression WITHIN the tpu group still fails even when the
+    # newest round overall is a cpu one.
+    _round(tmp_path, 2, merges=[600_000.0], backend="tpu")
+    code, verdict = gate.evaluate(gate.load_rounds(str(tmp_path)), 0.20)
+    assert code == 1 and "FAIL" in verdict
+
+
+def test_gap_gate_vacuous_then_pass_then_fail(tmp_path):
+    code, verdict = gate.evaluate_gap([], 0.20)
+    assert code == 0 and "vacuous" in verdict
+    _round(tmp_path, 1, merges=[100.0], backend="cpu", gap=10.0)
+    attr = gate.load_attribution_rounds(str(tmp_path))
+    code, _ = gate.evaluate_gap(attr, 0.20)
+    assert code == 0  # one carrier: vacuous
+    _round(tmp_path, 2, merges=[100.0], backend="cpu", gap=11.5)
+    attr = gate.load_attribution_rounds(str(tmp_path))
+    code, verdict = gate.evaluate_gap(attr, 0.20)
+    assert code == 0 and "OK" in verdict  # +15% < 20%
+    _round(tmp_path, 3, merges=[100.0], backend="cpu", gap=13.0)
+    attr = gate.load_attribution_rounds(str(tmp_path))
+    code, verdict = gate.evaluate_gap(attr, 0.20)
+    assert code == 1 and "FAIL" in verdict  # +30% vs BEST prior (r1)
+
+
+def test_gap_gate_absolute_floor_absorbs_noise(tmp_path):
+    # Near-zero gaps: +100% relative but 0.08ms absolute is noise, not a
+    # regression — the 0.25ms floor must absorb it.
+    _round(tmp_path, 1, merges=[100.0], backend="cpu", gap=0.08)
+    _round(tmp_path, 2, merges=[100.0], backend="cpu", gap=0.16)
+    attr = gate.load_attribution_rounds(str(tmp_path))
+    code, _ = gate.evaluate_gap(attr, 0.20)
+    assert code == 0
+    # ...while a real slide well past the floor still fails.
+    _round(tmp_path, 3, merges=[100.0], backend="cpu", gap=0.9)
+    attr = gate.load_attribution_rounds(str(tmp_path))
+    code, verdict = gate.evaluate_gap(attr, 0.20)
+    assert code == 1 and "FAIL" in verdict
 
 
 def test_main_against_repo_rounds():

@@ -18,7 +18,8 @@ import bench  # noqa: E402
 def test_bench_dense_tiny():
     (
         apply_rate, extras_rate, extras_ops_rate, p50, p99,
-        p50_e2e, p99_e2e, overhead, merge_rate, hbm, compute,
+        p50_e2e, p99_e2e, p50_e2e_olap, p99_e2e_olap,
+        overhead, merge_rate, hbm, compute,
     ) = bench.bench_dense(
         R=2, I=64, D_DCS=2, K=4, M=2, B=16, Br=4, windows=2,
         rounds_per_window=2,
@@ -27,6 +28,7 @@ def test_bench_dense_tiny():
     assert extras_ops_rate > 0
     assert p50 > 0 and p99 >= p50
     assert p50_e2e > 0 and p99_e2e >= p50_e2e and overhead > 0
+    assert p50_e2e_olap > 0 and p99_e2e_olap >= p50_e2e_olap
     assert set(hbm) == {"apply", "replica_state_merge", "observe"}
     for phase in hbm.values():
         assert phase["achieved_gb_s"] > 0 and phase["bytes_per_dispatch"] > 0

@@ -262,9 +262,11 @@ def test_attribute_serial_overlap_gap_and_clipping():
         _span("round.device_dispatch", 0.2, 0.5, tid=0),
         # Other-thread phase: overlappable — work the round did not wait on.
         _span("round.gossip_send", 0.0, 0.4, tid=1),
-        # Phase straddling the window end: clipped to it.
+        # Phase straddling the window end: clipped for the per-round
+        # sample, full extent in the totals ledger.
         _span("round.snapshot", 0.9, 1.5, tid=0),
-        # Entirely outside the round: ignored.
+        # Entirely outside the round: no round sample, still in totals
+        # (overlap-mode host stages run between e2e windows too).
         _span("round.delta_apply", 2.0, 2.1, tid=0),
     ]
     att = obs_spans.attribute({"m": recs})
@@ -274,18 +276,42 @@ def test_attribute_serial_overlap_gap_and_clipping():
     # serial union: [0,0.5) ∪ [0.9,1.0) = 0.6s
     assert row["serial_ms_p50"] == pytest.approx(600.0)
     assert row["overlap_ms_p50"] == pytest.approx(400.0)
+    # covered = serial ∪ overlappable; here the overlap interval is
+    # subsumed by serial, so covered stays 0.6s.
     assert row["gap_ms_p50"] == pytest.approx(400.0)
     assert row["coverage_p50"] == pytest.approx(0.6)
     totals = row["phases_ms_total"]
-    assert totals["round.snapshot"] == pytest.approx(100.0)  # clipped
-    assert "round.delta_apply" not in totals
-    # critical path ranks by attributed time: dispatch+wal 300ms each.
-    assert row["critical_path"][-1] == "round.snapshot"
+    assert totals["round.snapshot"] == pytest.approx(600.0)  # unclipped
+    assert totals["round.delta_apply"] == pytest.approx(100.0)
+    assert row["phases_ms_p50"]["round.snapshot"] == pytest.approx(100.0)
+    assert "round.delta_apply" not in row["phases_ms_p50"]
+    # critical path ranks by total phase time: snapshot 600ms leads,
+    # the out-of-window delta_apply sliver trails.
+    assert row["critical_path"][0] == "round.snapshot"
+    assert row["critical_path"][-1] == "round.delta_apply"
     fleet = att["fleet"]
     assert fleet["rounds"] == 1
     assert fleet["coverage_p50"] == pytest.approx(0.6)
     # The report renders without blowing up on the same structure.
     assert "coverage" in obs_spans.format_report(att)
+
+
+def test_attribute_counts_overlappable_phases_toward_coverage():
+    # An overlapped round: the round thread only dispatches (0.0-0.2);
+    # WAL append + gossip send run on the host-stage thread across the
+    # rest of the window. Union coverage must credit both classes.
+    recs = [
+        _span("round.e2e", 0.0, 1.0, tid=0),
+        _span("round.device_dispatch", 0.0, 0.2, tid=0),
+        _span("round.wal_append", 0.2, 0.6, tid=7),
+        _span("round.gossip_send", 0.5, 1.0, tid=7),
+    ]
+    att = obs_spans.attribute({"m": recs})
+    row = att["members"]["m"]
+    assert row["serial_ms_p50"] == pytest.approx(200.0)
+    assert row["overlap_ms_p50"] == pytest.approx(800.0)
+    assert row["gap_ms_p50"] == pytest.approx(0.0)
+    assert row["coverage_p50"] == pytest.approx(1.0)
 
 
 def test_attribute_skips_members_without_rounds():

@@ -23,7 +23,7 @@ PY ?= python
 # meaningful.
 COVER_THRESHOLD ?= 88
 
-.PHONY: all compile test cover typecheck xref native bench benchall dryrun net-demo chaos crash-demo obs-demo topo-demo spans-demo overlap-demo bench-gate clean
+.PHONY: all compile test cover typecheck xref native bench benchall dryrun net-demo chaos crash-demo obs-demo topo-demo spans-demo overlap-demo partition-demo bench-gate clean
 
 all: compile xref typecheck cover
 
@@ -130,6 +130,16 @@ topo-demo:
 # reduction with publish-every-1 host load. Also part of `make chaos`.
 overlap-demo:
 	env JAX_PLATFORMS=cpu $(PY) scripts/overlap_demo.py
+
+# Partition-plane gate (real sockets, in-process): a 3-worker TCP fleet
+# with one deliberately divergent partition; the gap is repaired twice
+# from the same state — whole-instance snapshot vs digest-vector +
+# psnap partial anti-entropy (core/partition.py, PartialAntiEntropy) —
+# gated on >=5x fewer anti-entropy bytes, bit-identical repair digests,
+# zero wasted psnaps, and fleet convergence to the sequential
+# reference. Writes PART_r01.json.
+partition-demo:
+	env JAX_PLATFORMS=cpu $(PY) scripts/partition_demo.py
 
 # Span-tracing demo (slow, real processes): a 3-worker TCP fleet with
 # the round-phase span plane armed (CCRDT_SPANS=1) — every worker's

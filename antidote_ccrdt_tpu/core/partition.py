@@ -1,0 +1,381 @@
+"""The partition plane: fixed hash partitions over each instance's id space.
+
+Big(ger) Sets (arxiv 1605.06424, PAPERS.md) decomposes one large CRDT
+into independently replicated partitions so anti-entropy, digests, and
+rejoin streaming operate on slices instead of the whole instance. This
+module is the single source of truth for that decomposition:
+
+* ``part_of(ids, P)`` — the stable id→partition map (Knuth multiplicative
+  hash; NEVER python ``hash()``, which is salted per process).
+* a per-engine *item plan* describing which leaf axes are item-indexed:
+  - ``TopkRmvDenseState``: the I axis (axis 2) of the slot/tombstone
+    leaves; ``vc``/``lossy`` are whole-instance.
+  - table engines (topk / leaderboard / wordcount): the last axis of
+    every 3-D ``[R, NK, P]`` plane; other leaves are whole-instance.
+  - ``LiftedMonoidState``: the replica-row axis (axis 0) of every inner
+    leaf plus ``ver`` — a row is the row-replace unit, so a partition of
+    rows is the finest slice the lifted join can exchange.
+* ``state_digests`` — a ``P+1``-entry crc32 vector. Index ``P`` is the
+  **meta partition**: the whole-instance leaves (vc, lossy, loss
+  counters...). Isolating them keeps one divergent id from dirtying every
+  digest while still making whole-leaf drift detectable and cheap to
+  repair (meta payloads are O(R·NK), not O(I)).
+* ``restrict_psnap`` / ``apply_psnap`` — partial snapshots. A psnap is
+  delta-SHAPED (`TopkRmvDelta`, the table-delta dict, or a monoid row
+  delta) restricted to one partition, so the existing expand+join /
+  row-replace machinery applies it and ``like_delta_for`` decodes it; no
+  new kernels. Join semantics make application idempotent and
+  order-free: merging a peer's psnap for partition p yields a local
+  state ⊇ the peer's state on p.
+* ``delta_parts`` — the partition set a decoded delta touches (computed
+  receiver-side; deltas need no wire change to "carry" their partitions).
+* the ``CCPT`` blob container for digest vectors, psnaps, and checkpoint
+  shards — first-bytes magic disambiguation mirroring ``topo/codec.py``'s
+  bare-ETF fallback, so legacy whole-instance blobs keep decoding.
+
+Digest contract: two states with equal leaves have equal digest vectors,
+and a state change confined to ids of partition p (resp. whole leaves)
+perturbs only entry p (resp. entry P). The whole-instance digest
+disagrees iff some vector entry disagrees.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+# Fibonacci/Knuth multiplicative constant: stable across processes,
+# well-mixed low bits after the multiply for power-of-two P too.
+_KNUTH = np.uint64(2654435761)
+_MASK32 = np.uint64(0xFFFFFFFF)
+
+DEFAULT_PARTITIONS = 8
+
+
+def n_partitions(default: int = DEFAULT_PARTITIONS) -> int:
+    """The fleet-wide partition count, env-tunable (``CCRDT_PARTITIONS``).
+    Every member of a fleet must agree on it (it is a wire/digest
+    parameter, like R or I)."""
+    try:
+        p = int(os.environ.get("CCRDT_PARTITIONS", default))
+    except ValueError:
+        p = default
+    return max(1, p)
+
+
+def part_of(ids: Any, P: int) -> np.ndarray:
+    """Stable id→partition map, vectorized. int array in, int32 out."""
+    a = np.asarray(ids, np.int64).astype(np.uint64)
+    return (((a * _KNUTH) & _MASK32) % np.uint64(P)).astype(np.int32)
+
+
+def meta_part(P: int) -> int:
+    """Index of the meta partition (whole-instance leaves) in a
+    ``P+1``-entry digest vector."""
+    return P
+
+
+# --- per-engine item plans -------------------------------------------------
+
+
+def _is_topk_rmv(state: Any) -> bool:
+    from ..models.topk_rmv_dense import TopkRmvDenseState
+
+    return isinstance(state, TopkRmvDenseState)
+
+
+def _is_lifted(state: Any) -> bool:
+    from ..parallel.monoid import LiftedMonoidState
+
+    return isinstance(state, LiftedMonoidState)
+
+
+def _item_plan(state: Any) -> Tuple[List[Tuple[str, Any, int]], List[Tuple[str, Any]], int]:
+    """((path, leaf, item_axis)[], (path, whole_leaf)[], item_count).
+
+    The item axis is the axis whose index IS the partitionable id; all
+    item leaves of one state share its extent (checked)."""
+    import jax
+
+    if _is_topk_rmv(state):
+        I = int(state.slot_score.shape[2])
+        items = [
+            ("slot_score", state.slot_score, 2),
+            ("slot_dc", state.slot_dc, 2),
+            ("slot_ts", state.slot_ts, 2),
+            ("rmv_vc", state.rmv_vc, 2),
+        ]
+        whole = [("vc", state.vc), ("lossy", state.lossy)]
+        return items, whole, I
+    if _is_lifted(state):
+        R = int(state.ver.shape[0])
+        flat = jax.tree_util.tree_flatten_with_path(state.inner)[0]
+        items = [(jax.tree_util.keystr(p), leaf, 0) for p, leaf in flat]
+        items.append((".ver", state.ver, 0))
+        return items, [], R
+    flat = jax.tree_util.tree_flatten_with_path(state)[0]
+    items, whole, extent = [], [], None
+    for p, leaf in flat:
+        path = jax.tree_util.keystr(p)
+        if leaf.ndim == 3:
+            items.append((path, leaf, 2))
+            n = int(leaf.shape[2])
+            if extent is None:
+                extent = n
+            elif extent != n:
+                raise ValueError(
+                    f"table planes disagree on item extent: {extent} vs {n}"
+                )
+        else:
+            whole.append((path, leaf))
+    return items, whole, (extent or 0)
+
+
+# --- per-partition digest vectors ------------------------------------------
+
+
+def state_digests(state: Any, P: int) -> np.ndarray:
+    """``uint32[P+1]`` crc32 digest vector; entry P is the meta partition
+    (whole-instance leaves). Pure function of the state's leaves."""
+    items, whole, extent = _item_plan(state)
+    parts = part_of(np.arange(extent), P) if extent else np.zeros(0, np.int32)
+    vec = np.zeros(P + 1, np.uint32)
+    for p in range(P):
+        idx = np.nonzero(parts == p)[0]
+        crc = 0
+        for path, leaf, axis in items:
+            sl = np.ascontiguousarray(np.take(np.asarray(leaf), idx, axis=axis))
+            crc = zlib.crc32(sl.tobytes(), zlib.crc32(path.encode(), crc))
+        vec[p] = crc & 0xFFFFFFFF
+    crc = 0
+    for path, leaf in whole:
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        crc = zlib.crc32(arr.tobytes(), zlib.crc32(path.encode(), crc))
+    vec[P] = crc & 0xFFFFFFFF
+    return vec
+
+
+def divergent_parts(a: Any, b: Any) -> List[int]:
+    """Indices where two digest vectors disagree (length mismatch = all)."""
+    av, bv = np.asarray(a), np.asarray(b)
+    if av.shape != bv.shape:
+        return list(range(max(av.size, bv.size)))
+    return [int(i) for i in np.nonzero(av != bv)[0]]
+
+
+# --- partition-restricted partial snapshots (psnaps) -----------------------
+
+
+def restrict_psnap(dense: Any, state: Any, part: int, P: int) -> Any:
+    """The slice of `state` belonging to partition `part`, as a
+    delta-shaped payload (apply with ``apply_psnap`` / decode against
+    ``parallel.delta.like_delta_for``). ``part == P`` is the meta
+    partition: whole-instance leaves with an empty item slice."""
+    import jax.numpy as jnp
+
+    from ..core.behaviour import MergeKind
+    from ..parallel.delta import TopkRmvDelta, _split_leaves
+
+    meta = part == P
+    if _is_topk_rmv(state):
+        R, NK, I, M = state.slot_score.shape
+        D = state.rmv_vc.shape[-1]
+        if meta:
+            rows = np.zeros(0, np.int64)
+        else:
+            ids = np.nonzero(part_of(np.arange(I), P) == part)[0]
+            # all (r, k) rows for the partition's ids; identity rows are
+            # dropped (they join as no-ops and only cost bytes)
+            rows = (
+                np.arange(R * NK)[:, None] * I + ids[None, :]
+            ).reshape(-1)
+            score = np.asarray(state.slot_score).reshape(R * NK * I, M)[rows]
+            dc = np.asarray(state.slot_dc).reshape(R * NK * I, M)[rows]
+            ts = np.asarray(state.slot_ts).reshape(R * NK * I, M)[rows]
+            rvc = np.asarray(state.rmv_vc).reshape(R * NK * I, D)[rows]
+            from ..ops.dense_table import NEG_INF
+
+            live = (
+                np.any(score != NEG_INF, axis=1)
+                | np.any(dc != 0, axis=1)
+                | np.any(ts != 0, axis=1)
+                | np.any(rvc != 0, axis=1)
+            )
+            rows = rows[live]
+        flat = lambda x, w: np.asarray(x).reshape(R * NK * I, w)  # noqa: E731
+        return TopkRmvDelta(
+            rows=jnp.asarray(rows.astype(np.int32)),
+            slot_score=jnp.asarray(flat(state.slot_score, M)[rows]),
+            slot_dc=jnp.asarray(flat(state.slot_dc, M)[rows]),
+            slot_ts=jnp.asarray(flat(state.slot_ts, M)[rows]),
+            rmv_vc=jnp.asarray(flat(state.rmv_vc, D)[rows]),
+            # zeros are the join identity for vc/lossy: a non-meta psnap
+            # asserts nothing about the whole-instance leaves
+            vc=state.vc if meta else jnp.zeros_like(state.vc),
+            lossy=state.lossy if meta else jnp.zeros_like(state.lossy),
+        )
+    if _is_lifted(state):
+        import jax
+
+        R = int(state.ver.shape[0])
+        if meta:
+            rows = np.zeros(0, np.int64)
+        else:
+            rows = np.nonzero(part_of(np.arange(R), P) == part)[0]
+        rj = jnp.asarray(rows.astype(np.int32))
+        flat = jax.tree_util.tree_flatten_with_path(state.inner)[0]
+        return {
+            "rows": rj,
+            "ver": state.ver[rj],
+            "leaves": {
+                jax.tree_util.keystr(p): leaf[rj] for p, leaf in flat
+            },
+        }
+    if getattr(dense, "merge_kind", None) == MergeKind.MONOID:
+        raise ValueError(
+            "psnaps for bare MONOID engines are unsound (re-merge "
+            "double-counts); gossip monoid engines through MonoidLift"
+        )
+    paths, leaves, table_paths, _ = _split_leaves(state)
+    by_path = dict(zip(paths, leaves))
+    extent = None
+    for p in table_paths:
+        extent = int(by_path[p].shape[2])
+        break
+    out: Dict[str, Any] = {"idx": None, "table": {}, "whole": {}}
+    if meta or extent is None:
+        idx = np.zeros(0, np.int64)
+    else:
+        ids = np.nonzero(part_of(np.arange(extent), P) == part)[0]
+        lead = 1
+        for p in table_paths:
+            lead = int(np.prod(by_path[p].shape[:2]))
+            break
+        idx = (np.arange(lead)[:, None] * extent + ids[None, :]).reshape(-1)
+    out["idx"] = jnp.asarray(idx.astype(np.int32))
+    for p in paths:
+        leaf = by_path[p]
+        if p in table_paths:
+            out["table"][p] = jnp.asarray(
+                np.asarray(leaf).reshape(-1)[idx]
+            )
+        else:
+            # identity (init) whole leaves unless this IS the meta psnap
+            out["whole"][p] = leaf if meta else None
+    if not meta:
+        R, NK = leaves[0].shape[:2]
+        ident = dense.init(R, NK)
+        ipaths, ileaves, _, _ = _split_leaves(ident)
+        ident_by = dict(zip(ipaths, ileaves))
+        for p in list(out["whole"]):
+            out["whole"][p] = ident_by[p]
+    return out
+
+
+def apply_psnap(dense: Any, state: Any, payload: Any) -> Any:
+    """Join a psnap payload into `state` (idempotent; order-free)."""
+    from ..parallel.delta import apply_any_delta
+
+    return apply_any_delta(dense, state, payload)
+
+
+def delta_parts(dense: Any, like_state: Any, delta: Any, P: int) -> Set[int]:
+    """The partitions a decoded delta touches — computed receiver-side,
+    so deltas "carry" their partition set with no wire change. JOIN
+    deltas always touch the meta partition (they ship vc/whole leaves)."""
+    from ..parallel.delta import TopkRmvDelta, _is_monoid_row_delta
+
+    if isinstance(delta, TopkRmvDelta):
+        I = dense.I
+        ids = np.asarray(delta.rows) % I
+        return set(int(x) for x in np.unique(part_of(ids, P))) | {P}
+    if _is_monoid_row_delta(delta):
+        rows = np.asarray(delta["rows"])
+        return set(int(x) for x in np.unique(part_of(rows, P)))
+    items, _, extent = _item_plan(like_state)
+    idx = np.asarray(delta.get("idx", np.zeros(0, np.int64)))
+    if extent:
+        ids = idx % extent
+        parts = set(int(x) for x in np.unique(part_of(ids, P)))
+    else:
+        parts = set()
+    return parts | {P}
+
+
+# --- CCPT blob container ---------------------------------------------------
+# First-bytes disambiguation, same move as topo/codec.py's bare-ETF
+# fallback: new blobs open with b"CCPT"; legacy whole-instance snapshot
+# blobs open with an 8-byte step header followed by serial.MAGIC
+# (b"CCRD" at offset 8). `is_partition_blob` keys the dispatch.
+
+PART_MAGIC = b"CCPT"
+# Version 1: raw payload. Version 2: zlib-deflated payload (psnaps only
+# — the 18-byte header stays uncompressed so `seq` keeps parsing at a
+# fixed offset on every transport). The encoder picks whichever is
+# smaller per blob; decoders accept both, so v1 artifacts (old
+# checkpoint shards, mixed-version peers) stay readable.
+PART_VERSION = 2
+KIND_DIGESTS = 0
+KIND_PSNAP = 1
+
+
+def is_partition_blob(blob: bytes) -> bool:
+    return bytes(blob[:4]) == PART_MAGIC
+
+
+def encode_digest_blob(seq: int, vec: Any) -> bytes:
+    # Digest vectors are 4(P+1) bytes — deflate cannot help, write v1.
+    v = np.asarray(vec, np.uint32)
+    return (
+        PART_MAGIC
+        + bytes([1, KIND_DIGESTS])
+        + struct.pack("<QI", int(seq), int(v.size))
+        + v.astype("<u4").tobytes()
+    )
+
+
+def decode_digest_blob(blob: bytes) -> Tuple[int, np.ndarray]:
+    _check_header(blob, KIND_DIGESTS)
+    seq, n = struct.unpack_from("<QI", blob, 6)
+    vec = np.frombuffer(blob, dtype="<u4", count=n, offset=18).astype(np.uint32)
+    return int(seq), vec
+
+
+def encode_psnap_blob(seq: int, part: int, dense_payload: bytes) -> bytes:
+    """`dense_payload` is a ``serial.dumps_dense`` blob of the restricted
+    delta-shaped psnap. The flat-serial envelope (leaf paths, dtypes)
+    dominates small psnaps — a meta psnap is ~70 bytes of arrays in a
+    ~2 KB blob — so the payload ships deflated (v2) whenever that is
+    actually smaller, raw (v1) otherwise."""
+    header = struct.pack("<QI", int(seq), int(part))
+    packed = zlib.compress(dense_payload, 6)
+    if len(packed) < len(dense_payload):
+        return PART_MAGIC + bytes([2, KIND_PSNAP]) + header + packed
+    return PART_MAGIC + bytes([1, KIND_PSNAP]) + header + dense_payload
+
+
+def decode_psnap_blob(blob: bytes) -> Tuple[int, int, bytes]:
+    """(seq, part, dense_payload). Accepts v1 (raw) and v2 (deflated)."""
+    _check_header(blob, KIND_PSNAP)
+    seq, part = struct.unpack_from("<QI", blob, 6)
+    payload = bytes(blob[18:])
+    if blob[4] >= 2:
+        payload = zlib.decompress(payload)
+    return int(seq), int(part), payload
+
+
+def _check_header(blob: bytes, kind: int) -> None:
+    if not is_partition_blob(blob):
+        raise ValueError("not a CCPT partition blob (bad magic)")
+    version, k = blob[4], blob[5]
+    if version > PART_VERSION:
+        raise ValueError(
+            f"partition blob version {version} newer than supported "
+            f"{PART_VERSION}"
+        )
+    if k != kind:
+        raise ValueError(f"partition blob kind {k} != expected {kind}")

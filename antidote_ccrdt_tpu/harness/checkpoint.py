@@ -291,3 +291,252 @@ def load_dense_checkpoint(
 
         check_state(dense, state)
     return step, name, state
+
+
+# -- partitioned (sharded) dense checkpoints --------------------------------
+#
+# One file per partition (`shard-<part>.ckpt`, a CCPT psnap container —
+# core/partition.py) plus a `manifest.json` commit marker. The unit of
+# durability is the PARTITION: a rejoining worker streams and persists
+# state shard by shard, and a crash mid-stream (SIGKILL between shards)
+# costs only the partition in flight — restart resumes from the last
+# durable shard instead of refetching one giant blob.
+
+_SHARD_PREFIX = "shard-"
+_SHARD_SUFFIX = ".ckpt"
+_MANIFEST = "manifest.json"
+
+
+def _shard_path(root: str, part: int) -> str:
+    return os.path.join(root, f"{_SHARD_PREFIX}{part:04d}{_SHARD_SUFFIX}")
+
+
+def _write_shard(
+    root: str, name: str, dense: Any, state: Any, part: int, P: int,
+    step: int,
+) -> int:
+    """Atomically persist partition `part` of `state`; returns bytes."""
+    from ..core import partition as pt
+
+    payload = serial.dumps_dense(
+        f"{name}_psnap", pt.restrict_psnap(dense, state, part, P)
+    )
+    blob = pt.encode_psnap_blob(step, part, payload)
+    path = _shard_path(root, part)
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    if faults.ACTIVE:
+        faults.fire("ckpt.replace")
+    os.replace(tmp, path)
+    return len(blob)
+
+
+def save_partitioned_checkpoint(
+    root: str, name: str, state: Any, dense: Any, step: int,
+    partitions: Optional[int] = None,
+) -> int:
+    """Shard `state` into per-partition checkpoint files (P id
+    partitions + the meta partition) and commit with a manifest.
+    Returns total bytes written. Shards first, manifest last: the
+    manifest is the whole-checkpoint commit point, but each shard is
+    individually durable the moment it lands (what the rejoin streamer
+    relies on)."""
+    import json
+
+    from ..core import partition as pt
+
+    P = partitions if partitions else pt.n_partitions()
+    os.makedirs(root, exist_ok=True)
+    total = 0
+    for part in range(P + 1):
+        total += _write_shard(root, name, dense, state, part, P, step)
+    digests = pt.state_digests(state, P)
+    manifest = {
+        "name": name,
+        "step": int(step),
+        "partitions": int(P),
+        "digests": [int(d) for d in digests],
+    }
+    tmp = os.path.join(root, f"{_MANIFEST}.tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(root, _MANIFEST))
+    return total
+
+
+def load_partitioned_checkpoint(
+    root: str, base: Any, dense: Any
+) -> Tuple[int, Optional[str], Any, List[int]]:
+    """-> (step, name, state, durable_parts): join every decodable shard
+    under `root` into `base`. Tolerates a PARTIAL checkpoint — missing,
+    torn, or foreign shards are skipped, not fatal (the streamer resumes
+    exactly from what this reports durable). `step` is the highest shard
+    seq seen (-1 = nothing durable). Falls back to a legacy single-file
+    whole-instance checkpoint (`snap.ckpt`) when no shards exist, so
+    pre-partition checkpoint directories keep restoring."""
+    from ..core import partition as pt
+    from ..parallel.delta import apply_any_delta, like_delta_for
+
+    state, step, name = base, -1, None
+    durable: List[int] = []
+    if not os.path.isdir(root):
+        return step, name, state, durable
+    shards = sorted(
+        f for f in os.listdir(root)
+        if f.startswith(_SHARD_PREFIX) and f.endswith(_SHARD_SUFFIX)
+    )
+    if not shards:
+        legacy = os.path.join(root, "snap.ckpt")
+        if os.path.exists(legacy):
+            try:
+                step, name, state = load_dense_checkpoint(
+                    legacy, base, dense=dense
+                )
+            except Exception:  # noqa: BLE001 — torn legacy file: nothing
+                pass           # durable, same contract as missing shards
+        return step, name, state, durable
+    like_delta = like_delta_for(dense, base)
+    for fname in shards:
+        try:
+            with open(os.path.join(root, fname), "rb") as f:
+                blob = f.read()
+            seq, part, payload = pt.decode_psnap_blob(blob)
+            got_name, delta = serial.loads_dense(payload, like_delta)
+            state = apply_any_delta(dense, state, delta)
+        except Exception:  # noqa: BLE001 — skip the torn shard; the
+            continue       # streamer refetches it
+        durable.append(int(part))
+        step = max(step, int(seq))
+        if name is None and got_name.endswith("_psnap"):
+            name = got_name[: -len("_psnap")]
+    return step, name, state, durable
+
+
+class RejoinStreamer:
+    """Incremental, resumable rejoin: instead of swallowing a peer's
+    whole snapshot, stream state PARTITION BY PARTITION, persisting each
+    one durably (`_write_shard`) before moving to the next.
+
+    Order is lowest-lag first: partitions whose digests already agree
+    with the peer complete immediately (persisted from local state, zero
+    transfer), then divergent partitions stream in ascending order. A
+    SIGKILL between shards costs only the partition in flight — the next
+    incarnation's `start()` loads the durable shards, re-diffs digests,
+    and plans only what is still missing (tests pin the drill).
+
+    Counters: `rejoin.parts_streamed`, `rejoin.parts_skipped` (already
+    durable/agreeing), `rejoin.stream_bytes`."""
+
+    def __init__(
+        self, root: str, name: str, dense: Any, store: Any, peer: str,
+        partitions: Optional[int] = None, metrics: Any = None,
+    ):
+        from ..core import partition as pt
+
+        self.root = root
+        self.name = name
+        self.dense = dense
+        self.store = store
+        self.peer = peer
+        self.partitions = partitions if partitions else pt.n_partitions()
+        self.metrics = metrics if metrics is not None else store.metrics
+        self.plan: List[int] = []
+        self.peer_seq: int = -1
+        self._pt = pt
+        os.makedirs(root, exist_ok=True)
+
+    def start(self, base: Any) -> Any:
+        """Join durable shards into `base`, diff digests against the
+        peer, and plan the remaining stream. Returns the restored state
+        (call `step`/`run` next). With no peer digest vector (legacy
+        peer), the plan covers every partition — still streamed and
+        persisted one at a time."""
+        from ..obs import events as obs_events
+
+        pt, P = self._pt, self.partitions
+        step, _name, state, durable = load_partitioned_checkpoint(
+            self.root, base, self.dense
+        )
+        got = self.store.fetch_digests(self.peer)
+        if got is None:
+            self.plan = [p for p in range(P + 1)]
+            self.peer_seq = -1
+        else:
+            self.peer_seq, peer_vec = got
+            own_vec = pt.state_digests(state, P)
+            div = set(pt.divergent_parts(own_vec, peer_vec))
+            # Lowest-lag first: agreeing partitions are done — persist
+            # any not yet durable straight from local state.
+            for p in range(P + 1):
+                if p in div:
+                    continue
+                if p not in durable:
+                    _write_shard(
+                        self.root, self.name, self.dense, state, p, P,
+                        max(0, self.peer_seq),
+                    )
+                self.metrics.count("rejoin.parts_skipped")
+            self.plan = sorted(div)
+        self.store.request_psnaps(self.peer, self.plan)
+        obs_events.emit(
+            "rejoin.plan", origin=self.peer, parts=list(self.plan),
+            durable=sorted(durable),
+        )
+        return state
+
+    def step(self, state: Any) -> Tuple[Any, Optional[int], bool]:
+        """Stream ONE partition: fetch its psnap, join it, persist the
+        shard. -> (state, part_streamed_or_None, finished). `None` with
+        finished=False means the psnap is still in flight (push media) —
+        advance the medium and call again."""
+        from ..obs import events as obs_events
+        from ..parallel.delta import delta_in_bounds, like_delta_for
+
+        if not self.plan:
+            return state, None, True
+        p = self.plan[0]
+        like = like_delta_for(self.dense, state)
+        r = self.store.fetch_psnap(
+            self.peer, p, like,
+            validate=lambda d: delta_in_bounds(self.dense, state, d),
+        )
+        if r is None:
+            self.store.request_psnaps(self.peer, [p])
+            return state, None, False
+        seq, payload = r
+        from ..parallel.delta import apply_any_delta
+
+        state = apply_any_delta(self.dense, state, payload)
+        nbytes = _write_shard(
+            self.root, self.name, self.dense, state, p, self.partitions,
+            max(seq, self.peer_seq, 0),
+        )
+        self.plan.pop(0)
+        self.metrics.count("rejoin.parts_streamed")
+        self.metrics.count("rejoin.stream_bytes", nbytes)
+        obs_events.emit(
+            "rejoin.part", origin=self.peer, part=p, bytes=nbytes,
+            remaining=len(self.plan),
+        )
+        return state, p, not self.plan
+
+    def run(self, state: Any, max_stalls: int = 64,
+            advance=None) -> Any:
+        """Drain the plan. `advance` (optional callable) pumps the
+        medium between stalled fetches — the sim drill passes
+        `lambda: net.advance(dt)`; real transports just retry."""
+        stalls = 0
+        while self.plan and stalls < max_stalls:
+            state, part, _done = self.step(state)
+            if part is None:
+                stalls += 1
+                if advance is not None:
+                    advance()
+            else:
+                stalls = 0
+        return state

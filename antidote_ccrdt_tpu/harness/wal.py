@@ -237,6 +237,13 @@ class ElasticWal:
     ``encode_term((step, owned, delta_blob))`` where ``delta_blob`` is
     the same `dumps_dense(f"{name}_delta", delta)` encoding the gossip
     tier ships, so WAL records and wire deltas stay one format.
+
+    With `partitions` set, records are tagged with the partition set
+    their delta touches (``encode_term((step, owned, blob, parts))`` —
+    a 4-tuple; `core.partition.delta_parts`), so recovery and rejoin
+    tooling can reason per partition. `recover` branches on the tuple
+    arity, so un-tagged legacy records and tagged records interleave
+    freely in one log (the mixed-version compat contract).
     """
 
     SNAP = "snap.ckpt"
@@ -249,11 +256,13 @@ class ElasticWal:
         name: str,
         segment_bytes: int = 256 << 10,
         metrics: Optional[Metrics] = None,
+        partitions: Optional[int] = None,
     ):
         self.dir = os.path.join(root, f"wal-{member}")
         self.member = member
         self.dense = dense
         self.name = name
+        self.partitions = partitions
         self.metrics = metrics if metrics is not None else Metrics()
         self.log = WriteAheadLog(
             self.dir, segment_bytes=segment_bytes, metrics=self.metrics
@@ -274,16 +283,32 @@ class ElasticWal:
             with obs_spans.span("round.wal_append", step=int(step)):
                 delta = make_delta(self.dense, prev_view, view)
                 blob = serial.dumps_dense(f"{self.name}_delta", delta)
-                payload = serial.encode_term(
-                    (int(step), [int(r) for r in owned], blob)
-                )
+                payload = self._encode_record(step, owned, view, delta, blob)
                 self.log.append(step, payload)
             return len(payload)
         delta = make_delta(self.dense, prev_view, view)
         blob = serial.dumps_dense(f"{self.name}_delta", delta)
-        payload = serial.encode_term((int(step), [int(r) for r in owned], blob))
+        payload = self._encode_record(step, owned, view, delta, blob)
         self.log.append(step, payload)
         return len(payload)
+
+    def _encode_record(
+        self, step: int, owned, view: Any, delta: Any, blob: bytes
+    ) -> bytes:
+        """Legacy 3-tuple record, or the partition-tagged 4-tuple when
+        this WAL runs with a partition count."""
+        base = (int(step), [int(r) for r in owned], blob)
+        if not self.partitions:
+            return serial.encode_term(base)
+        from ..core import partition as pt
+
+        try:
+            parts = sorted(
+                pt.delta_parts(self.dense, view, delta, self.partitions)
+            )
+        except Exception:  # noqa: BLE001 — a tag failure must not block
+            parts = []     # durability; empty tag = "unknown partitions"
+        return serial.encode_term(base + (parts,))
 
     def checkpoint(self, view: Any, step: int) -> None:
         """Anchor: durable full state at `step`, then compact every
@@ -323,16 +348,27 @@ class ElasticWal:
                 state = None   # must not block WAL replay (total recovery)
         like_delta = like_delta_for(self.dense, like_view)
         owned: Set[int] = set()
+        parts_touched: Set[int] = set()
         n = 0
         for seq, payload in self.log.records():
             try:
-                step, rec_owned, blob = serial.decode_term(payload)
+                rec = serial.decode_term(payload)
+                # Arity is the version marker: legacy records are
+                # (step, owned, blob); partition-tagged ones append the
+                # partition list. Both replay identically — the tag is
+                # metadata, the delta blob is the state.
+                if len(rec) == 4:
+                    step, rec_owned, blob, rec_parts = rec
+                else:
+                    step, rec_owned, blob = rec
+                    rec_parts = ()
                 _name, delta = serial.loads_dense(blob, like_delta)
                 base = like_view if state is None else state
                 state = apply_any_delta(self.dense, base, delta)
             except Exception:  # noqa: BLE001 — skip undecodable record,
                 continue       # the join tolerates gaps (next snapshot wins)
             owned.update(int(r) for r in rec_owned)
+            parts_touched.update(int(p) for p in rec_parts)
             last_step = max(last_step, int(step))
             n += 1
         if n:
@@ -342,6 +378,7 @@ class ElasticWal:
             records=n,
             last_step=last_step,
             owned=sorted(owned),
+            parts=sorted(parts_touched),
             had_checkpoint=os.path.exists(snap_path),
         )
         return state, last_step, owned

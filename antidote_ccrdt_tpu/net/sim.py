@@ -176,6 +176,11 @@ class SimTransport:
         )
         self._snaps: Dict[str, bytes] = {}
         self._deltas: Dict[str, Dict[int, bytes]] = {}
+        # Partition plane caches, mirroring net.tcp: digest vectors are
+        # pushed; psnaps are stored locally and only transferred when a
+        # peer requests divergent partitions.
+        self._digs: Dict[str, bytes] = {}
+        self._psnaps: Dict[str, Dict[int, bytes]] = {}
         # Clock model for offset-estimation drills: each member reads
         # the shared virtual clock through its own constant skew, and
         # `clock_exchange` runs the same T1/T2/T3 protocol the tcp hello
@@ -270,6 +275,46 @@ class SimTransport:
                     dst, ("delta", self.member, seq, keep, blob), False, 0
                 )
 
+    # -- partition plane ---------------------------------------------------
+
+    @staticmethod
+    def _ccpt_seq(blob: bytes) -> Optional[int]:
+        import struct as _struct
+
+        if len(blob) >= 14 and bytes(blob[:4]) == b"CCPT":
+            return _struct.unpack_from("<Q", blob, 6)[0]
+        return None
+
+    def publish_digest(self, blob: bytes) -> None:
+        self._check_live()
+        self._digs[self.member] = blob
+        path = [(self.member, self.zone)]
+        for dst, cross in self._targets():
+            if cross:
+                self._send(
+                    dst, ("rdig", self.member, blob, path), True, len(blob)
+                )
+            else:
+                self._send(dst, ("dig", self.member, blob), False, 0)
+
+    def fetch_digest(self, member: str) -> Optional[bytes]:
+        return self._digs.get(member)
+
+    def publish_psnap(self, part: int, blob: bytes) -> None:
+        self._check_live()
+        self._psnaps.setdefault(self.member, {})[int(part)] = blob
+
+    def fetch_psnap(self, member: str, part: int) -> Optional[bytes]:
+        return self._psnaps.get(member, {}).get(int(part))
+
+    def request_psnaps(self, member: str, parts: List[int]) -> None:
+        self._check_live()
+        if parts:
+            self.metrics.count("net.psnap_reqs_sent")
+            self._send(
+                member, ("psnap_req", self.member, list(parts)), False, 0
+            )
+
     # -- receive side ------------------------------------------------------
 
     def _store_snap(self, src: str, blob: bytes) -> bool:
@@ -297,6 +342,35 @@ class SimTransport:
         for s in [s for s in window if s <= hi - keep]:
             del window[s]
         return fresh and seq in window
+
+    def _store_dig(self, src: str, blob: bytes) -> bool:
+        old = self._digs.get(src)
+        new_seq = self._ccpt_seq(blob)
+        old_seq = self._ccpt_seq(old) if old is not None else None
+        if (
+            old is None
+            or new_seq is None
+            or old_seq is None
+            or new_seq >= old_seq
+        ):
+            self._digs[src] = blob
+            return True
+        return False
+
+    def _store_psnap(self, src: str, part: int, blob: bytes) -> bool:
+        window = self._psnaps.setdefault(src, {})
+        old = window.get(part)
+        new_seq = self._ccpt_seq(blob)
+        old_seq = self._ccpt_seq(old) if old is not None else None
+        if (
+            old is None
+            or new_seq is None
+            or old_seq is None
+            or new_seq >= old_seq
+        ):
+            window[part] = blob
+            return True
+        return False
 
     def _deliver(self, msg: tuple) -> None:
         if obs_spans.ACTIVE:
@@ -367,6 +441,39 @@ class SimTransport:
                     "delta", origin, path,
                     lambda p: ("rdelta", origin, seq, keep, blob, p),
                     len(blob), dseq=seq,
+                )
+        elif kind == "dig":
+            blob = msg[2]
+            if self._store_dig(src, blob) and (
+                self.zones.zone_of(src) == self.zone
+            ):
+                self._relay("dig", src, [(src, self.zone)],
+                            lambda p: ("rdig", src, blob, p), len(blob))
+        elif kind == "rdig":
+            _k, origin, blob, path = msg[:4]
+            for pm, pz in path:
+                self.zones.learn(pm, pz)
+            sender = path[-1][0] if path else origin
+            if not ZoneRouter.loop_safe(path, self.member):
+                self.metrics.count("topo.relay_loops")
+                return
+            if self._store_dig(origin, blob):
+                self._relay("dig", origin, path,
+                            lambda p: ("rdig", origin, blob, p), len(blob))
+        elif kind == "psnap":
+            _k, _s, part, blob = msg[:4]
+            self._store_psnap(src, int(part), blob)
+        elif kind == "psnap_req":
+            parts = msg[2]
+            self.metrics.count("net.psnap_reqs_recv")
+            own = self._psnaps.get(self.member, {})
+            for p in parts:
+                blob = own.get(int(p))
+                if blob is None:
+                    continue
+                self.metrics.count("net.psnap_serves")
+                self._send(
+                    src, ("psnap", self.member, int(p), blob), False, len(blob)
                 )
         if sender != self.member:
             self.membership.observe(sender)

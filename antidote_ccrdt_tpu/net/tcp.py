@@ -105,8 +105,12 @@ A_HELLO = Atom("hello")
 A_HELLO_ACK = Atom("hello_ack")
 A_RSNAP = Atom("rsnap")
 A_RDELTA = Atom("rdelta")
+A_DIG = Atom("dig")
+A_RDIG = Atom("rdig")
+A_PSNAP = Atom("psnap")
+A_PSNAP_REQ = Atom("psnap_req")
 
-_SNAP, _DELTA, _PING = "snap", "delta", "ping"
+_SNAP, _DELTA, _PING, _DIG, _PSNAP = "snap", "delta", "ping", "dig", "psnap"
 
 # (member, zone) hop stamps of a routed frame, origin first.
 _Path = List[Tuple[str, str]]
@@ -232,9 +236,9 @@ class _PeerLink:
         with self._cv:
             if self._stop:
                 return
-            if kind == _SNAP:
-                # Latest-wins anchor: a queued older snapshot is dead weight.
-                stale = [i for i, (k, _, _m) in enumerate(self._q) if k == _SNAP]
+            if kind in (_SNAP, _DIG):
+                # Latest-wins: a queued older snapshot/digest is dead weight.
+                stale = [i for i, (k, _, _m) in enumerate(self._q) if k == kind]
                 for i in reversed(stale):
                     del self._q[i]
             elif kind == _PING and any(k == _PING for k, _, _m in self._q):
@@ -417,6 +421,12 @@ class TcpTransport:
         self._lock = threading.Lock()
         self._snaps: Dict[str, bytes] = {}
         self._deltas: Dict[str, Dict[int, bytes]] = {}
+        # Partition plane: per-member digest-vector blobs (pushed, tiny)
+        # and per-(member, part) psnap blobs. Own psnaps are STORED here
+        # at anchor time and only cross the wire when a peer requests
+        # divergent partitions ({psnap_req} -> {psnap}).
+        self._digs: Dict[str, bytes] = {}
+        self._psnaps: Dict[str, Dict[int, bytes]] = {}
         self._closed = False
 
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -600,6 +610,34 @@ class TcpTransport:
             (A_RDELTA, ob, seq, keep, blob, pt, self._heard_term()), link
         )
 
+    def _dig_frame(self, blob: bytes, link: _PeerLink) -> Callable[[], bytes]:
+        mb = self.member.encode("utf-8")
+        return lambda: self._wire((A_DIG, mb, blob, self._heard_term()), link)
+
+    def _rdig_frame(
+        self, origin: str, blob: bytes, path: _Path, link: _PeerLink
+    ) -> Callable[[], bytes]:
+        ob, pt = origin.encode("utf-8"), self._path_term(path)
+        return lambda: self._wire(
+            (A_RDIG, ob, blob, pt, self._heard_term()), link
+        )
+
+    def _psnap_frame(
+        self, part: int, blob: bytes, link: _PeerLink
+    ) -> Callable[[], bytes]:
+        mb = self.member.encode("utf-8")
+        return lambda: self._wire(
+            (A_PSNAP, mb, part, blob, self._heard_term()), link
+        )
+
+    def _psnap_req_frame(
+        self, parts: List[int], link: _PeerLink
+    ) -> Callable[[], bytes]:
+        mb = self.member.encode("utf-8")
+        return lambda: self._wire(
+            (A_PSNAP_REQ, mb, list(parts), self._heard_term()), link
+        )
+
     # -- receive path ------------------------------------------------------
 
     def _accept(self) -> None:
@@ -670,6 +708,71 @@ class TcpTransport:
             for s in [s for s in window if s <= hi - keep]:
                 del window[s]
             return fresh and seq in window
+
+    @staticmethod
+    def _ccpt_seq(blob: bytes) -> Optional[int]:
+        """Embedded seq of a CCPT partition blob (core.partition), or
+        None for anything else — kept header-only so the transport stays
+        payload-opaque."""
+        if len(blob) >= 14 and bytes(blob[:4]) == b"CCPT":
+            return struct.unpack_from("<Q", blob, 6)[0]
+        return None
+
+    def _store_dig(self, m: str, blob: bytes) -> bool:
+        """Digest-vector cache write, newest-seq-wins (same reconnect
+        interleaving hazard as `_store_snap`)."""
+        with self._lock:
+            old = self._digs.get(m)
+            new_seq, old_seq = self._ccpt_seq(blob), (
+                self._ccpt_seq(old) if old is not None else None
+            )
+            if (
+                old is None
+                or new_seq is None
+                or old_seq is None
+                or new_seq >= old_seq
+            ):
+                self._digs[m] = blob
+                return True
+            return False
+
+    def _store_psnap(self, m: str, part: int, blob: bytes) -> bool:
+        with self._lock:
+            window = self._psnaps.setdefault(m, {})
+            old = window.get(part)
+            new_seq, old_seq = self._ccpt_seq(blob), (
+                self._ccpt_seq(old) if old is not None else None
+            )
+            if (
+                old is None
+                or new_seq is None
+                or old_seq is None
+                or new_seq >= old_seq
+            ):
+                window[part] = blob
+                return True
+            return False
+
+    def _serve_psnaps(self, requester: str, parts: List[int]) -> None:
+        """Answer one `{psnap_req}`: push OUR stored psnap blobs for the
+        requested partitions back to the requester (point-to-point on the
+        direct link; a requester we hold no link to falls back to
+        whole-snapshot resync on its side)."""
+        link = self._links.get(requester)
+        if link is None:
+            return
+        with self._lock:
+            own = dict(self._psnaps.get(self.member, {}))
+        for part in parts:
+            blob = own.get(int(part))
+            if blob is None:
+                continue
+            self.metrics.count("net.psnap_serves")
+            link.enqueue(
+                _PSNAP,
+                self._psnap_frame(int(part), blob, link),
+                meta={"origin": self.member, "part": int(part)},
+            )
 
     def _handle(self, term, conn: Optional[socket.socket] = None) -> None:
         self.metrics.count("net.frames_recv")
@@ -781,6 +884,45 @@ class TcpTransport:
                 return
             if self._store_delta(origin, int(seq), int(keep), blob):
                 self._relay_delta(origin, int(seq), int(keep), blob, path)
+        elif tag == A_DIG:
+            _, mb, blob, heard = term
+            m = mb.decode("utf-8")
+            obs_events.emit(
+                "frame.recv", fkind=_DIG, origin=m, bytes=len(blob)
+            )
+            if self._store_dig(m, blob) and self.zones.zone_of(m) == self.zone:
+                self._relay_dig(m, blob, [(m, self.zone)])
+        elif tag == A_RDIG:
+            _, ob, blob, path_t, heard = term
+            origin = ob.decode("utf-8")
+            path = [
+                (pm.decode("utf-8"), pz.decode("utf-8")) for pm, pz in path_t
+            ]
+            for pm, pz in path:
+                self.zones.learn(pm, pz)
+            m = path[-1][0] if path else origin
+            obs_events.emit(
+                "frame.recv", fkind=_DIG, origin=origin, bytes=len(blob),
+                hops=len(path),
+            )
+            if not ZoneRouter.loop_safe(path, self.member):
+                self.metrics.count("topo.relay_loops")
+                return
+            if self._store_dig(origin, blob):
+                self._relay_dig(origin, blob, path)
+        elif tag == A_PSNAP:
+            _, mb, part, blob, heard = term
+            m = mb.decode("utf-8")
+            obs_events.emit(
+                "frame.recv", fkind=_PSNAP, origin=m, part=int(part),
+                bytes=len(blob),
+            )
+            self._store_psnap(m, int(part), blob)
+        elif tag == A_PSNAP_REQ:
+            _, mb, parts, heard = term
+            m = mb.decode("utf-8")
+            self.metrics.count("net.psnap_reqs_recv")
+            self._serve_psnaps(m, [int(p) for p in parts])
         elif tag == A_PING:
             _, mb, heard = term
             m = mb.decode("utf-8")
@@ -801,6 +943,14 @@ class TcpTransport:
             )
 
         self._relay(_SNAP, origin, path, enq)
+
+    def _relay_dig(self, origin: str, blob: bytes, path: _Path) -> None:
+        def enq(link: _PeerLink, stamped: _Path, meta: Dict[str, object]):
+            link.enqueue(
+                _DIG, self._rdig_frame(origin, blob, stamped, link), meta
+            )
+
+        self._relay(_DIG, origin, path, enq)
 
     def _relay_delta(
         self, origin: str, seq: int, keep: int, blob: bytes, path: _Path
@@ -979,6 +1129,61 @@ class TcpTransport:
     def delta_members(self) -> List[str]:
         with self._lock:
             return sorted(self._deltas)
+
+    # -- Transport: partition plane ----------------------------------------
+
+    def publish_digest(self, blob: bytes) -> None:
+        """Push the (tiny) digest-vector blob like a snapshot anchor;
+        routed `{rdig}` across zones so remote fleets can detect
+        divergence without ever pulling whole snapshots."""
+        with self._lock:
+            self._digs[self.member] = blob
+        path = [(self.member, self.zone)]
+        for peer, cross in self._targets():
+            link = self._links.get(peer)
+            if link is None:
+                continue
+            if cross:
+                link.enqueue(
+                    _DIG,
+                    self._rdig_frame(self.member, blob, path, link),
+                    meta={"origin": self.member, "cross_zone": True},
+                )
+            else:
+                link.enqueue(
+                    _DIG,
+                    self._dig_frame(blob, link),
+                    meta={"origin": self.member},
+                )
+
+    def fetch_digest(self, member: str) -> Optional[bytes]:
+        with self._lock:
+            return self._digs.get(member)
+
+    def publish_psnap(self, part: int, blob: bytes) -> None:
+        """Store-only: psnap bytes cross the wire exclusively on demand
+        (`request_psnaps` -> `{psnap_req}` -> `{psnap}`) — broadcasting
+        them would re-create the whole-snapshot bill the partition plane
+        exists to avoid."""
+        with self._lock:
+            self._psnaps.setdefault(self.member, {})[int(part)] = blob
+
+    def fetch_psnap(self, member: str, part: int) -> Optional[bytes]:
+        with self._lock:
+            return self._psnaps.get(member, {}).get(int(part))
+
+    def request_psnaps(self, member: str, parts: List[int]) -> None:
+        if not parts:
+            return
+        link = self._links.get(member)
+        if link is None:
+            return  # unreachable peer: caller falls back to full resync
+        self.metrics.count("net.psnap_reqs_sent")
+        link.enqueue(
+            "psnap_req",  # no special queue policy: tiny and re-askable
+            self._psnap_req_frame([int(p) for p in parts], link),
+            meta={"origin": self.member},
+        )
 
     def close(self) -> None:
         if self._closed:

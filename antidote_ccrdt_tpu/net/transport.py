@@ -65,6 +65,18 @@ class Transport(Protocol):
     def delta_seqs(self, member: str) -> List[int]: ...
     def delta_members(self) -> List[str]: ...
 
+    # -- partition plane (optional; see core.partition) --------------------
+    # Digest blobs are tiny P+1-entry summaries pushed like snapshots;
+    # psnap blobs are per-partition partial snapshots that are STORED,
+    # not broadcast — peers pull only divergent partitions. Transports
+    # without these methods degrade to whole-instance resync (GossipNode
+    # probes with getattr), which is also the mixed-version-fleet path.
+    def publish_digest(self, blob: bytes) -> None: ...
+    def fetch_digest(self, member: str) -> Optional[bytes]: ...
+    def publish_psnap(self, part: int, blob: bytes) -> None: ...
+    def fetch_psnap(self, member: str, part: int) -> Optional[bytes]: ...
+    def request_psnaps(self, member: str, parts: List[int]) -> None: ...
+
     def close(self) -> None: ...
 
     def peers(self) -> List[str]:
@@ -255,6 +267,59 @@ class FsTransport:
             }
         )
 
+    # -- partition plane ---------------------------------------------------
+    # `dig-<member>` (latest digest vector blob, atomic replace) and
+    # `psnap-<member>-<part:04d>`. On a shared directory the fetch IS the
+    # request, so `request_psnaps` is a no-op and partial resync resolves
+    # within one sweep.
+
+    def publish_digest(self, blob: bytes) -> None:
+        if faults.ACTIVE:
+            mangled = faults.mangle("transport.publish", blob)
+            if mangled is None:
+                return
+            blob = mangled
+        path = os.path.join(self.root, f"dig-{self.member}")
+        tmp = f"{path}.tmp-{os.getpid()}-{threading.get_ident()}"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def fetch_digest(self, member: str) -> Optional[bytes]:
+        try:
+            with open(os.path.join(self.root, f"dig-{member}"), "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def publish_psnap(self, part: int, blob: bytes) -> None:
+        if faults.ACTIVE:
+            mangled = faults.mangle("transport.publish", blob)
+            if mangled is None:
+                return
+            blob = mangled
+        path = os.path.join(self.root, f"psnap-{self.member}-{part:04d}")
+        tmp = f"{path}.tmp-{os.getpid()}-{threading.get_ident()}"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def fetch_psnap(self, member: str, part: int) -> Optional[bytes]:
+        try:
+            with open(
+                os.path.join(self.root, f"psnap-{member}-{part:04d}"), "rb"
+            ) as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def request_psnaps(self, member: str, parts: List[int]) -> None:
+        pass  # pull medium: fetch_psnap reads the peer's files directly
+
     def close(self) -> None:
         pass
 
@@ -422,6 +487,111 @@ class GossipNode:
 
     def delta_members(self) -> List[str]:
         return self.transport.delta_members()
+
+    # -- partition plane ---------------------------------------------------
+    # Degrades per-method via getattr: a transport without the partition
+    # surface (or a legacy peer that never publishes digests) reads as
+    # None everywhere, and callers fall back to whole-instance resync.
+
+    def partitions_supported(self) -> bool:
+        return all(
+            hasattr(self.transport, m)
+            for m in ("publish_digest", "fetch_digest", "publish_psnap",
+                      "fetch_psnap", "request_psnaps")
+        )
+
+    def publish_partitioned(
+        self, name: str, state: Any, seq: int, dense: Any, P: int
+    ) -> Optional[Any]:
+        """Anchor-time partition publish: the P+1 digest vector (pushed
+        like a snapshot — tiny) plus psnap blobs for every partition whose
+        digest changed since the last anchor (ALL partitions on the first;
+        the psnap store is cumulative, so it is complete from then on).
+        Returns the digest vector, or None when the medium has no
+        partition surface."""
+        from ..core import partition as pt
+        from ..core import serial
+
+        pub_dig = getattr(self.transport, "publish_digest", None)
+        pub_ps = getattr(self.transport, "publish_psnap", None)
+        if pub_dig is None or pub_ps is None:
+            return None
+        vec = pt.state_digests(state, P)
+        cache = getattr(self, "_last_digests", None)
+        if cache is None:
+            cache = self._last_digests = {}
+        prev = cache.get(name)
+        changed = (
+            list(range(P + 1))
+            if prev is None or len(prev) != len(vec)
+            else pt.divergent_parts(prev, vec)
+        )
+        for part in changed:
+            payload = serial.dumps_dense(
+                f"{name}_psnap", pt.restrict_psnap(dense, state, part, P)
+            )
+            blob = pt.encode_psnap_blob(seq, part, payload)
+            self.metrics.count("net.psnap_publishes")
+            pub_ps(part, blob)
+        dig_blob = pt.encode_digest_blob(seq, vec)
+        self.metrics.count("net.dig_publishes")
+        self.metrics.count("net.dig_bytes", len(dig_blob))
+        pub_dig(dig_blob)
+        cache[name] = vec
+        return vec
+
+    def fetch_digests(self, member: str) -> Optional[Tuple[int, Any]]:
+        """(seq, uint32[P+1]) of `member`'s latest digest vector, or None
+        (legacy peer / torn blob / no partition surface) — total."""
+        from ..core import partition as pt
+
+        fd = getattr(self.transport, "fetch_digest", None)
+        if fd is None:
+            return None
+        blob = fd(member)
+        if blob is None:
+            return None
+        try:
+            seq, vec = pt.decode_digest_blob(blob)
+        except Exception:  # noqa: BLE001 — total, same policy as fetch
+            return None
+        return seq, vec
+
+    def fetch_psnap(
+        self, member: str, part: int, like_delta: Any, validate=None
+    ) -> Optional[Tuple[int, Any]]:
+        """(seq, decoded psnap payload) for one partition, or None —
+        total. Bills `net.psnap_bytes` (the anti-entropy bytes the
+        partition plane exists to shrink)."""
+        from ..core import partition as pt
+        from ..core import serial
+
+        fp = getattr(self.transport, "fetch_psnap", None)
+        if fp is None:
+            return None
+        blob = fp(member, part)
+        if blob is None:
+            return None
+        try:
+            seq, got_part, payload = pt.decode_psnap_blob(blob)
+            if got_part != part:
+                return None
+            _name, delta = serial.loads_dense(payload, like_delta)
+            if validate is not None and not validate(delta):
+                return None
+        except Exception:  # noqa: BLE001 — see fetch
+            return None
+        self.metrics.count("net.psnap_fetches")
+        self.metrics.count("net.psnap_bytes", len(blob))
+        obs_events.emit(
+            "psnap.fetch", origin=member, part=part, bytes=len(blob)
+        )
+        return seq, delta
+
+    def request_psnaps(self, member: str, parts: List[int]) -> None:
+        rq = getattr(self.transport, "request_psnaps", None)
+        if rq is not None and parts:
+            rq(member, list(parts))
 
     def close(self) -> None:
         self.transport.close()

@@ -175,19 +175,59 @@ def payload_digest(blob: bytes) -> int:
 
 
 def digest_agreement(
-    digests: Dict[str, Optional[int]]
+    digests: Dict[str, Any]
 ) -> Dict[str, Any]:
     """Fleet-wide convergence probe over per-member digests (None =
     member unreadable). Returns agreement plus the disagreeing groups so
-    an operator can see WHICH members split, not just that they did."""
-    groups: Dict[Optional[int], List[str]] = {}
+    an operator can see WHICH members split, not just that they did.
+
+    Values may be scalar whole-instance digests (legacy) or per-
+    partition digest VECTORS (`core.partition.state_digests`). With
+    vectors the report gains `divergent_parts`: the partition indices on
+    which any two live members disagree — the exact set partial
+    anti-entropy will transfer — so the probe answers "how big is the
+    repair" and not just "are we split"."""
+    groups: Dict[Any, List[str]] = {}
+    vectors = False
     for m, d in sorted(digests.items()):
-        groups.setdefault(d, []).append(m)
+        if d is None:
+            key: Any = None
+        elif isinstance(d, (list, tuple)) or hasattr(d, "__len__"):
+            key = tuple(int(x) for x in d)
+            vectors = True
+        else:
+            key = int(d)
+        groups.setdefault(key, []).append(m)
     live = {d: ms for d, ms in groups.items() if d is not None}
-    return {
+
+    def _label(d: Any) -> str:
+        if isinstance(d, tuple):
+            return "-".join("%08x" % e for e in d)
+        return "%08x" % d
+
+    out = {
         "agree": len(live) == 1 and len(groups) == len(live),
         "n_members": len(digests),
         "n_digests": len(live),
-        "groups": {("%08x" % d): ms for d, ms in live.items()},
+        "groups": {_label(d): ms for d, ms in live.items()},
         "unreadable": groups.get(None, []),
     }
+    if vectors:
+        vecs = [d for d in live if isinstance(d, tuple)]
+        divergent: set = set()
+        if vecs:
+            width = max(len(v) for v in vecs)
+            ref = vecs[0]
+            for v in vecs[1:]:
+                if len(v) != len(ref):
+                    divergent.update(range(width))
+                    break
+                divergent.update(
+                    i for i in range(width) if v[i] != ref[i]
+                )
+        if any(not isinstance(d, tuple) for d in live):
+            # A scalar mixed in with vectors (mixed-version fleet):
+            # incomparable shapes — every partition is suspect.
+            divergent.update(range(max((len(v) for v in vecs), default=0)))
+        out["divergent_parts"] = sorted(divergent)
+    return out

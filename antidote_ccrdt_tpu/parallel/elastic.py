@@ -87,6 +87,7 @@ class DeltaPublisher:
         lag_source: Optional[Callable[[], float]] = None,
         lag_threshold: float = 8.0,
         lag_full_every: int = 2,
+        partitions: Optional[int] = None,
     ):
         from ..core import serial
         from ..core.behaviour import MergeKind
@@ -112,6 +113,13 @@ class DeltaPublisher:
         self.lag_source = lag_source
         self.lag_threshold = lag_threshold
         self.lag_full_every = max(1, lag_full_every)
+        # Partition plane (core/partition.py): when set, every full
+        # anchor ALSO publishes the P+1 digest vector and per-partition
+        # psnaps, so peers running `PartialAntiEntropy` can repair only
+        # divergent partitions instead of pulling the whole snapshot.
+        # None = whole-instance gossip only (the legacy path, and what a
+        # mixed-version fleet degrades to).
+        self.partitions = partitions
         self.seq = -1
         self._prev: Any = None
         self._serial = serial
@@ -147,6 +155,14 @@ class DeltaPublisher:
                     self.store.publish(self.name, state, self.seq)
             else:
                 self.store.publish(self.name, state, self.seq)
+            if self.partitions:
+                # Partition artifacts ride the anchor cadence: the full
+                # snapshot stays published (legacy peers and the
+                # psnap-exhausted fallback read it), digests + changed
+                # psnaps go alongside.
+                self.store.publish_partitioned(
+                    self.name, state, self.seq, self.dense, self.partitions
+                )
             kind, nbytes = "full", -1
         else:
             if obs_spans.ACTIVE:
@@ -167,14 +183,143 @@ class DeltaPublisher:
         return {"kind": kind, "seq": self.seq, "nbytes": nbytes}
 
 
+class PartialAntiEntropy:
+    """Partition-granular resync (the tentpole of the partition plane):
+    instead of pulling a peer's whole snapshot on a delta-chain gap,
+    compare `P+1`-entry digest vectors (`core.partition.state_digests`)
+    and fetch psnaps for **only the divergent partitions**.
+
+    Outcome ladder per (member, gap):
+    1. vectors fully agree → advance the cursor to the digest seq with
+       ZERO fetches (the gap was bandwidth already paid via another
+       route — nothing to transfer at all);
+    2. some partitions diverge → `request_psnaps` + fetch + join each;
+       a partition counts repaired when the post-merge digest matches
+       the peer's OR the psnap's own seq has caught up to the digest seq
+       (a stored psnap's seq is the last anchor at which that partition
+       changed, so "older but matching" is complete, not stale);
+    3. psnaps missing / still divergent after `max_tries` sweeps →
+       report unhandled, and `sweep_deltas` falls back to the legacy
+       whole-snapshot fetch (also the mixed-version-fleet path: a legacy
+       peer publishes no digests, so step 1 bails immediately).
+
+    Counters: `net.partition_resyncs` (completed partial repairs),
+    `part.divergent` gauge (size of the last divergence set), and
+    `net.psnap_wasted` — fetches for a partition whose digests already
+    agreed. By construction this stays 0; scripts/chaos_gate.py fails
+    the build if it ever isn't."""
+
+    def __init__(
+        self, store: GossipNode, partitions: Optional[int] = None,
+        max_tries: int = 3,
+    ):
+        from ..core import partition as pt
+
+        self.store = store
+        self.partitions = partitions if partitions else pt.n_partitions()
+        self.max_tries = max(1, max_tries)
+        self._pt = pt
+        # member -> consecutive incomplete partial-resync attempts; reset
+        # on completion, tripped into full-snap fallback at max_tries.
+        self._tries: Dict[str, int] = {}
+
+    def try_resync(
+        self, member: str, dense: Any, state: Any, cur: int
+    ) -> Tuple[Any, int, bool]:
+        """(state, cursor, handled). handled=False → caller should run
+        the whole-snapshot path."""
+        from .delta import apply_any_delta, delta_in_bounds, like_delta_for
+
+        pt, P = self._pt, self.partitions
+        got = self.store.fetch_digests(member)
+        if got is None:
+            return state, cur, False  # legacy peer / torn blob
+        dig_seq, peer_vec = got
+        if dig_seq <= cur or len(peer_vec) != P + 1:
+            # Digest older than our cursor (the snap outran it) or a
+            # fleet disagreeing on P: partial resync can't certify
+            # anything — use the full snapshot.
+            return state, cur, False
+        own_vec = pt.state_digests(state, P)
+        div = pt.divergent_parts(own_vec, peer_vec)
+        self.store.metrics.set("part.divergent", float(len(div)))
+        if not div:
+            # Full agreement: the peer's anchor adds nothing we lack.
+            self.store.metrics.count("net.partition_agree_advances")
+            obs_events.emit(
+                "psnap.resync", origin=member, parts=[], seq=dig_seq,
+                fetched=0,
+            )
+            self._tries.pop(member, None)
+            return state, max(cur, dig_seq), True
+        # Wasted-resync guard (chaos_gate's detector): only divergent
+        # partitions may be fetched. Anything else would be billed here.
+        fetch_parts = []
+        for p in div:
+            if own_vec[p] == peer_vec[p]:
+                self.store.metrics.count("net.psnap_wasted")
+                continue
+            fetch_parts.append(p)
+        self.store.request_psnaps(member, fetch_parts)
+        like = like_delta_for(dense, state)
+        repaired_by_seq = set()
+        fetched = 0
+        for p in fetch_parts:
+            r = self.store.fetch_psnap(
+                member, p, like,
+                validate=lambda d: delta_in_bounds(dense, state, d),
+            )
+            if r is None:
+                continue  # not served yet (push media) — next sweep
+            ps_seq, payload = r
+            try:
+                state = apply_any_delta(dense, state, payload)
+            except Exception:  # noqa: BLE001 — total, same as sweep
+                continue
+            fetched += 1
+            if ps_seq >= dig_seq:
+                repaired_by_seq.add(p)
+        post_vec = pt.state_digests(state, P)
+        outstanding = [
+            p for p in fetch_parts
+            if post_vec[p] != peer_vec[p] and p not in repaired_by_seq
+        ]
+        if not outstanding:
+            self.store.metrics.count("net.partition_resyncs")
+            obs_events.emit(
+                "psnap.resync", origin=member, parts=list(fetch_parts),
+                seq=dig_seq, fetched=fetched,
+            )
+            self._tries.pop(member, None)
+            return state, max(cur, dig_seq), True
+        tries = self._tries.get(member, 0) + 1
+        self._tries[member] = tries
+        if tries >= self.max_tries:
+            # Residual divergence partial resync can't close (e.g. the
+            # peer pruned psnaps, or P mismatch upstream): give up and
+            # let the whole snapshot repair everything.
+            self._tries.pop(member, None)
+            return state, cur, False
+        # In progress: psnaps requested, replies in flight. Skip the
+        # full fetch this sweep; joins already applied are kept (they
+        # are monotone — never wrong, at worst incomplete).
+        return state, cur, True
+
+
 def sweep_deltas(
-    store: GossipNode, dense: Any, state: Any, cursors: Dict[str, int]
+    store: GossipNode, dense: Any, state: Any, cursors: Dict[str, int],
+    partial: Optional[PartialAntiEntropy] = None,
 ) -> Tuple[Any, Dict[str, Any]]:
     """Delta-aware sweep: per peer, chain contiguous deltas from the
     cursor; on a gap (pruned, torn, or never-seen member) resync from the
     peer's full snapshot and continue chaining. `cursors` maps member ->
     highest seq applied and is updated in place. Applying a full snapshot
-    after deltas (or twice) is harmless — everything is a join."""
+    after deltas (or twice) is harmless — everything is a join.
+
+    With `partial` (a `PartialAntiEntropy`), the gap branch first tries
+    partition-granular repair — digest-vector compare, then psnaps for
+    only the divergent partitions — and falls back to the whole snapshot
+    when the peer has no partition surface or the partial repair stalls."""
     from .delta import apply_any_delta, delta_in_bounds, like_delta_for
 
     dense, state = _resolve_monoid(dense, state, "sweep_deltas")
@@ -227,6 +372,14 @@ def sweep_deltas(
         cur = chain(m, cur)
         snap_seq = store.snapshot_seq(m)
         if snap_seq is not None and snap_seq > cur:
+            if partial is not None:
+                state, cur2, handled = partial.try_resync(m, dense, state, cur)
+                if handled:
+                    if cur2 > cur:
+                        cur = chain(m, cur2)
+                        stats["partials"] = stats.get("partials", 0) + 1
+                    cursors[m] = cur
+                    continue
             got = store.fetch(m, state, dense=dense)
             if got is None:
                 stats["skipped"] += 1

@@ -192,96 +192,149 @@ class HostStage:
 # -- the bounded inbound apply queue ------------------------------------------
 
 
+_ALL_PARTS = -1  # hole key meaning "every partition" (legacy / unknown)
+
+
 class _Entry:
-    __slots__ = ("kind", "member", "seq", "payload", "merged")
+    __slots__ = ("kind", "member", "seq", "payload", "merged", "parts")
 
     def __init__(self, kind: str, member: str, seq: int, payload: Any,
-                 merged: Any):
+                 merged: Any, parts: Optional[frozenset] = None):
         self.kind = kind          # "delta" | "snap"
         self.member = member
         self.seq = seq
         self.payload = payload    # decoded delta / fetched peer state
         self.merged = merged      # pre-expanded mergeable state, or None
+        # Partition set this payload touches (core.partition.delta_parts
+        # minus the meta partition — whole-instance leaves are shipped in
+        # full by every delta and are join-monotone, so their loss heals
+        # via ANY later payload). None = unknown/legacy: touches all.
+        # Empty frozenset = meta-only: dropping it loses nothing durable.
+        self.parts = parts
 
 
 class ApplyQueue:
     """Bounded queue of pre-decoded inbound payloads, shed with the
     net/tcp.py send-queue policy: oldest DELTA first, anchors kept,
-    snapshots latest-wins per member. Shedding a delta opens a per-member
-    HOLE (chained deltas are valid only gap-free): the member's later
-    queued deltas are purged with it, further deltas are refused, and
-    only a full snapshot with seq >= the hole heals it."""
+    snapshots latest-wins per member. Shedding a delta opens a HOLE
+    (chained deltas are valid only gap-free) — at PARTITION granularity
+    when entries carry their partition set: only the member's later
+    queued deltas that INTERSECT the victim's partitions are purged with
+    it, only intersecting further deltas are refused (disjoint
+    partitions keep flowing), and a full snapshot with seq >= a
+    partition's hole heals that partition. Entries without a partition
+    set (`parts=None` — legacy callers, engines without an item plan)
+    degrade to the old whole-member hole."""
 
     def __init__(self, depth: int = 32, metrics: Any = None):
         self.depth = max(1, depth)
         self.metrics = metrics
         self._lock = threading.Lock()
         self._q: "deque[_Entry]" = deque()
-        self._holes: Dict[str, int] = {}  # member -> min healing snap seq
+        # member -> {partition (or _ALL_PARTS) -> min healing snap seq}
+        self._holes: Dict[str, Dict[int, int]] = {}
 
     def __len__(self) -> int:
         with self._lock:
             return len(self._q)
 
     def dirty_floor(self, member: str) -> Optional[int]:
-        """The member's open hole (lowest snapshot seq that heals it),
-        or None when its delta chain is intact."""
+        """The member's widest open hole (lowest snapshot seq healing
+        ALL of its holed partitions), or None when its chain is intact."""
         with self._lock:
-            return self._holes.get(member)
+            holes = self._holes.get(member)
+            return max(holes.values()) if holes else None
+
+    def dirty_parts(self, member: str) -> Dict[int, int]:
+        """{partition -> min healing snap seq} for the member's open
+        holes (`_ALL_PARTS` = every partition)."""
+        with self._lock:
+            return dict(self._holes.get(member, {}))
 
     def _count(self, name: str, n: int = 1) -> None:
         if self.metrics is not None:
             self.metrics.count(name, n)
 
+    @staticmethod
+    def _holed(holes: Dict[int, int], parts: Optional[frozenset]) -> bool:
+        """Does a payload touching `parts` hit any open hole?"""
+        if not holes:
+            return False
+        if _ALL_PARTS in holes or parts is None:
+            return True
+        return any(p in holes for p in parts)
+
     def _shed_locked(self) -> None:
         """Make room (lock held): drop the oldest delta plus the same
-        member's later queued deltas (contiguity), recording the hole; a
-        queue of only snapshots drops its oldest (a hole marks it for
-        refetch — the newer anchor on the store still covers it)."""
+        member's later queued deltas intersecting its partition set
+        (partition-granular contiguity), recording a hole per touched
+        partition; a meta-only delta (empty parts) drops alone and holes
+        nothing — its whole-instance leaves are monotone and re-shipped
+        by every later payload. A queue of only snapshots drops its
+        oldest (a hole marks it for refetch — the newer anchor on the
+        store still covers it)."""
         victim = next((e for e in self._q if e.kind == "delta"), None)
         if victim is not None:
-            dropped = [
-                e for e in self._q
-                if e.kind == "delta" and e.member == victim.member
-                and e.seq >= victim.seq
-            ]
+            vp = victim.parts
+            dropped = [victim]
+            if vp is None or vp:
+                dropped += [
+                    e for e in self._q
+                    if e.kind == "delta" and e.member == victim.member
+                    and e.seq > victim.seq
+                    and (vp is None or e.parts is None or (e.parts & vp))
+                ]
             for e in dropped:
                 self._q.remove(e)
-            hole = max(e.seq for e in dropped)
-            self._holes[victim.member] = max(
-                self._holes.get(victim.member, -1), hole
-            )
+            holes = self._holes.setdefault(victim.member, {})
+            for e in dropped:
+                if e.parts is None:
+                    holes[_ALL_PARTS] = max(
+                        holes.get(_ALL_PARTS, -1), e.seq
+                    )
+                else:
+                    for p in e.parts:
+                        holes[p] = max(holes.get(p, -1), e.seq)
+            if not holes:
+                self._holes.pop(victim.member, None)
             self._count("overlap.dropped_deltas", len(dropped))
             return
         e = self._q.popleft()  # all snaps: oldest snap goes
-        self._holes[e.member] = max(self._holes.get(e.member, -1), e.seq)
+        holes = self._holes.setdefault(e.member, {})
+        holes[_ALL_PARTS] = max(holes.get(_ALL_PARTS, -1), e.seq)
         self._count("overlap.dropped_snaps")
 
     def put_delta(self, member: str, seq: int, payload: Any,
-                  merged: Any = None) -> bool:
-        """Enqueue delta `seq` of `member`; False when refused (open
-        hole — the caller must stop chaining until an anchor lands)."""
+                  merged: Any = None,
+                  parts: Optional[frozenset] = None) -> bool:
+        """Enqueue delta `seq` of `member`; False when refused (the
+        delta touches a holed partition — the caller must stop chaining
+        until an anchor covers it; deltas touching only intact
+        partitions are still accepted)."""
         with self._lock:
-            if member in self._holes:
+            if self._holed(self._holes.get(member, {}), parts):
                 return False
             if len(self._q) >= self.depth:
                 self._shed_locked()
-            if member in self._holes:
-                # The shed just holed THIS member's chain; the incoming
-                # delta is past the hole and useless until the anchor.
+            if self._holed(self._holes.get(member, {}), parts):
+                # The shed just holed (part of) THIS member's chain and
+                # the incoming delta lands in the gap.
                 self._count("overlap.dropped_deltas")
                 return False
-            self._q.append(_Entry("delta", member, seq, payload, merged))
+            self._q.append(
+                _Entry("delta", member, seq, payload, merged, parts)
+            )
             return True
 
     def put_snap(self, member: str, seq: int, payload: Any,
                  merged: Any = None) -> bool:
-        """Enqueue a full-snapshot anchor (latest-wins per member). Heals
-        the member's hole when seq covers it; an anchor BELOW an open
-        hole is refused (it cannot cover the gap)."""
+        """Enqueue a full-snapshot anchor (latest-wins per member). A
+        snapshot covers every partition through `seq`, so it heals each
+        hole it reaches (seq >= that partition's hole); an anchor below
+        ALL open holes is refused (it cannot cover any gap)."""
         with self._lock:
-            hole = self._holes.get(member)
-            if hole is not None and seq < hole:
+            holes = self._holes.get(member)
+            if holes and all(seq < h for h in holes.values()):
                 return False
             stale = [
                 e for e in self._q if e.kind == "snap" and e.member == member
@@ -290,10 +343,15 @@ class ApplyQueue:
                 self._q.remove(e)
             if len(self._q) >= self.depth:
                 self._shed_locked()
-            if self._holes.get(member, -1) > seq:
+            holes = self._holes.get(member)
+            if holes and all(seq < h for h in holes.values()):
                 return False  # the shed re-holed us above this anchor
             self._q.append(_Entry("snap", member, seq, payload, merged))
-            self._holes.pop(member, None)
+            if holes:
+                for p in [p for p, h in holes.items() if seq >= h]:
+                    holes.pop(p)
+                if not holes:
+                    self._holes.pop(member, None)
             return True
 
     def pop_all(self) -> List[_Entry]:
@@ -318,7 +376,8 @@ class DeltaPrefetcher:
     land on their own tid and read as OVERLAPPABLE."""
 
     def __init__(self, store: Any, dense: Any, like_state: Any,
-                 apq: ApplyQueue, metrics: Any = None):
+                 apq: ApplyQueue, metrics: Any = None,
+                 partitions: Optional[int] = None):
         from .delta import like_delta_for
         from .elastic import _resolve_monoid
         from .monoid import MonoidLift
@@ -329,6 +388,11 @@ class DeltaPrefetcher:
         self.like_state = like_state
         self.apq = apq
         self.metrics = metrics if metrics is not None else store.metrics
+        # With a partition count, every decoded delta is tagged with the
+        # partitions it touches (receiver-side: core.partition
+        # .delta_parts) so ApplyQueue sheds/heals at partition
+        # granularity. None keeps whole-member holes (legacy).
+        self.partitions = partitions
         self._like_delta = like_delta_for(dense, like_state)
         # Lifted monoid states carry host-side row versions; they apply
         # through apply_monoid_row_delta / MonoidLift.merge sequentially,
@@ -368,9 +432,12 @@ class DeltaPrefetcher:
                 continue
             cur = self.cursors.get(m, -1)
             hole = self.apq.dirty_floor(m)
-            if hole is not None:
+            if hole is not None and self.partitions is None:
                 # Anchor-only until the hole is covered: deltas past a
-                # dropped seq can never restore chain contiguity.
+                # dropped seq can never restore chain contiguity. (With
+                # partitions, holes are per-partition — keep chaining and
+                # let put_delta refuse only intersecting deltas; the
+                # trailing anchor fetch below covers the holed ones.)
                 snap_seq = store.snapshot_seq(m)
                 if snap_seq is not None and snap_seq >= hole:
                     new = self._fetch_snap(m, cur)
@@ -405,7 +472,23 @@ class DeltaPrefetcher:
                         merged = expand_delta(self.dense, delta)
                     except Exception:  # noqa: BLE001 — fold is best-effort
                         merged = None
-                if not self.apq.put_delta(m, cur + 1, delta, merged):
+                parts = None
+                if self.partitions:
+                    from ..core import partition as pt
+
+                    try:
+                        # Meta partition excluded: whole-instance leaves
+                        # ride every delta in full and are join-monotone,
+                        # so they need no hole bookkeeping (see _Entry).
+                        parts = frozenset(
+                            pt.delta_parts(
+                                self.dense, self.like_state, delta,
+                                self.partitions,
+                            )
+                        ) - {pt.meta_part(self.partitions)}
+                    except Exception:  # noqa: BLE001 — tag is best-effort
+                        parts = None  # untagged = touches-all (safe)
+                if not self.apq.put_delta(m, cur + 1, delta, merged, parts):
                     break  # queue holed this member: anchor path next poll
                 cur += 1
                 n += 1
@@ -457,14 +540,16 @@ class OverlapPipeline:
                  metrics: Any = None, depth: Optional[int] = None,
                  fold_cap: Optional[int] = None,
                  host_depth: Optional[int] = None,
-                 start_thread: bool = True):
+                 start_thread: bool = True,
+                 partitions: Optional[int] = None):
         self.metrics = metrics if metrics is not None else store.metrics
         self.apq = ApplyQueue(
             depth if depth is not None else queue_depth(),
             metrics=self.metrics,
         )
         self.prefetch = DeltaPrefetcher(
-            store, dense, like_state, self.apq, metrics=self.metrics
+            store, dense, like_state, self.apq, metrics=self.metrics,
+            partitions=partitions,
         )
         self.dense = self.prefetch.dense
         self.host = HostStage(

@@ -774,6 +774,136 @@ def bench_round_phases(R, I, D_DCS, K, M, B, Br, rounds=6, overlap=None):
     }
 
 
+def bench_partition_antientropy(P=8, resync_rounds=4):
+    """Partition-plane anti-entropy microbench (core/partition.py).
+
+    Two gossip nodes over the FS transport; the writer repeatedly
+    advances ONE partition and anchors, the reader repairs each gap via
+    `PartialAntiEntropy`. Reports the wire cost of a partial repair —
+    ``antientropy_bytes_per_resync`` (digest vector + fetched psnaps,
+    averaged over the resyncs) — against the whole-snapshot blob the
+    legacy path would have pulled for the same gap, plus
+    ``rejoin_stream_seconds``: wall time for a cold `RejoinStreamer`
+    to stream the final state partition by partition (shards persisted
+    as it goes). Protocol-bound, not accelerator-bound: geometry stays
+    fixed and small on every backend so rounds are comparable."""
+    import shutil
+    import tempfile
+
+    from antidote_ccrdt_tpu.core import partition as pt
+    from antidote_ccrdt_tpu.harness.checkpoint import RejoinStreamer
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import (
+        TopkRmvOps, make_dense,
+    )
+    from antidote_ccrdt_tpu.net.transport import FsTransport, GossipNode
+    from antidote_ccrdt_tpu.parallel.elastic import (
+        DeltaPublisher, PartialAntiEntropy, sweep_deltas,
+    )
+
+    import jax.numpy as jnp
+
+    R, NK, I, DCS, K, M, B = 4, 1, 256, 4, 8, 2, 32
+    dense = make_dense(n_ids=I, n_dcs=DCS, size=K, slots_per_id=M)
+    part_map = pt.part_of(np.arange(I), P)
+    p_star = int(np.bincount(part_map, minlength=P).argmax())
+    pools = {
+        "all": np.arange(I, dtype=np.int32),
+        "hot": np.arange(I, dtype=np.int32)[part_map == p_star],
+    }
+
+    def apply_ops(state, step, pool):
+        rng = np.random.default_rng(55_000 + step)
+        a_id = pools[pool][rng.integers(0, len(pools[pool]), (R, B))]
+        z = np.zeros((R, B), np.int32)
+        ops = TopkRmvOps(
+            add_key=jnp.asarray(z),
+            add_id=jnp.asarray(a_id.astype(np.int32)),
+            add_score=jnp.asarray(rng.integers(1, 500, (R, B)).astype(np.int32)),
+            add_dc=jnp.asarray(z),
+            add_ts=jnp.asarray(np.broadcast_to(
+                step * B + np.arange(B) + 1, (R, B)
+            ).astype(np.int32)),
+            rmv_key=jnp.asarray(np.zeros((R, 1), np.int32)),
+            rmv_id=jnp.asarray(np.full((R, 1), -1, np.int32)),
+            rmv_vc=jnp.asarray(np.zeros((R, 1, DCS), np.int32)),
+        )
+        state, _ = dense.apply_ops(state, ops, collect_dominated=False)
+        return state
+
+    root = tempfile.mkdtemp(prefix="ccrdt_ae_bench_")
+    try:
+        a = GossipNode(FsTransport(root, "a"))
+        b = GossipNode(FsTransport(root, "b"))
+        a.heartbeat(), b.heartbeat()
+        pub = DeltaPublisher(
+            a, dense, name="topk_rmv", full_every=1, partitions=P
+        )
+        partial = PartialAntiEntropy(b, partitions=P)
+        st_a = dense.init(R, NK)
+        step = 0
+        for _ in range(3):  # shared prefix over the whole id space
+            st_a = apply_ops(st_a, step, "all")
+            step += 1
+        pub.publish(st_a)
+        curs = {}
+        st_b, _ = sweep_deltas(b, dense, dense.init(R, NK), curs)
+
+        partial_bytes = whole_bytes = resyncs = 0
+        for _ in range(resync_rounds):
+            st_a = apply_ops(st_a, step, "hot")
+            step += 1
+            pub.publish(st_a)
+            whole_bytes += len(b.transport.fetch("a"))
+            raw_dig = b.transport.fetch_digest("a")
+            partial_bytes += len(raw_dig) if raw_dig else 0
+            c0 = b.metrics.counters.get("net.psnap_bytes", 0)
+            st_b, _stats = sweep_deltas(b, dense, st_b, curs, partial=partial)
+            partial_bytes += b.metrics.counters.get("net.psnap_bytes", 0) - c0
+            resyncs += 1
+        if not np.array_equal(
+            pt.state_digests(st_b, P), pt.state_digests(st_a, P)
+        ):
+            raise RuntimeError("anti-entropy bench diverged — repair broken")
+
+        # Cold rejoin: stream the writer's final anchor partition by
+        # partition into an empty worker, persisting each shard. One
+        # warmup fetch first so jit compilation of the psnap join does
+        # not masquerade as streaming time.
+        warm = RejoinStreamer(
+            os.path.join(root, "warm"), "topk_rmv", dense, b, "a",
+            partitions=P,
+        )
+        warm.run(warm.start(dense.init(R, NK)))
+        streamed0 = int(b.metrics.counters.get("rejoin.parts_streamed", 0))
+        t0 = time.perf_counter()
+        streamer = RejoinStreamer(
+            os.path.join(root, "ckpt"), "topk_rmv", dense, b, "a",
+            partitions=P,
+        )
+        st_r = streamer.run(streamer.start(dense.init(R, NK)))
+        rejoin_s = time.perf_counter() - t0
+        if streamer.plan or not np.array_equal(
+            pt.state_digests(st_r, P), pt.state_digests(st_a, P)
+        ):
+            raise RuntimeError("rejoin bench did not reach the peer state")
+        streamed = int(
+            b.metrics.counters.get("rejoin.parts_streamed", 0) - streamed0
+        )
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    per_resync = partial_bytes / max(1, resyncs)
+    return {
+        "partitions": P,
+        "resyncs": resyncs,
+        "antientropy_bytes_per_resync": round(per_resync, 1),
+        "whole_bytes_per_resync": round(whole_bytes / max(1, resyncs), 1),
+        "antientropy_reduction_x": round(whole_bytes / max(1.0, partial_bytes), 2),
+        "rejoin_stream_seconds": round(rejoin_s, 3),
+        "rejoin_parts_streamed": streamed,
+    }
+
+
 def main():
     import jax
 
@@ -862,6 +992,9 @@ def main():
         ),
     }
     baseline_rate = bench_scalar_baseline(R, I, D_DCS, K, base_ops)
+    antientropy = bench_partition_antientropy(
+        resync_rounds=2 if os.environ.get("CCRDT_BENCH_TINY") else 4
+    )
     round_phases = bench_round_phases(
         R, I, D_DCS, K, M, B, Br,
         rounds=3 if (backend == "cpu" or os.environ.get("CCRDT_BENCH_TINY"))
@@ -892,6 +1025,10 @@ def main():
         # the dispatch gap no phase owns. The summary line carries only
         # the two headline numbers (gap p50 + coverage).
         "round_phases": round_phases,
+        # Partition-plane anti-entropy costs (bench_partition_antientropy):
+        # fixed protocol geometry, so rounds compare; the summary line
+        # carries the two gated headline numbers.
+        "partition_antientropy": antientropy,
         "dispatch_overhead_ms_p50": round(dispatch_overhead_ms, 2),
         "batch_per_replica_round": f"{B} adds + {Br} rmvs",
         "backend": backend,
@@ -914,6 +1051,11 @@ def main():
     summary = {
         "metric": f"topk_rmv merges/sec ({I//1000}k ids x {R} replicas, K={K})",
         "value": round(apply_rate),
+        # Duplicate of "value" under the key scripts/bench_gate.py greps
+        # for: the details line can outgrow the driver's 2000-char tail
+        # window, but this summary line (checked < 1900 chars below)
+        # always survives it.
+        "merges_per_sec": round(apply_rate),
         "unit": "merges/sec",
         "vs_baseline": round(apply_rate / baseline_rate, 2),
         "p50_round_ms_windowed": round(p50_ms, 2),
@@ -927,6 +1069,10 @@ def main():
         "baseline_cpu_merges_per_sec": round(baseline_rate),
         "dispatch_gap_ms_p50": round_phases["dispatch_gap_ms_p50"],
         "span_coverage_p50": round_phases["span_coverage_p50"],
+        "antientropy_bytes_per_resync": antientropy[
+            "antientropy_bytes_per_resync"
+        ],
+        "rejoin_stream_seconds": antientropy["rejoin_stream_seconds"],
         "backend": backend,
         "details_file": "benchmarks/bench_details.json" if sidecar else "stdout",
     }

@@ -22,10 +22,16 @@ string. This gate:
    report-only): the latest attribution-bearing round fails when its
    gap grew more than ``--gap-tolerance`` (default 20%) AND more than
    0.25 ms absolute over the best (lowest) prior carrier — the
-   absolute floor keeps near-zero gaps from tripping on noise.
+   absolute floor keeps near-zero gaps from tripping on noise;
+5. gates the partition plane's anti-entropy costs (r7+): the latest
+   carrier's ``antientropy_bytes_per_resync`` and
+   ``rejoin_stream_seconds`` must stay within the same double
+   threshold (>20% relative AND an absolute floor — 512 B / 0.25 s)
+   of the best prior carrier — a psnap fattening back toward whole
+   snapshots or the incremental rejoin slowing down fails here.
 
 With fewer than two comparable rounds a gate passes vacuously (exit 0)
-and says so. The overall exit code is the worst of both gates.
+and says so. The overall exit code is the worst of all gates.
 
 Run: ``python scripts/bench_gate.py [--bench-dir DIR] [--tolerance 0.2]``
 (also wired as ``make bench-gate`` and into ``make chaos``).
@@ -43,6 +49,15 @@ from typing import Dict, List, Optional, Tuple
 
 _METRIC_RE = re.compile(r'"merges_per_sec":\s*([0-9][0-9_.eE+]*)')
 _BACKEND_RE = re.compile(r'"backend":\s*"([A-Za-z0-9_]+)"')
+# Fallback for tails whose fat details line pushed every
+# "merges_per_sec" key past the driver's 2000-char window: the compact
+# summary line (always last, checked < 1900 chars by bench.py) names
+# the same number as `"metric": "topk_rmv merges/sec (...)" ...
+# "value": N`.
+_SUMMARY_RE = re.compile(
+    r'"metric":\s*"topk_rmv merges/sec[^"]*",\s*"value":\s*'
+    r"([0-9][0-9_.eE+]*)"
+)
 
 
 def round_number(path: str) -> int:
@@ -65,6 +80,8 @@ def round_metrics(path: str) -> Tuple[Optional[float], Optional[str]]:
     # already unescaped it, so a plain regex over the text applies.
     tail = str(doc.get("tail", ""))
     vals = [float(v) for v in _METRIC_RE.findall(tail)]
+    if not vals:
+        vals = [float(v) for v in _SUMMARY_RE.findall(tail)]
     backends = _BACKEND_RE.findall(tail)
     return (max(vals) if vals else None), (backends[-1] if backends else None)
 
@@ -222,6 +239,93 @@ def evaluate_gap(
     return 0, f"{verdict}\nOK: within tolerance"
 
 
+_AE_RE = re.compile(r'"antientropy_bytes_per_resync":\s*([0-9][0-9_.eE+-]*)')
+_REJOIN_RE = re.compile(r'"rejoin_stream_seconds":\s*([0-9][0-9_.eE+-]*)')
+
+
+def load_partition_rounds(
+    bench_dir: str,
+) -> List[Tuple[int, str, float, float]]:
+    """[(round_no, path, antientropy_bytes_per_resync,
+    rejoin_stream_seconds)] for every BENCH round whose summary line
+    carries the partition-plane metrics (bench.bench_partition_antientropy,
+    r7+). The microbench runs a FIXED protocol geometry on every backend,
+    so rounds compare without backend grouping."""
+    out: List[Tuple[int, str, float, float]] = []
+    for p in sorted(
+        glob.glob(os.path.join(bench_dir, "BENCH_r*.json")), key=round_number
+    ):
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        tail = str(doc.get("tail", ""))
+        ae = _AE_RE.findall(tail)
+        rj = _REJOIN_RE.findall(tail)
+        if ae and rj:
+            out.append((round_number(p), p, float(ae[-1]), float(rj[-1])))
+    return out
+
+
+def evaluate_partition(
+    rounds: List[Tuple[int, str, float, float]],
+    tolerance: float = 0.20,
+    ae_floor_bytes: float = 512.0,
+    rejoin_floor_s: float = 0.25,
+) -> Tuple[int, str]:
+    """(exit_code, verdict) for the partition-plane gate: the latest
+    carrier fails when `antientropy_bytes_per_resync` or
+    `rejoin_stream_seconds` grew more than `tolerance` relative AND more
+    than the metric's absolute floor over the best (lowest) prior
+    carrier — both thresholds must trip, same double-threshold shape as
+    the dispatch-gap gate (psnaps are a few KB and a cold rejoin tens of
+    milliseconds; a pure percentage would fail on codec jitter or one
+    slow fsync). Fewer than two carriers pass vacuously."""
+    if len(rounds) < 2:
+        return 0, (
+            f"partition-gate: only {len(rounds)} round(s) carry the "
+            "anti-entropy metrics — nothing to compare, passing vacuously"
+        )
+    latest_n, _p, latest_ae, latest_rj = rounds[-1]
+    prior = rounds[:-1]
+    best_ae_n, _ap, best_ae, _ = min(prior, key=lambda r: r[2])
+    best_rj_n, _rp, _x, best_rj = min(prior, key=lambda r: r[3])
+    code = 0
+    lines: List[str] = []
+    ae_ceiling = max(best_ae * (1.0 + tolerance), best_ae + ae_floor_bytes)
+    verdict = (
+        f"partition-gate: r{latest_n:02d} antientropy_bytes_per_resync = "
+        f"{latest_ae:,.0f} vs best prior r{best_ae_n:02d} = {best_ae:,.0f} "
+        f"(ceiling +{tolerance:.0%} and +{ae_floor_bytes:.0f}B: "
+        f"{ae_ceiling:,.0f})"
+    )
+    if latest_ae > ae_ceiling:
+        code = 1
+        lines.append(
+            f"{verdict}\nFAIL: a partial resync moves "
+            f"{latest_ae - best_ae:+,.0f} bytes more — psnaps are "
+            "fattening back toward whole snapshots"
+        )
+    else:
+        lines.append(f"{verdict}\nOK: within tolerance")
+    rj_ceiling = max(best_rj * (1.0 + tolerance), best_rj + rejoin_floor_s)
+    verdict = (
+        f"partition-gate: r{latest_n:02d} rejoin_stream_seconds = "
+        f"{latest_rj:.3f} vs best prior r{best_rj_n:02d} = {best_rj:.3f} "
+        f"(ceiling +{tolerance:.0%} and +{rejoin_floor_s}s: {rj_ceiling:.3f})"
+    )
+    if latest_rj > rj_ceiling:
+        code = 1
+        lines.append(
+            f"{verdict}\nFAIL: the incremental rejoin stream slowed "
+            f"{latest_rj - best_rj:+.3f}s over the best prior carrier"
+        )
+    else:
+        lines.append(f"{verdict}\nOK: within tolerance")
+    return code, "\n".join(lines)
+
+
 def attribution_drift(
     rounds: List[Tuple[int, str, float, float]]
 ) -> List[str]:
@@ -276,11 +380,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     attr = load_attribution_rounds(args.bench_dir)
     for line in attribution_drift(attr):
         print(line)
+    part = load_partition_rounds(args.bench_dir)
+    for n, p, ae, rj in part:
+        print(
+            f"  partition r{n:02d} {os.path.basename(p)}: "
+            f"{ae:,.0f} B/resync, rejoin {rj:.3f}s"
+        )
     code, verdict = evaluate(rounds, args.tolerance)
     print(verdict)
     gap_code, gap_verdict = evaluate_gap(attr, args.gap_tolerance)
     print(gap_verdict)
-    return max(code, gap_code)
+    part_code, part_verdict = evaluate_partition(part, args.tolerance)
+    print(part_verdict)
+    return max(code, gap_code, part_code)
 
 
 if __name__ == "__main__":

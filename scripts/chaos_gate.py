@@ -1,9 +1,13 @@
 """Chaos metrics gate: fail `make chaos` if the fault machinery goes dark.
 
-Runs two seeded simulator chaos drills — the full-mesh drill pinned by
-tests/test_net_chaos.py (loss + duplication + partition + crash over
-chained-delta gossip) and the zone-topology drill pinned by
-tests/test_topo_chaos.py (two zones, whole-zone partition, the za
+Runs three seeded simulator chaos drills — the full-mesh drill pinned
+by tests/test_net_chaos.py (loss + duplication + partition + crash over
+chained-delta gossip), the partition-plane drill pinned by
+tests/test_partition.py (same fault schedule with partitioned
+publishers + PartialAntiEntropy; partial-resync counters must be lit
+and `net.psnap_wasted` — a psnap fetched for an already-agreeing
+partition — must be exactly zero), and the zone-topology drill pinned
+by tests/test_topo_chaos.py (two zones, whole-zone partition, the za
 anchor crashed; requires cross-zone traffic, anchor relays, AND an
 observed failover off the crashed anchor) — then asserts that every
 load-bearing counter is nonzero and prints the run's summary. The point is
@@ -13,7 +17,7 @@ instrumentation dropped, sim faults disabled) regresses these counters
 to zero and must fail the gate, because every downstream consumer — the
 dashboard, the lag tracker, the flight-log cross-checks — reads them.
 
-A third leg guards the span plane (obs/spans.py): it runs the tiny
+The last leg guards the span plane (obs/spans.py): it runs the tiny
 round-phase drill (`bench.bench_round_phases`) with tracing armed and
 fails if any load-bearing phase recorded zero time — the span analogue
 of a counter going dark — or if the phases' union (serial AND
@@ -61,6 +65,21 @@ REQUIRED_NONZERO = (
 # healthy; 0.6 is the "instrumentation collapsed" line, not a perf SLO.
 SPAN_MIN_COVERAGE = 0.6
 
+# Partition-plane leg (tests/test_partition.py's seeded sim drill with
+# partitioned publishers + PartialAntiEntropy on every sweep): partial
+# repairs must actually happen, and `net.psnap_wasted` — a psnap fetched
+# for a partition whose digests already agreed — must stay EXACTLY zero:
+# the wasted-resync detector. Partial anti-entropy's whole claim is
+# "only divergent partitions cross the wire"; one wasted fetch means the
+# divergence math broke even if convergence stays green.
+PARTITION_REQUIRED_NONZERO = (
+    "net.dig_publishes",        # digest vectors actually shipped
+    "net.psnap_publishes",      # per-partition psnaps stored at anchors
+    "net.psnap_fetches",        # peers pulled divergent partitions
+    "net.psnap_bytes",          # ...with the byte bill counted
+    "net.partition_resyncs",    # partial repairs completed
+)
+
 # Same contract for the zone-topology leg (tests/test_topo_chaos.py:
 # two zones, whole-zone partition, the za anchor crashed mid-run).
 TOPO_REQUIRED_NONZERO = (
@@ -101,7 +120,37 @@ def main() -> int:
     print(f"OK: all {len(REQUIRED_NONZERO)} required chaos counters "
           f"nonzero; {len(digests)} survivors converged")
 
-    # -- leg 2: the zone topology (whole-zone partition + anchor crash) ----
+    # -- leg 2: the partition plane (partial anti-entropy under chaos) -----
+    from test_partition import run_partition_chaos
+
+    p_digests, p_counters = run_partition_chaos(seed=7)
+    p_diverged = sorted(m for m, d in p_digests.items() if d != ref)
+    p_zeroed = sorted(
+        n for n in PARTITION_REQUIRED_NONZERO if not p_counters.get(n, 0)
+    )
+    wasted = int(p_counters.get("net.psnap_wasted", 0))
+    print("== partition chaos drill (seed=7, partial anti-entropy) ==")
+    print("  " + " ".join(
+        f"{n}={int(p_counters.get(n, 0))}"
+        for n in PARTITION_REQUIRED_NONZERO + ("net.psnap_wasted",)
+    ))
+    if p_diverged:
+        print(f"FAIL: partition-plane members diverged from the sequential "
+              f"reference: {p_diverged}")
+        return 1
+    if p_zeroed:
+        print("FAIL: partition counters regressed to zero (partial "
+              f"anti-entropy went dark): {p_zeroed}")
+        return 1
+    if wasted:
+        print(f"FAIL: {wasted} psnap fetch(es) covered a partition whose "
+              "digests already agreed — the wasted-resync detector fired")
+        return 1
+    print(f"OK: partition leg — {len(p_digests)} survivors converged via "
+          f"{int(p_counters.get('net.partition_resyncs', 0))} partial "
+          f"resyncs, 0 wasted psnaps")
+
+    # -- leg 3: the zone topology (whole-zone partition + anchor crash) ----
     t_digests, t_counters, anchor_events = run_topo_chaos("topk_rmv", seed=7)
     t_diverged = sorted(m for m, d in t_digests.items() if d != ref)
     t_zeroed = sorted(
@@ -134,7 +183,7 @@ def main() -> int:
           f"anchors, failover {victim} -> "
           f"{sorted({ev['new'] for ev in failovers})} observed")
 
-    # -- leg 3: the span plane (round-phase tracing + attribution) ---------
+    # -- leg 4: the span plane (round-phase tracing + attribution) ---------
     from bench import bench_round_phases
     from antidote_ccrdt_tpu.obs import spans as obs_spans
     from antidote_ccrdt_tpu.parallel import overlap as overlap_mod

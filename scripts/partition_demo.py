@@ -1,0 +1,364 @@
+"""Partition-plane acceptance drill (core/partition.py tentpole gate).
+
+Three workers gossip the topk_rmv grid over real TCP sockets
+(net/tcp.py) with the partition plane on: every full anchor publishes
+the P+1-entry digest vector plus per-partition psnaps, and every gap
+repair goes through `PartialAntiEntropy` (parallel/elastic.py).
+
+The drill manufactures exactly ONE divergent partition: during an
+outage window, worker w2 stops gossiping (publish + sweep) while every
+replica's ops are confined to ids that hash into a single partition
+`p*`. When w2 comes back its delta chains have been pruned, so the
+classic path would pull each peer's WHOLE snapshot; the partition path
+compares digest vectors, sees divergence only on {p*, meta}, and
+fetches just those psnaps.
+
+Both repairs are run on the same pre-resync state and compared:
+
+* bytes:  whole-instance snapshot blobs vs digest vector + fetched
+  psnaps — the gate requires the partial path to move >= 5x fewer
+  bytes;
+* result: the post-repair per-partition digest vectors must be
+  BIT-IDENTICAL between the two paths (partial resync is a pure
+  bandwidth optimization, never a semantic one);
+* fleet:  after the remaining steps + a convergence tail, all three
+  workers' digest vectors agree and the observable top-k matches the
+  sequential single-process reference bit-for-bit.
+
+Writes the measurements to PART_r01.json (committed as the carrier for
+regression comparison) and exits nonzero if any gate fails.
+
+Run:  make partition-demo
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts.cover import install_child_cover  # noqa: E402
+
+install_child_cover()  # no-op outside `make cover` runs
+
+# Drill geometry. I is deliberately larger than elastic_demo's so one
+# partition holds a meaningful slice (~I/P ids) and the byte comparison
+# is not dominated by fixed per-blob overheads.
+R, NK, I, DCS, K, M, B, Br = 4, 1, 256, 4, 8, 2, 32, 8
+STEPS = 12
+# Steps in [OUTAGE_LO, OUTAGE_HI): w2 neither publishes nor sweeps, and
+# every replica's ops touch only ids from partition p* — the window
+# that manufactures the single divergent partition.
+OUTAGE_LO, OUTAGE_HI = 4, 9
+
+MIN_RATIO = 5.0  # the acceptance gate from ISSUE/ROADMAP
+
+
+def _build():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import make_dense
+
+    return make_dense(n_ids=I, n_dcs=DCS, size=K, slots_per_id=M)
+
+
+def gen_ops(step: int, owned, pool):
+    """Deterministic [R, ...] batch like elastic_demo's drill, except
+    add/rmv ids are drawn from `pool` (all ids normally, the single
+    partition p*'s ids inside the outage window)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import TopkRmvOps
+
+    owned = set(owned)
+    pool = np.asarray(pool, np.int32)
+    a_key = np.zeros((R, B), np.int32)
+    a_id = np.zeros((R, B), np.int32)
+    a_score = np.zeros((R, B), np.int32)
+    a_dc = np.zeros((R, B), np.int32)
+    a_ts = np.zeros((R, B), np.int32)
+    r_key = np.zeros((R, Br), np.int32)
+    r_id = np.full((R, Br), -1, np.int32)
+    r_vc = np.zeros((R, Br, DCS), np.int32)
+    for r in range(R):
+        rng = np.random.default_rng(77_000 * (step + 1) + r)
+        ids = pool[rng.integers(0, len(pool), B)]
+        scores = rng.integers(1, 500, B)
+        if r in owned:
+            a_id[r], a_score[r] = ids, scores
+            a_dc[r] = r % DCS
+            a_ts[r] = step * B + np.arange(B) + 1
+            r_id[r] = pool[rng.integers(0, len(pool), Br)]
+            r_vc[r, :, r % DCS] = rng.integers(1, max(2, step * B + 1), Br)
+    return TopkRmvOps(
+        add_key=jnp.asarray(a_key), add_id=jnp.asarray(a_id),
+        add_score=jnp.asarray(a_score), add_dc=jnp.asarray(a_dc),
+        add_ts=jnp.asarray(a_ts),
+        rmv_key=jnp.asarray(r_key), rmv_id=jnp.asarray(r_id),
+        rmv_vc=jnp.asarray(r_vc),
+    )
+
+
+def step_pool(step: int, ids_p):
+    import numpy as np
+
+    if OUTAGE_LO <= step < OUTAGE_HI:
+        return ids_p
+    return np.arange(I, dtype=np.int32)
+
+
+def apply_step(dense, state, step: int, owned, ids_p):
+    state, _ = dense.apply_ops(
+        state, gen_ops(step, owned, step_pool(step, ids_p)),
+        collect_dominated=False,
+    )
+    return state
+
+
+def observable(dense, state):
+    from antidote_ccrdt_tpu.harness.dense_replay import fold_rows
+
+    obs = dense.value(fold_rows(dense, state, range(R)))[0][0]
+    return sorted((int(i), int(s)) for (i, s) in obs)
+
+
+def sequential_reference(dense, ids_p):
+    state = dense.init(R, NK)
+    for step in range(STEPS):
+        state = apply_step(dense, state, step, range(R), ids_p)
+    return observable(dense, state)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--partitions", type=int, default=8)
+    ap.add_argument(
+        "--out",
+        default=os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "PART_r01.json",
+        ),
+    )
+    args = ap.parse_args()
+    P = args.partitions
+
+    import numpy as np
+
+    from antidote_ccrdt_tpu.core import partition as pt
+    from antidote_ccrdt_tpu.net.tcp import TcpTransport
+    from antidote_ccrdt_tpu.net.transport import GossipNode
+    from antidote_ccrdt_tpu.parallel.elastic import (
+        DeltaPublisher, PartialAntiEntropy, sweep_deltas,
+    )
+
+    dense = _build()
+
+    # Pick p* = the best-populated partition; its id roster is the op
+    # pool inside the outage window.
+    part_map = pt.part_of(np.arange(I), P)
+    p_star = int(np.bincount(part_map, minlength=P).argmax())
+    ids_p = np.arange(I, dtype=np.int32)[part_map == p_star]
+    meta = pt.meta_part(P)
+
+    members = ["w0", "w1", "w2"]
+    owned = {"w0": [0, 3], "w1": [1], "w2": [2]}
+    transports = {m: TcpTransport(m) for m in members}
+    try:
+        for m in members:
+            for n in members:
+                if n != m:
+                    transports[m].add_peer(n, transports[n].address)
+        stores = {m: GossipNode(transports[m]) for m in members}
+        pubs = {
+            m: DeltaPublisher(
+                stores[m], dense, name="topk_rmv",
+                full_every=2, keep=2, partitions=P,
+            )
+            for m in members
+        }
+        partials = {
+            m: PartialAntiEntropy(stores[m], partitions=P, max_tries=12)
+            for m in members
+        }
+        states = {m: dense.init(R, NK) for m in members}
+        cursors = {m: {} for m in members}
+
+        # Start barrier: TCP membership is heard-from evidence.
+        deadline = time.time() + 10.0
+        while any(len(stores[m].members()) < len(members) for m in members):
+            for m in members:
+                stores[m].heartbeat()
+            if time.time() > deadline:
+                print("FAIL: start barrier timed out", file=sys.stderr)
+                return 1
+            time.sleep(0.05)
+
+        def round_of(step, fleet):
+            for m in fleet:
+                stores[m].heartbeat()
+                pubs[m].publish(states[m])
+            time.sleep(0.06)
+            for m in fleet:
+                states[m], _ = sweep_deltas(
+                    stores[m], dense, states[m], cursors[m],
+                    partial=partials[m],
+                )
+
+        # Phase 1: steps up to the end of the outage. w2 applies its own
+        # ops every step (it is slow, not dead) but stops gossiping.
+        for step in range(OUTAGE_HI):
+            for m in members:
+                states[m] = apply_step(dense, states[m], step, owned[m], ids_p)
+            fleet = members if step < OUTAGE_LO else ["w0", "w1"]
+            round_of(step, fleet)
+
+        # Phase 2: the resync moment. w2's delta chains were pruned
+        # (keep=2), so both repair paths start from the same gap. Run the
+        # whole-instance repair on a clone for the byte/digest baseline,
+        # then the partial repair on the live state.
+        pre_state = states["w2"]
+        peers = ["w0", "w1"]
+
+        whole_bytes = 0
+        whole_state = pre_state
+        for m in peers:
+            raw = transports["w2"].fetch(m)
+            if raw is None:
+                print(f"FAIL: no snapshot from {m} at resync", file=sys.stderr)
+                return 1
+            whole_bytes += len(raw)
+            got = stores["w2"].fetch(m, pre_state, dense=dense)
+            if got is None:
+                print(f"FAIL: snapshot from {m} undecodable", file=sys.stderr)
+                return 1
+            whole_state = dense.merge(whole_state, got[1])
+
+        c0 = dict(stores["w2"].metrics.counters)
+        dig_bytes = 0
+        div_seen = set()
+        part_state = pre_state
+        for m in peers:
+            raw = transports["w2"].fetch_digest(m)
+            if raw is not None:
+                dig_bytes += len(raw)
+            got = stores["w2"].fetch_digests(m)
+            if got is not None:
+                div_seen.update(
+                    int(p) for p in pt.divergent_parts(
+                        pt.state_digests(part_state, P), got[1]
+                    )
+                )
+            cur = cursors["w2"].get(m, -1)
+            for _ in range(40):
+                part_state, cur2, handled = partials["w2"].try_resync(
+                    m, dense, part_state, cur
+                )
+                if not handled:
+                    print(
+                        f"FAIL: partial resync fell back to full snap ({m})",
+                        file=sys.stderr,
+                    )
+                    return 1
+                if cur2 > cur:
+                    cur = cur2
+                    break
+                time.sleep(0.05)  # psnap replies in flight
+            else:
+                print(f"FAIL: partial resync stalled ({m})", file=sys.stderr)
+                return 1
+            cursors["w2"][m] = cur
+        c1 = dict(stores["w2"].metrics.counters)
+        psnap_bytes = int(c1.get("net.psnap_bytes", 0) - c0.get("net.psnap_bytes", 0))
+        partial_bytes = psnap_bytes + dig_bytes
+        resyncs = int(
+            c1.get("net.partition_resyncs", 0) - c0.get("net.partition_resyncs", 0)
+        )
+        wasted = int(c1.get("net.psnap_wasted", 0))
+
+        vec_whole = pt.state_digests(whole_state, P)
+        vec_part = pt.state_digests(part_state, P)
+        repair_identical = bool(np.array_equal(vec_whole, vec_part))
+        states["w2"] = part_state
+
+        # Phase 3: remaining steps with everyone gossiping, then a
+        # convergence tail until the digest vectors agree fleet-wide.
+        for step in range(OUTAGE_HI, STEPS):
+            for m in members:
+                states[m] = apply_step(dense, states[m], step, owned[m], ids_p)
+            round_of(step, members)
+        agree = False
+        for _ in range(80):
+            vecs = [pt.state_digests(states[m], P) for m in members]
+            if all(np.array_equal(vecs[0], v) for v in vecs[1:]):
+                agree = True
+                break
+            round_of(STEPS, members)
+
+        ref = sequential_reference(dense, ids_p)
+        finals = {m: observable(dense, states[m]) for m in members}
+        ref_match = all(finals[m] == ref for m in members)
+        ratio = whole_bytes / max(1, partial_bytes)
+
+        checks = {
+            "partial_ge_5x_smaller": ratio >= MIN_RATIO,
+            "repair_digests_bit_identical": repair_identical,
+            "fleet_digest_vectors_agree": agree,
+            "matches_sequential_reference": ref_match,
+            "divergence_confined_to_pstar_meta": div_seen <= {p_star, meta}
+            and p_star in div_seen,
+            "partition_resyncs_counted": resyncs >= 1,
+            "no_wasted_psnaps": wasted == 0,
+        }
+        report = {
+            "drill": "partition_demo",
+            "geometry": {
+                "R": R, "NK": NK, "I": I, "DCS": DCS, "K": K, "M": M,
+                "B": B, "Br": Br, "steps": STEPS,
+            },
+            "partitions": P,
+            "p_star": p_star,
+            "p_star_ids": int(len(ids_p)),
+            "outage_steps": [OUTAGE_LO, OUTAGE_HI],
+            "divergent_parts": sorted(div_seen),
+            "whole_resync_bytes": whole_bytes,
+            "partial_resync_bytes": {
+                "psnaps": psnap_bytes, "digests": dig_bytes,
+                "total": partial_bytes,
+            },
+            "bytes_ratio": round(ratio, 3),
+            "min_ratio": MIN_RATIO,
+            "counters_w2": {
+                k: int(v)
+                for k, v in sorted(stores["w2"].metrics.counters.items())
+                if k.startswith(("net.psnap", "net.partition", "net.dig"))
+            },
+            "checks": checks,
+            "pass": all(checks.values()),
+        }
+        with open(args.out, "w") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(json.dumps(report, indent=2, sort_keys=True))
+        if not report["pass"]:
+            failed = [k for k, ok in checks.items() if not ok]
+            print(f"FAIL: {', '.join(failed)}", file=sys.stderr)
+            return 1
+        print(
+            f"PASS: partial anti-entropy moved {partial_bytes} bytes vs "
+            f"{whole_bytes} whole-instance ({ratio:.1f}x reduction), "
+            f"digests bit-identical"
+        )
+        return 0
+    finally:
+        for t in transports.values():
+            t.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

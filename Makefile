@@ -23,7 +23,7 @@ PY ?= python
 # meaningful.
 COVER_THRESHOLD ?= 88
 
-.PHONY: all compile test cover typecheck xref native bench benchall dryrun net-demo chaos crash-demo obs-demo topo-demo spans-demo overlap-demo partition-demo bench-gate clean
+.PHONY: all compile test cover typecheck xref native bench benchall dryrun net-demo chaos crash-demo obs-demo topo-demo spans-demo overlap-demo partition-demo serve-demo bench-gate clean
 
 all: compile xref typecheck cover
 
@@ -77,8 +77,10 @@ net-demo:
 # faults, delta gossip, SWIM deaths, cross-zone frames, anchor
 # relays/failover) must be nonzero — a refactor that silently stops
 # counting fails here even if convergence stays green; chaos_gate's
-# third leg does the same for the span plane (all round phases lit,
-# attribution reconciling against round.e2e). The third make leg adds
+# serve leg reruns the skewed-clock serving drill (zero served results
+# older than their advertised staleness bound, zero identity
+# mismatches); its span leg does the same for the span plane (all
+# round phases lit, attribution reconciling against round.e2e). The third make leg adds
 # the scrape-under-fault matrix (tcp.send / bridge.read must degrade a
 # live scrape, never hang) and the trace-CLI unit surface; the fourth
 # is the bench regression gate over the committed BENCH_r*.json rounds;
@@ -140,6 +142,16 @@ overlap-demo:
 # reference. Writes PART_r01.json.
 partition-demo:
 	env JAX_PLATFORMS=cpu $(PY) scripts/partition_demo.py
+
+# Serving-plane gate (real sockets, in-process): a 3-worker TCP fleet
+# serves batched in-band {query} frames while writes flow and seeded
+# faults drop sends / stall serves — gated on >=50k reads/sec (CPU),
+# measured read p99, ZERO responses older than their advertised
+# staleness bound, every served value bit-identical to the engine's
+# value() at the claimed as_of_seq, and write-fleet convergence to the
+# sequential reference. Writes SERVE_r01.json.
+serve-demo:
+	env JAX_PLATFORMS=cpu $(PY) scripts/serve_demo.py
 
 # Span-tracing demo (slow, real processes): a 3-worker TCP fleet with
 # the round-phase span plane armed (CCRDT_SPANS=1) — every worker's

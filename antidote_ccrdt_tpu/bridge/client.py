@@ -166,6 +166,12 @@ class BridgeClient:
         out = self.call((Atom("metrics"),))
         return bytes(out).decode("utf-8")
 
+    def query(self, payload: bytes) -> bytes:
+        """Serve-plane read over the data-plane connection: {query,
+        Payload} -> canonical response bytes, byte-identical to the tcp
+        query frame and POST /query for the same request."""
+        return bytes(self.call((Atom("query"), bytes(payload))))
+
     def compact(self, handle: Any, effect_terms: List[Any]) -> List[Any]:
         return self.call((Atom("compact"), handle, effect_terms))
 

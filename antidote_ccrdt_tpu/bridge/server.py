@@ -1235,6 +1235,10 @@ class BridgeServer:
         self._replies: "OrderedDict[Tuple[bytes, Any], Any]" = OrderedDict()
         self._replies_cap = reply_cache_size
         self._replies_lock = threading.Lock()
+        # Serve plane: {query, Payload} ops route here when installed —
+        # the bridge is the third query surface (tcp frame, HTTP POST,
+        # and this), all carrying the same canonical bytes.
+        self.query_handler = None
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -1277,6 +1281,11 @@ class BridgeServer:
 
     def __exit__(self, *exc):
         self.close()
+
+    def install_serve(self, plane) -> None:
+        """Attach a serve plane (or any bytes->bytes handler); the
+        {query} op starts answering. Mirrors TcpTransport.install_serve."""
+        self.query_handler = getattr(plane, "handle", plane)
 
     # -- dispatch ----------------------------------------------------------
 
@@ -1587,6 +1596,16 @@ class BridgeServer:
 
             self.metrics.count("bridge.scrapes")
             return obs_export.prometheus_text(self.metrics).encode("utf-8")
+        if tag == "query":
+            # {query, Payload} -> serve-plane response bytes, verbatim.
+            # Same canonical request/response codec as the tcp frame and
+            # POST /query, so host-language clients get byte-identical
+            # answers on every surface.
+            handler = self.query_handler
+            if handler is None:
+                raise ValueError("no serve plane installed")
+            self.metrics.count("bridge.queries")
+            return bytes(handler(bytes(op[1])))
         raise ValueError(f"unknown op: {tag}")
 
 

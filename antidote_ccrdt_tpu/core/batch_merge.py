@@ -104,6 +104,34 @@ def merge_slots(merge):
     return hit[1]
 
 
+# One jitted whole-tree copy, shared by every state shape (jit re-traces
+# per treedef/shape, so a single cache slot covers all engines).
+_COPY_SLOT: List[Any] = []
+
+
+def snapshot_state(state):
+    """One-dispatch device copy of a state pytree: the serve plane's
+    read-replica buffer (PR 9). The copy — not a reference — is what
+    makes a held snapshot immune to the donated jit slots above: a
+    buffer the replica owns can never be aliased away by a later
+    donate_rhs/donate_both merge of the live state. Same slot
+    discipline as `merge_slots`: jitted once, cached for the process."""
+    import jax
+    import jax.numpy as jnp
+
+    if not _COPY_SLOT:
+        _COPY_SLOT.append(jax.jit(lambda s: jax.tree.map(jnp.copy, s)))
+    tok = (
+        obs_spans.begin("round.device_dispatch", site="batch_merge.snapshot")
+        if obs_spans.ACTIVE
+        else None
+    )
+    try:
+        return _COPY_SLOT[0](state)
+    finally:
+        obs_spans.end(tok)
+
+
 def merge_into(merge, state, incoming, donate_incoming: bool = True):
     """One window's merge through the donated slot: `state ⊔ incoming`,
     with `incoming`'s buffers donated to the result. The caller must own

@@ -188,6 +188,12 @@ class SimTransport:
         # error by the configured RTT asymmetry.
         self.clock_skew = 0.0
         self.clock = obs_spans.ClockSync()
+        # Serve plane: in-band "query" messages are answered by this
+        # handler when installed; replies land in the querier's
+        # `query_resps` list at delivery time (the sim's synchronous
+        # analog of tcp's reply-on-inbound-connection).
+        self.query_handler = None
+        self.query_resps: List[Tuple[str, bytes]] = []
 
     def local_clock(self) -> float:
         """This member's view of time: virtual clock + its skew."""
@@ -200,6 +206,19 @@ class SimTransport:
         self._send(
             peer, ("clock_req", self.member, self.local_clock()), False, 0
         )
+
+    def install_serve(self, plane) -> None:
+        """Attach a serve plane (or any bytes->bytes handler), exactly
+        as `TcpTransport.install_serve` — sim drills exercise the same
+        query path chaos-deterministically."""
+        self.query_handler = getattr(plane, "handle", plane)
+
+    def query(self, peer: str, payload: bytes) -> None:
+        """Send one serve-plane read to `peer`; the response arrives in
+        `self.query_resps` as (peer, bytes) once the net delivers it."""
+        self._check_live()
+        self._send(peer, ("query", self.member, bytes(payload)), False,
+                   len(payload))
 
     def install_router(self, timeout_s: float = 2.0) -> ZoneRouter:
         """Switch from full-mesh to the zone-aware topology, exactly as
@@ -463,6 +482,24 @@ class SimTransport:
         elif kind == "psnap":
             _k, _s, part, blob = msg[:4]
             self._store_psnap(src, int(part), blob)
+        elif kind == "query":
+            payload = msg[2]
+            handler = self.query_handler
+            self.metrics.count("net.queries")
+            if handler is not None:
+                try:
+                    resp = bytes(handler(bytes(payload)))
+                except Exception as e:  # noqa: BLE001 — degrade, never wedge
+                    import json as _json
+
+                    resp = _json.dumps({"error": str(e)}).encode("utf-8")
+            else:
+                import json as _json
+
+                resp = _json.dumps({"error": "no serve plane"}).encode("utf-8")
+            self._send(src, ("query_resp", self.member, resp), False, len(resp))
+        elif kind == "query_resp":
+            self.query_resps.append((src, bytes(msg[2])))
         elif kind == "psnap_req":
             parts = msg[2]
             self.metrics.count("net.psnap_reqs_recv")

@@ -75,7 +75,7 @@ import struct
 import threading
 import time
 from collections import deque
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..bridge.protocol import pack_frame, unpack_frames
 from ..core import etf
@@ -109,6 +109,8 @@ A_DIG = Atom("dig")
 A_RDIG = Atom("rdig")
 A_PSNAP = Atom("psnap")
 A_PSNAP_REQ = Atom("psnap_req")
+A_QUERY = Atom("query")
+A_QUERY_RESP = Atom("query_resp")
 
 _SNAP, _DELTA, _PING, _DIG, _PSNAP = "snap", "delta", "ping", "dig", "psnap"
 
@@ -145,6 +147,30 @@ def scrape_metrics(addr: Tuple[str, int], timeout: float = 2.0) -> Tuple[str, st
                             time.monotonic(),
                         )
                     return member, term[2].decode("utf-8")
+
+
+def query_peer(
+    addr: Tuple[str, int], payload: bytes, timeout: float = 2.0
+) -> Tuple[str, bytes]:
+    """One-shot serve-plane read against a live `TcpTransport`: connect
+    to its gossip listener, send `{query, Payload}`, return (member,
+    response bytes — the serve plane's canonical JSON, verbatim).
+    Bounded by `timeout` end-to-end like `scrape_metrics`: a wedged or
+    fault-injected worker yields `socket.timeout`/`ConnectionError`,
+    never a hang. The querier never joins the gossip membership."""
+    deadline = time.monotonic() + timeout
+    with socket.create_connection(addr, timeout=timeout) as s:
+        s.sendall(pack_frame((A_QUERY, bytes(payload))))
+        buf = bytearray()
+        while True:
+            s.settimeout(max(0.01, deadline - time.monotonic()))
+            data = s.recv(1 << 16)
+            if not data:
+                raise ConnectionError("query connection closed before reply")
+            buf.extend(data)
+            for term in unpack_frames(buf):
+                if term[0] == A_QUERY_RESP:
+                    return term[1].decode("utf-8"), bytes(term[2])
 
 
 def probe_clock(
@@ -427,6 +453,10 @@ class TcpTransport:
         # divergent partitions ({psnap_req} -> {psnap}).
         self._digs: Dict[str, bytes] = {}
         self._psnaps: Dict[str, Dict[int, bytes]] = {}
+        # Serve plane: `{query, Payload}` frames are answered by this
+        # handler (bytes -> bytes) when a plane is installed; None means
+        # this worker does not serve reads (error reply, never a hang).
+        self.query_handler: Optional[Callable[[bytes], bytes]] = None
         self._closed = False
 
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -458,6 +488,12 @@ class TcpTransport:
                 name, tuple(addr), self._rng, self.metrics,
                 *self._link_params, negotiate=self._hello_exchange,
             )
+
+    def install_serve(self, plane: Any) -> None:
+        """Attach a serve plane (or any bytes->bytes handler): inbound
+        `{query, Payload}` frames are answered with `{query_resp,
+        Member, ResponseBytes}` on the same connection."""
+        self.query_handler = getattr(plane, "handle", plane)
 
     def learn_zone(self, name: str, zone: str) -> None:
         """Feed static zone config (address files, CLI) into the map —
@@ -786,6 +822,12 @@ class TcpTransport:
                 t1 = term[1] if len(term) > 1 else None
                 self._send_metrics_resp(conn, t1=t1)
             return
+        if tag == A_QUERY:
+            # Serve-plane read: same reply-on-inbound-connection contract
+            # as the scrape — the querier never joins the membership.
+            if conn is not None and len(term) > 1:
+                self._send_query_resp(conn, bytes(term[1]))
+            return
         if tag == A_HELLO:
             # Link setup from a topo-aware peer: learn its zone, answer
             # with ours and the best codec we can decode of its offer.
@@ -1020,6 +1062,43 @@ class TcpTransport:
             if faults.ACTIVE and faults.fire("tcp.send") == "drop":
                 self.metrics.count("net.fault_drops")
                 raise OSError("injected scrape-reply drop")
+            conn.sendall(frame)
+        except OSError:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _send_query_resp(self, conn: socket.socket, payload: bytes) -> None:
+        """Answer one `{query, Payload}` via the installed serve plane.
+        Degrade-never-hang, exactly like `_send_metrics_resp`: a handler
+        failure (including an injected `serve.query` fault) or the
+        `tcp.send` fault point closes the connection, so the querier
+        sees EOF/error within its own timeout."""
+        self.metrics.count("net.queries")
+        try:
+            handler = self.query_handler
+            if handler is None:
+                from ..serve import plane as serve_plane
+
+                resp = serve_plane.encode(
+                    {"member": self.member, "error": "no serve plane"}
+                )
+            else:
+                resp = bytes(handler(payload))
+        except Exception:  # noqa: BLE001 — degrade: close, querier times out
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        frame = pack_frame(
+            (A_QUERY_RESP, self.member.encode("utf-8"), resp)
+        )
+        try:
+            if faults.ACTIVE and faults.fire("tcp.send") == "drop":
+                self.metrics.count("net.fault_drops")
+                raise OSError("injected query-reply drop")
             conn.sendall(frame)
         except OSError:
             try:

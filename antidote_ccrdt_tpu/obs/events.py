@@ -49,6 +49,8 @@ Wired event kinds:
     wal.append / wal.rotate / wal.checkpoint / wal.recover / wal.torn
     fault.hit                          (utils.faults firings)
     bridge.request / bridge.reconnect
+    serve.query / serve.swap            (read-serving plane: batched
+                                        reads answered, replica swaps)
     sim.drop / sim.crash / sim.partition / sim.heal
     proc.start / proc.exit
 

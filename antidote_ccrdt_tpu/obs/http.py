@@ -11,7 +11,15 @@ framework) serving:
   can tell the series apart. Content-Type is the Prometheus text
   exposition type.
 * ``GET /healthz``  — `{"ok": true, "member": ..., "uptime_s": ...}`,
-  the liveness probe a supervisor or k8s deployment points at.
+  the liveness probe a supervisor or k8s deployment points at. With a
+  ``health_extra`` callable installed, the doc gains serving-readiness
+  fields (max peer staleness, applied watermark, overlap queue depth,
+  serve-plane snapshot seq) so a load balancer can drain a worker whose
+  replica lags instead of routing stale reads to it.
+* ``POST /query``   — the serve plane's HTTP surface: the request body
+  is the canonical query payload, the response the canonical answer
+  bytes (byte-identical to the tcp ``{query}`` frame and the bridge op
+  for the same request). 404 until a handler is installed.
 
 Failure behavior mirrors the transports' "degrade, never hang" rule: a
 snapshot/render failure returns a 500 with the error text — the scrape
@@ -61,11 +69,15 @@ class MetricsHttpServer:
         host: str = "127.0.0.1",
         port: int = 0,
         labels: Optional[Dict[str, str]] = None,
+        query_handler: Optional[Callable[[bytes], bytes]] = None,
+        health_extra: Optional[Callable[[], Dict[str, Any]]] = None,
     ):
         self.member = member
         self._source = source
         self._labels = dict(labels) if labels else {"member": member}
         self._t0 = time.time()
+        self.query_handler = query_handler
+        self.health_extra = health_extra
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -78,6 +90,12 @@ class MetricsHttpServer:
                     outer._serve_metrics(self)
                 elif self.path.split("?", 1)[0] == "/healthz":
                     outer._serve_health(self)
+                else:
+                    self._reply(404, b"not found\n", "text/plain")
+
+            def do_POST(self):  # noqa: N802 - http.server API
+                if self.path.split("?", 1)[0] == "/query":
+                    outer._serve_query(self)
                 else:
                     self._reply(404, b"not found\n", "text/plain")
 
@@ -129,9 +147,34 @@ class MetricsHttpServer:
             "pid": os.getpid(),
             "uptime_s": round(time.time() - self._t0, 3),
         }
+        extra = self.health_extra
+        if extra is not None:
+            try:
+                doc.update(extra())
+            except Exception as e:  # noqa: BLE001 — a broken readiness
+                # probe must not take liveness down with it; flag it.
+                doc["health_extra_error"] = str(e)
         handler._reply(
             200, (json.dumps(doc) + "\n").encode("utf-8"), "application/json"
         )
+
+    def _serve_query(self, handler) -> None:
+        fn = self.query_handler
+        if fn is None:
+            handler._reply(404, b"no serve plane\n", "text/plain")
+            return
+        try:
+            n = int(handler.headers.get("Content-Length", "0"))
+            body = handler.rfile.read(n) if n > 0 else b""
+            resp = bytes(fn(body))
+        except Exception as e:  # noqa: BLE001 — degrade to an error
+            # response; the plane's registry/caches are lock-protected
+            # and the next query starts clean.
+            handler._reply(
+                500, f"query failed: {e}\n".encode("utf-8"), "text/plain"
+            )
+            return
+        handler._reply(200, resp, "application/json")
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -187,6 +230,8 @@ def install_from_env(
     member: str,
     env: Optional[Dict[str, str]] = None,
     addr_dir: Optional[str] = None,
+    query_handler: Optional[Callable[[bytes], bytes]] = None,
+    health_extra: Optional[Callable[[], Dict[str, Any]]] = None,
 ) -> Optional[MetricsHttpServer]:
     """Start a metrics endpoint iff ``CCRDT_HTTP_PORT`` is set (port 0 =
     kernel-assigned). Returns the running server, or None when the env
@@ -200,7 +245,13 @@ def install_from_env(
         port = int(raw)
     except ValueError:
         return None
-    srv = MetricsHttpServer(source, member, port=port).start()
+    srv = MetricsHttpServer(
+        source,
+        member,
+        port=port,
+        query_handler=query_handler,
+        health_extra=health_extra,
+    ).start()
     if addr_dir:
         write_addr_file(addr_dir, member, srv.address)
     return srv

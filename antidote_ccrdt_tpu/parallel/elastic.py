@@ -123,6 +123,11 @@ class DeltaPublisher:
         self.seq = -1
         self._prev: Any = None
         self._serial = serial
+        # Serve-plane hook: called as on_publish(state, seq) after every
+        # publish, the natural swap point for a read replica — the state
+        # just shipped is exactly what peers will converge toward, so
+        # serving it keeps reads within one round of the write frontier.
+        self.on_publish: Optional[Callable[[Any, int], None]] = None
 
     def publish(self, state: Any) -> Dict[str, Any]:
         from .delta import make_delta
@@ -180,6 +185,12 @@ class DeltaPublisher:
             self.store.publish_delta(blob, self.seq, keep=self.keep)
             kind, nbytes = "delta", len(blob)
         self._prev = state
+        if self.on_publish is not None:
+            try:
+                self.on_publish(state, self.seq)
+            except Exception:
+                # The read plane must never stall the write plane.
+                self.store.metrics.count("serve.swap_errors")
         return {"kind": kind, "seq": self.seq, "nbytes": nbytes}
 
 

@@ -1,0 +1,70 @@
+"""serve/: the read-serving plane (PR 9).
+
+Reads dwarf writes at the ROADMAP's "millions of users" scale, and until
+now the only read path was calling `value()` in-process. This package
+serves bounded-staleness reads off the elastic worker's replicated
+state:
+
+* `replica`  — device-resident double-buffered snapshots, swapped at
+               publish boundaries so queries never race the donated
+               merge slots;
+* `kernels`  — per-type batched query answering: one fold+observe
+               dispatch materializes every key, queries are gathers;
+* `cache`    — hot-key answers that outlive swaps, bounded by LRU and
+               the staleness-pedigree horizon;
+* `plane`    — the `ServePlane` facade all three wire surfaces call
+               (`net/tcp.py` `{query}` frame, bridge `{query}` op,
+               `POST /query` on `obs/http.py`).
+
+Workers opt in via ``CCRDT_SERVE=1`` (`install_from_env`, the same
+env-propagation pattern as `utils.faults` / `obs.http`).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+from .cache import HotKeyCache
+from .kernels import SnapshotView, answer, answer_one, materialize, query_key
+from .plane import Overloaded, ServePlane, encode, request_bytes
+from .replica import ReadReplica, Snapshot
+
+ENV_FLAG = "CCRDT_SERVE"
+
+_FALSE = {"", "0", "false", "no", "off"}
+
+__all__ = [
+    "ENV_FLAG",
+    "HotKeyCache",
+    "Overloaded",
+    "ReadReplica",
+    "ServePlane",
+    "Snapshot",
+    "SnapshotView",
+    "answer",
+    "answer_one",
+    "encode",
+    "install_from_env",
+    "materialize",
+    "query_key",
+    "request_bytes",
+]
+
+
+def install_from_env(
+    dense: Any,
+    member: str,
+    metrics: Any = None,
+    lag_tracker: Any = None,
+    env: Optional[dict] = None,
+) -> Optional[ServePlane]:
+    """Build a `ServePlane` iff ``CCRDT_SERVE`` is truthy — workers call
+    this unconditionally, like `faults.install_from_env`. Returns None
+    when serving is off (the default: pure write fleets pay nothing)."""
+    raw = (env if env is not None else os.environ).get(ENV_FLAG, "")
+    if raw.strip().lower() in _FALSE:
+        return None
+    return ServePlane(
+        dense, member=member, metrics=metrics, lag_tracker=lag_tracker
+    )

@@ -1,0 +1,345 @@
+"""`ServePlane`: the query front-end every wire surface shares.
+
+One plane per worker ties the pieces together:
+
+* `ReadReplica` — double-buffered device snapshots, swapped at publish
+  boundaries by the worker's round thread (`swap`);
+* a **bounded batching queue** — concurrent listener threads enqueue
+  their decoded queries and one drainer answers the whole accumulated
+  batch against a single snapshot materialization (the "one dispatch,
+  thousands of queries" shape). Overflow sheds loudly
+  (`serve.queue_shed` + an ``overloaded`` error response) instead of
+  queueing unboundedly;
+* `HotKeyCache` — answers outlive swaps; the `max_staleness_s` request
+  knob decides whether an aged entry still qualifies, falls through to
+  the fresh replica, or rejects (`serve.stale_rejects`);
+* the **staleness contract** — every served value carries
+  ``(value, as_of_seq, staleness_bound_s)`` with
+  ``bound = (now - swap_mono) + lag_bound_at_swap``, all differences of
+  this worker's monotonic clock (skew-immune; rounded UP to the µs so
+  formatting can never shave the bound below truth).
+
+Wire surfaces call ONE method — ``handle(request_bytes) ->
+response_bytes`` — and transport the bytes verbatim, which is what
+makes the tri-surface parity test (`tests/test_serve_parity.py`)
+byte-exact: the codec is canonical JSON (sorted keys, compact
+separators), so identical questions at identical snapshots produce
+identical bytes on the TCP frame, the bridge op, and POST /query.
+
+Request:  ``{"queries": [{"op": "value"|"topk"|"range", "key": int,
+            "k"?: int, "lo"?: int, "hi"?: int}, ...],
+            "max_staleness_s"?: float}``
+Response: ``{"member": str, "n": int, "results": [
+            {"value": ..., "as_of_seq": int, "staleness_bound_s": float}
+            | {"error": ...}, ...]}``
+
+`utils.faults` point ``serve.query`` fires at the top of `handle` on
+every surface, so injected stalls/raises exercise each listener's own
+degrade path (connection close / error frame / HTTP 500 — never a
+hang).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..obs import events as obs_events
+from ..utils import faults
+from ..utils.metrics import Metrics
+from . import kernels
+from .cache import HotKeyCache
+from .replica import ReadReplica
+
+
+class Overloaded(RuntimeError):
+    """The bounded query queue is full; the caller is shed."""
+
+
+def encode(doc: Dict[str, Any]) -> bytes:
+    """Canonical response/request bytes: sorted keys, compact
+    separators — the tri-surface byte-identity anchor."""
+    return (json.dumps(doc, sort_keys=True, separators=(",", ":")) + "\n").encode(
+        "utf-8"
+    )
+
+
+def request_bytes(
+    queries: List[Dict[str, Any]], max_staleness_s: Optional[float] = None
+) -> bytes:
+    doc: Dict[str, Any] = {"queries": list(queries)}
+    if max_staleness_s is not None:
+        doc["max_staleness_s"] = float(max_staleness_s)
+    return encode(doc)
+
+
+def _ceil6(x: float) -> float:
+    """Round a staleness bound UP at µs precision — conservative by
+    construction (a bound may only ever grow in transit)."""
+    return math.ceil(max(0.0, x) * 1e6) / 1e6
+
+
+class _Pending:
+    __slots__ = ("queries", "max_staleness", "done", "results", "error")
+
+    def __init__(self, queries: List[Dict[str, Any]], max_staleness: Optional[float]):
+        self.queries = queries
+        self.max_staleness = max_staleness
+        self.done = False
+        self.results: Optional[List[Any]] = None
+        self.error: Optional[BaseException] = None
+
+
+class _Batcher:
+    """Bounded accumulate-and-drain queue. Any caller thread may become
+    the drainer: the first arriver while no drain is running takes the
+    whole pending list and answers it in one pass; threads that enqueued
+    meanwhile wait on the condition and either find their result ready
+    or become the next drainer. No dedicated thread, no idle latency —
+    a lone request drains itself immediately, a burst coalesces."""
+
+    def __init__(self, exec_batch: Callable[[List[_Pending]], None],
+                 queue_max: int, metrics: Metrics):
+        self._exec = exec_batch
+        self.queue_max = max(1, int(queue_max))
+        self.metrics = metrics
+        self._cv = threading.Condition()
+        self._pending: List[_Pending] = []
+        self._busy = False
+
+    def run(self, queries: List[Dict[str, Any]],
+            max_staleness: Optional[float]) -> List[Any]:
+        p = _Pending(queries, max_staleness)
+        with self._cv:
+            depth = sum(len(x.queries) for x in self._pending)
+            if depth + len(queries) > self.queue_max:
+                self.metrics.count("serve.queue_shed")
+                raise Overloaded(
+                    f"query queue full ({depth}+{len(queries)} > {self.queue_max})"
+                )
+            self._pending.append(p)
+            while not p.done and self._busy:
+                self._cv.wait(0.05)
+            if not p.done:
+                self._busy = True
+                batch, self._pending = self._pending, []
+        if not p.done:
+            try:
+                self._exec(batch)
+            finally:
+                # A drainer that died mid-batch must not strand followers.
+                for x in batch:
+                    if not x.done:
+                        x.error = x.error or RuntimeError("batch aborted")
+                        x.done = True
+                with self._cv:
+                    self._busy = False
+                    self._cv.notify_all()
+        if p.error is not None:
+            raise p.error
+        return p.results or []
+
+
+class ServePlane:
+    """One worker's read-serving plane (see module docstring)."""
+
+    def __init__(
+        self,
+        dense: Any,
+        member: str = "?",
+        metrics: Optional[Metrics] = None,
+        lag_tracker: Any = None,
+        mono: Callable[[], float] = time.monotonic,
+        cache_cap: int = 1024,
+        queue_max: int = 4096,
+        meta_keep: int = 8,
+    ):
+        self.dense = dense
+        self.member = member
+        self.metrics = metrics if metrics is not None else Metrics()
+        self.lag_tracker = lag_tracker
+        self.mono = mono  # injectable: frozen in parity tests, virtual in sim
+        self.replica = ReadReplica(metrics=self.metrics, mono=mono)
+        self.cache = HotKeyCache(cap=cache_cap, metrics=self.metrics)
+        self.meta_keep = max(1, int(meta_keep))
+        # seq -> (swap_mono, lag_bound_s): the staleness pedigree window
+        # cached answers are bounded against. Guarded: swap() runs on the
+        # round thread, _bound() on whichever listener thread drains.
+        self._meta: "OrderedDict[int, Tuple[float, float]]" = OrderedDict()
+        self._meta_lock = threading.Lock()
+        self._batcher = _Batcher(self._exec_batch, queue_max, self.metrics)
+
+    # -- write side: the round thread ---------------------------------------
+
+    def lag_bound_s(self) -> float:
+        """How far behind the fleet's observed writes this worker could
+        be right now: max over peers of (age of oldest unapplied delta +
+        silence time). 0.0 with no tracker/peers (single-writer truth)."""
+        lt = self.lag_tracker
+        if lt is None:
+            return 0.0
+        rep = lt.report()
+        return max(
+            (r["lag_s"] + r["staleness_s"] for r in rep.values()), default=0.0
+        )
+
+    def swap(self, state: Any, seq: int) -> None:
+        """Publish-boundary hook: snapshot `state` as the live read
+        replica at `seq`, stamped with the current lag bound."""
+        snap = self.replica.swap(state, seq, self.lag_bound_s())
+        with self._meta_lock:
+            self._meta[snap.seq] = (snap.swap_mono, snap.lag_bound_s)
+            while len(self._meta) > self.meta_keep:
+                self._meta.popitem(last=False)
+            horizon = min(self._meta)
+        self.cache.purge_below(horizon)
+
+    # -- read side: listener threads ----------------------------------------
+
+    def handle(self, raw: bytes) -> bytes:
+        """The one entry point every wire surface calls; response bytes
+        are carried verbatim (byte-identical across surfaces)."""
+        if faults.ACTIVE:
+            faults.fire("serve.query")  # injected stall/raise per surface
+        t0 = time.perf_counter()
+        self.metrics.count("serve.requests")
+        try:
+            req = json.loads(bytes(raw).decode("utf-8"))
+            queries = req["queries"]
+            if not isinstance(queries, list) or not all(
+                isinstance(q, dict) for q in queries
+            ):
+                raise ValueError("queries must be a list of objects")
+            ms = req.get("max_staleness_s")
+            ms = None if ms is None else float(ms)
+        except Exception as e:  # noqa: BLE001 — malformed input degrades
+            self.metrics.count("serve.errors")
+            return encode({"member": self.member, "error": f"bad request: {e}"})
+        try:
+            results = self._batcher.run(queries, ms)
+        except Overloaded as e:
+            return encode({"member": self.member, "error": f"overloaded: {e}"})
+        except Exception as e:  # noqa: BLE001 — the batch never hangs a caller
+            self.metrics.count("serve.errors")
+            return encode({"member": self.member, "error": str(e)})
+        self.metrics.merge(
+            {"latencies": {"serve.read": [time.perf_counter() - t0]}}
+        )
+        obs_events.emit("serve.query", n=len(queries), max_staleness_s=ms)
+        return encode(
+            {"member": self.member, "n": len(results), "results": results}
+        )
+
+    def query(
+        self,
+        queries: List[Dict[str, Any]],
+        max_staleness_s: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """In-process convenience: encode, handle, decode."""
+        return json.loads(
+            self.handle(request_bytes(queries, max_staleness_s)).decode("utf-8")
+        )
+
+    # -- batch execution (single drainer at a time) --------------------------
+
+    def _bound(self, seq: int) -> Optional[float]:
+        with self._meta_lock:
+            meta = self._meta.get(seq)
+        if meta is None:
+            return None
+        swap_mono, lag_bound = meta
+        return (self.mono() - swap_mono) + lag_bound
+
+    def _exec_batch(self, batch: List[_Pending]) -> None:
+        nq = sum(len(p.queries) for p in batch)
+        self.metrics.count("serve.batches")
+        self.metrics.count("serve.queries", nq)
+        live = self.replica.live()
+        bounds: List[float] = []
+        for p in batch:
+            p.results = [self._one(q, p.max_staleness, live, bounds)
+                         for q in p.queries]
+            p.done = True
+        if bounds:
+            self.metrics.merge({"latencies": {"serve.staleness_bound": bounds}})
+
+    def _one(
+        self,
+        q: Dict[str, Any],
+        ms: Optional[float],
+        live: Any,
+        bounds: List[float],
+    ) -> Dict[str, Any]:
+        try:
+            kq = kernels.query_key(q)
+        except Exception as e:  # noqa: BLE001 — one bad query, one error slot
+            self.metrics.count("serve.errors")
+            return {"error": f"bad query: {e}"}
+        hit = self.cache.get(kq)
+        if hit is not None:
+            val, seq = hit
+            b = self._bound(seq)
+            if b is not None:
+                b6 = _ceil6(b)
+                # No knob: only the live seq's own memo qualifies (reads
+                # default to the freshest snapshot). A knob explicitly
+                # opts into any cached answer inside the bound.
+                ok = (
+                    b6 <= ms
+                    if ms is not None
+                    else (live is None or seq == live.seq)
+                )
+                if ok:
+                    self.metrics.count("serve.cache_hits")
+                    bounds.append(b6)
+                    return {"value": val, "as_of_seq": seq,
+                            "staleness_bound_s": b6}
+        # Fall through to the fresh replica.
+        if live is None:
+            self.metrics.count("serve.errors")
+            return {"error": "no snapshot"}
+        if live.view is None:
+            live.view = kernels.materialize(self.dense, live.state)
+        # Bound stamped AFTER materialization: the answer leaves the
+        # plane no earlier than this instant, and a bound only ages —
+        # stamping before a (possibly compiling) materialize would
+        # under-report by its duration.
+        b = self._bound(live.seq)
+        if b is None:  # pedigree raced out of the window: recompute direct
+            b = (self.mono() - live.swap_mono) + live.lag_bound_s
+        b6 = _ceil6(b)
+        if ms is not None and b6 > ms:
+            self.metrics.count("serve.stale_rejects")
+            return {"error": "stale", "staleness_bound_s": b6,
+                    "max_staleness_s": ms}
+        self.metrics.count("serve.cache_misses")
+        try:
+            val = kernels.answer_one(live.view, q)
+        except ValueError as e:
+            self.metrics.count("serve.errors")
+            return {"error": str(e)}
+        self.cache.put(kq, val, live.seq)
+        bounds.append(b6)
+        return {"value": val, "as_of_seq": live.seq, "staleness_bound_s": b6}
+
+    # -- health --------------------------------------------------------------
+
+    def health_fields(self) -> Dict[str, Any]:
+        """Readiness view for /healthz: what seq the replica serves and
+        how stale it could be — what an LB needs to drain stale replicas."""
+        live = self.replica.live()
+        if live is None:
+            return {"serve_seq": -1, "serve_staleness_bound_s": None,
+                    "serve_cache_entries": len(self.cache)}
+        b = self._bound(live.seq)
+        if b is None:
+            b = (self.mono() - live.swap_mono) + live.lag_bound_s
+        return {
+            "serve_seq": live.seq,
+            "serve_staleness_bound_s": _ceil6(b),
+            "serve_cache_entries": len(self.cache),
+        }

@@ -774,6 +774,70 @@ def bench_round_phases(R, I, D_DCS, K, M, B, Br, rounds=6, overlap=None):
     }
 
 
+def bench_serve(frames=400, batch=512):
+    """Read-serving plane microbench (serve/plane.py).
+
+    One worker, one swapped replica, one thread, no wire: direct
+    `ServePlane.handle` calls with `batch` mixed queries per frame
+    (70% value / 20% topk / 10% range), the same frame shape the serve
+    demo's clients send over TCP. Measures the serving engine itself —
+    codec + batcher + memoized materialization — without chaos or
+    socket noise, so rounds are comparable: ``serve_reads_per_sec``
+    (served results / wall time) and ``serve_read_p99_ms`` (per-frame
+    p99). Protocol-bound after the single warm materialization;
+    geometry stays fixed and small on every backend."""
+    import random
+
+    from antidote_ccrdt_tpu import serve as serve_mod
+    from antidote_ccrdt_tpu.harness.opgen import TopkRmvEffectGen, Workload
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import make_dense
+
+    R, I, D_DCS, K, M = 4, 256, 4, 8, 2
+    dense = make_dense(n_ids=I, n_dcs=D_DCS, size=K, slots_per_id=M)
+    gen = TopkRmvEffectGen(
+        Workload(n_replicas=R, n_ids=I, zipf_a=1.2, score_max=10_000, seed=3)
+    )
+    state = dense.init(n_replicas=R, n_keys=1)
+    for _ in range(4):
+        state, _ = dense.apply_ops(
+            state, gen.next_batch(64, 8), collect_dominated=False
+        )
+    plane = serve_mod.ServePlane(dense, member="bench")
+    plane.swap(state, 0)
+
+    rng = random.Random(11)
+    reqs = []
+    for _ in range(8):  # a few frame shapes, reused round-robin
+        qs = []
+        for _ in range(batch):
+            pick = rng.random()
+            if pick < 0.7:
+                qs.append({"op": "value", "key": 0})
+            elif pick < 0.9:
+                qs.append({"op": "topk", "key": 0, "k": rng.choice((3, 5))})
+            else:
+                lo = rng.choice((0, 100, 1000))
+                qs.append({"op": "range", "key": 0, "lo": lo, "hi": lo + 900})
+        reqs.append(serve_mod.request_bytes(qs, max_staleness_s=600.0))
+    plane.handle(reqs[0])  # warm: compiles the fold, fills the memo
+
+    lat = []
+    t0 = time.perf_counter()
+    for i in range(frames):
+        t = time.perf_counter()
+        plane.handle(reqs[i % len(reqs)])
+        lat.append(time.perf_counter() - t)
+    total = time.perf_counter() - t0
+    lat.sort()
+    return {
+        "frames": frames,
+        "batch": batch,
+        "serve_reads_per_sec": round(frames * batch / total),
+        "serve_read_p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
+        "serve_read_p99_ms": round(lat[int(0.99 * (len(lat) - 1))] * 1e3, 3),
+    }
+
+
 def bench_partition_antientropy(P=8, resync_rounds=4):
     """Partition-plane anti-entropy microbench (core/partition.py).
 
@@ -995,6 +1059,9 @@ def main():
     antientropy = bench_partition_antientropy(
         resync_rounds=2 if os.environ.get("CCRDT_BENCH_TINY") else 4
     )
+    serving = bench_serve(
+        frames=5 if os.environ.get("CCRDT_BENCH_TINY") else 400
+    )
     round_phases = bench_round_phases(
         R, I, D_DCS, K, M, B, Br,
         rounds=3 if (backend == "cpu" or os.environ.get("CCRDT_BENCH_TINY"))
@@ -1029,6 +1096,9 @@ def main():
         # fixed protocol geometry, so rounds compare; the summary line
         # carries the two gated headline numbers.
         "partition_antientropy": antientropy,
+        # Read-serving plane microbench (bench_serve): same story — fixed
+        # frame shape, two gated headline numbers on the summary line.
+        "serve": serving,
         "dispatch_overhead_ms_p50": round(dispatch_overhead_ms, 2),
         "batch_per_replica_round": f"{B} adds + {Br} rmvs",
         "backend": backend,
@@ -1073,6 +1143,8 @@ def main():
             "antientropy_bytes_per_resync"
         ],
         "rejoin_stream_seconds": antientropy["rejoin_stream_seconds"],
+        "serve_reads_per_sec": serving["serve_reads_per_sec"],
+        "serve_read_p99_ms": serving["serve_read_p99_ms"],
         "backend": backend,
         "details_file": "benchmarks/bench_details.json" if sidecar else "stdout",
     }

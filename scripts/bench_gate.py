@@ -326,6 +326,91 @@ def evaluate_partition(
     return code, "\n".join(lines)
 
 
+_SERVE_RPS_RE = re.compile(r'"serve_reads_per_sec":\s*([0-9][0-9_.eE+-]*)')
+_SERVE_P99_RE = re.compile(r'"serve_read_p99_ms":\s*([0-9][0-9_.eE+-]*)')
+
+
+def load_serve_rounds(bench_dir: str) -> List[Tuple[int, str, float, float]]:
+    """[(round_no, path, serve_reads_per_sec, serve_read_p99_ms)] for
+    every BENCH round whose summary line carries the serving-plane
+    metrics (bench.bench_serve, r8+). Fixed frame shape on every
+    backend, so rounds compare without backend grouping."""
+    out: List[Tuple[int, str, float, float]] = []
+    for p in sorted(
+        glob.glob(os.path.join(bench_dir, "BENCH_r*.json")), key=round_number
+    ):
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        tail = str(doc.get("tail", ""))
+        rps = _SERVE_RPS_RE.findall(tail)
+        p99 = _SERVE_P99_RE.findall(tail)
+        if rps and p99:
+            out.append((round_number(p), p, float(rps[-1]), float(p99[-1])))
+    return out
+
+
+def evaluate_serve(
+    rounds: List[Tuple[int, str, float, float]],
+    tolerance: float = 0.20,
+    rps_floor_abs: float = 5_000.0,
+    p99_floor_ms: float = 1.0,
+) -> Tuple[int, str]:
+    """(exit_code, verdict) for the serving-plane gate: the latest
+    carrier fails when `serve_reads_per_sec` fell more than `tolerance`
+    relative AND more than `rps_floor_abs` under the best prior, or
+    `serve_read_p99_ms` grew more than `tolerance` relative AND more
+    than `p99_floor_ms` over the best (lowest) prior — the same
+    double-threshold shape as the other microbench gates (a per-frame
+    p99 of a few ms would trip a pure percentage on scheduler jitter).
+    Fewer than two carriers pass vacuously."""
+    if len(rounds) < 2:
+        return 0, (
+            f"serve-gate: only {len(rounds)} round(s) carry the serving "
+            "metrics — nothing to compare, passing vacuously"
+        )
+    latest_n, _p, latest_rps, latest_p99 = rounds[-1]
+    prior = rounds[:-1]
+    best_rps_n, _rp, best_rps, _ = max(prior, key=lambda r: r[2])
+    best_p99_n, _pp, _x, best_p99 = min(prior, key=lambda r: r[3])
+    code = 0
+    lines: List[str] = []
+    rps_floor = min(best_rps * (1.0 - tolerance), best_rps - rps_floor_abs)
+    verdict = (
+        f"serve-gate: r{latest_n:02d} serve_reads_per_sec = "
+        f"{latest_rps:,.0f} vs best prior r{best_rps_n:02d} = "
+        f"{best_rps:,.0f} (floor -{tolerance:.0%} and "
+        f"-{rps_floor_abs:,.0f}/s: {rps_floor:,.0f})"
+    )
+    if latest_rps < rps_floor:
+        code = 1
+        lines.append(
+            f"{verdict}\nFAIL: the serving engine lost "
+            f"{best_rps - latest_rps:,.0f} reads/sec over the best "
+            "prior carrier"
+        )
+    else:
+        lines.append(f"{verdict}\nOK: within tolerance")
+    p99_ceiling = max(best_p99 * (1.0 + tolerance), best_p99 + p99_floor_ms)
+    verdict = (
+        f"serve-gate: r{latest_n:02d} serve_read_p99_ms = {latest_p99:.3f} "
+        f"vs best prior r{best_p99_n:02d} = {best_p99:.3f} "
+        f"(ceiling +{tolerance:.0%} and +{p99_floor_ms}ms: "
+        f"{p99_ceiling:.3f})"
+    )
+    if latest_p99 > p99_ceiling:
+        code = 1
+        lines.append(
+            f"{verdict}\nFAIL: the per-frame read tail slowed "
+            f"{latest_p99 - best_p99:+.3f}ms over the best prior carrier"
+        )
+    else:
+        lines.append(f"{verdict}\nOK: within tolerance")
+    return code, "\n".join(lines)
+
+
 def attribution_drift(
     rounds: List[Tuple[int, str, float, float]]
 ) -> List[str]:
@@ -386,13 +471,21 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"  partition r{n:02d} {os.path.basename(p)}: "
             f"{ae:,.0f} B/resync, rejoin {rj:.3f}s"
         )
+    srv = load_serve_rounds(args.bench_dir)
+    for n, p, rps, p99 in srv:
+        print(
+            f"  serve r{n:02d} {os.path.basename(p)}: "
+            f"{rps:,.0f} reads/s, frame p99 {p99:.3f}ms"
+        )
     code, verdict = evaluate(rounds, args.tolerance)
     print(verdict)
     gap_code, gap_verdict = evaluate_gap(attr, args.gap_tolerance)
     print(gap_verdict)
     part_code, part_verdict = evaluate_partition(part, args.tolerance)
     print(part_verdict)
-    return max(code, gap_code, part_code)
+    serve_code, serve_verdict = evaluate_serve(srv, args.tolerance)
+    print(serve_verdict)
+    return max(code, gap_code, part_code, serve_code)
 
 
 if __name__ == "__main__":

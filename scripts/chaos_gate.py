@@ -17,7 +17,13 @@ instrumentation dropped, sim faults disabled) regresses these counters
 to zero and must fail the gate, because every downstream consumer — the
 dashboard, the lag tracker, the flight-log cross-checks — reads them.
 
-The last leg guards the span plane (obs/spans.py): it runs the tiny
+The serving leg reruns the skewed-clock serve drill pinned by
+tests/test_serve_staleness.py and holds it to the read plane's two
+exactly-zero contracts — no served result older than its advertised
+staleness bound, no served value differing from the engine's value()
+at the claimed as_of_seq — on top of the usual counters-nonzero rule.
+
+The span leg guards the span plane (obs/spans.py): it runs the tiny
 round-phase drill (`bench.bench_round_phases`) with tracing armed and
 fails if any load-bearing phase recorded zero time — the span analogue
 of a counter going dark — or if the phases' union (serial AND
@@ -78,6 +84,22 @@ PARTITION_REQUIRED_NONZERO = (
     "net.psnap_fetches",        # peers pulled divergent partitions
     "net.psnap_bytes",          # ...with the byte bill counted
     "net.partition_resyncs",    # partial repairs completed
+)
+
+# Serving-plane leg (tests/test_serve_staleness.py's seeded sim drill:
+# asymmetric link latency, seeded loss/dup, large asymmetric clock skew,
+# queries served mid-gossip): the read path's own heartbeat counters,
+# plus two EXACTLY-ZERO contracts checked on the audit — bound
+# violations (a served result older than its advertised staleness
+# bound) and identity mismatches (a served value differing from the
+# engine's own value() at the claimed as_of_seq).
+SERVE_REQUIRED_NONZERO = (
+    "serve.swaps",         # replicas actually swapped at publish points
+    "serve.requests",      # query frames reached the plane
+    "serve.batches",       # the coalescing batcher actually drained
+    "serve.queries",       # ...with the per-query bill counted
+    "serve.stale_rejects", # the staleness knob actually rejected
+    "net.queries",         # in-band wire queries crossed the (lossy) sim
 )
 
 # Same contract for the zone-topology leg (tests/test_topo_chaos.py:
@@ -222,6 +244,46 @@ def main() -> int:
     print(f"OK: span leg — all {len(obs_spans.PHASES)} phases lit, the "
           f"phase union explains {rp['span_coverage_p50']:.1%} of round "
           f"wall (critical path: {' > '.join(rp['critical_path'][:3])})")
+
+    # -- leg 5: the serving plane (bounded-staleness reads under chaos) ----
+    from test_serve_staleness import run_serve_chaos
+
+    audit = run_serve_chaos(seed=7)
+    s_counters = audit["counters"]
+    s_zeroed = sorted(
+        n for n in SERVE_REQUIRED_NONZERO if not s_counters.get(n, 0)
+    )
+    print("== serve chaos drill (seed=7, skewed clocks, asymmetric "
+          "links) ==")
+    print("  " + " ".join(
+        f"{n}={int(s_counters.get(n, 0))}" for n in SERVE_REQUIRED_NONZERO
+    ))
+    print(f"  served={audit['served']} rejected={audit['rejected']} "
+          f"wire_responses={audit['wire_responses']} "
+          f"violations={audit['violations']} "
+          f"identity_mismatches={audit['identity_mismatches']}")
+    if s_zeroed:
+        print("FAIL: serving counters regressed to zero (the read plane "
+              f"went dark): {s_zeroed}")
+        return 1
+    if audit["violations"]:
+        print(f"FAIL: {audit['violations']} served result(s) were older "
+              "than their advertised staleness bound — the bound "
+              "arithmetic leaked a foreign clock")
+        return 1
+    if audit["identity_mismatches"]:
+        print(f"FAIL: {audit['identity_mismatches']} served value(s) "
+              "differ from the engine's value() at the claimed as_of_seq "
+              "— the replica served torn or stale-beyond-claim state")
+        return 1
+    if not audit["served"] or not audit["wire_responses"]:
+        print("FAIL: the drill served nothing "
+              f"(served={audit['served']}, "
+              f"wire_responses={audit['wire_responses']})")
+        return 1
+    print(f"OK: serve leg — {audit['served']} reads served under chaos "
+          f"({audit['rejected']} honestly rejected as stale), 0 bound "
+          "violations, 0 identity mismatches")
     return 0
 
 

@@ -369,7 +369,6 @@ def run_worker(store, drill, dense, state, args, result_dir):
     # XLA hot-path profiler arms on $CCRDT_PROFILE.
     obs_events.install_from_env(args.member)
     obs_export.install_atexit_dump(store.metrics, args.member)
-    obs_http.install_from_env(store.metrics, args.member, addr_dir=result_dir)
     obs_profile.install_from_env(store.metrics)
     # Span plane (CCRDT_SPANS): round-phase spans spill next to the
     # flight log and mirror into metrics as span.* latency series, so
@@ -389,6 +388,52 @@ def run_worker(store, drill, dense, state, args, result_dir):
     pub = None  # set below when --delta
     cursors: dict = {}
     owned_prev: set = set()
+
+    # --- read-serving plane (tentpole, PR 9): CCRDT_SERVE=1 attaches a
+    # ServePlane to this worker — the replica swaps to the merged view at
+    # every publish boundary, and all three wire surfaces (tcp {query}
+    # frame, bridge {query} op, POST /query) answer off it with
+    # bounded-staleness pedigrees fed by the lag tracker.
+    from antidote_ccrdt_tpu import serve as serve_mod
+
+    plane = serve_mod.install_from_env(
+        dense, args.member, metrics=store.metrics, lag_tracker=lag_tracker
+    )
+    ctx = {"ovl": None}  # filled below; health_extra closes over the cell
+
+    def _serve_swap(view, seq) -> None:
+        if plane is not None:
+            plane.swap(view, seq)
+
+    def health_extra() -> dict:
+        """Serving-readiness: can a load balancer route reads here?"""
+        lag = lag_tracker.report()
+        doc = {
+            "max_peer_staleness_s": round(
+                max((r["staleness_s"] for r in lag.values()), default=0.0), 6
+            ),
+            "applied_watermark": max(cursors.values(), default=-1)
+            if cursors
+            else -1,
+            "overlap_queue_depth": (
+                len(ctx["ovl"].apq) if ctx["ovl"] is not None else 0
+            ),
+        }
+        if plane is not None:
+            doc.update(plane.health_fields())
+        return doc
+
+    obs_http.install_from_env(
+        store.metrics,
+        args.member,
+        addr_dir=result_dir,
+        query_handler=plane.handle if plane is not None else None,
+        health_extra=health_extra,
+    )
+    tr = getattr(store, "transport", None)
+    if plane is not None and tr is not None and hasattr(tr, "install_serve"):
+        # TCP fleets additionally answer {query} frames in-band.
+        tr.install_serve(plane)
 
     # --- crash-consistent WAL (tentpole, PR 2): recover checkpoint ⊔
     # delta suffix, resume AFTER the last durable step. Peer adoption
@@ -425,9 +470,10 @@ def run_worker(store, drill, dense, state, args, result_dir):
     def do_publish(store, seq_hint):
         view = drill.pub_state(dense, state)
         if pub is not None:
-            pub.publish(view)
+            pub.publish(view)  # pub.on_publish swaps the read replica
         else:
             store.publish(drill.publish_name, view, seq_hint)
+            _serve_swap(view, seq_hint)
 
     def do_sweep(store, st):
         view = drill.pub_state(dense, st)
@@ -481,7 +527,25 @@ def run_worker(store, drill, dense, state, args, result_dir):
     def drop_status(step, owned) -> None:
         """Periodic machine-readable status for the live dashboard:
         obs-<member>.json in the result dir (atomic replace)."""
-        counters = store.metrics.snapshot()["counters"]
+        snap = store.metrics.snapshot()
+        counters = snap["counters"]
+        serve_doc = {
+            k[len("serve."):]: v
+            for k, v in counters.items()
+            if k.startswith("serve.")
+        }
+        # Tail percentiles for the dashboard's serving columns, from the
+        # same reservoirs the exporters read.
+        reads = sorted(snap["latencies"].get("serve.read", []))
+        if reads:
+            serve_doc["read_p99_ms"] = round(
+                reads[int(0.99 * (len(reads) - 1))] * 1e3, 3
+            )
+        bounds = sorted(snap["latencies"].get("serve.staleness_bound", []))
+        if bounds:
+            serve_doc["staleness_p99_s"] = round(
+                bounds[int(0.99 * (len(bounds) - 1))], 6
+            )
         doc = {
             "member": args.member,
             "zone": getattr(store, "zone", None),
@@ -496,6 +560,7 @@ def run_worker(store, drill, dense, state, args, result_dir):
                 if k.startswith("net.sendq.")
             },
             "wal_last_seq": counters.get("wal.last_seq"),
+            "serve": serve_doc,
         }
         path = os.path.join(result_dir, f"obs-{args.member}.json")
         tmp = f"{path}.tmp-{os.getpid()}"
@@ -520,6 +585,7 @@ def run_worker(store, drill, dense, state, args, result_dir):
             store, dense, name=drill.publish_name, full_every=4,
             lag_source=lag_source, lag_threshold=lag_anchor_ops,
         )
+        pub.on_publish = _serve_swap
         if start_step > 0:
             # Resume the delta-seq lineage PAST anything the lost
             # incarnation published (old seq <= old step < start_step):
@@ -546,6 +612,7 @@ def run_worker(store, drill, dense, state, args, result_dir):
         # feed_lag's applied watermarks are now the pipeline's (what
         # drain_into actually folded), not sweep_deltas' cursor dict.
         cursors = ovl.cursors
+        ctx["ovl"] = ovl  # healthz readiness reads the live queue depth
 
     def _overlap_boundary(view, step, owned_snapshot):
         """The publish boundary as ONE host-stage task, FIFO after this
@@ -567,9 +634,10 @@ def run_worker(store, drill, dense, state, args, result_dir):
             finally:
                 obs_spans.end(tok)
             if pub is not None:
-                pub.publish(view)
+                pub.publish(view)  # pub.on_publish swaps the read replica
             else:
                 store.publish(drill.publish_name, view, step)
+                _serve_swap(view, step)
         feed_lag()
         drop_status(step, owned_snapshot)
         if wal is not None:
@@ -746,9 +814,9 @@ def run_worker(store, drill, dense, state, args, result_dir):
             if m != args.member and m not in alive_now
         }
         dead_n = len(confirmed_dead)
-        store.publish(
-            drill.publish_name, drill.pub_state(dense, state), STEPS + dead_n
-        )
+        final_view = drill.pub_state(dense, state)
+        store.publish(drill.publish_name, final_view, STEPS + dead_n)
+        _serve_swap(final_view, STEPS + dead_n)
         feed_lag()
         drop_status(STEPS, owned)
         pending = []

@@ -139,6 +139,38 @@ def _fmt_sendq(status: Optional[Dict[str, Any]]) -> str:
     return " ".join(f"{p}:{int(v)}" for p, v in sorted(q.items()))
 
 
+# QPS needs a rate, and status drops carry cumulative counters — so the
+# renderer keeps the previous frame's (time, serve.queries) per member.
+# Module state, same lifetime as the watch loop that calls render_frame.
+_SERVE_PREV: Dict[str, Any] = {}
+
+
+def _fmt_serve(status: Optional[Dict[str, Any]], member: str) -> str:
+    """Serving column group: query rate since the previous frame, cache
+    hit rate, client-visible read p99, and the p99 of the advertised
+    staleness bounds — all from the worker's serve.* metrics."""
+    sv = (status or {}).get("serve") or {}
+    if not sv:
+        return "-"
+    now = time.time()
+    q = float(sv.get("queries", 0))
+    prev = _SERVE_PREV.get(member)
+    _SERVE_PREV[member] = (now, q)
+    qps = "-"
+    if prev and now > prev[0]:
+        qps = f"{max(0.0, (q - prev[1]) / (now - prev[0])):,.0f}"
+    hits = float(sv.get("cache_hits", 0))
+    misses = float(sv.get("cache_misses", 0))
+    hit = f"{hits / (hits + misses):.0%}" if hits + misses else "-"
+    p99 = sv.get("read_p99_ms")
+    sp99 = sv.get("staleness_p99_s")
+    return (
+        f"q/s {qps} hit {hit} "
+        f"p99 {'-' if p99 is None else format(p99, '.1f') + 'ms'} "
+        f"stale99 {'-' if sp99 is None else format(sp99 * 1e3, '.1f') + 'ms'}"
+    )
+
+
 def render_frame(root: str, clear: bool = True) -> str:
     rows = scrape_root(root)
     lines = []
@@ -147,7 +179,8 @@ def render_frame(root: str, clear: bool = True) -> str:
     lines.append(f"== ccrdt gossip dashboard  root={root}  t={time.time():.2f}")
     hdr = (
         f"{'member':<10}{'zone':<6}{'hb-age':>8} {'state':<9}{'snap':>5} "
-        f"{'delta-window':<14}{'wal':>5}  {'sendq':<16}{'lag (peer:ops/secs)'}"
+        f"{'delta-window':<14}{'wal':>5}  {'sendq':<16}"
+        f"{'lag (peer:ops/secs)':<26}  {'serving'}"
     )
     lines.append(hdr)
     lines.append("-" * len(hdr))
@@ -182,7 +215,7 @@ def render_frame(root: str, clear: bool = True) -> str:
             f"{m:<10}{z:<6}{age:>8} {r['state']:<9}"
             f"{'-' if r['snap'] is None else r['snap']:>5} "
             f"{window:<14}{'-' if wal is None else int(wal):>5}  "
-            f"{_fmt_sendq(st):<16}{_fmt_lag(st)}"
+            f"{_fmt_sendq(st):<16}{_fmt_lag(st):<26}  {_fmt_serve(st, m)}"
         )
     return "\n".join(lines)
 

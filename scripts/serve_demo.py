@@ -1,0 +1,386 @@
+"""Read-serving plane acceptance drill (serve/ tentpole gate).
+
+Three workers gossip the topk_rmv grid over real TCP sockets while
+client threads hammer the in-band ``{query}`` frame with big batched
+reads — under chaos-style faults (seeded tcp.send drops + serve.query
+delays from utils/faults.py). The gate holds the serving plane to its
+whole contract at once:
+
+* throughput — the fleet must serve >= 50k batched reads/sec on CPU,
+  with the client-side per-frame p99 measured and reported;
+* honesty — zero responses whose advertised ``staleness_bound_s`` is
+  smaller than the snapshot's true age at send time (client and servers
+  share one monotonic clock in-process, so the check is exact: the
+  bound must cover ``t_send - t_swap`` of the claimed ``as_of_seq``);
+* bit-identity — every served "value" equals the engine's own `value()`
+  of the snapshot that was swapped in at the claimed seq, recorded at
+  swap time;
+* the write plane is undisturbed — after the query storm the fleet
+  converges to the sequential single-process reference digest.
+
+Writes the measurements to SERVE_r01.json (committed as the carrier for
+regression comparison) and exits nonzero if any gate fails.
+
+Run:  make serve-demo
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts.cover import install_child_cover  # noqa: E402
+
+install_child_cover()  # no-op outside `make cover` runs
+
+# Drill geometry: NK=4 keys so the query mix actually spreads.
+R, NK, I, DCS, K, M, B, Br = 4, 4, 64, 4, 8, 2, 32, 8
+STEPS = 10
+STEP_SLEEP = 0.25          # the query storm runs inside this window
+MIN_READS_PER_SEC = 50_000
+QUERY_BATCH = 1024
+CLIENT_THREADS = 4
+
+
+def _build():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import make_dense
+
+    return make_dense(n_ids=I, n_dcs=DCS, size=K, slots_per_id=M)
+
+
+def gen_ops(step: int, owned):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import TopkRmvOps
+
+    owned = set(owned)
+    a_key = np.zeros((R, B), np.int32)
+    a_id = np.zeros((R, B), np.int32)
+    a_score = np.zeros((R, B), np.int32)
+    a_dc = np.zeros((R, B), np.int32)
+    a_ts = np.zeros((R, B), np.int32)
+    r_key = np.zeros((R, Br), np.int32)
+    r_id = np.full((R, Br), -1, np.int32)
+    r_vc = np.zeros((R, Br, DCS), np.int32)
+    for r in range(R):
+        rng = np.random.default_rng(55_000 * (step + 1) + r)
+        if r in owned:
+            a_key[r] = rng.integers(0, NK, B)
+            a_id[r] = rng.integers(0, I, B)
+            a_score[r] = rng.integers(1, 500, B)
+            a_dc[r] = r % DCS
+            a_ts[r] = step * B + np.arange(B) + 1
+            r_key[r] = rng.integers(0, NK, Br)
+            r_id[r] = rng.integers(0, I, Br)
+            r_vc[r, :, r % DCS] = rng.integers(1, max(2, step * B + 1), Br)
+    return TopkRmvOps(
+        add_key=jnp.asarray(a_key), add_id=jnp.asarray(a_id),
+        add_score=jnp.asarray(a_score), add_dc=jnp.asarray(a_dc),
+        add_ts=jnp.asarray(a_ts),
+        rmv_key=jnp.asarray(r_key), rmv_id=jnp.asarray(r_id),
+        rmv_vc=jnp.asarray(r_vc),
+    )
+
+
+def apply_step(dense, state, step: int, owned):
+    state, _ = dense.apply_ops(
+        state, gen_ops(step, owned), collect_dominated=False
+    )
+    return state
+
+
+def ref_values(dense, state):
+    """Per-key reference: the engine's own value() of the folded
+    snapshot, JSON-shaped — what every served "value" must equal."""
+    from antidote_ccrdt_tpu.harness.dense_replay import fold_rows
+
+    per_key = dense.value(fold_rows(dense, state, range(R)))[0]
+    return [[[int(i), int(s)] for i, s in row] for row in per_key]
+
+
+def digest(dense, state):
+    return [sorted(map(tuple, row)) for row in ref_values(dense, state)]
+
+
+def sequential_reference(dense):
+    state = dense.init(R, NK)
+    for step in range(STEPS):
+        state = apply_step(dense, state, step, range(R))
+    return digest(dense, state)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--out",
+        default=os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "SERVE_r01.json",
+        ),
+    )
+    ap.add_argument("--min-reads", type=float, default=MIN_READS_PER_SEC)
+    args = ap.parse_args()
+
+    import random
+
+    from antidote_ccrdt_tpu import serve
+    from antidote_ccrdt_tpu.net.tcp import TcpTransport, query_peer
+    from antidote_ccrdt_tpu.net.transport import GossipNode
+    from antidote_ccrdt_tpu.obs.lag import LagTracker
+    from antidote_ccrdt_tpu.parallel.elastic import sweep
+    from antidote_ccrdt_tpu.utils import faults
+
+    dense = _build()
+    members = ["w0", "w1", "w2"]
+    owned = {"w0": [0, 1], "w1": [2], "w2": [3]}
+    transports = {m: TcpTransport(m) for m in members}
+    try:
+        for m in members:
+            for n in members:
+                if n != m:
+                    transports[m].add_peer(n, transports[n].address)
+        stores = {m: GossipNode(transports[m]) for m in members}
+        lags = {m: LagTracker(m) for m in members}
+        planes = {
+            m: serve.ServePlane(
+                dense, member=m, metrics=stores[m].metrics,
+                lag_tracker=lags[m],
+            )
+            for m in members
+        }
+        for m in members:
+            transports[m].install_serve(planes[m])
+        states = {m: dense.init(R, NK) for m in members}
+
+        # Start barrier.
+        deadline = time.time() + 10.0
+        while any(len(stores[m].members()) < len(members) for m in members):
+            for m in members:
+                stores[m].heartbeat()
+            if time.time() > deadline:
+                print("FAIL: start barrier timed out", file=sys.stderr)
+                return 1
+            time.sleep(0.05)
+
+        # Warm every jit path BEFORE the measured storm — the apply/fold
+        # compiles would otherwise stall the GIL mid-storm and poison the
+        # read p99: a throwaway write step on scratch state, plus swap
+        # seq -1 and one throwaway query per worker.
+        scratch = apply_step(dense, dense.init(R, NK), 0, range(R))
+        ref_values(dense, scratch)
+        for m in members:
+            planes[m].swap(states[m], -1)
+            query_peer(
+                transports[m].address,
+                serve.request_bytes([{"op": "value", "key": 0}]),
+                timeout=10.0,
+            )
+
+        # truth[(member, seq)] = (mono recorded AFTER the swap returned,
+        # per-key reference values of the swapped state). Recording after
+        # keeps the bound audit conservative: t_rec >= the snapshot's
+        # swap_mono, so `bound >= t_send - t_rec` is implied by honesty.
+        truth = {}
+
+        # Chaos-style faults for the storm: seeded send drops (gossip
+        # AND query replies) plus occasional serve-side delays.
+        faults.install({
+            "tcp.send": [{"action": "drop", "rate": 0.02}],
+            "serve.query": [{"action": "delay", "rate": 0.01,
+                             "delay_s": 0.002}],
+        }, seed=5)
+
+        stop = threading.Event()
+        frames = [[] for _ in range(CLIENT_THREADS)]
+        frame_errors = [0] * CLIENT_THREADS
+
+        def client(ci: int) -> None:
+            rng = random.Random(1000 + ci)
+            while not stop.is_set():
+                m = members[rng.randrange(len(members))]
+                qs = []
+                for _ in range(QUERY_BATCH):
+                    key = rng.randrange(NK)
+                    pick = rng.random()
+                    if pick < 0.7:
+                        qs.append({"op": "value", "key": key})
+                    elif pick < 0.9:
+                        qs.append({"op": "topk", "key": key, "k": 5})
+                    else:
+                        qs.append({"op": "range", "key": key,
+                                   "lo": 100, "hi": 400})
+                # Mostly a loose knob; rarely an impossible one, to
+                # prove rejection is a real code path under load.
+                ms = 1e-6 if rng.random() < 0.02 else 5.0
+                t_send = time.monotonic()
+                try:
+                    _, raw = query_peer(
+                        transports[m].address,
+                        serve.request_bytes(qs, max_staleness_s=ms),
+                        timeout=2.0,
+                    )
+                    doc = json.loads(raw.decode("utf-8"))
+                except Exception:  # noqa: BLE001 — chaos shot this frame
+                    frame_errors[ci] += 1
+                    continue
+                frames[ci].append(
+                    (m, t_send, time.monotonic() - t_send, qs, doc)
+                )
+
+        threads = [
+            threading.Thread(target=client, args=(i,), daemon=True)
+            for i in range(CLIENT_THREADS)
+        ]
+        t_storm0 = time.monotonic()
+        for t in threads:
+            t.start()
+
+        # The write plane runs its ordinary rounds under the storm.
+        for step in range(STEPS):
+            for m in members:
+                stores[m].heartbeat()
+                states[m] = apply_step(dense, states[m], step, owned[m])
+                stores[m].publish("topk_rmv", states[m], step)
+            time.sleep(0.05)
+            for m in members:
+                swept, _ = sweep(stores[m], dense, states[m])
+                states[m] = swept
+                for peer in members:
+                    if peer == m:
+                        continue
+                    hi = stores[m].snapshot_seq(peer)
+                    if hi is not None:
+                        lags[m].observe_published(peer, hi)
+                        lags[m].observe_applied(peer, hi)
+                vals = ref_values(dense, states[m])
+                planes[m].swap(states[m], step)
+                truth[(m, step)] = (time.monotonic(), vals)
+            time.sleep(STEP_SLEEP)
+
+        stop.set()
+        for t in threads:
+            t.join(5.0)
+        t_storm = time.monotonic() - t_storm0
+        faults.uninstall()
+
+        # Convergence tail, chaos off: the storm must not have disturbed
+        # the write plane.
+        ref = sequential_reference(dense)
+        converged = False
+        for i in range(80):
+            if all(digest(dense, states[m]) == ref for m in members):
+                converged = True
+                break
+            for m in members:
+                stores[m].heartbeat()
+                stores[m].publish("topk_rmv", states[m], STEPS + i)
+            time.sleep(0.05)
+            for m in members:
+                swept, _ = sweep(stores[m], dense, states[m])
+                states[m] = swept
+
+        # -- audit ----------------------------------------------------------
+        served = rejected = violations = mismatches = overloaded = 0
+        lat = []
+        eps = 1e-9
+        for ci in range(CLIENT_THREADS):
+            for m, t_send, dt, qs, doc in frames[ci]:
+                if "error" in doc:
+                    overloaded += 1
+                    continue
+                lat.append(dt)
+                for q, r in zip(qs, doc["results"]):
+                    if "error" in r:
+                        if r.get("error") == "stale":
+                            rejected += 1
+                        continue
+                    served += 1
+                    t_rec, vals = truth.get(
+                        (m, r["as_of_seq"]), (None, None)
+                    )
+                    if t_rec is None:
+                        continue  # warmup snapshot (seq -1)
+                    if r["staleness_bound_s"] + eps < t_send - t_rec:
+                        violations += 1
+                    if q["op"] == "value" and r["value"] != vals[q["key"]]:
+                        mismatches += 1
+        lat.sort()
+        p99_ms = (lat[int(0.99 * (len(lat) - 1))] * 1e3) if lat else None
+        p50_ms = (lat[len(lat) // 2] * 1e3) if lat else None
+        reads_per_sec = served / max(t_storm, 1e-9)
+        errors = sum(frame_errors)
+
+        counters = {}
+        for m in members:
+            for k, v in stores[m].metrics.snapshot()["counters"].items():
+                if k.startswith(("serve.", "net.queries")):
+                    counters[k] = counters.get(k, 0) + int(v)
+
+        checks = {
+            "reads_per_sec_ge_min": reads_per_sec >= args.min_reads,
+            "zero_bound_violations": violations == 0,
+            "zero_identity_mismatches": mismatches == 0,
+            "stale_rejects_observed": rejected >= 1
+            and counters.get("serve.stale_rejects", 0) >= 1,
+            "write_fleet_converged": converged,
+            "serve_counters_lit": all(
+                counters.get(k, 0) > 0
+                for k in ("serve.swaps", "serve.requests", "serve.batches",
+                          "serve.queries", "serve.cache_hits")
+            ),
+            "chaos_actually_fired": errors > 0
+            or counters.get("serve.requests", 0) > served // QUERY_BATCH,
+        }
+        report = {
+            "drill": "serve_demo",
+            "geometry": {"R": R, "NK": NK, "I": I, "DCS": DCS, "K": K,
+                         "M": M, "B": B, "steps": STEPS},
+            "clients": CLIENT_THREADS,
+            "query_batch": QUERY_BATCH,
+            "storm_s": round(t_storm, 3),
+            "reads_per_sec": round(reads_per_sec, 1),
+            "min_reads_per_sec": args.min_reads,
+            "read_p50_ms": None if p50_ms is None else round(p50_ms, 3),
+            "read_p99_ms": None if p99_ms is None else round(p99_ms, 3),
+            "served": served,
+            "stale_rejected": rejected,
+            "frame_errors": errors,
+            "overloaded_frames": overloaded,
+            "bound_violations": violations,
+            "identity_mismatches": mismatches,
+            "counters": dict(sorted(counters.items())),
+            "checks": checks,
+            "pass": all(checks.values()),
+        }
+        with open(args.out, "w") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(json.dumps(report, indent=2, sort_keys=True))
+        if not report["pass"]:
+            failed = [k for k, ok in checks.items() if not ok]
+            print(f"FAIL: {', '.join(failed)}", file=sys.stderr)
+            return 1
+        print(
+            f"PASS: served {served} reads at {reads_per_sec:,.0f}/s "
+            f"(p99 {p99_ms:.2f}ms), 0 bound violations, 0 identity "
+            f"mismatches, fleet converged under chaos"
+        )
+        return 0
+    finally:
+        faults.uninstall()
+        for t in transports.values():
+            t.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

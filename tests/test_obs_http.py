@@ -71,6 +71,81 @@ def test_broken_source_degrades_to_500_then_recovers():
             assert "ccrdt_net_frames_sent" in resp.read().decode()
 
 
+def test_healthz_readiness_fields_from_health_extra():
+    def extra():
+        return {
+            "max_peer_staleness_s": 0.25,
+            "applied_watermark": 7,
+            "overlap_queue_depth": 2,
+            "serve_seq": 7,
+        }
+
+    with obs_http.MetricsHttpServer(Metrics(), "w3", health_extra=extra) as srv:
+        with _get(srv.address, "/healthz") as resp:
+            doc = json.loads(resp.read())
+        assert doc["ok"] is True
+        assert doc["max_peer_staleness_s"] == 0.25
+        assert doc["applied_watermark"] == 7
+        assert doc["overlap_queue_depth"] == 2
+        assert doc["serve_seq"] == 7
+
+
+def test_healthz_survives_broken_health_extra():
+    def extra():
+        raise RuntimeError("readiness probe exploded")
+
+    with obs_http.MetricsHttpServer(Metrics(), "w4", health_extra=extra) as srv:
+        with _get(srv.address, "/healthz") as resp:
+            doc = json.loads(resp.read())
+        # Liveness stays 200: the broken readiness probe is reported,
+        # not fatal.
+        assert doc["ok"] is True
+        assert "readiness probe exploded" in doc["health_extra_error"]
+
+
+def _post(addr, path, data, timeout=5.0):
+    return urllib.request.urlopen(
+        urllib.request.Request(
+            f"http://{addr[0]}:{addr[1]}{path}", data=data, method="POST"
+        ),
+        timeout=timeout,
+    )
+
+
+def test_post_query_routes_to_handler():
+    def handler(raw):
+        return b'{"echo":' + raw + b"}"
+
+    with obs_http.MetricsHttpServer(
+        Metrics(), "w5", query_handler=handler
+    ) as srv:
+        with _post(srv.address, "/query", b'"hi"') as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"] == "application/json"
+            assert resp.read() == b'{"echo":"hi"}'
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(srv.address, "/nope", b"x")
+        assert ei.value.code == 404
+
+
+def test_post_query_without_handler_404_and_broken_handler_500():
+    with obs_http.MetricsHttpServer(Metrics(), "w6") as srv:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(srv.address, "/query", b"{}")
+        assert ei.value.code == 404
+
+    def handler(raw):
+        raise RuntimeError("plane exploded")
+
+    with obs_http.MetricsHttpServer(
+        Metrics(), "w7", query_handler=handler
+    ) as srv:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(srv.address, "/query", b"{}")
+        assert ei.value.code == 500
+        assert b"plane exploded" in ei.value.read()
+
+
 def test_install_from_env_gating(tmp_path):
     m = Metrics()
     assert obs_http.install_from_env(m, "w0", env={}) is None

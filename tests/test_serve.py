@@ -1,0 +1,353 @@
+"""Unit tests for the read-serving plane (serve/): replica double
+buffering, per-type query kernels, hot-key caching with staleness
+fall-through, the bounded coalescing batcher, the canonical codec, and
+env gating."""
+
+import json
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from antidote_ccrdt_tpu import serve
+from antidote_ccrdt_tpu.models.topk_rmv_dense import TopkRmvOps, make_dense
+from antidote_ccrdt_tpu.serve.plane import _Batcher, _ceil6
+from antidote_ccrdt_tpu.utils.metrics import Metrics
+
+R, NK, I, DCS, K, M, B, Br = 2, 1, 8, 2, 10, 2, 4, 2
+
+
+def _engine():
+    return make_dense(n_ids=I, n_dcs=DCS, size=K, slots_per_id=M)
+
+
+def _ops(ids, scores, replica=0, ts0=1):
+    """Adds on one replica (everything else padding: ts=0 / rmv_id=-1)."""
+    a_key = np.zeros((R, B), np.int32)
+    a_id = np.zeros((R, B), np.int32)
+    a_score = np.zeros((R, B), np.int32)
+    a_dc = np.zeros((R, B), np.int32)
+    a_ts = np.zeros((R, B), np.int32)
+    a_id[replica, : len(ids)] = ids
+    a_score[replica, : len(ids)] = scores
+    a_ts[replica, : len(ids)] = np.arange(ts0, ts0 + len(ids))
+    return TopkRmvOps(
+        add_key=jnp.asarray(a_key), add_id=jnp.asarray(a_id),
+        add_score=jnp.asarray(a_score), add_dc=jnp.asarray(a_dc),
+        add_ts=jnp.asarray(a_ts),
+        rmv_key=jnp.zeros((R, Br), jnp.int32),
+        rmv_id=jnp.full((R, Br), -1, jnp.int32),
+        rmv_vc=jnp.zeros((R, Br, DCS), jnp.int32),
+    )
+
+
+def _apply(dense, state, ids, scores, **kw):
+    state, _ = dense.apply_ops(
+        state, _ops(ids, scores, **kw), collect_dominated=False
+    )
+    return state
+
+
+def _fake_clock(t0=100.0):
+    cell = [t0]
+    return cell, (lambda: cell[0])
+
+
+# --- replica ----------------------------------------------------------------
+
+
+def test_replica_double_buffer_and_snapshot_isolation():
+    dense = _engine()
+    m = Metrics()
+    plane = serve.ServePlane(dense, member="w0", metrics=m)
+    s0 = _apply(dense, dense.init(R, NK), [1, 2], [50, 40])
+    plane.swap(s0, 0)
+    v0 = plane.query([{"op": "value", "key": 0}])["results"][0]["value"]
+
+    # Advancing the worker's own state does NOT move the live snapshot:
+    # the replica owns a device copy, not a reference.
+    s1 = _apply(dense, s0, [3], [99], ts0=10)
+    assert plane.query([{"op": "value", "key": 0}])["results"][0]["value"] == v0
+
+    plane.swap(s1, 1)
+    live, prev = plane.replica.live(), plane.replica.previous()
+    assert live.seq == 1 and prev.seq == 0
+    v1 = plane.query([{"op": "value", "key": 0}])["results"][0]["value"]
+    assert [3, 99] in v1 and [3, 99] not in v0
+    assert m.snapshot()["counters"]["serve.swaps"] == 2
+
+
+def test_answers_match_engine_value_at_as_of_seq():
+    """The bit-identity core: served value == dense.value() of the
+    folded snapshot, reshaped to JSON."""
+    from antidote_ccrdt_tpu.harness.dense_replay import fold_rows
+
+    dense = _engine()
+    state = _apply(dense, dense.init(R, NK), [1, 2, 3], [50, 40, 30])
+    state = _apply(dense, state, [4], [45], replica=1)
+    plane = serve.ServePlane(dense, member="w0")
+    plane.swap(state, 7)
+    doc = plane.query([
+        {"op": "value", "key": 0},
+        {"op": "topk", "key": 0, "k": 2},
+        {"op": "range", "key": 0, "lo": 35, "hi": 50},
+    ])
+    ref = [[int(i), int(s)] for i, s in
+           dense.value(fold_rows(dense, state, range(R)))[0][0]]
+    r = doc["results"]
+    assert all(x["as_of_seq"] == 7 for x in r)
+    assert r[0]["value"] == ref
+    assert r[1]["value"] == ref[:2]
+    assert r[2]["value"] == [p for p in ref if 35 <= p[1] <= 50]
+
+
+def test_monoid_kernels_average_and_wordcount():
+    from antidote_ccrdt_tpu.models.average import AverageDense, AverageOps
+    from antidote_ccrdt_tpu.models.wordcount import WordcountDense, WordcountOps
+    from antidote_ccrdt_tpu.parallel.monoid import MonoidContributor, MonoidLift
+
+    # average (scalar observable): value only, topk is a per-result error.
+    lift = MonoidLift(AverageDense())
+    contrib = MonoidContributor(lift, R, 2)
+    key = np.zeros((R, B), np.int32)
+    val = np.zeros((R, B), np.int32)
+    cnt = np.zeros((R, B), np.int32)
+    val[0], cnt[0] = [10, 20, 30, 40], 1
+    contrib.apply(
+        AverageOps(key=jnp.asarray(key), value=jnp.asarray(val),
+                   count=jnp.asarray(cnt)),
+        owned=[0],
+    )
+    plane = serve.ServePlane(lift, member="w0")
+    plane.swap(contrib.view, 0)
+    doc = plane.query([{"op": "value", "key": 0}, {"op": "topk", "key": 0}])
+    assert doc["results"][0]["value"] == pytest.approx(25.0)
+    assert "error" in doc["results"][1]
+
+    # wordcount (vocab observable): nonzero (token, count) pairs; topk
+    # ranks by count then token; range filters counts.
+    V = 8
+    wlift = MonoidLift(WordcountDense(V))
+    wc = MonoidContributor(wlift, R, 1)
+    tok = np.full((R, B), -1, np.int32)
+    tok[0] = [3, 3, 5, 3]
+    wc.apply(
+        WordcountOps(key=jnp.zeros((R, B), jnp.int32), token=jnp.asarray(tok)),
+        owned=[0],
+    )
+    wplane = serve.ServePlane(wlift, member="w0")
+    wplane.swap(wc.view, 0)
+    doc = wplane.query([
+        {"op": "value", "key": 0},
+        {"op": "topk", "key": 0, "k": 1},
+        {"op": "range", "key": 0, "lo": 1, "hi": 1},
+    ])
+    assert doc["results"][0]["value"] == [[3, 3], [5, 1]]
+    assert doc["results"][1]["value"] == [[3, 3]]
+    assert doc["results"][2]["value"] == [[5, 1]]
+
+
+def test_bad_queries_degrade_per_result():
+    dense = _engine()
+    plane = serve.ServePlane(dense, member="w0")
+    plane.swap(dense.init(R, NK), 0)
+    doc = plane.query([
+        {"op": "value", "key": 999},    # out of range
+        {"op": "nope", "key": 0},       # unknown op
+        {"op": "value", "key": 0},      # still answered
+    ])
+    assert "error" in doc["results"][0]
+    assert "error" in doc["results"][1]
+    assert doc["results"][2]["value"] == []
+
+
+def test_no_snapshot_and_bad_request():
+    plane = serve.ServePlane(_engine(), member="w0")
+    assert plane.query([{"op": "value", "key": 0}])["results"][0] == {
+        "error": "no snapshot"
+    }
+    out = json.loads(plane.handle(b"not json").decode())
+    assert "bad request" in out["error"]
+    out = json.loads(plane.handle(b'{"queries": 7}').decode())
+    assert "bad request" in out["error"]
+    assert plane.health_fields()["serve_seq"] == -1
+
+
+# --- staleness + cache ------------------------------------------------------
+
+
+def test_max_staleness_cache_fallthrough_and_reject():
+    dense = _engine()
+    m = Metrics()
+    cell, mono = _fake_clock()
+    plane = serve.ServePlane(dense, member="w0", metrics=m, mono=mono)
+    plane.swap(_apply(dense, dense.init(R, NK), [1], [5]), 0)
+
+    q = [{"op": "value", "key": 0}]
+    r = plane.query(q, max_staleness_s=1.0)["results"][0]
+    assert r["value"] == [[1, 5]] and r["staleness_bound_s"] <= 1.0
+    # Second ask is a cache hit (still within the bound).
+    assert plane.query(q, max_staleness_s=1.0)["results"][0]["value"] == [[1, 5]]
+    c = m.snapshot()["counters"]
+    assert c["serve.cache_hits"] == 1 and c["serve.cache_misses"] == 1
+
+    # Age the snapshot past the knob: cached entry no longer qualifies,
+    # the fresh replica is just as old -> stale reject, never a lie.
+    cell[0] += 5.0
+    r = plane.query(q, max_staleness_s=1.0)["results"][0]
+    assert r["error"] == "stale" and r["staleness_bound_s"] >= 5.0
+    c = m.snapshot()["counters"]
+    assert c["serve.stale_rejects"] == 1
+    assert c["serve.cache_misses"] == 1  # a reject is not a miss
+
+    # No knob -> the aged answer is still served, bound honestly large.
+    r = plane.query(q)["results"][0]
+    assert r["value"] == [[1, 5]] and r["staleness_bound_s"] >= 5.0
+
+    # A fresh swap satisfies the strict knob again (cache fall-through
+    # re-fills at the new seq).
+    plane.swap(_apply(dense, dense.init(R, NK), [1], [5]), 1)
+    r = plane.query(q, max_staleness_s=1.0)["results"][0]
+    assert r["as_of_seq"] == 1 and r["staleness_bound_s"] <= 1.0
+
+
+def test_lag_bound_feeds_staleness_pedigree():
+    class FakeLag:
+        def report(self):
+            return {"peer": {"lag_s": 2.0, "staleness_s": 1.5}}
+
+    cell, mono = _fake_clock()
+    plane = serve.ServePlane(
+        _engine(), member="w0", lag_tracker=FakeLag(), mono=mono
+    )
+    plane.swap(_engine().init(R, NK), 0)
+    cell[0] += 0.25
+    r = plane.query([{"op": "value", "key": 0}])["results"][0]
+    # bound = age (0.25) + lag bound at swap (3.5), rounded UP.
+    assert r["staleness_bound_s"] >= 3.75
+    h = plane.health_fields()
+    assert h["serve_seq"] == 0 and h["serve_staleness_bound_s"] >= 3.75
+
+
+def test_cache_lru_eviction_and_purge():
+    m = Metrics()
+    cache = serve.HotKeyCache(cap=2, metrics=m)
+    cache.put(("a",), 1, 0)
+    cache.put(("b",), 2, 1)
+    assert cache.get(("a",)) == (1, 0)  # refresh: b becomes LRU
+    cache.put(("c",), 3, 2)
+    assert cache.get(("b",)) is None
+    assert m.snapshot()["counters"]["serve.cache_evictions"] == 1
+    assert cache.purge_below(2) == 1  # drops ("a",) seq 0
+    assert len(cache) == 1 and cache.get(("c",)) == (3, 2)
+
+
+def test_cache_purged_past_pedigree_horizon():
+    dense = _engine()
+    plane = serve.ServePlane(dense, member="w0", meta_keep=2)
+    state = dense.init(R, NK)
+    plane.swap(state, 0)
+    plane.query([{"op": "value", "key": 0}])  # fills cache at seq 0
+    assert len(plane.cache) == 1
+    plane.swap(state, 1)
+    plane.swap(state, 2)  # horizon now 1: the seq-0 answer is unboundable
+    assert len(plane.cache) == 0
+
+
+# --- batcher ----------------------------------------------------------------
+
+
+def test_batcher_coalesces_concurrent_callers():
+    execd = []
+    gate = threading.Event()
+
+    def exec_batch(batch):
+        if not execd:
+            gate.wait(5.0)  # hold the first drain open
+        execd.append([len(p.queries) for p in batch])
+        for p in batch:
+            p.results = [None] * len(p.queries)
+            p.done = True
+
+    b = _Batcher(exec_batch, queue_max=100, metrics=Metrics())
+    results = []
+    t0 = threading.Thread(target=lambda: results.append(b.run([{}], None)))
+    t0.start()
+    time.sleep(0.1)  # t0 is the busy drainer now
+    ts = [
+        threading.Thread(target=lambda: results.append(b.run([{}, {}], None)))
+        for _ in range(3)
+    ]
+    for t in ts:
+        t.start()
+    time.sleep(0.2)  # followers enqueue behind the held drain
+    gate.set()
+    for t in [t0] + ts:
+        t.join(5.0)
+    assert len(results) == 4
+    # First drain took the lone request; one follower drained the rest
+    # as a single coalesced batch.
+    assert execd[0] == [1]
+    assert sorted(len(x) for x in execd[1:]) in ([3], [1, 2], [1, 1, 1], [2, 1])
+    assert sum(len(x) for x in execd) == 4
+
+
+def test_batcher_sheds_overflow_loudly():
+    m = Metrics()
+    dense = _engine()
+    plane = serve.ServePlane(dense, member="w0", metrics=m, queue_max=2)
+    plane.swap(dense.init(R, NK), 0)
+    doc = plane.query([{"key": 0}, {"key": 0}, {"key": 0}])
+    assert "overloaded" in doc["error"]
+    assert m.snapshot()["counters"]["serve.queue_shed"] == 1
+    # Within bounds still serves.
+    assert plane.query([{"key": 0}])["results"][0]["value"] == []
+
+
+def test_batcher_aborted_drain_strands_nobody():
+    def exec_batch(batch):
+        raise RuntimeError("kernel exploded")
+
+    b = _Batcher(exec_batch, queue_max=10, metrics=Metrics())
+    with pytest.raises(RuntimeError):
+        b.run([{}], None)
+    assert not b._busy and not b._pending  # next caller starts clean
+
+
+# --- codec ------------------------------------------------------------------
+
+
+def test_codec_canonical_and_ceil6_conservative():
+    assert serve.encode({"b": 1, "a": 2}) == b'{"a":2,"b":1}\n'
+    assert serve.request_bytes([{"op": "value", "key": 3}], 0.5) == (
+        b'{"max_staleness_s":0.5,"queries":[{"key":3,"op":"value"}]}\n'
+    )
+    for x in (0.0, 1e-9, 0.1234567, 3.9999999):
+        assert _ceil6(x) >= x
+    assert _ceil6(-1.0) == 0.0
+
+
+def test_query_key_normalizes_identical_questions():
+    from antidote_ccrdt_tpu.serve.kernels import query_key
+
+    assert query_key({"op": "value", "key": 1}) == query_key(
+        {"key": 1, "op": "value", "extra": "ignored"}
+    )
+    assert query_key({}) == ("value", 0, None, None, None)
+    assert query_key({"op": "topk", "key": 1, "k": 3}) != query_key(
+        {"op": "topk", "key": 1, "k": 4}
+    )
+
+
+# --- env gating -------------------------------------------------------------
+
+
+def test_install_from_env_gating():
+    dense = _engine()
+    assert serve.install_from_env(dense, "w0", env={}) is None
+    assert serve.install_from_env(
+        dense, "w0", env={serve.ENV_FLAG: "0"}) is None
+    plane = serve.install_from_env(dense, "w0", env={serve.ENV_FLAG: "1"})
+    assert isinstance(plane, serve.ServePlane) and plane.member == "w0"

@@ -1,0 +1,179 @@
+"""Tri-surface parity for the serving plane: the SAME query against the
+SAME snapshot must produce byte-identical responses on every wire
+surface — the tcp ``{query}`` frame, the bridge ``{query}`` op, and
+``POST /query`` — because all three carry `ServePlane.handle` bytes
+verbatim and the codec is canonical JSON. The plane's clock is frozen so
+the advertised staleness bound cannot drift between the surface calls.
+
+The second half is degrade-never-hang: with `utils.faults` firing at the
+``serve.query`` point, each surface fails its own bounded way (closed
+connection / error frame / HTTP 500) and recovers on the next request
+once the fault plan is gone.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from antidote_ccrdt_tpu import serve
+from antidote_ccrdt_tpu.bridge.client import BridgeClient
+from antidote_ccrdt_tpu.bridge.server import BridgeServer
+from antidote_ccrdt_tpu.net.tcp import TcpTransport, query_peer
+from antidote_ccrdt_tpu.obs import http as obs_http
+from antidote_ccrdt_tpu.utils import faults
+from antidote_ccrdt_tpu.utils.metrics import Metrics
+
+from tests.test_serve import R, _apply, _engine
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_leak():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+def _frozen_plane(metrics=None):
+    import time
+
+    dense = _engine()
+    plane = serve.ServePlane(dense, member="w0", metrics=metrics or Metrics())
+    state = _apply(dense, dense.init(R, 1), [1, 2, 3], [50, 40, 30])
+    plane.swap(state, 4)
+    t = time.monotonic()
+    plane.mono = lambda: t  # freeze: bounds identical across surfaces
+    return plane
+
+
+REQ = serve.request_bytes(
+    [{"op": "value", "key": 0}, {"op": "topk", "key": 0, "k": 2}],
+    max_staleness_s=60.0,
+)
+
+
+def _post(addr, payload, timeout=5.0):
+    return urllib.request.urlopen(
+        urllib.request.Request(
+            f"http://{addr[0]}:{addr[1]}/query", data=payload, method="POST"
+        ),
+        timeout=timeout,
+    )
+
+
+def test_three_surfaces_byte_identical():
+    plane = _frozen_plane()
+    want = plane.handle(REQ)
+    assert json.loads(want.decode())["results"][0]["value"]  # non-trivial
+
+    t = TcpTransport("w0")
+    t.install_serve(plane)
+    try:
+        member, tcp_resp = query_peer(t.address, REQ, timeout=5.0)
+        assert member == "w0"
+    finally:
+        t.close()
+
+    with obs_http.MetricsHttpServer(
+        plane.metrics, "w0", query_handler=plane.handle
+    ) as srv:
+        with _post(srv.address, REQ) as r:
+            assert r.status == 200
+            http_resp = r.read()
+
+    bs = BridgeServer(port=0).start()
+    bs.install_serve(plane)
+    try:
+        cl = BridgeClient("127.0.0.1", bs.address[1])
+        bridge_resp = cl.query(REQ)
+        cl.close()
+    finally:
+        bs.close()
+
+    assert tcp_resp == want
+    assert http_resp == want
+    assert bridge_resp == want
+
+
+def test_sim_surface_matches_too():
+    from antidote_ccrdt_tpu.net.sim import SimNet
+
+    plane = _frozen_plane()
+    want = plane.handle(REQ)
+    net = SimNet(seed=3)
+    a, b = net.join("a"), net.join("b")
+    b.install_serve(plane)
+    a.query("b", REQ)
+    net.advance(1.0)
+    assert a.query_resps == [("b", want)]
+
+
+def test_tcp_surface_no_plane_degrades():
+    t = TcpTransport("w9")
+    try:
+        member, resp = query_peer(t.address, REQ, timeout=5.0)
+        assert member == "w9"
+        assert json.loads(resp.decode())["error"] == "no serve plane"
+    finally:
+        t.close()
+
+
+def test_tcp_surface_fault_closes_never_hangs():
+    plane = _frozen_plane()
+    t = TcpTransport("w0")
+    t.install_serve(plane)
+    try:
+        faults.install(
+            {"serve.query": [{"action": "raise", "at": [0]}]}, seed=7
+        )
+        with pytest.raises((ConnectionError, OSError)):
+            query_peer(t.address, REQ, timeout=2.0)
+        # The fault budget is spent: the next query serves normally.
+        member, resp = query_peer(t.address, REQ, timeout=5.0)
+        assert member == "w0" and b"results" in resp
+    finally:
+        t.close()
+
+
+def test_http_surface_fault_500_then_recovers():
+    plane = _frozen_plane()
+    with obs_http.MetricsHttpServer(
+        plane.metrics, "w0", query_handler=plane.handle
+    ) as srv:
+        faults.install(
+            {"serve.query": [{"action": "raise", "at": [0]}]}, seed=7
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(srv.address, REQ)
+        assert ei.value.code == 500
+        with _post(srv.address, REQ) as r:
+            assert r.status == 200 and b"results" in r.read()
+
+
+def test_bridge_surface_fault_errors_then_recovers():
+    plane = _frozen_plane()
+    bs = BridgeServer(port=0).start()
+    bs.install_serve(plane)
+    try:
+        cl = BridgeClient("127.0.0.1", bs.address[1])
+        faults.install(
+            {"serve.query": [{"action": "raise", "at": [0]}]}, seed=7
+        )
+        with pytest.raises(Exception):
+            cl.query(REQ)
+        assert b"results" in cl.query(REQ)
+        cl.close()
+    finally:
+        bs.close()
+
+
+def test_bridge_no_plane_is_an_error_not_a_hang():
+    bs = BridgeServer(port=0).start()
+    try:
+        cl = BridgeClient("127.0.0.1", bs.address[1])
+        with pytest.raises(Exception):
+            cl.query(REQ)
+        cl.close()
+    finally:
+        bs.close()

@@ -23,7 +23,7 @@ PY ?= python
 # meaningful.
 COVER_THRESHOLD ?= 88
 
-.PHONY: all compile test cover typecheck xref native bench benchall dryrun net-demo chaos crash-demo obs-demo topo-demo spans-demo overlap-demo partition-demo serve-demo bench-gate clean
+.PHONY: all compile test cover typecheck xref native bench benchall dryrun net-demo chaos crash-demo obs-demo topo-demo spans-demo overlap-demo partition-demo serve-demo audit-demo bench-gate clean
 
 all: compile xref typecheck cover
 
@@ -152,6 +152,19 @@ partition-demo:
 # sequential reference. Writes SERVE_r01.json.
 serve-demo:
 	env JAX_PLATFORMS=cpu $(PY) scripts/serve_demo.py
+
+# Certified-convergence gate (obs/audit.py): the lattice-law checker
+# over every registered op type (+ the committed broken-merge fixture,
+# which MUST be caught), a seeded-chaos 3-worker TCP fleet whose run is
+# replay-certified from the flight-log spill into a signed convergence
+# certificate (written to AUDIT_r01.json; per-worker digests must match
+# the sequential reference, zero false wedge alarms), and the
+# deterministic divergent arm — watchdog flags within one digest
+# exchange, wedges past the bound, and the failed certificate's
+# counterexample names the diverging partition. Also part of
+# `make chaos` via scripts/chaos_gate.py.
+audit-demo:
+	env JAX_PLATFORMS=cpu $(PY) scripts/audit_demo.py
 
 # Span-tracing demo (slow, real processes): a 3-worker TCP fleet with
 # the round-phase span plane armed (CCRDT_SPANS=1) — every worker's

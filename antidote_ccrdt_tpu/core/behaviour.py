@@ -184,6 +184,7 @@ class Registry:
         self._dense: dict[str, DenseCCRDT] = {}
         self._dense_factory: dict[str, Any] = {}
         self._extra_ops: set[str] = set()
+        self._law_fixture: dict[str, Any] = {}
 
     def register(
         self,
@@ -192,6 +193,7 @@ class Registry:
         dense: Optional[DenseCCRDT] = None,
         dense_factory: Optional[Any] = None,
         generates_extra_operations: bool = False,
+        law_fixture: Optional[Any] = None,
     ) -> None:
         if scalar is not None:
             self._scalar[name] = scalar
@@ -201,6 +203,8 @@ class Registry:
             self._dense_factory[name] = dense_factory
         if generates_extra_operations:
             self._extra_ops.add(name)
+        if law_fixture is not None:
+            self._law_fixture[name] = law_fixture
 
     def is_type(self, name: Any) -> bool:
         return isinstance(name, str) and (
@@ -228,6 +232,20 @@ class Registry:
 
     def dense_types(self) -> Iterable[str]:
         return set(self._dense) | set(self._dense_factory)
+
+    # -- lattice-law audit hooks (obs/audit.py LawChecker) -----------------
+    # A law fixture is `fn(seed, n) -> {"dense": engine, "states": [A, B,
+    # C], "chain": (prev, cur) | None}` generating REACHABLE batched
+    # states (a [1, n] instance grid built from real op applications) for
+    # the merge/delta law checker in ops/laws.py. Types without a fixture
+    # are reported as unaudited, so a new type can't silently skip the
+    # certification gate.
+
+    def law_fixture(self, name: str) -> Optional[Any]:
+        return self._law_fixture.get(name)
+
+    def law_fixtures(self) -> dict[str, Any]:
+        return dict(self._law_fixture)
 
 
 registry = Registry()

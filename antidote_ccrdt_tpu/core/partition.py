@@ -167,6 +167,50 @@ def divergent_parts(a: Any, b: Any) -> List[int]:
     return [int(i) for i in np.nonzero(av != bv)[0]]
 
 
+class DigestSampler:
+    """Bounded-cadence memo of `state_digests` for the audit plane.
+
+    `state_digests` is a host-side crc sweep over every leaf — cheap at
+    anchor cadence, not at per-round watchdog cadence. The sampler
+    memoizes the vector keyed on the caller's own progress seq (the
+    publisher seq: the state cannot have changed without it advancing)
+    and, when no seq is available, rate-limits recomputation to
+    `min_interval_s` on the monotonic clock. The staleness this trades
+    is exactly one publish interval — the same freshness the digest a
+    PEER fetched has, so watchdog comparisons stay apples-to-apples."""
+
+    def __init__(
+        self, P: Optional[int] = None, min_interval_s: float = 0.25,
+        mono: Any = None,
+    ) -> None:
+        import time
+
+        self.P = P if P else n_partitions()
+        self.min_interval_s = float(min_interval_s)
+        self._mono = mono if mono is not None else time.monotonic
+        self._seq: Optional[int] = None
+        self._at: float = float("-inf")
+        self._vec: Optional[np.ndarray] = None
+        self.computes = 0  # recomputation count (bench: sampling cost)
+
+    def sample(self, state: Any, seq: Optional[int] = None) -> np.ndarray:
+        now = self._mono()
+        if self._vec is not None:
+            if seq is not None and seq == self._seq:
+                return self._vec
+            if seq is None and now - self._at < self.min_interval_s:
+                return self._vec
+        self._vec = state_digests(state, self.P)
+        self._seq, self._at = seq, now
+        self.computes += 1
+        return self._vec
+
+    def invalidate(self) -> None:
+        """Force the next `sample` to recompute (e.g. after applying a
+        repair outside the seq axis)."""
+        self._seq, self._at, self._vec = None, float("-inf"), None
+
+
 # --- partition-restricted partial snapshots (psnaps) -----------------------
 
 

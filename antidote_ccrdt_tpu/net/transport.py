@@ -555,6 +555,10 @@ class GossipNode:
             seq, vec = pt.decode_digest_blob(blob)
         except Exception:  # noqa: BLE001 — total, same policy as fetch
             return None
+        # The audit watchdog rides these fetches (one observe_peer per
+        # successful digest exchange) — count them so the chaos gate can
+        # prove the watchdog's feed never silently goes dark.
+        self.metrics.count("net.dig_fetches")
         return seq, vec
 
     def fetch_psnap(

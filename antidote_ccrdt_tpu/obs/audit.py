@@ -1,0 +1,648 @@
+"""The certified-convergence plane: lattice-law auditing, flight-log
+replay certification, and a live divergence watchdog.
+
+Three verdict surfaces over the same machinery the fleet already runs:
+
+* **LawChecker** — machine-checks merge commutativity/associativity/
+  idempotence and the delta-composition law for every op type on the
+  registry (batched on-device; kernels + reachable-state fixtures live
+  in `ops/laws.py`). A type without a registered fixture is reported as
+  unaudited, never silently skipped.
+
+* **certify / verify_certificate** — replay certification of a real
+  run: consume the ``(origin, dseq)`` flight-recorder spill
+  (`obs.events.scan_dir`), audit causal delivery per process
+  incarnation, reconcile published-vs-covered op counts per
+  (applier, origin) pair, compare per-worker partition-digest vectors
+  (`obs.lag.digest_agreement`) and optionally a sequential reference —
+  then emit a signed-digest *convergence certificate* (sha256 over the
+  canonical JSON body), or a minimal counterexample slice naming the
+  divergent partitions when certification fails.
+
+* **DivergenceWatchdog** — rides the per-partition digest vectors the
+  partial anti-entropy tier already exchanges (`PartialAntiEntropy`
+  feeds `observe_peer` on every digest fetch): per-peer divergence
+  state machine (ok → diverged → wedged), time-to-agreement samples,
+  and a wedged-divergence alarm when digests disagree AND no repair
+  progress lands within the bound. Gauges/counters ride the ordinary
+  `utils.metrics.Metrics` object, so all three scrape surfaces (HTTP,
+  in-band frame, bridge op) export them for free; `health_fields()`
+  extends ``/healthz`` via the never-fatal `health_extra` probe.
+
+Module discipline: top-level imports are stdlib + the stdlib-only obs
+siblings, so `scripts/ccrdt_trace.py` (and any cold CLI) can import the
+causal auditor without paying for jax; `LawChecker.run` pulls
+`ops.laws` lazily.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from . import events as obs_events
+from .lag import digest_agreement
+
+CERTIFICATE_KIND = "ccrdt-convergence-certificate"
+CERTIFICATE_VERSION = 1
+
+
+# -- causal apply-order audit ------------------------------------------------
+# Canonical home of the auditor scripts/ccrdt_trace.py `audit` exposes
+# (the CLI imports it from here); kept stdlib-only on purpose.
+
+
+def audit_apply_order(
+    logs: Dict[str, List[Dict[str, Any]]]
+) -> List[Dict[str, Any]]:
+    """Causal-order violations in the apply streams, one row each.
+
+    Within ONE flight log (= one process incarnation) the `delta.apply`
+    events for a given origin must carry contiguous ascending dseqs:
+    `sweep_deltas` only emits the event after advancing its cursor by
+    exactly one; a `snap.apply` at step S or a partial resync
+    (`psnap.resync` at dig_seq S) are the only legitimate jumps (the
+    cursor resumes from max(cur, S)). The baseline is the
+    FIRST dseq seen in the log, not 0 — the ring truncates and a worker
+    may join mid-stream, so absolute position proves nothing; ordering
+    within the log does. Events replay in the recorder's own `seq`
+    order (per-process lamport axis), so wall-clock skew cannot
+    manufacture violations. A `gap-skip` (dseq jumped past cur+1 with no
+    snapshot) means ops were silently lost; a `double-apply` (dseq at or
+    below the cursor) means the cursor went backwards. Different
+    incarnations of the same member audit independently: recovery
+    legitimately re-applies."""
+    violations: List[Dict[str, Any]] = []
+    for fname, evs in sorted(logs.items()):
+        applier = next(
+            (str(e["member"]) for e in evs if e.get("member")), fname
+        )
+        ordered = sorted(
+            (
+                e for e in evs
+                if e.get("kind") in ("delta.apply", "snap.apply",
+                                     "psnap.resync")
+                and e.get("origin") is not None
+            ),
+            key=lambda e: int(e.get("seq", 0)),
+        )
+        cur: Dict[str, int] = {}
+        for ev in ordered:
+            origin = str(ev["origin"])
+            if ev["kind"] in ("snap.apply", "psnap.resync"):
+                s = ev.get("step") if ev["kind"] == "snap.apply" \
+                    else ev.get("dig_seq")
+                if s is not None:
+                    prev = cur.get(origin)
+                    cur[origin] = int(s) if prev is None else max(prev, int(s))
+                continue
+            d = ev.get("dseq")
+            if d is None:
+                continue
+            d = int(d)
+            prev = cur.get(origin)
+            if prev is None or d == prev + 1:
+                cur[origin] = d
+                continue
+            violations.append(
+                {
+                    "log": fname,
+                    "applier": applier,
+                    "origin": origin,
+                    "kind": "double-apply" if d <= prev else "gap-skip",
+                    "prev_dseq": prev,
+                    "dseq": d,
+                    "seq": int(ev.get("seq", -1)),
+                }
+            )
+            cur[origin] = max(prev, d)
+    return violations
+
+
+# -- op-count reconciliation -------------------------------------------------
+
+
+def reconcile_op_counts(
+    logs: Dict[str, List[Dict[str, Any]]]
+) -> Dict[str, Any]:
+    """Published-vs-covered reconciliation over a QUIESCED run's spill.
+
+    For every origin with `delta.publish` events, each OTHER member's
+    final coverage — the max of its applied dseqs, `snap.apply` steps,
+    and partial-resync digest seqs (all on the publisher's one seq axis)
+    — must reach the origin's highest published dseq. A member below
+    that watermark at end of run has silently lost ops (the causal audit
+    catches mis-ordering; this catches truncation). Members are judged
+    on the union of their incarnations, so a recovered worker's
+    coverage carries across its restart."""
+    published: Dict[str, List[int]] = {}
+    for evs in logs.values():
+        for e in evs:
+            if e.get("kind") == "delta.publish" and e.get("dseq") is not None:
+                o = str(e.get("origin") or e.get("member") or "?")
+                published.setdefault(o, []).append(int(e["dseq"]))
+
+    coverage: Dict[str, Dict[str, int]] = {}
+    applied_n: Dict[str, Dict[str, int]] = {}
+    for fname, evs in sorted(logs.items()):
+        member = next(
+            (str(e["member"]) for e in evs if e.get("member")), fname
+        )
+        cov = coverage.setdefault(member, {})
+        nap = applied_n.setdefault(member, {})
+        for e in sorted(evs, key=lambda e: int(e.get("seq", 0))):
+            kind, origin = e.get("kind"), e.get("origin")
+            if origin is None:
+                continue
+            o = str(origin)
+            if kind == "delta.apply" and e.get("dseq") is not None:
+                cov[o] = max(cov.get(o, -1), int(e["dseq"]))
+                nap[o] = nap.get(o, 0) + 1
+            elif kind == "snap.apply" and e.get("step") is not None:
+                cov[o] = max(cov.get(o, -1), int(e["step"]))
+            elif kind == "psnap.resync" and e.get("dig_seq") is not None:
+                cov[o] = max(cov.get(o, -1), int(e["dig_seq"]))
+
+    uncovered: List[Dict[str, Any]] = []
+    pairs = 0
+    for origin, seqs in sorted(published.items()):
+        want = max(seqs)
+        for member, cov in sorted(coverage.items()):
+            if member == origin:
+                continue
+            pairs += 1
+            have = cov.get(origin, -1)
+            if have < want:
+                uncovered.append(
+                    {
+                        "applier": member,
+                        "origin": origin,
+                        "covered_through": have,
+                        "published_through": want,
+                        "applied": applied_n.get(member, {}).get(origin, 0),
+                    }
+                )
+    return {
+        "ok": not uncovered,
+        "origins": {
+            o: {"published": len(s), "max_dseq": max(s)}
+            for o, s in sorted(published.items())
+        },
+        "pairs_checked": pairs,
+        "uncovered": uncovered,
+    }
+
+
+# -- convergence certificates ------------------------------------------------
+
+
+def _digest_key(d: Any) -> Any:
+    if d is None:
+        return None
+    if isinstance(d, (list, tuple)) or hasattr(d, "__len__"):
+        return tuple(int(x) for x in d)
+    return int(d)
+
+
+def _digest_label(d: Any) -> Optional[str]:
+    k = _digest_key(d)
+    if k is None:
+        return None
+    if isinstance(k, tuple):
+        return "-".join("%08x" % e for e in k)
+    return "%08x" % k
+
+
+def _canonical(body: Dict[str, Any]) -> bytes:
+    return json.dumps(
+        body, sort_keys=True, separators=(",", ":"), default=str
+    ).encode()
+
+
+def sign_certificate(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """Stamp `signature` = sha256 over the canonical JSON of everything
+    else. Not cryptographic authentication (no key) — a tamper-evident
+    content digest, the same trust model as the repo's crc32 state
+    digests but collision-resistant enough to archive."""
+    body = {k: v for k, v in doc.items() if k != "signature"}
+    doc["signature"] = hashlib.sha256(_canonical(body)).hexdigest()
+    return doc
+
+
+def verify_certificate(doc: Dict[str, Any]) -> bool:
+    sig = doc.get("signature")
+    if not isinstance(sig, str):
+        return False
+    body = {k: v for k, v in doc.items() if k != "signature"}
+    return hashlib.sha256(_canonical(body)).hexdigest() == sig
+
+
+def _counterexample(
+    causal: List[Dict[str, Any]],
+    recon: Dict[str, Any],
+    agreement: Optional[Dict[str, Any]],
+    reference: Optional[Dict[str, Any]],
+) -> Dict[str, Any]:
+    """The minimal slice an operator needs to localize the failure:
+    WHICH partitions split, WHICH member groups hold which digest, the
+    first causal violations, the first uncovered (applier, origin)
+    ranges."""
+    out: Dict[str, Any] = {}
+    if agreement is not None and not agreement.get("agree", True):
+        out["divergent_parts"] = agreement.get("divergent_parts", [])
+        out["digest_groups"] = agreement.get("groups", {})
+    if reference is not None and not reference.get("ok", True):
+        out["reference_mismatch"] = reference.get("mismatched", {})
+    if causal:
+        out["causal_violations"] = causal[:5]
+    if recon.get("uncovered"):
+        out["uncovered"] = recon["uncovered"][:5]
+    return out
+
+
+def certify(
+    obs_dir: Optional[str] = None,
+    logs: Optional[Dict[str, List[Dict[str, Any]]]] = None,
+    digests: Optional[Dict[str, Any]] = None,
+    reference: Any = None,
+    meta: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Replay-certify a finished run into a signed convergence
+    certificate.
+
+    Inputs: the flight-log spill (`obs_dir` or a pre-scanned `logs`
+    dict), per-worker final digests (scalar or per-partition vectors),
+    and optionally the sequential-reference digest the fleet must match.
+    The certificate's `ok` is the conjunction of every check it could
+    run; a check with no evidence (no digests, no reference) is absent,
+    not vacuously true. On failure the doc gains a `counterexample`
+    slice naming the divergent partitions / members / seq ranges."""
+    if logs is None:
+        logs = obs_events.scan_dir(obs_dir) if obs_dir else {}
+    causal = audit_apply_order(logs)
+    recon = reconcile_op_counts(logs)
+    agreement = digest_agreement(digests) if digests else None
+    reference_section: Optional[Dict[str, Any]] = None
+    if reference is not None and digests:
+        ref_key = _digest_key(reference)
+        mismatched = {
+            m: _digest_label(d)
+            for m, d in sorted(digests.items())
+            if _digest_key(d) != ref_key
+        }
+        reference_section = {
+            "ok": not mismatched,
+            "reference": _digest_label(reference),
+            "mismatched": mismatched,
+        }
+
+    checks: Dict[str, bool] = {
+        "causal_delivery": not causal,
+        "op_count_reconciliation": bool(recon["ok"]),
+    }
+    if agreement is not None:
+        checks["partition_digest_agreement"] = bool(agreement["agree"])
+    if reference_section is not None:
+        checks["matches_reference"] = bool(reference_section["ok"])
+    ok = all(checks.values())
+
+    doc: Dict[str, Any] = {
+        "kind": CERTIFICATE_KIND,
+        "version": CERTIFICATE_VERSION,
+        "t": round(time.time(), 3),
+        "ok": ok,
+        "checks": checks,
+        "worker_digests": (
+            {m: _digest_label(d) for m, d in sorted(digests.items())}
+            if digests else {}
+        ),
+        "causal": {
+            "ok": not causal,
+            "n_violations": len(causal),
+            "violations": causal[:16],
+        },
+        "reconciliation": recon,
+        "agreement": agreement,
+        "reference": reference_section,
+        "n_flight_logs": len(logs),
+        "meta": meta or {},
+    }
+    if not ok:
+        doc["counterexample"] = _counterexample(
+            causal, recon, agreement, reference_section
+        )
+    sign_certificate(doc)
+    obs_events.emit(
+        "audit.certificate", ok=ok,
+        signature=doc["signature"][:16],
+        divergent_parts=(
+            doc.get("counterexample", {}).get("divergent_parts", [])
+        ),
+    )
+    return doc
+
+
+# -- lattice-law checking ----------------------------------------------------
+
+
+class LawChecker:
+    """Run the merge/delta law suite for every registered dense type.
+
+    Fixtures come from the registry (`Registry.law_fixture`), so each
+    type supplies its own reachable-state generator; `extra_fixtures`
+    lets a caller inject unregistered ones — the negative selftest
+    (`ops.laws.broken_merge_fixture`) enters that way and MUST fail.
+    `pairs` is the instance-grid width: one merge dispatch checks that
+    many instance pairs. Types on the registry with no fixture land in
+    `unaudited` and flip `ok` False — a new type cannot silently skip
+    the gate."""
+
+    def __init__(
+        self,
+        types: Optional[Sequence[str]] = None,
+        seed: int = 0,
+        pairs: int = 512,
+        extra_fixtures: Optional[Dict[str, Callable[..., Any]]] = None,
+        metrics: Any = None,
+    ) -> None:
+        self.types = list(types) if types is not None else None
+        self.seed = int(seed)
+        self.pairs = max(1, int(pairs))
+        self.extra_fixtures = dict(extra_fixtures or {})
+        self.metrics = metrics
+
+    def run(self) -> Dict[str, Any]:
+        from ..core.behaviour import registry
+        from ..ops import laws  # lazy: pulls jax + registers fixtures
+
+        wanted = (
+            set(self.types) if self.types is not None
+            else set(registry.dense_types()) | set(self.extra_fixtures)
+        )
+        fixtures: Dict[str, Any] = {
+            name: fx
+            for name, fx in registry.law_fixtures().items()
+            if name in wanted
+        }
+        fixtures.update(
+            (n, f) for n, f in self.extra_fixtures.items() if n in wanted
+        )
+        unaudited = sorted(wanted - set(fixtures))
+
+        types_out: Dict[str, Any] = {}
+        n_checks = n_failures = 0
+        for name in sorted(fixtures):
+            spec = fixtures[name](self.seed, self.pairs)
+            rep = laws.check_engine_laws(
+                spec["dense"], spec["states"], spec.get("chain")
+            )
+            types_out[name] = rep
+            n_checks += len(rep["laws"])
+            n_failures += sum(
+                1 for e in rep["laws"].values() if not e["ok"]
+            )
+        report = {
+            "ok": not unaudited and all(r["ok"] for r in types_out.values()),
+            "pairs": self.pairs,
+            "seed": self.seed,
+            "n_types": len(types_out),
+            "n_law_checks": n_checks,
+            "n_law_failures": n_failures,
+            "unaudited": unaudited,
+            "types": types_out,
+        }
+        if self.metrics is not None:
+            self.metrics.count("audit.law_checks", float(n_checks))
+            if n_failures:
+                self.metrics.count("audit.law_failures", float(n_failures))
+        obs_events.emit(
+            "audit.laws", ok=report["ok"], n_types=len(types_out),
+            n_checks=n_checks, n_failures=n_failures,
+            unaudited=unaudited,
+        )
+        return report
+
+
+# -- live divergence watchdog ------------------------------------------------
+
+
+def _div_parts(own: Any, peer: Any) -> List[int]:
+    """Indices where two digest vectors disagree (scalar digests compare
+    as 1-vectors; incomparable lengths flag every index)."""
+    a = list(own) if hasattr(own, "__len__") else [own]
+    b = list(peer) if hasattr(peer, "__len__") else [peer]
+    if len(a) != len(b):
+        return list(range(max(len(a), len(b))))
+    return [i for i in range(len(a)) if int(a[i]) != int(b[i])]
+
+
+class DivergenceWatchdog:
+    """Per-peer divergence state machine over the digest vectors the
+    partial anti-entropy tier already fetches.
+
+    States: 0 ok, 1 diverged, 2 wedged. A peer enters `diverged` the
+    first observation its vector disagrees with ours — i.e. within one
+    digest-exchange round of the divergence existing. Divergence is
+    NORMAL in steady state (ops in flight); the alarm condition is
+    *wedged*: still diverged after `wedge_after_s` seconds with no
+    repair progress (progress = the divergent set shrinking, or the
+    anti-entropy tier reporting applied psnaps via
+    `note_repair_progress`). Agreement closes the episode and records a
+    time-to-agreement sample.
+
+    Everything is monotonic-clock based (injectable for tests); gauges
+    and counters land on the supplied `Metrics` so the existing scrape
+    surfaces export them; transitions emit `audit.*` flight events."""
+
+    STATE_OK, STATE_DIVERGED, STATE_WEDGED = 0, 1, 2
+    _STATE_NAMES = {0: "ok", 1: "diverged", 2: "wedged"}
+
+    def __init__(
+        self,
+        member: str,
+        wedge_after_s: float = 5.0,
+        mono: Callable[[], float] = time.monotonic,
+        metrics: Any = None,
+        max_tta_samples: int = 256,
+    ) -> None:
+        self.member = member
+        self.wedge_after_s = float(wedge_after_s)
+        self._mono = mono
+        self.metrics = metrics
+        self._max_tta = max(1, int(max_tta_samples))
+        # peer -> {"state", "since", "progress", "parts", "seq"}
+        self._peers: Dict[str, Dict[str, Any]] = {}
+        self._tta: List[float] = []
+        self.last_certificate: Optional[Dict[str, Any]] = None
+
+    # -- feeding ----------------------------------------------------------
+
+    def observe_peer(
+        self, peer: str, own_vec: Any, peer_vec: Any,
+        seq: Optional[int] = None,
+    ) -> int:
+        """One digest exchange with `peer`: compare vectors, advance the
+        state machine, export gauges. Returns the peer's state."""
+        now = self._mono()
+        div = _div_parts(own_vec, peer_vec)
+        rec = self._peers.get(peer)
+        if div:
+            if rec is None or rec["state"] == self.STATE_OK:
+                rec = {
+                    "state": self.STATE_DIVERGED, "since": now,
+                    "progress": now, "parts": div, "seq": seq,
+                }
+                self._peers[peer] = rec
+                self._count("audit.divergences")
+                obs_events.emit(
+                    "audit.divergence", peer=peer, parts=div, dig_seq=seq,
+                )
+            else:
+                if set(div) < set(rec["parts"]):
+                    # Strictly shrinking divergence = repair landing.
+                    rec["progress"] = now
+                rec["parts"], rec["seq"] = div, seq
+                if (
+                    rec["state"] == self.STATE_DIVERGED
+                    and now - rec["progress"] > self.wedge_after_s
+                ):
+                    rec["state"] = self.STATE_WEDGED
+                    self._count("audit.wedge_alarms")
+                    obs_events.emit(
+                        "audit.wedged", peer=peer, parts=div,
+                        age_s=round(now - rec["since"], 3), dig_seq=seq,
+                    )
+        else:
+            if rec is not None and rec["state"] != self.STATE_OK:
+                tta = now - rec["since"]
+                self._tta.append(tta)
+                del self._tta[: -self._max_tta]
+                self._count("audit.agreements")
+                obs_events.emit(
+                    "audit.agreement", peer=peer,
+                    tta_s=round(tta, 6), dig_seq=seq,
+                )
+            self._peers[peer] = {
+                "state": self.STATE_OK, "since": now, "progress": now,
+                "parts": [], "seq": seq,
+            }
+        self._export()
+        return self._peers[peer]["state"]
+
+    def note_repair_progress(self, peer: str) -> None:
+        """The anti-entropy tier applied repair payloads for `peer` —
+        resets the wedge clock (a slow-but-moving repair is not wedged)."""
+        rec = self._peers.get(peer)
+        if rec is not None:
+            rec["progress"] = self._mono()
+
+    def drop(self, peer: str) -> None:
+        """Forget a DEAD peer (SWIM verdict): its frozen digest vector
+        must not age into a phantom wedge alarm."""
+        self._peers.pop(peer, None)
+        self._export()
+
+    def note_certificate(self, cert: Dict[str, Any]) -> None:
+        """Record the last convergence certificate for the health/status
+        surfaces."""
+        self.last_certificate = {
+            "ok": bool(cert.get("ok")),
+            "signature": str(cert.get("signature", ""))[:16],
+            "t": cert.get("t"),
+        }
+        if self.metrics is not None:
+            self.metrics.set(
+                "audit.certificate_ok", 1.0 if cert.get("ok") else 0.0
+            )
+
+    # -- reading ----------------------------------------------------------
+
+    def state(self) -> int:
+        return max(
+            (r["state"] for r in self._peers.values()),
+            default=self.STATE_OK,
+        )
+
+    def divergence_age_s(self) -> float:
+        now = self._mono()
+        return max(
+            (
+                now - r["since"] for r in self._peers.values()
+                if r["state"] != self.STATE_OK
+            ),
+            default=0.0,
+        )
+
+    def divergent_parts(self) -> List[int]:
+        parts: set = set()
+        for r in self._peers.values():
+            if r["state"] != self.STATE_OK:
+                parts.update(r["parts"])
+        return sorted(parts)
+
+    def tta_p50_s(self) -> Optional[float]:
+        if not self._tta:
+            return None
+        vals = sorted(self._tta)
+        return vals[(len(vals) - 1) // 2]
+
+    def peers(self) -> Dict[str, Dict[str, Any]]:
+        return {
+            p: {
+                "state": self._STATE_NAMES[r["state"]],
+                "parts": list(r["parts"]),
+                "dig_seq": r["seq"],
+            }
+            for p, r in sorted(self._peers.items())
+        }
+
+    # -- exporting --------------------------------------------------------
+
+    def _count(self, name: str) -> None:
+        if self.metrics is not None:
+            self.metrics.count(name)
+
+    def _export(self) -> None:
+        if self.metrics is None:
+            return
+        self.metrics.set("audit.watchdog_state", float(self.state()))
+        self.metrics.set(
+            "audit.divergence_age_seconds", round(self.divergence_age_s(), 6)
+        )
+        p50 = self.tta_p50_s()
+        if p50 is not None:
+            self.metrics.set("audit.tta_p50_seconds", round(p50, 6))
+
+    def health_fields(self) -> Dict[str, Any]:
+        """/healthz verdict fields (merged via the never-fatal
+        `health_extra` probe in obs/http.py)."""
+        out: Dict[str, Any] = {
+            "audit_watchdog_state": self._STATE_NAMES[self.state()],
+            "audit_divergence_age_s": round(self.divergence_age_s(), 3),
+            "audit_divergent_parts": self.divergent_parts(),
+        }
+        p50 = self.tta_p50_s()
+        if p50 is not None:
+            out["audit_tta_p50_ms"] = round(1000.0 * p50, 3)
+        if self.last_certificate is not None:
+            out["audit_last_certificate"] = dict(self.last_certificate)
+        return out
+
+    def status_fields(self) -> Dict[str, Any]:
+        """Compact block for the per-worker status drops the dashboard
+        scrapes (obs-<member>.json)."""
+        p50 = self.tta_p50_s()
+        return {
+            "state": self._STATE_NAMES[self.state()],
+            "age_s": round(self.divergence_age_s(), 3),
+            "tta_p50_ms": (
+                round(1000.0 * p50, 3) if p50 is not None else None
+            ),
+            "ttas": len(self._tta),
+            "cert_ok": (
+                None if self.last_certificate is None
+                else self.last_certificate["ok"]
+            ),
+        }

@@ -1,0 +1,373 @@
+"""Batched lattice-law kernels + per-type law fixtures (the audit plane's
+compute tier).
+
+Certified-MRDT-style machine checking (PAPERS.md: arxiv 2203.14518) of the
+algebraic laws every replication mechanism in this repo leans on:
+
+* merge commutativity + associativity for EVERY registered dense type;
+* merge idempotence for JOIN types (MONOID states are deltas — merging a
+  delta with itself legitimately double-counts, so idempotence is not a
+  law there; the gossip tier ships monoid state through the versioned
+  `MonoidLift` rows instead);
+* delta composition: ``apply_any_delta(dense, prev, make_delta(dense,
+  prev, cur)) == cur`` for a chained (prev, cur) pair — the exact
+  invariant `sweep_deltas` relies on when it chains a peer's delta
+  stream.
+
+Batching: a fixture generates states with a [1, n] instance grid (each
+key cell an independently-reached instance), so one ``merge`` dispatch
+checks n instance pairs and one tree-compare dispatch reduces them —
+checking thousands of pairs costs a handful of XLA dispatches, not
+thousands of Python loops.
+
+Fixtures are registered on the central type registry
+(`core.behaviour.Registry.register(law_fixture=...)`) so new types can
+ship their own reachable-state generators; this module registers
+generators for the six built-in types at import time. States MUST come
+from real op applications — random leaf noise would violate engine
+invariants (sorted slots, masked sets) and fail laws that in fact hold
+on every reachable state.
+
+`BrokenMergeDense` is the committed negative fixture: a deliberately
+non-commutative merge the checker must flag (the audit CLI's
+``laws --selftest`` and tests/test_audit.py both require it to FAIL).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.behaviour import MergeKind, registry
+
+
+# -- batched tree comparison -------------------------------------------------
+
+
+@jax.jit
+def _tree_eq(a: Any, b: Any) -> jax.Array:
+    eqs = [
+        jnp.all(x == y)
+        for x, y in zip(
+            jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+        )
+    ]
+    if not eqs:
+        return jnp.asarray(True)
+    return jnp.all(jnp.stack(eqs))
+
+
+def tree_equal(a: Any, b: Any) -> bool:
+    """Exact leaf-wise equality of two identically-shaped pytrees, reduced
+    on device to one scalar."""
+    return bool(_tree_eq(a, b))
+
+
+def instance_mismatch(a: Any, b: Any) -> np.ndarray:
+    """bool [R, NK] per-instance mismatch mask: every leaf reduced over
+    its trailing axes onto the leading instance grid (leaves without the
+    grid — there are none on DenseCCRDT states, but fixtures may carry
+    them — broadcast into every cell)."""
+    leaves_a = jax.tree_util.tree_leaves(a)
+    leaves_b = jax.tree_util.tree_leaves(b)
+    grid: Optional[Tuple[int, int]] = next(
+        (tuple(x.shape[:2]) for x in leaves_a if getattr(x, "ndim", 0) >= 2),
+        None,
+    )
+    if grid is None:
+        ne = any(not bool(jnp.all(x == y)) for x, y in zip(leaves_a, leaves_b))
+        return np.asarray([[ne]])
+    mask = np.zeros(grid, bool)
+    for x, y in zip(leaves_a, leaves_b):
+        ne = np.asarray(x != y)
+        if ne.ndim >= 2 and ne.shape[:2] == grid:
+            mask |= ne.reshape(grid[0], grid[1], -1).any(axis=-1)
+        elif ne.any():
+            mask |= True
+    return mask
+
+
+# -- law checking ------------------------------------------------------------
+
+
+def check_engine_laws(
+    dense: Any, states: List[Any], chain: Optional[Tuple[Any, Any]] = None
+) -> Dict[str, Any]:
+    """Machine-check the merge laws for one engine on >= 3 batched states.
+
+    The verdict uses the engine's OWN equality (`dense.equal`) when it
+    has one — topk_rmv's slot planes are canonical up to the engine's
+    equality, not bit order — and exact tree equality otherwise. The
+    per-instance failure count (for counterexamples) always comes from
+    the tree mismatch mask, so a failing law names the first bad
+    (replica, key) cell."""
+    a, b, c = states[0], states[1], states[2]
+    merge = jax.jit(dense.merge)
+    eng_eq = getattr(dense, "equal", None)
+
+    def equal(x: Any, y: Any) -> bool:
+        return bool(eng_eq(x, y)) if eng_eq is not None else tree_equal(x, y)
+
+    ab = merge(a, b)
+    pairs: Dict[str, Tuple[Any, Any]] = {
+        "commutativity": (ab, merge(b, a)),
+        "associativity": (merge(ab, c), merge(a, merge(b, c))),
+    }
+    if dense.merge_kind == MergeKind.JOIN:
+        pairs["idempotence"] = (merge(a, a), a)
+    if chain is not None:
+        from ..parallel.delta import apply_any_delta, make_delta
+
+        prev, cur = chain
+        pairs["delta_composition"] = (
+            apply_any_delta(dense, prev, make_delta(dense, prev, cur)), cur
+        )
+
+    n_instances = int(np.prod(
+        jax.tree_util.tree_leaves(a)[0].shape[:2]
+    ))
+    laws: Dict[str, Any] = {}
+    for law, (x, y) in pairs.items():
+        ok = equal(x, y)
+        entry: Dict[str, Any] = {"ok": ok, "instances": n_instances}
+        if not ok:
+            mask = instance_mismatch(x, y)
+            bad = np.argwhere(mask)
+            entry["failed_instances"] = int(mask.sum())
+            if len(bad):
+                entry["first_failure_rk"] = [int(v) for v in bad[0]]
+        laws[law] = entry
+    return {
+        "type": getattr(dense, "type_name", type(dense).__name__),
+        "merge_kind": dense.merge_kind.value,
+        "n_instances": n_instances,
+        "laws": laws,
+        "ok": all(e["ok"] for e in laws.values()),
+    }
+
+
+# -- built-in fixtures -------------------------------------------------------
+#
+# fixture(seed, n) -> {"dense": engine, "states": [A, B, C], "chain":
+# (prev, cur) | None}; every state is a [1, n] instance grid built by
+# applying a seeded op batch, so all n pairs are reachable.
+
+
+def _fx_topk(seed: int, n: int) -> Dict[str, Any]:
+    from ..models import topk as tk
+
+    d = tk.make_dense(n_ids=24, size=4)
+
+    def gen(s: int, nb: int = 4) -> Any:
+        rng = np.random.default_rng(1000 * (seed + 1) + s)
+        bsz = nb * n
+        ops = tk.TopkOps(
+            key=jnp.asarray(rng.integers(0, n, bsz).astype(np.int32)[None]),
+            id=jnp.asarray(rng.integers(0, 24, bsz).astype(np.int32)[None]),
+            score=jnp.asarray(
+                rng.integers(1, 500, bsz).astype(np.int32)[None]
+            ),
+            valid=jnp.asarray(np.ones(bsz, bool)[None]),
+        )
+        return ops
+
+    def st(s: int) -> Any:
+        out, _ = d.apply_ops(d.init(1, n), gen(s))
+        return out
+
+    prev = st(0)
+    cur, _ = d.apply_ops(prev, gen(7))
+    return {
+        "dense": d, "states": [st(0), st(1), st(2)], "chain": (prev, cur),
+    }
+
+
+def _fx_leaderboard(seed: int, n: int) -> Dict[str, Any]:
+    from ..models import leaderboard as lb
+
+    d = lb.make_dense(n_players=24, size=4)
+
+    def gen(s: int) -> Any:
+        rng = np.random.default_rng(2000 * (seed + 1) + s)
+        bsz, bb = 4 * n, max(4, n // 2)
+        return lb.LeaderboardOps(
+            add_key=jnp.asarray(rng.integers(0, n, bsz).astype(np.int32)[None]),
+            add_id=jnp.asarray(rng.integers(0, 24, bsz).astype(np.int32)[None]),
+            add_score=jnp.asarray(
+                rng.integers(1, 500, bsz).astype(np.int32)[None]
+            ),
+            add_valid=jnp.asarray(np.ones(bsz, bool)[None]),
+            ban_key=jnp.asarray(rng.integers(0, n, bb).astype(np.int32)[None]),
+            ban_id=jnp.asarray(rng.integers(0, 24, bb).astype(np.int32)[None]),
+            ban_valid=jnp.asarray((rng.random(bb) < 0.5)[None]),
+        )
+
+    def st(s: int) -> Any:
+        out, _ = d.apply_ops(d.init(1, n), gen(s))
+        return out
+
+    prev = st(0)
+    cur, _ = d.apply_ops(prev, gen(7))
+    return {
+        "dense": d, "states": [st(0), st(1), st(2)], "chain": (prev, cur),
+    }
+
+
+def _fx_wordcount(name: str):
+    def fixture(seed: int, n: int) -> Dict[str, Any]:
+        from ..models import wordcount as wc
+
+        d = wc.make_dense(n_buckets=32)
+
+        def gen(s: int) -> Any:
+            rng = np.random.default_rng(3000 * (seed + 1) + s)
+            bsz = 6 * n
+            # Tokens beyond the table (>= 32) exercise the lost-counter
+            # monoid leaf too.
+            return wc.WordcountOps(
+                key=jnp.asarray(
+                    rng.integers(0, n, bsz).astype(np.int32)[None]
+                ),
+                token=jnp.asarray(
+                    rng.integers(0, 40, bsz).astype(np.int32)[None]
+                ),
+            )
+
+        def st(s: int) -> Any:
+            out, _ = d.apply_ops(d.init(1, n), gen(s))
+            return out
+
+        prev = st(0)
+        cur, _ = d.apply_ops(prev, gen(7))
+        return {
+            "dense": d, "states": [st(0), st(1), st(2)],
+            "chain": (prev, cur),
+        }
+
+    return fixture
+
+
+def _fx_average(seed: int, n: int) -> Dict[str, Any]:
+    from ..models.average import AverageDense, AverageOps
+
+    d = AverageDense()
+
+    def gen(s: int) -> Any:
+        rng = np.random.default_rng(4000 * (seed + 1) + s)
+        bsz = 4 * n
+        return AverageOps(
+            key=jnp.asarray(rng.integers(0, n, bsz).astype(np.int32)[None]),
+            value=jnp.asarray(
+                rng.integers(-50, 50, bsz).astype(np.int32)[None]
+            ),
+            count=jnp.asarray(rng.integers(0, 5, bsz).astype(np.int32)[None]),
+        )
+
+    def st(s: int) -> Any:
+        out, _ = d.apply_ops(d.init(1, n), gen(s))
+        return out
+
+    prev = st(0)
+    cur, _ = d.apply_ops(prev, gen(7))
+    return {
+        "dense": d, "states": [st(0), st(1), st(2)], "chain": (prev, cur),
+    }
+
+
+def _fx_topk_rmv(seed: int, n: int) -> Dict[str, Any]:
+    from ..models.topk_rmv_dense import TopkRmvOps, make_dense
+
+    i_, dcs = 16, 3
+    d = make_dense(n_ids=i_, n_dcs=dcs, size=4, slots_per_id=3)
+
+    def gen(s: int) -> Any:
+        rng = np.random.default_rng(5000 * (seed + 1) + s)
+        bsz, br = 4 * n, max(4, n // 2)
+        r_vc = np.zeros((1, br, dcs), np.int32)
+        r_vc[0, :, rng.integers(0, dcs)] = rng.integers(1, 200, br)
+        return TopkRmvOps(
+            add_key=jnp.asarray(rng.integers(0, n, bsz).astype(np.int32)[None]),
+            add_id=jnp.asarray(rng.integers(0, i_, bsz).astype(np.int32)[None]),
+            add_score=jnp.asarray(
+                rng.integers(1, 500, bsz).astype(np.int32)[None]
+            ),
+            add_dc=jnp.asarray(
+                rng.integers(0, dcs, bsz).astype(np.int32)[None]
+            ),
+            add_ts=jnp.asarray(
+                rng.integers(1, 1000, bsz).astype(np.int32)[None]
+            ),
+            rmv_key=jnp.asarray(rng.integers(0, n, br).astype(np.int32)[None]),
+            rmv_id=jnp.asarray(rng.integers(0, i_, br).astype(np.int32)[None]),
+            rmv_vc=jnp.asarray(r_vc),
+        )
+
+    def st(s: int) -> Any:
+        out, _ = d.apply_ops(d.init(1, n), gen(s), collect_dominated=False)
+        return out
+
+    prev = st(0)
+    cur, _ = d.apply_ops(prev, gen(7), collect_dominated=False)
+    return {
+        "dense": d, "states": [st(0), st(1), st(2)], "chain": (prev, cur),
+    }
+
+
+# -- the committed negative fixture ------------------------------------------
+
+
+class BrokenMergeDense:
+    """A deliberately NON-commutative, NON-associative 'engine' whose
+    merge is ``2a - b``. It is idempotent (``2a - a == a``) on purpose:
+    the checker must flag the specific broken laws, not just any law.
+    Never registered on the global registry — it enters a run only via
+    `LawChecker(extra_fixtures=...)` / ``ccrdt_audit.py laws --selftest``."""
+
+    type_name = "broken_merge_fixture"
+    merge_kind = MergeKind.JOIN
+
+    def init(self, n_replicas: int, n_keys: int) -> Dict[str, jax.Array]:
+        return {"x": jnp.zeros((n_replicas, n_keys), jnp.int32)}
+
+    def merge(
+        self, a: Dict[str, jax.Array], b: Dict[str, jax.Array]
+    ) -> Dict[str, jax.Array]:
+        return {"x": 2 * a["x"] - b["x"]}
+
+
+def broken_merge_fixture(seed: int, n: int) -> Dict[str, Any]:
+    d = BrokenMergeDense()
+
+    def st(lo: int, hi: int) -> Dict[str, jax.Array]:
+        rng = np.random.default_rng(6000 * (seed + 1) + lo)
+        # Disjoint value ranges guarantee a != b somewhere, so the
+        # commutativity failure is deterministic, never seed-luck.
+        return {
+            "x": jnp.asarray(rng.integers(lo, hi, (1, n)).astype(np.int32))
+        }
+
+    return {
+        "dense": d,
+        "states": [st(1, 100), st(100, 200), st(200, 300)],
+        "chain": None,
+    }
+
+
+# -- registration ------------------------------------------------------------
+
+_BUILTIN_FIXTURES = {
+    "topk": _fx_topk,
+    "leaderboard": _fx_leaderboard,
+    "wordcount": _fx_wordcount("wordcount"),
+    "worddocumentcount": _fx_wordcount("worddocumentcount"),
+    "average": _fx_average,
+    "topk_rmv": _fx_topk_rmv,
+}
+
+for _name, _fx in _BUILTIN_FIXTURES.items():
+    registry.register(_name, law_fixture=_fx)
+del _name, _fx

@@ -222,7 +222,7 @@ class PartialAntiEntropy:
 
     def __init__(
         self, store: GossipNode, partitions: Optional[int] = None,
-        max_tries: int = 3,
+        max_tries: int = 3, watchdog: Optional[Any] = None,
     ):
         from ..core import partition as pt
 
@@ -233,6 +233,11 @@ class PartialAntiEntropy:
         # member -> consecutive incomplete partial-resync attempts; reset
         # on completion, tripped into full-snap fallback at max_tries.
         self._tries: Dict[str, int] = {}
+        # Optional obs.audit.DivergenceWatchdog: every digest exchange
+        # below feeds it (observe_peer), and applied psnaps reset its
+        # wedge clock (note_repair_progress) — this resync loop IS the
+        # repair whose absence the wedged-divergence alarm detects.
+        self.watchdog = watchdog
 
     def try_resync(
         self, member: str, dense: Any, state: Any, cur: int
@@ -254,11 +259,13 @@ class PartialAntiEntropy:
         own_vec = pt.state_digests(state, P)
         div = pt.divergent_parts(own_vec, peer_vec)
         self.store.metrics.set("part.divergent", float(len(div)))
+        if self.watchdog is not None:
+            self.watchdog.observe_peer(member, own_vec, peer_vec, seq=dig_seq)
         if not div:
             # Full agreement: the peer's anchor adds nothing we lack.
             self.store.metrics.count("net.partition_agree_advances")
             obs_events.emit(
-                "psnap.resync", origin=member, parts=[], seq=dig_seq,
+                "psnap.resync", origin=member, parts=[], dig_seq=dig_seq,
                 fetched=0,
             )
             self._tries.pop(member, None)
@@ -295,11 +302,13 @@ class PartialAntiEntropy:
             p for p in fetch_parts
             if post_vec[p] != peer_vec[p] and p not in repaired_by_seq
         ]
+        if fetched and self.watchdog is not None:
+            self.watchdog.note_repair_progress(member)
         if not outstanding:
             self.store.metrics.count("net.partition_resyncs")
             obs_events.emit(
                 "psnap.resync", origin=member, parts=list(fetch_parts),
-                seq=dig_seq, fetched=fetched,
+                dig_seq=dig_seq, fetched=fetched,
             )
             self._tries.pop(member, None)
             return state, max(cur, dig_seq), True
@@ -494,5 +503,8 @@ def sweep(store: GossipNode, dense: Any, state: Any) -> Tuple[Any, int]:
                 state = dense.merge(state, peer)
         finally:
             obs_spans.end(tok)
+        # Visible to the replay certifier: a full-snapshot fold covers the
+        # origin's stream through _step (obs/audit.py reconcile_op_counts).
+        obs_events.emit("snap.apply", origin=m, step=_step, via="sweep")
         n += 1
     return state, n

@@ -968,6 +968,114 @@ def bench_partition_antientropy(P=8, resync_rounds=4):
     }
 
 
+def bench_audit_overhead(P=8, rounds=12, repeats=3):
+    """Audit-plane overhead microbench (obs/audit.py).
+
+    The same two-node FS-transport publish/sweep round loop run both
+    ways — audit plane dark, then armed (per-round digest-vector
+    sampling via `core.partition.DigestSampler` plus a digest fetch +
+    `DivergenceWatchdog.observe_peer` on the reader, the exact work a
+    certifiable fleet adds to every round) — reporting
+    ``audit_overhead_pct``: the relative wall cost of running certified.
+    Each arm takes the min over `repeats` fresh runs (after 2 warmup
+    rounds per run) so FS jitter does not masquerade as a regression;
+    protocol-bound fixed geometry keeps rounds comparable across
+    backends."""
+    import shutil
+    import tempfile
+
+    from antidote_ccrdt_tpu.core import partition as pt
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import (
+        TopkRmvOps, make_dense,
+    )
+    from antidote_ccrdt_tpu.net.transport import FsTransport, GossipNode
+    from antidote_ccrdt_tpu.obs.audit import DivergenceWatchdog
+    from antidote_ccrdt_tpu.parallel.elastic import (
+        DeltaPublisher, sweep_deltas,
+    )
+
+    import jax.numpy as jnp
+
+    R, NK, I, DCS, K, M, B = 4, 1, 256, 4, 8, 2, 32
+    dense = make_dense(n_ids=I, n_dcs=DCS, size=K, slots_per_id=M)
+
+    def apply_ops(state, step):
+        rng = np.random.default_rng(77_000 + step)
+        z = np.zeros((R, B), np.int32)
+        ops = TopkRmvOps(
+            add_key=jnp.asarray(z),
+            add_id=jnp.asarray(rng.integers(0, I, (R, B)).astype(np.int32)),
+            add_score=jnp.asarray(rng.integers(1, 500, (R, B)).astype(np.int32)),
+            add_dc=jnp.asarray(z),
+            add_ts=jnp.asarray(np.broadcast_to(
+                step * B + np.arange(B) + 1, (R, B)
+            ).astype(np.int32)),
+            rmv_key=jnp.asarray(np.zeros((R, 1), np.int32)),
+            rmv_id=jnp.asarray(np.full((R, 1), -1, np.int32)),
+            rmv_vc=jnp.asarray(np.zeros((R, 1, DCS), np.int32)),
+        )
+        state, _ = dense.apply_ops(state, ops, collect_dominated=False)
+        return state
+
+    def run_arm(audited):
+        root = tempfile.mkdtemp(prefix="ccrdt_audit_bench_")
+        try:
+            a = GossipNode(FsTransport(root, "a"))
+            b = GossipNode(FsTransport(root, "b"))
+            a.heartbeat(), b.heartbeat()
+            pub = DeltaPublisher(
+                a, dense, name="topk_rmv", full_every=1, partitions=P
+            )
+            sampler = pt.DigestSampler(P)
+            wd = DivergenceWatchdog("b", metrics=b.metrics)
+            st_a, st_b, curs = dense.init(R, NK), dense.init(R, NK), {}
+            t_loop, state_wd = 0.0, None
+            for r in range(rounds + 2):  # 2 warmup rounds (jit + fs cache)
+                t0 = time.perf_counter()
+                st_a = apply_ops(st_a, r)
+                pub.publish(st_a)
+                st_b, _ = sweep_deltas(b, dense, st_b, curs)
+                if audited:
+                    got = b.fetch_digests("a")
+                    if got is not None:
+                        dig_seq, peer_vec = got
+                        own = sampler.sample(st_b, seq=dig_seq)
+                        state_wd = wd.observe_peer(
+                            "a", own, peer_vec, seq=dig_seq
+                        )
+                if r >= 2:
+                    t_loop += time.perf_counter() - t0
+            if audited and state_wd != DivergenceWatchdog.STATE_OK:
+                raise RuntimeError(
+                    "audit bench watchdog saw divergence on a clean loop"
+                )
+            if not np.array_equal(
+                pt.state_digests(st_b, P), pt.state_digests(st_a, P)
+            ):
+                raise RuntimeError("audit bench diverged — gossip broken")
+            return t_loop, sampler.computes
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+
+    t_off = t_on = float("inf")
+    computes = 0
+    for _ in range(max(1, repeats)):
+        t_off = min(t_off, run_arm(False)[0])
+    for _ in range(max(1, repeats)):
+        t, computes = run_arm(True)
+        t_on = min(t_on, t)
+    overhead_pct = max(0.0, 100.0 * (t_on - t_off) / max(t_off, 1e-9))
+    return {
+        "partitions": P,
+        "rounds": rounds,
+        "repeats": repeats,
+        "round_ms_plain": round(1e3 * t_off / max(1, rounds), 3),
+        "round_ms_audited": round(1e3 * t_on / max(1, rounds), 3),
+        "audit_overhead_pct": round(overhead_pct, 2),
+        "digest_computes": computes,
+    }
+
+
 def main():
     import jax
 
@@ -1062,6 +1170,10 @@ def main():
     serving = bench_serve(
         frames=5 if os.environ.get("CCRDT_BENCH_TINY") else 400
     )
+    audit_ov = bench_audit_overhead(
+        rounds=4 if os.environ.get("CCRDT_BENCH_TINY") else 12,
+        repeats=1 if os.environ.get("CCRDT_BENCH_TINY") else 3,
+    )
     round_phases = bench_round_phases(
         R, I, D_DCS, K, M, B, Br,
         rounds=3 if (backend == "cpu" or os.environ.get("CCRDT_BENCH_TINY"))
@@ -1099,6 +1211,10 @@ def main():
         # Read-serving plane microbench (bench_serve): same story — fixed
         # frame shape, two gated headline numbers on the summary line.
         "serve": serving,
+        # Audit-plane overhead (bench_audit_overhead): what running
+        # certified costs per gossip round; the gated headline pct rides
+        # the summary line.
+        "audit": audit_ov,
         "dispatch_overhead_ms_p50": round(dispatch_overhead_ms, 2),
         "batch_per_replica_round": f"{B} adds + {Br} rmvs",
         "backend": backend,
@@ -1145,6 +1261,7 @@ def main():
         "rejoin_stream_seconds": antientropy["rejoin_stream_seconds"],
         "serve_reads_per_sec": serving["serve_reads_per_sec"],
         "serve_read_p99_ms": serving["serve_read_p99_ms"],
+        "audit_overhead_pct": audit_ov["audit_overhead_pct"],
         "backend": backend,
         "details_file": "benchmarks/bench_details.json" if sidecar else "stdout",
     }

@@ -1,0 +1,369 @@
+"""Certified-convergence demo/gate (obs/audit.py — the PR-10 tentpole).
+
+Three legs, each a different verdict surface of the audit plane:
+
+* **laws** — the lattice-law property checker over every registered op
+  type (merge commutativity/associativity/idempotence + the
+  delta-composition law, batched on-device), plus the negative
+  selftest: the committed non-commutative fixture
+  (`ops.laws.BrokenMergeDense`) MUST be flagged — a checker that waves
+  a broken merge through is itself broken.
+
+* **healthy** — a seeded-chaos 3-worker REAL-PROCESS TCP fleet
+  (scripts/net_gossip_demo.py: delta gossip, partition plane +
+  divergence watchdog armed, deterministic `tcp.send` drops from
+  utils/faults.py). After convergence the supervisor replay-certifies
+  the run: flight-log spill (causal delivery + op-count
+  reconciliation) + per-worker digests vs the sequential reference →
+  a signed convergence certificate, written to AUDIT_r01.json. The
+  healthy arm must certify OK with ZERO wedge alarms (no false
+  alarms under injected-but-healing faults).
+
+* **divergent** — the fault-injected arm, in-process and fully
+  deterministic: a twin state gets one surgical extra op confined to
+  one known partition (`core.partition.part_of`). The watchdog must
+  flag the divergence on the FIRST digest exchange (within one
+  round), escalate to a wedged alarm once the clock passes the bound
+  with no repair, and close the episode with a time-to-agreement
+  sample when the twin heals. Certification of the divergent digests
+  must FAIL with a counterexample naming the diverging partition.
+
+Run directly (`make audit-demo`) or via scripts/chaos_gate.py, which
+re-runs all three legs and gates on their verdicts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import zlib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from scripts.cover import install_child_cover  # noqa: E402
+
+install_child_cover()  # no-op outside `make cover` runs
+
+N_WORKERS = 3
+PARTITIONS = 8
+SEED = 7
+WORKER_TIMEOUT_S = 240
+
+
+def _crc(digest) -> int:
+    """Canonical scalar digest over an arbitrary JSON-able observable
+    digest (the topk_rmv drill digest is a nested list — the certificate
+    layer compares exact ints, so hash the canonical JSON)."""
+    return zlib.crc32(
+        json.dumps(digest, sort_keys=True).encode("utf-8")
+    ) & 0xFFFFFFFF
+
+
+def run_laws(pairs: int = 32, seed: int = 0) -> dict:
+    """Leg 1: every registered type passes its laws AND the committed
+    broken fixture is caught."""
+    from antidote_ccrdt_tpu.obs import audit as obs_audit
+    from antidote_ccrdt_tpu.ops.laws import broken_merge_fixture
+
+    report = obs_audit.LawChecker(seed=seed, pairs=pairs).run()
+    broken = obs_audit.LawChecker(
+        types=["broken_merge_fixture"], seed=seed, pairs=pairs,
+        extra_fixtures={"broken_merge_fixture": broken_merge_fixture},
+    ).run()
+    laws = broken["types"]["broken_merge_fixture"]["laws"]
+    selftest_caught = (
+        not laws["commutativity"]["ok"]
+        and not laws["associativity"]["ok"]
+        # 2a-b is idempotent (2a-a == a): the checker must report the
+        # laws INDEPENDENTLY, not fail everything wholesale.
+        and laws["idempotence"]["ok"]
+    )
+    return {
+        "ok": bool(report["ok"]) and selftest_caught,
+        "registry_ok": bool(report["ok"]),
+        "selftest_caught": selftest_caught,
+        "n_types": report["n_types"],
+        "n_law_checks": report["n_law_checks"],
+        "n_law_failures": report["n_law_failures"],
+        "unaudited": report["unaudited"],
+    }
+
+
+def run_healthy(root: str | None = None, keep: bool = False) -> dict:
+    """Leg 2: real-process seeded-chaos fleet -> signed certificate."""
+    from antidote_ccrdt_tpu.obs import audit as obs_audit
+    from antidote_ccrdt_tpu.utils.faults import plan_to_env
+    from scripts.elastic_demo import reference_digest
+
+    own_root = root is None
+    root = root or tempfile.mkdtemp(prefix="ccrdt-audit-")
+    obs_dir = os.path.join(root, "obs")
+    os.makedirs(obs_dir, exist_ok=True)
+
+    procs = []
+    for i in range(N_WORKERS):
+        env = dict(os.environ)
+        # Workers are CPU-only subprocesses; a TPU-targeting XLA_FLAGS
+        # inherited from the supervisor would abort them at import.
+        env.pop("XLA_FLAGS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["CCRDT_OBS_DIR"] = obs_dir
+        # Seeded chaos, healing by construction: a handful of dropped
+        # TCP frames at fixed per-worker hit ordinals (past the hello
+        # exchange). Lost deltas force real digest-vector resyncs —
+        # the watchdog rides those — and the retry/final-convergence
+        # machinery repairs everything, so certification must still
+        # pass with zero wedge alarms.
+        env["CCRDT_FAULTS"] = plan_to_env(
+            {"tcp.send": [
+                {"action": "drop", "at": [9 + 4 * i, 21 + 3 * i],
+                 "max_fires": 2},
+            ]},
+            seed=SEED + i,
+        )
+        cmd = [
+            sys.executable,
+            os.path.join(REPO, "scripts", "net_gossip_demo.py"),
+            "--root", root, "--member", f"w{i}",
+            "--n-members", str(N_WORKERS),
+            "--type", "topk_rmv", "--delta", "--no-overlap",
+            "--partitions", str(PARTITIONS),
+        ]
+        procs.append(subprocess.Popen(
+            cmd, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        ))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=WORKER_TIMEOUT_S)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise RuntimeError("audit_demo: fleet wedged (worker timeout)")
+    for i, p in enumerate(procs):
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"audit_demo: worker w{i} rc={p.returncode}\n"
+                + outs[i][-4000:]
+            )
+
+    finals = {}
+    for i in range(N_WORKERS):
+        with open(os.path.join(root, f"final-w{i}.json")) as f:
+            finals[f"w{i}"] = json.load(f)
+    digests = {m: _crc(doc["digest"]) for m, doc in finals.items()}
+    reference = _crc(reference_digest("topk_rmv"))
+
+    cert = obs_audit.certify(
+        obs_dir=obs_dir, digests=digests, reference=reference,
+        meta={
+            "arm": "healthy", "workers": sorted(finals),
+            "faults": "tcp.send deterministic drops (seeded chaos)",
+            "partitions": PARTITIONS,
+        },
+    )
+    verified = obs_audit.verify_certificate(cert)
+
+    counters: dict = {}
+    for doc in finals.values():
+        for k, v in doc["metrics"].items():
+            if k.startswith(("audit.", "net.partition", "net.psnap",
+                             "net.dig_")):
+                counters[k] = counters.get(k, 0) + v
+    result = {
+        "ok": bool(cert["ok"]) and verified
+        and counters.get("audit.wedge_alarms", 0) == 0,
+        "cert": cert,
+        "verified": verified,
+        "digests": digests,
+        "reference": reference,
+        "wedge_alarms": int(counters.get("audit.wedge_alarms", 0)),
+        "counters": counters,
+        "root": root,
+    }
+    if own_root and not keep:
+        shutil.rmtree(root, ignore_errors=True)
+    return result
+
+
+def run_divergent() -> dict:
+    """Leg 3: deterministic divergence — watchdog detection within one
+    digest exchange, wedge alarm, heal, and a FAILED certificate whose
+    counterexample names the partition."""
+    import numpy as np
+
+    from antidote_ccrdt_tpu.core import partition as pt
+    from antidote_ccrdt_tpu.obs import audit as obs_audit
+    from antidote_ccrdt_tpu.utils.metrics import Metrics
+    from scripts.elastic_demo import B, Br, DCS, R, STEPS, DRILLS
+
+    drill = DRILLS["topk_rmv"]
+    dense = drill.make_engine()
+    good = drill.init(dense)
+    for step in range(3):
+        good = drill.apply(dense, good, step, range(R))
+
+    # The twin takes ONE extra add on a single known id — so exactly
+    # that id's partition (plus the meta partition: the add bumps
+    # whole-instance leaves) may diverge.
+    id_star = 17
+    p_star = int(pt.part_of([id_star], PARTITIONS)[0])
+    twin, _ = dense.apply_ops(
+        good, _single_add_ops(id_star, ts=STEPS * B + 1000, np=np,
+                              B=B, Br=Br, DCS=DCS, R=R),
+        collect_dominated=False,
+    )
+
+    va = [int(x) for x in pt.state_digests(good, PARTITIONS)]
+    vb = [int(x) for x in pt.state_digests(twin, PARTITIONS)]
+    div = pt.divergent_parts(va, vb)
+
+    clock = {"t": 0.0}
+    metrics = Metrics()
+    wd = obs_audit.DivergenceWatchdog(
+        "probe", wedge_after_s=2.0, mono=lambda: clock["t"],
+        metrics=metrics,
+    )
+    s_agree = wd.observe_peer("twin", va, va, seq=1)
+    s_first = wd.observe_peer("twin", va, vb, seq=2)   # one exchange
+    clock["t"] += 3.0                                   # past the bound
+    s_wedged = wd.observe_peer("twin", va, vb, seq=3)
+    clock["t"] += 0.5
+    s_healed = wd.observe_peer("twin", vb, vb, seq=4)   # twin adopted
+
+    cert = obs_audit.certify(
+        digests={"w_good": va, "w_twin": vb}, reference=va,
+        meta={"arm": "divergent", "id_star": id_star, "p_star": p_star},
+    )
+    wd.note_certificate(cert)
+    counters = metrics.snapshot()["counters"]
+    counterexample_parts = cert.get("counterexample", {}).get(
+        "divergent_parts", []
+    )
+    ok = (
+        bool(div) and p_star in div
+        and s_agree == wd.STATE_OK
+        and s_first == wd.STATE_DIVERGED    # flagged within one round
+        and s_wedged == wd.STATE_WEDGED
+        and s_healed == wd.STATE_OK
+        and not cert["ok"]
+        and obs_audit.verify_certificate(cert)
+        and p_star in counterexample_parts
+        and counterexample_parts == div
+    )
+    return {
+        "ok": ok,
+        "p_star": p_star,
+        "divergent_parts": div,
+        "counterexample_parts": counterexample_parts,
+        "states": {
+            "agree": s_agree, "first": s_first,
+            "wedged": s_wedged, "healed": s_healed,
+        },
+        "tta_p50_s": wd.tta_p50_s(),
+        "counters": {k: v for k, v in counters.items()
+                     if k.startswith("audit.")},
+        "cert": cert,
+    }
+
+
+def _single_add_ops(id_star, ts, np, B, Br, DCS, R):
+    """A TopkRmvOps batch that is all padding except one add of
+    `id_star` on replica 0 (padding convention: add_ts=0 / rmv_id=-1,
+    same as elastic_demo gen_ops)."""
+    import jax.numpy as jnp
+
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import TopkRmvOps
+
+    a_key = np.zeros((R, B), np.int32)
+    a_id = np.zeros((R, B), np.int32)
+    a_score = np.zeros((R, B), np.int32)
+    a_dc = np.zeros((R, B), np.int32)
+    a_ts = np.zeros((R, B), np.int32)
+    a_id[0, 0], a_score[0, 0], a_ts[0, 0] = id_star, 499, ts
+    r_key = np.zeros((R, Br), np.int32)
+    r_id = np.full((R, Br), -1, np.int32)
+    r_vc = np.zeros((R, Br, DCS), np.int32)
+    return TopkRmvOps(
+        add_key=jnp.asarray(a_key), add_id=jnp.asarray(a_id),
+        add_score=jnp.asarray(a_score), add_dc=jnp.asarray(a_dc),
+        add_ts=jnp.asarray(a_ts),
+        rmv_key=jnp.asarray(r_key), rmv_id=jnp.asarray(r_id),
+        rmv_vc=jnp.asarray(r_vc),
+    )
+
+
+def run_all(pairs: int = 32, root: str | None = None) -> dict:
+    return {
+        "laws": run_laws(pairs=pairs),
+        "healthy": run_healthy(root=root),
+        "divergent": run_divergent(),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", help="fleet scratch dir (default: tmp)")
+    ap.add_argument("--pairs", type=int, default=32,
+                    help="law-check instance pairs per dispatch")
+    ap.add_argument("--out", default="AUDIT_r01.json",
+                    help="where to write the healthy-arm certificate")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    res = run_all(pairs=args.pairs, root=args.root)
+    laws, healthy, divergent = (
+        res["laws"], res["healthy"], res["divergent"]
+    )
+    with open(args.out, "w") as f:
+        json.dump(healthy["cert"], f, indent=2, sort_keys=True)
+        f.write("\n")
+
+    if args.json:
+        print(json.dumps({
+            "laws": laws,
+            "healthy": {k: v for k, v in healthy.items() if k != "cert"},
+            "divergent": {
+                k: v for k, v in divergent.items() if k != "cert"
+            },
+        }, sort_keys=True, default=str))
+    else:
+        print("== certified convergence (obs/audit.py) ==")
+        print(
+            f"laws      : {'ok' if laws['ok'] else 'FAIL'} "
+            f"({laws['n_law_checks']} checks / {laws['n_types']} types, "
+            f"{laws['n_law_failures']} failures, broken fixture "
+            f"{'caught' if laws['selftest_caught'] else 'MISSED'})"
+        )
+        cert = healthy["cert"]
+        print(
+            f"healthy   : cert {'OK' if cert['ok'] else 'FAILED'} "
+            f"(signature {'valid' if healthy['verified'] else 'INVALID'}, "
+            f"{cert['n_flight_logs']} flight logs, "
+            f"wedge alarms {healthy['wedge_alarms']}) -> {args.out}"
+        )
+        print(
+            f"divergent : watchdog "
+            f"{divergent['states']} parts={divergent['divergent_parts']} "
+            f"p*={divergent['p_star']} cert FAILED as required, "
+            f"counterexample names {divergent['counterexample_parts']}"
+        )
+    ok = laws["ok"] and healthy["ok"] and divergent["ok"]
+    print("audit-demo:", "OK" if ok else "FAIL")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -29,6 +29,12 @@ string. This gate:
    threshold (>20% relative AND an absolute floor — 512 B / 0.25 s)
    of the best prior carrier — a psnap fattening back toward whole
    snapshots or the incremental rejoin slowing down fails here.
+6. gates the audit plane's per-round cost (r10+): the latest carrier's
+   ``audit_overhead_pct`` (bench.bench_audit_overhead — digest
+   sampling + watchdog observation on a gossip round loop) must stay
+   within >20% relative AND >1pp absolute of the best prior carrier —
+   certification drifting from "rides along" to "taxes the hot path"
+   fails here.
 
 With fewer than two comparable rounds a gate passes vacuously (exit 0)
 and says so. The overall exit code is the worst of all gates.
@@ -411,6 +417,64 @@ def evaluate_serve(
     return code, "\n".join(lines)
 
 
+_AUDIT_RE = re.compile(r'"audit_overhead_pct":\s*([0-9][0-9_.eE+-]*)')
+
+
+def load_audit_rounds(bench_dir: str) -> List[Tuple[int, str, float]]:
+    """[(round_no, path, audit_overhead_pct)] for every BENCH round
+    whose summary line carries the audit-plane overhead
+    (bench.bench_audit_overhead, r10+). Fixed protocol geometry on
+    every backend, so rounds compare without backend grouping."""
+    out: List[Tuple[int, str, float]] = []
+    for p in sorted(
+        glob.glob(os.path.join(bench_dir, "BENCH_r*.json")), key=round_number
+    ):
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        tail = str(doc.get("tail", ""))
+        ov = _AUDIT_RE.findall(tail)
+        if ov:
+            out.append((round_number(p), p, float(ov[-1])))
+    return out
+
+
+def evaluate_audit(
+    rounds: List[Tuple[int, str, float]],
+    tolerance: float = 0.20,
+    abs_floor_pp: float = 1.0,
+) -> Tuple[int, str]:
+    """(exit_code, verdict) for the audit-overhead gate: the latest
+    carrier fails when ``audit_overhead_pct`` grew more than `tolerance`
+    relative AND more than `abs_floor_pp` percentage points absolute
+    over the best (lowest) prior carrier — the double-threshold shape
+    shared with the other microbench gates (overhead of a few percent
+    would trip a pure relative gate on timer jitter alone). Fewer than
+    two carriers pass vacuously."""
+    if len(rounds) < 2:
+        return 0, (
+            f"audit-gate: only {len(rounds)} round(s) carry "
+            "audit_overhead_pct — nothing to compare, passing vacuously"
+        )
+    latest_n, _p, latest_ov = rounds[-1]
+    best_n, _bp, best_ov = min(rounds[:-1], key=lambda r: r[2])
+    ceiling = max(best_ov * (1.0 + tolerance), best_ov + abs_floor_pp)
+    verdict = (
+        f"audit-gate: r{latest_n:02d} audit_overhead_pct = {latest_ov:.2f} "
+        f"vs best prior r{best_n:02d} = {best_ov:.2f} "
+        f"(ceiling +{tolerance:.0%} and +{abs_floor_pp}pp: {ceiling:.2f})"
+    )
+    if latest_ov > ceiling:
+        return 1, (
+            f"{verdict}\nFAIL: running certified now costs "
+            f"{latest_ov - best_ov:+.2f}pp more per gossip round — the "
+            "audit plane is leaking onto the hot path"
+        )
+    return 0, f"{verdict}\nOK: within tolerance"
+
+
 def attribution_drift(
     rounds: List[Tuple[int, str, float, float]]
 ) -> List[str]:
@@ -477,6 +541,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"  serve r{n:02d} {os.path.basename(p)}: "
             f"{rps:,.0f} reads/s, frame p99 {p99:.3f}ms"
         )
+    aud = load_audit_rounds(args.bench_dir)
+    for n, p, ov in aud:
+        print(
+            f"  audit r{n:02d} {os.path.basename(p)}: "
+            f"overhead {ov:.2f}% per round"
+        )
     code, verdict = evaluate(rounds, args.tolerance)
     print(verdict)
     gap_code, gap_verdict = evaluate_gap(attr, args.gap_tolerance)
@@ -485,7 +555,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     print(part_verdict)
     serve_code, serve_verdict = evaluate_serve(srv, args.tolerance)
     print(serve_verdict)
-    return max(code, gap_code, part_code, serve_code)
+    audit_code, audit_verdict = evaluate_audit(aud, args.tolerance)
+    print(audit_verdict)
+    return max(code, gap_code, part_code, serve_code, audit_code)
 
 
 if __name__ == "__main__":

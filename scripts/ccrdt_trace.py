@@ -46,6 +46,10 @@ from typing import Any, Dict, List, Optional, Tuple
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from antidote_ccrdt_tpu.obs import events as obs_events  # noqa: E402
+from antidote_ccrdt_tpu.obs.audit import audit_apply_order  # noqa: E402,F401
+# audit_apply_order moved to obs/audit.py (the certifier reuses it);
+# re-exported here because `audit` below and the trace-CLI tests call it
+# under this module's name. obs.audit stays stdlib-only at import time.
 
 # Display order of a delta's lifecycle stages (fs medium uses write/
 # fetch, tcp uses send/recv — a path holds whichever its medium emitted;
@@ -181,70 +185,6 @@ def find_stragglers(
     if med <= 0:
         return med, []
     return med, [r for r in rows if r["latency_ms"] >= factor * med]
-
-
-def audit_apply_order(
-    logs: Dict[str, List[Dict[str, Any]]]
-) -> List[Dict[str, Any]]:
-    """Causal-order violations in the apply streams, one row each.
-
-    Within ONE flight log (= one process incarnation) the `delta.apply`
-    events for a given origin must carry contiguous ascending dseqs:
-    `sweep_deltas` only emits the event after advancing its cursor by
-    exactly one, and a `snap.apply` at step S is the only legitimate
-    jump (the cursor resumes from max(cur, S)). The baseline is the
-    FIRST dseq seen in the log, not 0 — the ring truncates and a worker
-    may join mid-stream, so absolute position proves nothing; ordering
-    within the log does. Events replay in the recorder's own `seq`
-    order (per-process lamport axis), so wall-clock skew cannot
-    manufacture violations. A `gap-skip` (dseq jumped past cur+1 with no
-    snapshot) means ops were silently lost; a `double-apply` (dseq at or
-    below the cursor) means the cursor went backwards. Different
-    incarnations of the same member audit independently: recovery
-    legitimately re-applies."""
-    violations: List[Dict[str, Any]] = []
-    for fname, evs in sorted(logs.items()):
-        applier = next(
-            (str(e["member"]) for e in evs if e.get("member")), fname
-        )
-        ordered = sorted(
-            (
-                e for e in evs
-                if e.get("kind") in ("delta.apply", "snap.apply")
-                and e.get("origin") is not None
-            ),
-            key=lambda e: int(e.get("seq", 0)),
-        )
-        cur: Dict[str, int] = {}
-        for ev in ordered:
-            origin = str(ev["origin"])
-            if ev["kind"] == "snap.apply":
-                s = ev.get("step")
-                if s is not None:
-                    prev = cur.get(origin)
-                    cur[origin] = int(s) if prev is None else max(prev, int(s))
-                continue
-            d = ev.get("dseq")
-            if d is None:
-                continue
-            d = int(d)
-            prev = cur.get(origin)
-            if prev is None or d == prev + 1:
-                cur[origin] = d
-                continue
-            violations.append(
-                {
-                    "log": fname,
-                    "applier": applier,
-                    "origin": origin,
-                    "kind": "double-apply" if d <= prev else "gap-skip",
-                    "prev_dseq": prev,
-                    "dseq": d,
-                    "seq": int(ev.get("seq", -1)),
-                }
-            )
-            cur[origin] = max(prev, d)
-    return violations
 
 
 # -- rendering ---------------------------------------------------------------

@@ -35,6 +35,16 @@ the leg also requires the pipeline's own counters nonzero —
 `overlap.host_tasks` and `overlap.windows` at zero mean the drill
 silently fell back to the serial path.
 
+The audit leg guards the certified-convergence plane (obs/audit.py,
+via scripts/audit_demo.py): the lattice-law checker must pass every
+registered type AND catch the committed broken-merge fixture, the
+seeded-chaos real-process fleet must replay-certify into a valid
+signed certificate with ZERO false wedge alarms on the healthy arm,
+and the deterministic divergent arm must light every watchdog counter
+(divergence flagged within one digest exchange, wedge alarm past the
+bound, time-to-agreement on heal) with the failed certificate's
+counterexample naming the diverging partition.
+
 Run:  python scripts/chaos_gate.py
 Make: part of `make chaos` (after the pytest leg).
 """
@@ -100,6 +110,16 @@ SERVE_REQUIRED_NONZERO = (
     "serve.queries",       # ...with the per-query bill counted
     "serve.stale_rejects", # the staleness knob actually rejected
     "net.queries",         # in-band wire queries crossed the (lossy) sim
+)
+
+# Audit leg (scripts/audit_demo.py's deterministic divergent arm): the
+# divergence watchdog's full episode — detection, wedge alarm,
+# agreement — must move its counters. Zero on any of these means the
+# live divergence plane went dark even if certification stays green.
+AUDIT_REQUIRED_NONZERO = (
+    "audit.divergences",   # the watchdog flagged the divergence at all
+    "audit.wedge_alarms",  # ...escalated once repair stalled past bound
+    "audit.agreements",    # ...and closed the episode with a tta sample
 )
 
 # Same contract for the zone-topology leg (tests/test_topo_chaos.py:
@@ -284,6 +304,63 @@ def main() -> int:
     print(f"OK: serve leg — {audit['served']} reads served under chaos "
           f"({audit['rejected']} honestly rejected as stale), 0 bound "
           "violations, 0 identity mismatches")
+
+    # -- leg 6: the certified-convergence plane (obs/audit.py) -------------
+    import audit_demo
+
+    laws = audit_demo.run_laws(pairs=32)
+    healthy = audit_demo.run_healthy()
+    divergent = audit_demo.run_divergent()
+    a_counters = divergent["counters"]
+    a_zeroed = sorted(
+        n for n in AUDIT_REQUIRED_NONZERO if not a_counters.get(n, 0)
+    )
+    print("== audit drill (laws + certified fleet + divergent arm) ==")
+    print("  " + " ".join(
+        f"{n}={int(a_counters.get(n, 0))}" for n in AUDIT_REQUIRED_NONZERO
+    ))
+    print(f"  laws: {laws['n_law_checks']} checks / {laws['n_types']} "
+          f"types, {laws['n_law_failures']} failures, broken fixture "
+          f"{'caught' if laws['selftest_caught'] else 'MISSED'}")
+    print(f"  healthy cert: ok={healthy['cert']['ok']} "
+          f"verified={healthy['verified']} "
+          f"wedge_alarms={healthy['wedge_alarms']}")
+    print(f"  divergent: p*={divergent['p_star']} counterexample="
+          f"{divergent['counterexample_parts']}")
+    if not laws["ok"]:
+        print("FAIL: lattice-law checker — "
+              + ("registered type failed its laws "
+                 f"({laws['n_law_failures']} failures, "
+                 f"unaudited: {laws['unaudited']})"
+                 if not laws["registry_ok"]
+                 else "the committed broken-merge fixture was MISSED"))
+        return 1
+    if not healthy["cert"]["ok"] or not healthy["verified"]:
+        print("FAIL: the healthy fleet did not certify "
+              f"(checks: {healthy['cert']['checks']}, "
+              f"signature valid: {healthy['verified']})")
+        return 1
+    if healthy["wedge_alarms"]:
+        print(f"FAIL: {healthy['wedge_alarms']} wedge alarm(s) on the "
+              "healthy arm — the watchdog false-alarmed on healing "
+              "transient divergence")
+        return 1
+    if a_zeroed:
+        print("FAIL: watchdog counters regressed to zero (the live "
+              f"divergence plane went dark): {a_zeroed}")
+        return 1
+    if not divergent["ok"]:
+        print("FAIL: divergent arm — expected diverged-within-one-"
+              "exchange -> wedged -> healed and a failed certificate "
+              f"naming partition {divergent['p_star']}; got states "
+              f"{divergent['states']}, counterexample "
+              f"{divergent['counterexample_parts']}")
+        return 1
+    print(f"OK: audit leg — {laws['n_law_checks']} laws green + broken "
+          f"fixture caught, healthy fleet certified "
+          f"(sha256:{healthy['cert']['signature'][:16]}…, 0 false "
+          f"alarms), divergence flagged in one exchange naming "
+          f"partition {divergent['p_star']}")
     return 0
 
 

@@ -304,6 +304,14 @@ def main() -> None:
         "long delta chain; 0 disables",
     )
     ap.add_argument(
+        "--partitions", type=int, default=0,
+        help="arm the partition plane (needs --delta): every full anchor "
+        "also publishes the P-partition digest vector + psnaps, delta-"
+        "chain gaps repair partition-granularly (PartialAntiEntropy), "
+        "and the divergence watchdog (obs/audit.py) rides the digest "
+        "exchanges; 0 disables (legacy whole-snapshot resync)",
+    )
+    ap.add_argument(
         "--wal-dir", default="",
         help="enable the crash-consistent write-ahead delta log "
         "(harness/wal.py) under this directory: every applied op batch "
@@ -348,8 +356,10 @@ def run_worker(store, drill, dense, state, args, result_dir):
     from antidote_ccrdt_tpu.obs import events as obs_events
     from antidote_ccrdt_tpu.obs import export as obs_export
     from antidote_ccrdt_tpu.obs.lag import LagTracker
+    from antidote_ccrdt_tpu.obs.audit import DivergenceWatchdog
     from antidote_ccrdt_tpu.parallel.elastic import (
         DeltaPublisher,
+        PartialAntiEntropy,
         my_replicas,
         sweep,
         sweep_deltas,
@@ -384,8 +394,15 @@ def run_worker(store, drill, dense, state, args, result_dir):
         obs_spans.install_from_env(args.member, store.metrics)
     lag_tracker = LagTracker(args.member)
     confident_stale = max(1.5 * args.timeout, 0.6)
+    # Divergence watchdog (obs/audit.py): always armed — with no
+    # partition plane it just exports the OK gauges (so the dashboard
+    # audit column renders on every fleet); with --partitions the
+    # partial anti-entropy tier feeds it a per-peer digest-vector
+    # observation on every fetch.
+    watchdog = DivergenceWatchdog(args.member, metrics=store.metrics)
 
     pub = None  # set below when --delta
+    pae = None  # set below when --delta --partitions
     cursors: dict = {}
     owned_prev: set = set()
 
@@ -419,6 +436,7 @@ def run_worker(store, drill, dense, state, args, result_dir):
                 len(ctx["ovl"].apq) if ctx["ovl"] is not None else 0
             ),
         }
+        doc.update(watchdog.health_fields())
         if plane is not None:
             doc.update(plane.health_fields())
         return doc
@@ -478,7 +496,8 @@ def run_worker(store, drill, dense, state, args, result_dir):
     def do_sweep(store, st):
         view = drill.pub_state(dense, st)
         if pub is not None:
-            swept, stats = sweep_deltas(store, dense, view, cursors)
+            swept, stats = sweep_deltas(store, dense, view, cursors,
+                                        partial=pae)
         else:
             swept, stats = sweep(store, dense, view)
         return drill.set_view(dense, st, swept), stats
@@ -561,6 +580,7 @@ def run_worker(store, drill, dense, state, args, result_dir):
             },
             "wal_last_seq": counters.get("wal.last_seq"),
             "serve": serve_doc,
+            "audit": watchdog.status_fields(),
         }
         path = os.path.join(result_dir, f"obs-{args.member}.json")
         tmp = f"{path}.tmp-{os.getpid()}"
@@ -581,11 +601,17 @@ def run_worker(store, drill, dense, state, args, result_dir):
                     (r["lag_ops"] for r in lag_tracker.report().values()),
                     default=0,
                 )
+        P = int(getattr(args, "partitions", 0) or 0)
         pub = DeltaPublisher(
             store, dense, name=drill.publish_name, full_every=4,
             lag_source=lag_source, lag_threshold=lag_anchor_ops,
+            partitions=P or None,
         )
         pub.on_publish = _serve_swap
+        if P:
+            # Gap repairs go partition-granular, and every digest fetch
+            # feeds the watchdog's per-peer divergence state machine.
+            pae = PartialAntiEntropy(store, partitions=P, watchdog=watchdog)
         if start_step > 0:
             # Resume the delta-seq lineage PAST anything the lost
             # incarnation published (old seq <= old step < start_step):
@@ -814,6 +840,10 @@ def run_worker(store, drill, dense, state, args, result_dir):
             if m != args.member and m not in alive_now
         }
         dead_n = len(confirmed_dead)
+        for m in confirmed_dead:
+            # A dead peer's frozen digest vector must not age into a
+            # wedged-divergence alarm; adoption already owns its ops.
+            watchdog.drop(m)
         final_view = drill.pub_state(dense, state)
         store.publish(drill.publish_name, final_view, STEPS + dead_n)
         _serve_swap(final_view, STEPS + dead_n)

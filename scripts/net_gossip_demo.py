@@ -80,6 +80,9 @@ def main() -> None:
     ap.add_argument("--step-sleep", type=float, default=0.15)
     ap.add_argument("--publish-every", type=int, default=2)
     ap.add_argument("--delta", action="store_true")
+    ap.add_argument("--partitions", type=int, default=0,
+                    help="arm the partition plane + divergence watchdog "
+                    "(see elastic_demo.py --partitions); 0 disables")
     ap.add_argument("--overlap", dest="overlap", action="store_true",
                     default=None,
                     help="overlapped round pipeline (parallel/overlap.py); "

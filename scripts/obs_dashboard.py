@@ -171,6 +171,27 @@ def _fmt_serve(status: Optional[Dict[str, Any]], member: str) -> str:
     )
 
 
+def _fmt_audit(status: Optional[Dict[str, Any]]) -> str:
+    """Audit column group: divergence-watchdog verdict, how long the
+    worst divergence has been open, and the time-to-agreement p50 — from
+    the watchdog block elastic_demo's status drops carry (fed by the
+    audit.* gauges every scrape surface also exports)."""
+    au = (status or {}).get("audit") or {}
+    if not au:
+        return "-"
+    state = str(au.get("state", "?"))
+    age = au.get("age_s")
+    tta = au.get("tta_p50_ms")
+    cert = au.get("cert_ok")
+    out = (
+        f"{state} age {'-' if age is None else format(age, '.1f') + 's'} "
+        f"tta50 {'-' if tta is None else format(tta, '.0f') + 'ms'}"
+    )
+    if cert is not None:
+        out += f" cert {'ok' if cert else 'FAIL'}"
+    return out
+
+
 def render_frame(root: str, clear: bool = True) -> str:
     rows = scrape_root(root)
     lines = []
@@ -180,7 +201,7 @@ def render_frame(root: str, clear: bool = True) -> str:
     hdr = (
         f"{'member':<10}{'zone':<6}{'hb-age':>8} {'state':<9}{'snap':>5} "
         f"{'delta-window':<14}{'wal':>5}  {'sendq':<16}"
-        f"{'lag (peer:ops/secs)':<26}  {'serving'}"
+        f"{'lag (peer:ops/secs)':<26}  {'serving':<34}  {'audit'}"
     )
     lines.append(hdr)
     lines.append("-" * len(hdr))
@@ -215,7 +236,8 @@ def render_frame(root: str, clear: bool = True) -> str:
             f"{m:<10}{z:<6}{age:>8} {r['state']:<9}"
             f"{'-' if r['snap'] is None else r['snap']:>5} "
             f"{window:<14}{'-' if wal is None else int(wal):>5}  "
-            f"{_fmt_sendq(st):<16}{_fmt_lag(st):<26}  {_fmt_serve(st, m)}"
+            f"{_fmt_sendq(st):<16}{_fmt_lag(st):<26}  "
+            f"{_fmt_serve(st, m):<34}  {_fmt_audit(st)}"
         )
     return "\n".join(lines)
 

@@ -1,0 +1,407 @@
+"""The certified-convergence plane (obs/audit.py) unit surface.
+
+Pinned here:
+
+* **LawChecker** — the full registry passes its merge/delta law suite;
+  the committed non-commutative fixture (`ops.laws.broken_merge_fixture`)
+  is caught on exactly the laws it breaks (commutativity + associativity
+  FAIL, idempotence PASSES — law verdicts are independent, not a single
+  pass/fail bit); a registered type without a fixture lands in
+  `unaudited` and flips the gate, never silently skips.
+* **certify / verify_certificate** — a clean flight-log spill with
+  agreeing digests and a matching reference certifies ok with a valid
+  signature; any post-signing tamper breaks verification; divergent
+  digest vectors fail certification with a counterexample naming the
+  divergent partitions; coverage via snapshot folds and partial resyncs
+  reconciles, truncation is caught as `uncovered`.
+* **DivergenceWatchdog** — the ok -> diverged -> wedged state machine on
+  an injected monotonic clock: divergence flagged on the FIRST
+  disagreeing exchange, wedge only after `wedge_after_s` with no
+  progress, shrinking divergence / `note_repair_progress` reset the
+  wedge clock, agreement records a time-to-agreement sample, equal
+  vectors never alarm, `drop` forgets a dead peer's frozen vector, and
+  the gauges/health/status surfaces export what the dashboards read.
+"""
+
+import copy
+
+import pytest
+
+from antidote_ccrdt_tpu.obs import audit
+from antidote_ccrdt_tpu.obs.audit import (
+    DivergenceWatchdog,
+    LawChecker,
+    certify,
+    reconcile_op_counts,
+    sign_certificate,
+    verify_certificate,
+)
+from antidote_ccrdt_tpu.utils.metrics import Metrics
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _wd(**kw):
+    kw.setdefault("wedge_after_s", 5.0)
+    clk = Clock()
+    m = Metrics()
+    return DivergenceWatchdog("me", mono=clk, metrics=m, **kw), clk, m
+
+
+# -- lattice-law checking ----------------------------------------------------
+
+
+@pytest.mark.slow
+def test_law_checker_registry_all_green():
+    rep = LawChecker(pairs=16, seed=3).run()
+    assert rep["ok"], rep
+    assert rep["n_types"] >= 6 and rep["unaudited"] == []
+    assert rep["n_law_failures"] == 0
+    # Every type got at least commutativity + associativity.
+    assert rep["n_law_checks"] >= 2 * rep["n_types"]
+
+
+def test_law_checker_catches_broken_fixture_per_law():
+    from antidote_ccrdt_tpu.ops.laws import broken_merge_fixture
+
+    m = Metrics()
+    rep = LawChecker(
+        types=["broken_merge_fixture"],
+        extra_fixtures={"broken_merge_fixture": broken_merge_fixture},
+        pairs=16, metrics=m,
+    ).run()
+    assert not rep["ok"]
+    laws = rep["types"]["broken_merge_fixture"]["laws"]
+    # 2a - b: non-commutative, non-associative, but idempotent — the
+    # checker must name the broken laws, not blanket-fail the type.
+    assert not laws["commutativity"]["ok"]
+    assert not laws["associativity"]["ok"]
+    assert laws["idempotence"]["ok"]
+    assert laws["commutativity"]["failed_instances"] >= 1
+    assert m.counters["audit.law_failures"] == 2.0
+
+
+def test_law_checker_unaudited_type_flips_gate():
+    rep = LawChecker(types=["topk", "ghost-type"], pairs=8).run()
+    assert not rep["ok"]
+    assert rep["unaudited"] == ["ghost-type"]
+    assert rep["types"]["topk"]["ok"]
+
+
+# -- replay certification ----------------------------------------------------
+
+
+def _pub(origin, dseq, seq):
+    return {"kind": "delta.publish", "member": origin, "origin": origin,
+            "dseq": dseq, "seq": seq}
+
+
+def _app(member, origin, dseq, seq):
+    return {"kind": "delta.apply", "member": member, "origin": origin,
+            "dseq": dseq, "seq": seq}
+
+
+def _clean_logs():
+    return {
+        "flight-a-1.jsonl": [
+            _pub("a", 1, 0), _pub("a", 2, 1),
+            _app("a", "b", 1, 2),
+        ],
+        "flight-b-1.jsonl": [
+            _pub("b", 1, 0),
+            _app("b", "a", 1, 1), _app("b", "a", 2, 2),
+        ],
+    }
+
+
+def test_certify_clean_run_signs_ok():
+    digests = {"a": [7, 8, 9], "b": [7, 8, 9]}
+    cert = certify(logs=_clean_logs(), digests=digests,
+                   reference=[7, 8, 9], meta={"drill": "unit"})
+    assert cert["ok"]
+    assert cert["checks"] == {
+        "causal_delivery": True,
+        "op_count_reconciliation": True,
+        "partition_digest_agreement": True,
+        "matches_reference": True,
+    }
+    assert "counterexample" not in cert
+    assert cert["n_flight_logs"] == 2
+    assert verify_certificate(cert)
+    # Tamper with anything after signing — verification breaks.
+    forged = copy.deepcopy(cert)
+    forged["ok"] = True
+    forged["worker_digests"]["a"] = "deadbeef"
+    assert not verify_certificate(forged)
+    resigned = sign_certificate(dict(forged))
+    assert verify_certificate(resigned)
+    assert resigned["signature"] != cert["signature"]
+
+
+def test_certify_without_evidence_omits_checks():
+    # No digests, no reference: those checks are ABSENT, not vacuously
+    # true — the certificate only claims what it could audit.
+    cert = certify(logs=_clean_logs())
+    assert cert["ok"]
+    assert set(cert["checks"]) == {
+        "causal_delivery", "op_count_reconciliation"}
+    assert cert["agreement"] is None and cert["reference"] is None
+
+
+def test_certify_divergent_digests_counterexample_names_partition():
+    digests = {"a": [5, 6, 7], "b": [5, 60, 7], "c": [5, 6, 7]}
+    cert = certify(logs=_clean_logs(), digests=digests, reference=[5, 6, 7])
+    assert not cert["ok"]
+    assert not cert["checks"]["partition_digest_agreement"]
+    assert not cert["checks"]["matches_reference"]
+    cx = cert["counterexample"]
+    assert cx["divergent_parts"] == [1]
+    assert sorted(cx["reference_mismatch"]) == ["b"]
+    # The digest groups split b from {a, c}.
+    assert any(sorted(ms) == ["a", "c"] for ms in cx["digest_groups"].values())
+    # A failed certificate still carries a valid signature.
+    assert verify_certificate(cert)
+
+
+def test_reconcile_covers_via_snapshot_and_psnap():
+    logs = _clean_logs()
+    # c saw none of a's deltas directly: a full snapshot fold at a's
+    # step 2 covers the stream; for b, a partial resync at dig_seq 1
+    # plus the applied delta 2... but drop the delta: dig_seq 1 alone
+    # leaves dseq 2 uncovered.
+    logs["flight-c-1.jsonl"] = [
+        {"kind": "snap.apply", "member": "c", "origin": "a", "step": 2,
+         "seq": 0},
+        {"kind": "psnap.resync", "member": "c", "origin": "b", "dig_seq": 1,
+         "seq": 1},
+    ]
+    rec = reconcile_op_counts(logs)
+    assert rec["ok"], rec
+    assert rec["origins"]["a"]["max_dseq"] == 2
+    # Now truncate: one applier short of the watermark.
+    logs["flight-c-1.jsonl"] = [
+        {"kind": "psnap.resync", "member": "c", "origin": "a", "dig_seq": 1,
+         "seq": 0},
+        _app("c", "b", 1, 1),
+    ]
+    rec = reconcile_op_counts(logs)
+    assert not rec["ok"]
+    assert rec["uncovered"] == [{
+        "applier": "c", "origin": "a",
+        "covered_through": 1, "published_through": 2, "applied": 0,
+    }]
+    cert = certify(logs=logs)
+    assert not cert["ok"]
+    assert cert["counterexample"]["uncovered"][0]["applier"] == "c"
+
+
+def test_reconcile_coverage_spans_incarnations():
+    # A restarted worker's coverage is judged on the union of its
+    # incarnations: pre-crash it applied dseq 1, post-recovery 2.
+    logs = {
+        "flight-a-1.jsonl": [_pub("a", 1, 0), _pub("a", 2, 1)],
+        "flight-b-100.jsonl": [_app("b", "a", 1, 0)],
+        "flight-b-200.jsonl": [
+            _app("b", "a", 1, 0), _app("b", "a", 2, 1)],
+    }
+    assert reconcile_op_counts(logs)["ok"]
+
+
+# -- divergence watchdog -----------------------------------------------------
+
+
+def test_watchdog_agreeing_vectors_never_alarm():
+    wd, clk, m = _wd()
+    for i in range(5):
+        clk.t = float(i * 10)  # far past any wedge bound
+        assert wd.observe_peer("b", [1, 2, 3], [1, 2, 3], seq=i) \
+            == wd.STATE_OK
+    assert wd.state() == wd.STATE_OK
+    assert wd.divergence_age_s() == 0.0
+    assert "audit.divergences" not in m.counters
+    assert m.counters["audit.watchdog_state"] == 0.0
+
+
+def test_watchdog_flags_first_divergent_exchange_then_wedges():
+    wd, clk, m = _wd(wedge_after_s=5.0)
+    assert wd.observe_peer("b", [1, 2], [1, 2], seq=0) == wd.STATE_OK
+    clk.t = 1.0
+    # First disagreeing observation — diverged within ONE exchange.
+    assert wd.observe_peer("b", [1, 2], [1, 9], seq=1) == wd.STATE_DIVERGED
+    assert m.counters["audit.divergences"] == 1.0
+    assert wd.divergent_parts() == [1]
+    # Still diverged inside the bound: no alarm.
+    clk.t = 4.0
+    assert wd.observe_peer("b", [1, 2], [1, 9], seq=2) == wd.STATE_DIVERGED
+    assert "audit.wedge_alarms" not in m.counters
+    # Past the bound with zero progress: wedged.
+    clk.t = 6.5
+    assert wd.observe_peer("b", [1, 2], [1, 9], seq=3) == wd.STATE_WEDGED
+    assert m.counters["audit.wedge_alarms"] == 1.0
+    assert m.counters["audit.watchdog_state"] == 2.0
+    assert abs(wd.divergence_age_s() - 5.5) < 1e-9
+    # Agreement heals even a wedged peer and samples time-to-agreement.
+    clk.t = 8.0
+    assert wd.observe_peer("b", [1, 2], [1, 2], seq=4) == wd.STATE_OK
+    assert m.counters["audit.agreements"] == 1.0
+    assert abs(wd.tta_p50_s() - 7.0) < 1e-9
+    assert m.counters["audit.watchdog_state"] == 0.0
+
+
+def test_watchdog_progress_resets_wedge_clock():
+    wd, clk, m = _wd(wedge_after_s=5.0)
+    clk.t = 0.0
+    wd.observe_peer("b", [1, 2, 3], [9, 9, 3])
+    # The divergent set SHRINKS at t=4 — repair is landing, clock resets.
+    clk.t = 4.0
+    assert wd.observe_peer("b", [1, 2, 3], [9, 2, 3]) == wd.STATE_DIVERGED
+    clk.t = 8.0  # 8s since onset, but only 4s since progress
+    assert wd.observe_peer("b", [1, 2, 3], [9, 2, 3]) == wd.STATE_DIVERGED
+    # Out-of-band progress (applied psnaps) also resets it.
+    clk.t = 8.5
+    wd.note_repair_progress("b")
+    clk.t = 13.0
+    assert wd.observe_peer("b", [1, 2, 3], [9, 2, 3]) == wd.STATE_DIVERGED
+    assert "audit.wedge_alarms" not in m.counters
+    # ...but stalling past the bound finally trips it.
+    clk.t = 19.0
+    assert wd.observe_peer("b", [1, 2, 3], [9, 2, 3]) == wd.STATE_WEDGED
+
+
+def test_watchdog_drop_forgets_dead_peer():
+    wd, clk, _m = _wd(wedge_after_s=2.0)
+    wd.observe_peer("dead", [1], [2])
+    assert wd.state() == wd.STATE_DIVERGED
+    # SWIM declares it dead: its frozen vector must not age into a
+    # wedge alarm.
+    wd.drop("dead")
+    assert wd.state() == wd.STATE_OK
+    assert wd.divergent_parts() == []
+    assert wd.peers() == {}
+
+
+def test_watchdog_scalar_and_mismatched_vectors():
+    wd, clk, _m = _wd()
+    # Scalar digests compare as 1-vectors.
+    assert wd.observe_peer("b", 7, 7) == wd.STATE_OK
+    assert wd.observe_peer("b", 7, 8) == wd.STATE_DIVERGED
+    assert wd.divergent_parts() == [0]
+    # Incomparable lengths (mid-repartition peer) flag every index.
+    assert wd.observe_peer("c", [1, 2], [1, 2, 3]) == wd.STATE_DIVERGED
+    assert set(wd._peers["c"]["parts"]) == {0, 1, 2}
+
+
+def test_watchdog_health_and_status_surfaces():
+    wd, clk, m = _wd(wedge_after_s=5.0)
+    clk.t = 1.0
+    wd.observe_peer("b", [1, 2], [1, 9], seq=41)
+    clk.t = 3.5
+    h = wd.health_fields()
+    assert h["audit_watchdog_state"] == "diverged"
+    assert abs(h["audit_divergence_age_s"] - 2.5) < 1e-9
+    assert h["audit_divergent_parts"] == [1]
+    assert "audit_tta_p50_ms" not in h  # no agreements yet
+    st = wd.status_fields()
+    assert st["state"] == "diverged" and st["ttas"] == 0
+    assert st["tta_p50_ms"] is None and st["cert_ok"] is None
+    # Heal + record a certificate: both surfaces pick it up.
+    clk.t = 4.0
+    wd.observe_peer("b", [1, 9], [1, 9], seq=42)
+    cert = certify(logs=_clean_logs(), digests={"a": [1], "b": [1]})
+    wd.note_certificate(cert)
+    h = wd.health_fields()
+    assert h["audit_watchdog_state"] == "ok"
+    assert h["audit_last_certificate"]["ok"] is True
+    assert h["audit_last_certificate"]["signature"] == \
+        cert["signature"][:16]
+    assert abs(h["audit_tta_p50_ms"] - 3000.0) < 1e-6
+    assert wd.status_fields()["cert_ok"] is True
+    assert m.counters["audit.certificate_ok"] == 1.0
+
+
+def test_watchdog_tta_p50_is_median():
+    wd, clk, _m = _wd()
+    for i, dur in enumerate([1.0, 9.0, 2.0]):
+        t0 = 100.0 * i
+        clk.t = t0
+        wd.observe_peer("b", [1], [2])
+        clk.t = t0 + dur
+        wd.observe_peer("b", [1], [1])
+    assert wd.tta_p50_s() == 2.0
+
+
+# -- the audit CLI (scripts/ccrdt_audit.py) ----------------------------------
+
+
+def _load_audit_cli():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "ccrdt_audit",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts", "ccrdt_audit.py",
+        ),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_cli_certify_verify_roundtrip_and_tamper(tmp_path, capsys):
+    import json
+    import os
+
+    cli = _load_audit_cli()
+    obs_dir = tmp_path / "obs"
+    os.makedirs(obs_dir)
+    for fname, evs in _clean_logs().items():
+        with open(obs_dir / fname, "w") as fh:
+            for ev in evs:
+                fh.write(json.dumps(ev) + "\n")
+    dig_file = tmp_path / "digests.json"
+    # Dashed-hex labels (what certificates print) must round-trip in.
+    dig_file.write_text(json.dumps(
+        {"a": [7, 8], "b": "00000007-00000008"}))
+    cert_path = str(tmp_path / "cert.json")
+    rc = cli.main([
+        "certify", str(obs_dir), "--digests", str(dig_file),
+        "--reference", "00000007-00000008", "--out", cert_path,
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "certificate  : OK" in out
+    assert cli.main(["verify", cert_path]) == 0
+    assert "valid" in capsys.readouterr().out
+    # Tamper with the verdict on disk: verify must exit 1.
+    doc = json.loads(open(cert_path).read())
+    doc["checks"]["causal_delivery"] = False
+    with open(cert_path, "w") as fh:
+        json.dump(doc, fh)
+    assert cli.main(["verify", cert_path]) == 1
+    assert "INVALID" in capsys.readouterr().out
+
+
+def test_cli_certify_divergence_exits_nonzero(tmp_path, capsys):
+    import json
+    import os
+
+    cli = _load_audit_cli()
+    obs_dir = tmp_path / "obs"
+    os.makedirs(obs_dir)
+    (obs_dir / "flight-a-1.jsonl").write_text(
+        json.dumps(_pub("a", 1, 0)) + "\n")
+    dig_file = tmp_path / "digests.json"
+    dig_file.write_text(json.dumps({"a": [1, 2], "b": [1, 99]}))
+    rc = cli.main(["certify", str(obs_dir), "--digests", str(dig_file),
+                   "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["counterexample"]["divergent_parts"] == [1]

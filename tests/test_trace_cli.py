@@ -205,6 +205,53 @@ def test_audit_incarnations_are_independent():
     assert trace.audit_apply_order(logs) == []
 
 
+def test_audit_snap_jumps_interleaved_with_partition_wal_records():
+    # A snapshot fold mid-stream, with partition-tagged WAL records (and
+    # other wal.* noise) interleaved: the auditor must key only on the
+    # apply/snapshot kinds and never read a wal record's seq-ish fields
+    # as apply-stream state.
+    logs = {"flight-a-1.jsonl": [
+        {"member": "a", **_apply("o", 1, 0)},
+        {"member": "a", "kind": "wal.append", "origin": "o", "part": 3,
+         "dseq": 40, "seq": 1},
+        {"member": "a", **_apply("o", 2, 2)},
+        {"member": "a", "kind": "wal.fsync", "part": 3, "seq": 3},
+        {"member": "a", "kind": "snap.apply", "origin": "o", "step": 7,
+         "seq": 4},
+        {"member": "a", "kind": "wal.append", "origin": "o", "part": 1,
+         "dseq": 41, "seq": 5},
+        {"member": "a", **_apply("o", 8, 6)},
+        {"member": "a", **_apply("o", 9, 7)},
+    ]}
+    assert trace.audit_apply_order(logs) == []
+
+
+def test_audit_shed_hole_heal_via_psnap_not_flagged():
+    # Load-shed drops deltas 3..9; partial anti-entropy heals the hole
+    # with a psnap carrying the publisher's digest seq; the stream then
+    # resumes at dig_seq+1. No gap-skip — psnap.resync is a legitimate
+    # cursor jump, exactly like snap.apply.
+    logs = {"flight-a-1.jsonl": [
+        {"member": "a", **_apply("o", 1, 0)},
+        {"member": "a", **_apply("o", 2, 1)},
+        {"member": "a", "kind": "psnap.resync", "origin": "o", "dig_seq": 9,
+         "parts": [2, 5], "seq": 2},
+        {"member": "a", "kind": "wal.append", "origin": "o", "part": 5,
+         "dseq": 77, "seq": 3},
+        {"member": "a", **_apply("o", 10, 4)},
+        {"member": "a", **_apply("o", 11, 5)},
+    ]}
+    assert trace.audit_apply_order(logs) == []
+    # A STALE psnap (dig_seq behind the cursor) must not rewind it:
+    # re-applying 10,11 after one would still be a double-apply.
+    logs["flight-a-1.jsonl"].append(
+        {"member": "a", "kind": "psnap.resync", "origin": "o", "dig_seq": 4,
+         "seq": 6})
+    logs["flight-a-1.jsonl"].append({"member": "a", **_apply("o", 11, 7)})
+    vs = trace.audit_apply_order(logs)
+    assert [(v["kind"], v["dseq"]) for v in vs] == [("double-apply", 11)]
+
+
 def test_cli_audit_exit_codes_and_json(fleet_dir, capsys):
     # The synthetic fleet's apply streams are clean.
     assert trace.main(["audit", fleet_dir]) == 0

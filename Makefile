@@ -102,10 +102,12 @@ bench-gate:
 
 # The crash-consistency drill (slow, real processes): SIGKILL a
 # WAL-backed worker mid-run, restart it, and require bit-identical
-# convergence twice — once via WAL recovery (checkpoint + delta
-# suffix), once with the WAL deleted via peer adoption.
+# convergence — via WAL recovery under EVERY durability discipline
+# (sync fsync-per-append, group commit, async watermark: recovery must
+# equal watermark truncation and the certifier's durability check must
+# pass), plus once with the WAL deleted via peer adoption.
 crash-demo:
-	env JAX_PLATFORMS=cpu $(PY) scripts/crash_recovery_demo.py --mode both
+	env JAX_PLATFORMS=cpu $(PY) scripts/crash_recovery_demo.py --mode both --durability all
 
 # Observability demo (slow, real processes): a 3-worker TCP gossip
 # fleet with the full obs plane on — live dashboard frames, LIVE scrapes

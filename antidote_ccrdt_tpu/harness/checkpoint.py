@@ -22,6 +22,15 @@ host database would use:
 
 Dense states checkpoint through `core.serial.dumps_dense` (npz + treedef
 manifest) — see `save_dense_checkpoint` / `load_dense_checkpoint`.
+
+The partitioned variants below (`save_partitioned_checkpoint`,
+`RejoinStreamer`) make the PARTITION the unit of durability: one shard
+file per partition plus a manifest commit marker, and rejoin streams
+divergent partitions in lag order. This is deliberately the same axis
+`harness/wal.py` shards its per-partition segment streams on (PR 11):
+a partition's whole durable footprint — its checkpoint shard and its
+WAL stream — can be recovered, compacted, or streamed to a rejoining
+worker without touching its siblings.
 """
 
 from __future__ import annotations

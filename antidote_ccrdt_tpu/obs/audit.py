@@ -195,6 +195,118 @@ def reconcile_op_counts(
     }
 
 
+# -- durability-watermark reconciliation -------------------------------------
+
+
+def reconcile_durability(
+    logs: Dict[str, List[Dict[str, Any]]]
+) -> Dict[str, Any]:
+    """Published-vs-durable reconciliation on the `wal.*` flight-event
+    axis (PR 11 async durability: gossip may ship a delta BEFORE its
+    fsync, so a crash can lose appended-but-unacked records — legal
+    ONLY if the loss is visible here and covered by a successor).
+
+    Per crashed incarnation (a flight log with no `proc.exit`): its
+    exposure is S = max `wal.append` wseq — everything it might have
+    published — against W = its last `wal.durable` watermark. S > W
+    means the crash could have dropped (W, S]. That exposure is COVERED
+    iff a later incarnation of the same member (ordered by `proc.start`
+    time) re-derives the range: its own `wal.append` trail reaches S
+    (it resumed at W+1 and re-ran the steps) or its `wal.recover`
+    found last_step >= S (the tail survived — group-mode under-claims).
+    Anything else is unaudited loss and fails the certificate with a
+    counterexample naming the uncovered seq range.
+
+    The check activates only when some log carries `wal.durable`
+    events (group/async modes emit them per flush; a sync-mode or
+    WAL-less run has no watermark discipline to audit)."""
+    incs: List[Any] = []
+    for fname, evs in sorted(logs.items()):
+        member = next(
+            (str(e["member"]) for e in evs if e.get("member")), fname
+        )
+        start_t = next(
+            (
+                float(e.get("t", 0.0))
+                for e in evs
+                if e.get("kind") == "proc.start"
+            ),
+            min((float(e.get("t", 0.0)) for e in evs), default=0.0),
+        )
+        incs.append((member, start_t, fname, evs))
+    active = any(
+        e.get("kind") == "wal.durable" for _, _, _, evs in incs for e in evs
+    )
+    exposed: List[Dict[str, Any]] = []
+    covered = 0
+    checked = 0
+    for member, t0, fname, evs in incs:
+        if any(e.get("kind") == "proc.exit" for e in evs):
+            continue  # clean exit: close() flushed, nothing exposed
+        appends = [
+            int(e["wseq"])
+            for e in evs
+            if e.get("kind") == "wal.append" and e.get("wseq") is not None
+        ]
+        if not appends:
+            continue
+        checked += 1
+        s_hi = max(appends)
+        w = max(
+            (
+                int(e["through"])
+                for e in evs
+                if e.get("kind") == "wal.durable"
+                and e.get("through") is not None
+            ),
+            default=-1,
+        )
+        if s_hi <= w:
+            continue  # everything appended was acked durable
+        ok = False
+        for m2, t2, f2, evs2 in incs:
+            if m2 != member or f2 == fname or t2 <= t0:
+                continue
+            a2 = max(
+                (
+                    int(e["wseq"])
+                    for e in evs2
+                    if e.get("kind") == "wal.append"
+                    and e.get("wseq") is not None
+                ),
+                default=-1,
+            )
+            r2 = max(
+                (
+                    int(e.get("last_step", -1))
+                    for e in evs2
+                    if e.get("kind") == "wal.recover"
+                ),
+                default=-1,
+            )
+            if a2 >= s_hi or r2 >= s_hi:
+                ok = True
+                break
+        if ok:
+            covered += 1
+        else:
+            exposed.append(
+                {
+                    "member": member,
+                    "durable_through": w,
+                    "exposed_through": s_hi,
+                    "uncovered": [w + 1, s_hi],
+                }
+            )
+    return {
+        "ok": not exposed,
+        "active": active,
+        "crashed_checked": checked,
+        "covered": covered,
+        "exposed": exposed,
+    }
+
+
 # -- convergence certificates ------------------------------------------------
 
 
@@ -244,11 +356,12 @@ def _counterexample(
     recon: Dict[str, Any],
     agreement: Optional[Dict[str, Any]],
     reference: Optional[Dict[str, Any]],
+    durability: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """The minimal slice an operator needs to localize the failure:
     WHICH partitions split, WHICH member groups hold which digest, the
     first causal violations, the first uncovered (applier, origin)
-    ranges."""
+    ranges, the first pre-fsync-loss exposures with their seq ranges."""
     out: Dict[str, Any] = {}
     if agreement is not None and not agreement.get("agree", True):
         out["divergent_parts"] = agreement.get("divergent_parts", [])
@@ -259,6 +372,8 @@ def _counterexample(
         out["causal_violations"] = causal[:5]
     if recon.get("uncovered"):
         out["uncovered"] = recon["uncovered"][:5]
+    if durability is not None and durability.get("exposed"):
+        out["durability_exposures"] = durability["exposed"][:5]
     return out
 
 
@@ -283,6 +398,7 @@ def certify(
         logs = obs_events.scan_dir(obs_dir) if obs_dir else {}
     causal = audit_apply_order(logs)
     recon = reconcile_op_counts(logs)
+    durability = reconcile_durability(logs)
     agreement = digest_agreement(digests) if digests else None
     reference_section: Optional[Dict[str, Any]] = None
     if reference is not None and digests:
@@ -306,6 +422,12 @@ def certify(
         checks["partition_digest_agreement"] = bool(agreement["agree"])
     if reference_section is not None:
         checks["matches_reference"] = bool(reference_section["ok"])
+    if durability["active"]:
+        # Only when some log carries a durability watermark: a run
+        # without group/async WAL has no published-vs-durable gap to
+        # audit, and an absent check must stay absent, not vacuously
+        # true.
+        checks["durability_watermark"] = bool(durability["ok"])
     ok = all(checks.values())
 
     doc: Dict[str, Any] = {
@@ -324,6 +446,7 @@ def certify(
             "violations": causal[:16],
         },
         "reconciliation": recon,
+        "durability": durability,
         "agreement": agreement,
         "reference": reference_section,
         "n_flight_logs": len(logs),
@@ -331,7 +454,7 @@ def certify(
     }
     if not ok:
         doc["counterexample"] = _counterexample(
-            causal, recon, agreement, reference_section
+            causal, recon, agreement, reference_section, durability
         )
     sign_certificate(doc)
     obs_events.emit(

@@ -47,6 +47,9 @@ Wired event kinds:
     transport.delta_write              (fs medium; the frame-send analog)
     peer.suspect / peer.dead / peer.realive   (SWIM transitions, with age)
     wal.append / wal.rotate / wal.checkpoint / wal.recover / wal.torn
+    wal.durable / wal.truncate         (group-commit flush acks and
+                                        watermark truncation: the
+                                        published-vs-durable audit axis)
     fault.hit                          (utils.faults firings)
     bridge.request / bridge.reconnect
     serve.query / serve.swap            (read-serving plane: batched

@@ -66,19 +66,23 @@ def _changed_mask(prev: Any, cur: Any) -> jax.Array:
 def state_delta(dense: Any, prev: Any, cur: Any) -> TopkRmvDelta:
     """Rows of `cur` that differ from `prev` (plus the whole small
     leaves). The changed-row mask is one fused device reduction; the row
-    gather runs once per publish, off the apply hot path."""
+    gather itself is HOST-side numpy fancy-indexing: the changed-row
+    count n differs on every publish, so an eager device gather would
+    recompile per distinct n (the mirror of the device scatter pathology
+    `expand_delta` avoids). The delta is serialized to bytes right after
+    anyway, so pulling the leaves to host here costs one transfer the
+    gossip path was about to pay regardless."""
     R, NK, I, M = cur.slot_score.shape
     D = cur.rmv_vc.shape[-1]
     mask = np.asarray(_changed_mask(prev, cur)).reshape(-1)
     rows = np.nonzero(mask)[0].astype(np.int32)
-    rj = jnp.asarray(rows)
-    flat = lambda x, w: x.reshape(R * NK * I, w)  # noqa: E731
+    flat = lambda x, w: np.asarray(x).reshape(R * NK * I, w)  # noqa: E731
     return TopkRmvDelta(
-        rows=rj,
-        slot_score=flat(cur.slot_score, M)[rj],
-        slot_dc=flat(cur.slot_dc, M)[rj],
-        slot_ts=flat(cur.slot_ts, M)[rj],
-        rmv_vc=flat(cur.rmv_vc, D)[rj],
+        rows=jnp.asarray(rows),
+        slot_score=jnp.asarray(flat(cur.slot_score, M)[rows]),
+        slot_dc=jnp.asarray(flat(cur.slot_dc, M)[rows]),
+        slot_ts=jnp.asarray(flat(cur.slot_ts, M)[rows]),
+        rmv_vc=jnp.asarray(flat(cur.rmv_vc, D)[rows]),
         vc=cur.vc,
         lossy=cur.lossy,
     )

@@ -123,13 +123,61 @@ class DeltaPublisher:
         self.seq = -1
         self._prev: Any = None
         self._serial = serial
+        # encode_delta stash: (seq, is_full) frozen so the publish that
+        # consumes a pre-cut blob takes the SAME anchor/pressure branch
+        # the encode did (a lag probe flipping between the two calls
+        # would otherwise ship a blob cut for the wrong branch).
+        self._next_plan: Optional[Tuple[int, bool]] = None
         # Serve-plane hook: called as on_publish(state, seq) after every
         # publish, the natural swap point for a read replica — the state
         # just shipped is exactly what peers will converge toward, so
         # serving it keeps reads within one round of the write frontier.
         self.on_publish: Optional[Callable[[Any, int], None]] = None
 
-    def publish(self, state: Any) -> Dict[str, Any]:
+    def _branch(self, seq: int) -> bool:
+        """True = `seq` publishes a full anchor. Evaluates (and counts)
+        the lag-pressure probe, so call once per seq — `encode_delta`
+        freezes its answer in `_next_plan` for the matching publish."""
+        full_every = self.full_every
+        pressured = False
+        if self.lag_source is not None:
+            try:
+                pressured = float(self.lag_source()) >= self.lag_threshold
+            except Exception:
+                pressured = False  # a broken probe must not stop publishing
+        if pressured and self.lag_full_every < full_every:
+            full_every = self.lag_full_every
+            self.store.metrics.count("net.lag_anchor_cuts")
+        return self._prev is None or seq % full_every == 0
+
+    def encode_delta(self, state: Any) -> Optional[Dict[str, Any]]:
+        """Pre-cut the NEXT publish's delta so callers can reuse ONE
+        join-decomposed delta for both the WAL record and the gossip
+        blob (`wal.log_step(..., delta=, blob=)` then
+        `publish(state, encoded=...)`) instead of extracting it twice.
+        Returns None when the next publish is a full anchor (anchors
+        ship whole snapshots; the WAL then cuts its own delta)."""
+        from .delta import make_delta
+
+        seq = self.seq + 1
+        is_full = self._branch(seq)
+        self._next_plan = (seq, is_full)
+        if is_full:
+            return None
+        if obs_spans.ACTIVE:
+            with obs_spans.span(
+                "round.delta_encode", origin=self.store.member, dseq=seq
+            ):
+                delta = make_delta(self.dense, self._prev, state)
+                blob = self._serial.dumps_dense(f"{self.name}_delta", delta)
+        else:
+            delta = make_delta(self.dense, self._prev, state)
+            blob = self._serial.dumps_dense(f"{self.name}_delta", delta)
+        return {"seq": seq, "delta": delta, "blob": blob}
+
+    def publish(
+        self, state: Any, encoded: Optional[Dict[str, Any]] = None
+    ) -> Dict[str, Any]:
         from .delta import make_delta
 
         from .monoid import LiftedMonoidState, MonoidLift
@@ -143,17 +191,13 @@ class DeltaPublisher:
                 "(parallel/monoid.py)"
             )
         self.seq += 1
-        full_every = self.full_every
-        pressured = False
-        if self.lag_source is not None:
-            try:
-                pressured = float(self.lag_source()) >= self.lag_threshold
-            except Exception:
-                pressured = False  # a broken probe must not stop publishing
-        if pressured and self.lag_full_every < full_every:
-            full_every = self.lag_full_every
-            self.store.metrics.count("net.lag_anchor_cuts")
-        if self._prev is None or self.seq % full_every == 0:
+        if self._next_plan is not None and self._next_plan[0] == self.seq:
+            is_full = self._next_plan[1]
+            self._next_plan = None
+        else:
+            self._next_plan = None
+            is_full = self._branch(self.seq)
+        if is_full:
             if obs_spans.ACTIVE:
                 # Full-snapshot anchor: serialize + hand to the medium.
                 with obs_spans.span("round.snapshot", seq=self.seq):
@@ -170,7 +214,16 @@ class DeltaPublisher:
                 )
             kind, nbytes = "full", -1
         else:
-            if obs_spans.ACTIVE:
+            if (
+                encoded is not None
+                and encoded.get("seq") == self.seq
+                and encoded.get("blob") is not None
+            ):
+                # Pre-cut by encode_delta (same _prev, same seq): the
+                # extraction cost was already paid — and already
+                # attributed to round.delta_encode — there.
+                blob = encoded["blob"]
+            elif obs_spans.ACTIVE:
                 with obs_spans.span(
                     "round.delta_encode", origin=self.store.member,
                     dseq=self.seq,

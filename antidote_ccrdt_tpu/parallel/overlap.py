@@ -34,6 +34,12 @@ already has:
    along — in one `core.batch_merge.fold_states` call (log2 N batched
    dispatches) instead of one dispatch per window.
 
+PR 11 adds the durability half of the bargain: `CommitCoalescer` runs
+group commit ON the host stage — WAL appends stage (write, no fsync) and
+the publish-boundary task, FIFO-after every append it covers, commits
+the whole batch with one fsync per dirty segment stream (see
+harness/wal.py for the three durability modes and the async watermark).
+
 Overflow policy (`ApplyQueue`): drop-oldest-delta-keep-anchor, mirroring
 `net/tcp.py`'s send-queue shed. Dropping delta seq k breaks the chained
 contiguity obligation for that member, so the shed also drops its later
@@ -64,6 +70,7 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 import warnings
 from collections import deque
 from typing import Any, Dict, List, Optional
@@ -187,6 +194,51 @@ class HostStage:
         if self._exc is not None:
             exc, self._exc = self._exc, None
             raise exc
+
+
+# -- group-commit coalescer ----------------------------------------------------
+
+
+class CommitCoalescer:
+    """Batches WAL fsyncs across members sharing a log device (PR 11
+    group commit). Every `ElasticWal` registered here stages its appends
+    (group/async durability); `flush()` — called from the publish-
+    boundary task running ON the HostStage, so it sits FIFO-after every
+    append it covers — commits all of them: one `wal.fsync` fault fire
+    and one fsync per dirty segment stream per member, instead of one
+    per append. `maybe_flush()` is the time-bounded variant for call
+    sites that run every round (a flush is forced anyway whenever a
+    member's own byte/time backstop trips inside `log_step`).
+
+    Single-member processes still win: consecutive ROUNDS between
+    publish boundaries share one fsync (`wal.group_size` histogram
+    records how many)."""
+
+    def __init__(self, metrics: Any = None, min_interval_ms: float = 0.0):
+        self.metrics = metrics
+        self.min_interval_ms = float(min_interval_ms)
+        self._wals: List[Any] = []
+        self._last = 0.0
+
+    def add(self, wal: Any) -> None:
+        if wal is not None and wal not in self._wals:
+            self._wals.append(wal)
+
+    def flush(self) -> int:
+        """Commit every registered member's staged batch. Returns the
+        total records acked durable across members."""
+        total = 0
+        for wal in self._wals:
+            total += wal.flush()
+        self._last = time.monotonic()
+        if total and self.metrics is not None:
+            self.metrics.count("wal.coalesced_commits")
+        return total
+
+    def maybe_flush(self) -> int:
+        if (time.monotonic() - self._last) * 1e3 < self.min_interval_ms:
+            return 0
+        return self.flush()
 
 
 # -- the bounded inbound apply queue ------------------------------------------
@@ -588,6 +640,15 @@ class OverlapPipeline:
         sequentially. Join algebra makes the order irrelevant; the
         flight-recorder apply events are emitted in queue order, which
         preserves per-member seq contiguity for `ccrdt_trace audit`."""
+        # The span brackets the WHOLE apply stage — queue pop, fold
+        # dispatch, the sequential fallback, AND the apply-event
+        # bookkeeping. Any of these can absorb tens of ms (the pop and
+        # the dispatch both ride behind the previous round's chained
+        # device work), so billing only the inner merge section left
+        # that wall time as unattributed gap in `spans.attribute`. The
+        # span's m0 is backdated over the pop (an empty drain emits no
+        # span at all — near-zero samples would skew the phase p50s).
+        t0 = time.monotonic() if obs_spans.ACTIVE else None
         entries = self.apq.pop_all()
         if not entries:
             return state
@@ -602,6 +663,8 @@ class OverlapPipeline:
             if obs_spans.ACTIVE
             else None
         )
+        if tok is not None:
+            tok["m0"] = t0
         try:
             merge = self.dense.merge
             i = 0
@@ -622,15 +685,19 @@ class OverlapPipeline:
                 except Exception:  # noqa: BLE001 — fall back per entry
                     state = self._apply_sequential(state, chunk)
             state = self._apply_sequential(state, rest)
+            for e in entries:
+                if e.kind == "delta":
+                    obs_events.emit(
+                        "delta.apply", origin=e.member, dseq=e.seq
+                    )
+                else:
+                    obs_events.emit(
+                        "snap.apply", origin=e.member, step=e.seq
+                    )
+                if e.seq > self.cursors.get(e.member, -1):
+                    self.cursors[e.member] = e.seq
         finally:
             obs_spans.end(tok)
-        for e in entries:
-            if e.kind == "delta":
-                obs_events.emit("delta.apply", origin=e.member, dseq=e.seq)
-            else:
-                obs_events.emit("snap.apply", origin=e.member, step=e.seq)
-            if e.seq > self.cursors.get(e.member, -1):
-                self.cursors[e.member] = e.seq
         self.metrics.count("overlap.windows", len(entries))
         return state
 

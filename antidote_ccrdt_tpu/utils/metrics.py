@@ -79,6 +79,14 @@ class Metrics:
         with self._lock:
             self.counters[name] = value
 
+    def observe(self, name: str, value: float) -> None:
+        """Record one sample into a named distribution (same reservoirs
+        the timers feed, so exporters/summary pick it up unchanged).
+        For non-duration histograms like `wal.group_size`."""
+        with self._lock:
+            rec = self.latencies.setdefault(name, LatencyRecorder())
+        rec.record(float(value))
+
     @contextlib.contextmanager
     def timer(self, name: str) -> Iterator[None]:
         # Recorder creation must hold the lock: two threads racing the

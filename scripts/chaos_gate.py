@@ -45,6 +45,14 @@ and the deterministic divergent arm must light every watchdog counter
 bound, time-to-agreement on heal) with the failed certificate's
 counterexample naming the diverging partition.
 
+The durability leg (PR 11) re-runs the real-process SIGKILL crash
+drill under async WAL durability (publish may overtake fsync) and
+holds the certifier's published-vs-durable reconciliation to both
+verdicts: the real fleet's exposed-then-re-derived loss must certify
+OK, and a deliberately fabricated pre-fsync-loss flight log (appended
+through seq 9, acked through 5, no successor) must FAIL certification
+with a counterexample naming the uncovered seq range.
+
 Run:  python scripts/chaos_gate.py
 Make: part of `make chaos` (after the pytest leg).
 """
@@ -361,6 +369,37 @@ def main() -> int:
           f"(sha256:{healthy['cert']['signature'][:16]}…, 0 false "
           f"alarms), divergence flagged in one exchange naming "
           f"partition {divergent['p_star']}")
+
+    # -- leg 7: async durability (published-vs-durable reconciliation) -----
+    dur = audit_demo.run_durability()
+    fleet = dur["fleet"]
+    print("== async-durability drill (SIGKILL fleet + fabricated "
+          "pre-fsync-loss arm) ==")
+    print(f"  fleet: kill_seq={fleet['kill_seq']} "
+          f"appended={fleet['victim_flight_last_step']} "
+          f"durable={fleet['victim_flight_durable']} "
+          f"recovered_to={fleet['victim_recover_last_step']} "
+          f"checks={fleet['certifier_checks']}")
+    print(f"  fabricated: cert_ok={dur['fabricated_cert_ok']} "
+          f"exposures={dur['fabricated_exposures']}")
+    if not fleet["ok"]:
+        print("FAIL: async-durability fleet drill — "
+              f"{fleet['problems']}")
+        return 1
+    if fleet["certifier_checks"].get("durability_watermark") is not True:
+        print("FAIL: the certifier's durability_watermark check did not "
+              f"activate+pass on the async fleet: "
+              f"{fleet['certifier_checks']}")
+        return 1
+    if not dur["fabricated_flagged"]:
+        print("FAIL: the fabricated pre-fsync-loss arm was NOT flagged — "
+              "the certifier waved provable unaudited loss through "
+              f"(exposures: {dur['fabricated_exposures']})")
+        return 1
+    print("OK: durability leg — async crash recovered to the watermark "
+          "and certified (loss re-derived by the successor); fabricated "
+          "loss flagged with uncovered range "
+          f"{dur['fabricated_exposures'][0]['uncovered']}")
     return 0
 
 

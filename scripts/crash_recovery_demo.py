@@ -19,7 +19,26 @@ Two modes, both required by the robustness PR's acceptance bar:
   path (``wal.recovered_records`` absent) and still converge — the PR 1
   invariant stays load-bearing when the durable path is gone.
 
-Run:  python scripts/crash_recovery_demo.py [--mode both] [--type topk_rmv]
+Both modes now run under every WAL durability discipline (PR 11:
+``--durability sync|group|async|all``, exported to the workers as
+``CCRDT_WAL_DURABILITY``). Per-mode assertions, all post-mortem from
+the flight logs:
+
+* sync/group — durable-before-visible: the restarted victim's
+  ``wal.recover`` must reach at least the seq the victim had PUBLISHED
+  at kill time (group commit flushes at the boundary, before publish).
+* async — recovery == watermark truncation: recover.last_step must be
+  bracketed by the killed incarnation's last ``wal.durable`` watermark
+  (nothing acked is lost) and its last ``wal.append`` (nothing is
+  invented), and the obs/audit certifier's ``durability_watermark``
+  check must pass — any appended-but-unacked records the crash dropped
+  are audited as covered by the successor, never silently gone.
+
+Digest equality against the sequential reference stays bit-exact in
+every combination.
+
+Run:  python scripts/crash_recovery_demo.py [--mode both]
+          [--durability all] [--type topk_rmv]
 Make: make crash-demo
 """
 
@@ -42,7 +61,7 @@ MEMBERS = ("w0", "w1", "w2")
 VICTIM = "w1"
 
 
-def _env(root: str) -> dict:
+def _env(root: str, durability: str) -> dict:
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # parent flags (device counts) break workers
     env["JAX_PLATFORMS"] = "cpu"
@@ -50,16 +69,18 @@ def _env(root: str) -> dict:
     # SIGKILL — that is the point) + exit-time metrics snapshots.
     env["CCRDT_OBS_DIR"] = os.path.join(root, "obs")
     env["CCRDT_METRICS_DIR"] = os.path.join(root, "metrics")
+    env["CCRDT_WAL_DURABILITY"] = durability
     return env
 
 
-def _launch(root: str, member: str, type_name: str, wal_dir: str):
+def _launch(root: str, member: str, type_name: str, wal_dir: str,
+            durability: str):
     return subprocess.Popen(
         [sys.executable, DEMO, "--root", root, "--member", member,
          "--n-members", str(len(MEMBERS)), "--type", type_name,
          "--wal-dir", wal_dir],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=_env(root),
-        text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        env=_env(root, durability), text=True,
     )
 
 
@@ -75,13 +96,17 @@ def _snap_seq(root: str, member: str):
     return struct.unpack("<Q", hdr)[0]
 
 
-def run_scenario(mode: str, type_name: str, timeout: float) -> dict:
+def run_scenario(
+    mode: str, type_name: str, timeout: float, durability: str = "group"
+) -> dict:
     """One kill/restart drill; returns a verdict dict (ok + evidence)."""
     from scripts.elastic_demo import reference_digest
 
-    root = tempfile.mkdtemp(prefix=f"crash-{mode}-")
+    root = tempfile.mkdtemp(prefix=f"crash-{mode}-{durability}-")
     wal_dir = os.path.join(root, "wal")
-    procs = {m: _launch(root, m, type_name, wal_dir) for m in MEMBERS}
+    procs = {
+        m: _launch(root, m, type_name, wal_dir, durability) for m in MEMBERS
+    }
 
     # Wait for the victim to have durable, published progress (a couple
     # of steps in the WAL AND visible to peers), then SIGKILL it.
@@ -106,7 +131,8 @@ def run_scenario(mode: str, type_name: str, timeout: float) -> dict:
         # must self-regenerate — convergence without WAL recovery.
         shutil.rmtree(os.path.join(wal_dir, f"wal-{VICTIM}"), ignore_errors=True)
         time.sleep(1.0)
-    procs[VICTIM] = _launch(root, VICTIM, type_name, wal_dir)
+    procs[VICTIM] = _launch(root, VICTIM, type_name, wal_dir, durability)
+    restart_pid = procs[VICTIM].pid
 
     rcs, outs = {}, {}
     for m, p in procs.items():
@@ -163,8 +189,69 @@ def run_scenario(mode: str, type_name: str, timeout: float) -> dict:
             f"was killed at published seq {kill_seq}"
         )
 
+    # Durability-mode post-mortem (PR 11): the killed incarnation's last
+    # acked watermark (wal.durable) vs where the restarted incarnation's
+    # wal.recover actually landed.
+    flight_durable = max(
+        (int(e["through"]) for e in killed_log
+         if e.get("kind") == "wal.durable"),
+        default=-1,
+    )
+    restart_log = obs_events.read_log(
+        os.path.join(root, "obs", f"flight-{VICTIM}-{restart_pid}.jsonl")
+    )
+    recover_ev = next(
+        (e for e in restart_log if e.get("kind") == "wal.recover"), None
+    )
+    recovered_last = (
+        None if recover_ev is None else int(recover_ev["last_step"])
+    )
+    if mode == "wal" and recover_ev is None:
+        bad.append("restarted victim emitted no wal.recover event")
+    elif mode == "wal" and durability in ("sync", "group"):
+        # Durable-before-visible: anything the victim had PUBLISHED was
+        # fsync-acked first (sync: per append; group: boundary flush
+        # precedes the publish), so recovery must reach the kill seq.
+        if recovered_last < kill_seq:
+            bad.append(
+                f"{durability}: recovered last_step {recovered_last} < "
+                f"published seq {kill_seq} at kill — acked record lost"
+            )
+    elif mode == "wal" and durability == "async":
+        # Recovery == watermark truncation: the resume point is
+        # bracketed by the killed incarnation's last ack (below it an
+        # acked record was lost) and its last append (above it recovery
+        # invented records the victim never wrote).
+        if recovered_last < flight_durable:
+            bad.append(
+                f"async: recovered last_step {recovered_last} < durable "
+                f"watermark {flight_durable} — acked record lost"
+            )
+        if flight_last_step is not None and recovered_last > flight_last_step:
+            bad.append(
+                f"async: recovered last_step {recovered_last} > last "
+                f"appended {flight_last_step} — recovery past the log"
+            )
+
+    # Certifier reconciliation over the whole fleet's flight logs: any
+    # records the crash dropped past the watermark must be audited as
+    # covered by the successor incarnation — zero unaudited loss.
+    from antidote_ccrdt_tpu.obs import audit as obs_audit
+
+    cert = obs_audit.certify(obs_dir=os.path.join(root, "obs"))
+    if durability in ("group", "async") and "durability_watermark" not in (
+        cert["checks"]
+    ):
+        bad.append(f"{durability}: certifier durability check never activated")
+    if cert["checks"].get("durability_watermark") is False:
+        bad.append(
+            "certifier durability_watermark FAILED: "
+            + json.dumps(cert["durability"].get("exposed", []))
+        )
+
     verdict = {
         "mode": mode,
+        "durability": durability,
         "type": type_name,
         "ok": not bad,
         "problems": bad,
@@ -175,6 +262,9 @@ def run_scenario(mode: str, type_name: str, timeout: float) -> dict:
         "kill_seq": kill_seq,
         "victim_flight_events": len(killed_log),
         "victim_flight_last_step": flight_last_step,
+        "victim_flight_durable": flight_durable,
+        "victim_recover_last_step": recovered_last,
+        "certifier_checks": cert["checks"],
         "returncodes": rcs,
         "root": root,
     }
@@ -187,12 +277,33 @@ def run_scenario(mode: str, type_name: str, timeout: float) -> dict:
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", default="both", choices=("wal", "adopt", "both"))
+    ap.add_argument(
+        "--durability", default="all",
+        choices=("sync", "group", "async", "all"),
+        help="WAL durability discipline for the fleet (all = drill each)",
+    )
     ap.add_argument("--type", default="topk_rmv")
     ap.add_argument("--timeout", type=float, default=240.0)
     args = ap.parse_args()
 
     modes = ("wal", "adopt") if args.mode == "both" else (args.mode,)
-    verdicts = [run_scenario(m, args.type, args.timeout) for m in modes]
+    durabilities = (
+        ("sync", "group", "async")
+        if args.durability == "all" else (args.durability,)
+    )
+    # The wal-mode drill runs under EVERY durability discipline (its
+    # assertions differ per mode); adopt deletes the WAL outright, so
+    # one representative durability is enough.
+    plan = []
+    if "wal" in modes:
+        plan += [("wal", d) for d in durabilities]
+    if "adopt" in modes:
+        plan.append(("adopt", "group" if "group" in durabilities
+                     else durabilities[0]))
+    verdicts = [
+        run_scenario(m, args.type, args.timeout, durability=d)
+        for m, d in plan
+    ]
     print(json.dumps(verdicts, indent=2), flush=True)
     if not all(v["ok"] for v in verdicts):
         sys.exit(1)

@@ -321,6 +321,16 @@ def main() -> None:
         "regenerating its whole history via peer adoption",
     )
     ap.add_argument("--wal-segment-bytes", type=int, default=256 << 10)
+    ap.add_argument(
+        "--wal-durability", default="",
+        choices=("", "sync", "group", "async"),
+        help="WAL durability mode (harness/wal.py): sync = fsync per "
+        "append (legacy), group = group commit — appends stage and the "
+        "publish boundary fsyncs the whole batch once (default), async "
+        "= publish may ship before the fsync; the durable watermark is "
+        "published (wal.durable_seq) and recovery truncates to it. "
+        "Empty = CCRDT_WAL_DURABILITY env, else group",
+    )
     args = ap.parse_args()
 
     import jax
@@ -416,7 +426,9 @@ def run_worker(store, drill, dense, state, args, result_dir):
     plane = serve_mod.install_from_env(
         dense, args.member, metrics=store.metrics, lag_tracker=lag_tracker
     )
-    ctx = {"ovl": None}  # filled below; health_extra closes over the cell
+    ctx = {"ovl": None, "wal": None}  # filled below; health_extra
+    # closes over the cells (the scrape server may call before they are
+    # assigned, so the dict — not late locals — carries them)
 
     def _serve_swap(view, seq) -> None:
         if plane is not None:
@@ -436,6 +448,16 @@ def run_worker(store, drill, dense, state, args, result_dir):
                 len(ctx["ovl"].apq) if ctx["ovl"] is not None else 0
             ),
         }
+        w = ctx["wal"]
+        if w is not None:
+            # Durability readiness: how exposed is this worker right now
+            # (async mode: appended-but-unfsynced records a crash would
+            # truncate; sync/group: always 0 outside a staged batch).
+            doc["wal_durability"] = w.durability
+            doc["wal_durable_seq"] = int(w.durable_seq)
+            doc["wal_durability_lag"] = int(
+                max(0, w._last_appended - w.durable_seq)
+            )
         doc.update(watchdog.health_fields())
         if plane is not None:
             doc.update(plane.health_fields())
@@ -467,7 +489,14 @@ def run_worker(store, drill, dense, state, args, result_dir):
             wal_dir, args.member, dense, drill.publish_name,
             segment_bytes=getattr(args, "wal_segment_bytes", 256 << 10),
             metrics=store.metrics,
+            partitions=int(getattr(args, "partitions", 0) or 0) or None,
+            durability=getattr(args, "wal_durability", "") or None,
         )
+        ctx["wal"] = wal
+        from antidote_ccrdt_tpu.parallel.overlap import CommitCoalescer
+
+        coalescer = CommitCoalescer(metrics=store.metrics)
+        coalescer.add(wal)
         rec_state, last_step, rec_owned = wal.recover(
             drill.pub_state(dense, state)
         )
@@ -579,6 +608,11 @@ def run_worker(store, drill, dense, state, args, result_dir):
                 if k.startswith("net.sendq.")
             },
             "wal_last_seq": counters.get("wal.last_seq"),
+            "wal_durable_seq": counters.get("wal.durable_seq"),
+            "wal_durability_lag": counters.get("wal.durability_lag"),
+            "wal_durability": (
+                ctx["wal"].durability if ctx["wal"] is not None else None
+            ),
             "serve": serve_doc,
             "audit": watchdog.status_fields(),
         }
@@ -659,6 +693,15 @@ def run_worker(store, drill, dense, state, args, result_dir):
                 pass
             finally:
                 obs_spans.end(tok)
+            if wal is not None and wal.durability != "async":
+                # Group commit: this boundary task runs FIFO-after every
+                # append it covers, so ONE flush here makes the whole
+                # batch durable BEFORE the publish below makes any of it
+                # visible (the write-ahead contract, batched). Async
+                # mode skips it on purpose — the publish may overtake
+                # the fsync, and the published wal.durable_seq watermark
+                # plus the certifier account for exactly that window.
+                coalescer.flush()
             if pub is not None:
                 pub.publish(view)  # pub.on_publish swaps the read replica
             else:
@@ -768,6 +811,8 @@ def run_worker(store, drill, dense, state, args, result_dir):
                 )
             if step % args.publish_every == 0:
                 with store.metrics.timer("net.round"):
+                    if wal is not None and wal.durability != "async":
+                        coalescer.flush()  # durable before visible
                     do_publish(store, step)
                     state, _ = do_sweep(store, state)
                 feed_lag()
@@ -818,6 +863,11 @@ def run_worker(store, drill, dense, state, args, result_dir):
     # changing),
     # so a victim running slow under load gets waited out instead of
     # abandoned at a flat cutoff; a truly wedged fleet still exits.
+    if wal is not None and wal.durability != "async":
+        # The trailing steps since the last publish boundary are still
+        # staged; the convergence loop below publishes state that
+        # includes them, so commit the batch before anything ships.
+        coalescer.flush()
     deadline = time.time() + 10
     hard_deadline = time.time() + 60
     confirmed_dead: set = set()

@@ -132,6 +132,26 @@ def _fmt_lag(status: Optional[Dict[str, Any]]) -> str:
     )
 
 
+def _fmt_wal(status: Optional[Dict[str, Any]]) -> str:
+    """WAL column group: durability mode, appended vs durable watermark,
+    and the exposure lag between them (PR 11 group/async commit — a
+    nonzero lag in async mode is the published-before-fsync window the
+    certifier audits; in group mode it is at most one staged batch)."""
+    st = status or {}
+    last = st.get("wal_last_seq")
+    if last is None:
+        return "-"
+    mode = str(st.get("wal_durability") or "?")[:1]  # s/g/a
+    durable = st.get("wal_durable_seq")
+    lag = st.get("wal_durability_lag")
+    out = f"{mode}:{int(last)}"
+    if durable is not None:
+        out += f"/{int(durable)}"
+    if lag:
+        out += f" +{int(lag)}"
+    return out
+
+
 def _fmt_sendq(status: Optional[Dict[str, Any]]) -> str:
     q = (status or {}).get("sendq") or {}
     if not q:
@@ -200,7 +220,7 @@ def render_frame(root: str, clear: bool = True) -> str:
     lines.append(f"== ccrdt gossip dashboard  root={root}  t={time.time():.2f}")
     hdr = (
         f"{'member':<10}{'zone':<6}{'hb-age':>8} {'state':<9}{'snap':>5} "
-        f"{'delta-window':<14}{'wal':>5}  {'sendq':<16}"
+        f"{'delta-window':<14}{'wal m:last/dur':>14}  {'sendq':<16}"
         f"{'lag (peer:ops/secs)':<26}  {'serving':<34}  {'audit'}"
     )
     lines.append(hdr)
@@ -231,11 +251,10 @@ def render_frame(root: str, clear: bool = True) -> str:
         age = "-" if r["hb_age"] is None else f"{r['hb_age']:.2f}s"
         d = r["deltas"]
         window = f"{d[0]}..{d[-1]}" if d else "-"
-        wal = (st or {}).get("wal_last_seq")
         lines.append(
             f"{m:<10}{z:<6}{age:>8} {r['state']:<9}"
             f"{'-' if r['snap'] is None else r['snap']:>5} "
-            f"{window:<14}{'-' if wal is None else int(wal):>5}  "
+            f"{window:<14}{_fmt_wal(st):>14}  "
             f"{_fmt_sendq(st):<16}{_fmt_lag(st):<26}  "
             f"{_fmt_serve(st, m):<34}  {_fmt_audit(st)}"
         )

@@ -102,30 +102,32 @@ def test_backend_groups_compare_independently(tmp_path):
 def test_gap_gate_vacuous_then_pass_then_fail(tmp_path):
     code, verdict = gate.evaluate_gap([], 0.20)
     assert code == 0 and "vacuous" in verdict
-    _round(tmp_path, 1, merges=[100.0], backend="cpu", gap=10.0)
+    _round(tmp_path, 1, merges=[100.0], backend="cpu", gap=200.0)
     attr = gate.load_attribution_rounds(str(tmp_path))
     code, _ = gate.evaluate_gap(attr, 0.20)
     assert code == 0  # one carrier: vacuous
-    _round(tmp_path, 2, merges=[100.0], backend="cpu", gap=11.5)
+    _round(tmp_path, 2, merges=[100.0], backend="cpu", gap=230.0)
     attr = gate.load_attribution_rounds(str(tmp_path))
     code, verdict = gate.evaluate_gap(attr, 0.20)
     assert code == 0 and "OK" in verdict  # +15% < 20%
-    _round(tmp_path, 3, merges=[100.0], backend="cpu", gap=13.0)
+    _round(tmp_path, 3, merges=[100.0], backend="cpu", gap=260.0)
     attr = gate.load_attribution_rounds(str(tmp_path))
     code, verdict = gate.evaluate_gap(attr, 0.20)
     assert code == 1 and "FAIL" in verdict  # +30% vs BEST prior (r1)
 
 
 def test_gap_gate_absolute_floor_absorbs_noise(tmp_path):
-    # Near-zero gaps: +100% relative but 0.08ms absolute is noise, not a
-    # regression — the 0.25ms floor must absorb it.
-    _round(tmp_path, 1, merges=[100.0], backend="cpu", gap=0.08)
-    _round(tmp_path, 2, merges=[100.0], backend="cpu", gap=0.16)
+    # Small gaps: +175% relative but 14ms absolute is within one CFS
+    # throttle window on a shared-CPU carrier — the 40ms floor must
+    # absorb it (the gate hunts 100ms-class host-tail slides, not
+    # scheduler noise).
+    _round(tmp_path, 1, merges=[100.0], backend="cpu", gap=8.0)
+    _round(tmp_path, 2, merges=[100.0], backend="cpu", gap=22.0)
     attr = gate.load_attribution_rounds(str(tmp_path))
     code, _ = gate.evaluate_gap(attr, 0.20)
     assert code == 0
     # ...while a real slide well past the floor still fails.
-    _round(tmp_path, 3, merges=[100.0], backend="cpu", gap=0.9)
+    _round(tmp_path, 3, merges=[100.0], backend="cpu", gap=90.0)
     attr = gate.load_attribution_rounds(str(tmp_path))
     code, verdict = gate.evaluate_gap(attr, 0.20)
     assert code == 1 and "FAIL" in verdict

@@ -2,10 +2,13 @@
 
 Runs scripts/crash_recovery_demo.py: a 3-member shared-directory gossip
 fleet with the WAL enabled, the victim SIGKILLed mid-run and restarted.
-Asserted twice — recovery through the WAL (checkpoint ⊔ delta suffix,
-resume past the last durable step) and, with the WAL deleted, through
-the peer-adoption fallback — both converging bit-identically to the
-sequential reference.
+Asserted along two axes — the recovery PATH (through the WAL: checkpoint
+⊔ delta suffix, resume past the last durable step; or, with the WAL
+deleted, through the peer-adoption fallback) and the DURABILITY
+discipline (PR 11: sync fsync-per-append, group commit, and async with
+the published-vs-durable watermark — the demo asserts recovery ==
+watermark truncation and the obs/audit certifier's durability check).
+Every combination converges bit-identically to the sequential reference.
 """
 
 import json
@@ -19,12 +22,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEMO = os.path.join(REPO, "scripts", "crash_recovery_demo.py")
 
 
-def _run(mode):
+def _run(mode, durability):
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env["JAX_PLATFORMS"] = "cpu"
     p = subprocess.run(
-        [sys.executable, DEMO, "--mode", mode],
+        [sys.executable, DEMO, "--mode", mode, "--durability", durability],
         capture_output=True, text=True, env=env, timeout=420,
     )
     assert p.returncode == 0, f"drill failed:\n{p.stdout[-4000:]}\n{p.stderr[-2000:]}"
@@ -32,15 +35,28 @@ def _run(mode):
 
 
 @pytest.mark.slow
-def test_sigkill_victim_recovers_via_wal():
-    (v,) = _run("wal")
+@pytest.mark.parametrize("durability", ["sync", "group", "async"])
+def test_sigkill_victim_recovers_via_wal(durability):
+    (v,) = _run("wal", durability)
     assert v["ok"], v
+    assert v["durability"] == durability
     assert v["victim_recovered_records"] > 0
     assert v["victim_resume_step"] is not None and v["victim_resume_step"] >= 1
+    if durability in ("group", "async"):
+        # The durability-watermark reconciliation must have ACTIVATED
+        # (these modes emit wal.durable acks) and passed: any records
+        # the SIGKILL dropped past the watermark were audited as
+        # re-derived by the restarted incarnation.
+        assert v["certifier_checks"].get("durability_watermark") is True, v
+    if durability == "async":
+        # Recovery == watermark truncation: resume point bracketed by
+        # the killed incarnation's last ack and last append.
+        assert v["victim_recover_last_step"] >= v["victim_flight_durable"], v
+        assert v["victim_recover_last_step"] <= v["victim_flight_last_step"], v
 
 
 @pytest.mark.slow
 def test_sigkill_victim_without_wal_converges_via_adoption():
-    (v,) = _run("adopt")
+    (v,) = _run("adopt", "group")
     assert v["ok"], v
     assert v["victim_recovered_records"] == 0

@@ -250,6 +250,256 @@ def test_ckpt_replace_fault_preserves_previous_checkpoint(tmp_path):
     assert rec is not None
 
 
+# --- durability modes (group commit / async watermark) ----------------------
+
+
+def _freeze_backstops(monkeypatch):
+    """Disable the byte/time group-commit backstops so tests control
+    exactly when flush() happens (JIT compile pauses would otherwise
+    trip the time bound mid-_log_steps)."""
+    monkeypatch.setenv("CCRDT_WAL_GROUP_MS", "1000000")
+    monkeypatch.setenv("CCRDT_WAL_GROUP_BYTES", str(1 << 30))
+
+
+def test_group_commit_stages_until_flush(tmp_path, monkeypatch):
+    _freeze_backstops(monkeypatch)
+    drill, dense, state = _drill("topk_rmv")
+    m = Metrics()
+    wal = ElasticWal(str(tmp_path), "w0", dense, drill.publish_name,
+                     metrics=m, durability="group")
+    assert wal.durability == "group"
+    state = _log_steps(drill, dense, state, wal, 3, [0])
+    # Appended + staged, but nothing is fsync-acked yet.
+    assert wal.log.last_seq == 2
+    assert wal.durable_seq == -1
+    assert m.counters.get("wal.durability_lag") == 3.0
+    # One flush acks the whole batch.
+    assert wal.flush() == 3
+    assert wal.durable_seq == 2
+    assert m.counters.get("wal.durability_lag") == 0.0
+    assert m.counters.get("wal.flushes") == 1
+    assert m.snapshot()["latencies"].get("wal.group_size") == [3.0]
+    assert wal.flush() == 0  # nothing staged -> no second ack
+    wal.close()
+
+    # The flushed log recovers exactly like a sync-mode one.
+    drill2, dense2, state2 = _drill("topk_rmv")
+    wal2 = ElasticWal(str(tmp_path), "w0", dense2, drill2.publish_name)
+    rec, last_step, _ = wal2.recover(drill2.pub_state(dense2, state2))
+    wal2.close()
+    assert last_step == 2
+    state2 = drill2.set_view(dense2, state2, rec)
+    assert drill2.digest(dense2, state2) == drill.digest(dense, state)
+
+
+def test_group_fsync_fault_poisons_whole_batch(tmp_path, monkeypatch):
+    """One injected EIO at flush() fail-stops the ENTIRE batch: nothing
+    is acked (no partial commit), the staged records stay pending, and a
+    retry re-commits the same batch."""
+    _freeze_backstops(monkeypatch)
+    drill, dense, state = _drill("topk_rmv")
+    wal = ElasticWal(str(tmp_path), "w0", dense, drill.publish_name,
+                     durability="group")
+    _log_steps(drill, dense, state, wal, 2, [0])
+    with faults.injected({"wal.fsync": [{"action": "raise", "at": [0]}]}):
+        with pytest.raises(faults.InjectedFault):
+            wal.flush()
+        assert wal.durable_seq == -1  # whole batch poisoned, zero acks
+        assert wal.flush() == 2       # retry commits the SAME batch
+    assert wal.durable_seq == 1
+    wal.close()
+
+
+def test_async_recovery_truncates_to_watermark(tmp_path, monkeypatch):
+    """async durability: a crash loses exactly the appended-but-unacked
+    tail — recovery truncates every stream to the fsync'd wm watermark
+    and replays precisely the certified-durable prefix."""
+    _freeze_backstops(monkeypatch)
+    drill, dense, state = _drill("topk_rmv")
+    wal = ElasticWal(str(tmp_path), "w0", dense, drill.publish_name,
+                     durability="async")
+    digest_at = {}
+    for step in range(5):
+        pre = drill.pub_state(dense, state)
+        state = drill.apply(dense, state, step, [0])
+        wal.log_step(step, [0], pre, drill.pub_state(dense, state))
+        digest_at[step] = drill.digest(dense, state)
+        if step == 2:
+            wal.flush()  # watermark advances to 2; steps 3..4 stay staged
+    assert wal.durable_seq == 2 and wal.log.last_seq == 4
+    # Crash: abandon the wal WITHOUT close() (close would flush the tail).
+    del wal
+
+    drill2, dense2, state2 = _drill("topk_rmv")
+    m = Metrics()
+    wal2 = ElasticWal(str(tmp_path), "w0", dense2, drill2.publish_name,
+                      metrics=m, durability="async")
+    rec, last_step, _ = wal2.recover(drill2.pub_state(dense2, state2))
+    wal2.close()
+    assert last_step == 2  # NOT 4: the unacked tail must not resurrect
+    assert m.counters.get("wal.truncated_records") == 2
+    state2 = drill2.set_view(dense2, state2, rec)
+    assert drill2.digest(dense2, state2) == digest_at[2]
+
+
+def test_async_reopen_seeds_watermark_over_existing_log(tmp_path, monkeypatch):
+    """A sync/group log reopened as async and crashed BEFORE its first
+    flush must not truncate records the earlier run made durable: the
+    open seeds the wm watermark at the on-disk tail."""
+    _freeze_backstops(monkeypatch)
+    drill, dense, state = _drill("topk_rmv")
+    wal = ElasticWal(str(tmp_path), "w0", dense, drill.publish_name,
+                     durability="group")
+    state = _log_steps(drill, dense, state, wal, 3, [0])
+    wal.close()  # close flushes: all 3 records durable
+
+    wal2 = ElasticWal(str(tmp_path), "w0", dense, drill.publish_name,
+                      durability="async")
+    assert wal2.durable_seq == 2  # seeded, not -1
+    del wal2  # crash before any append/flush
+
+    drill3, dense3, state3 = _drill("topk_rmv")
+    m = Metrics()
+    wal3 = ElasticWal(str(tmp_path), "w0", dense3, drill3.publish_name,
+                      metrics=m, durability="async")
+    rec, last_step, _ = wal3.recover(drill3.pub_state(dense3, state3))
+    wal3.close()
+    assert last_step == 2
+    assert m.counters.get("wal.truncated_records", 0) == 0
+
+
+def test_non_async_reopen_discards_stale_watermark(tmp_path, monkeypatch):
+    """Reopening an async log as group applies the watermark truncation
+    ONCE (the stale tail was never acked no matter how we reopen), then
+    deletes the wm dir so it can never truncate future durable records."""
+    _freeze_backstops(monkeypatch)
+    drill, dense, state = _drill("topk_rmv")
+    wal = ElasticWal(str(tmp_path), "w0", dense, drill.publish_name,
+                     durability="async")
+    for step in range(4):
+        pre = drill.pub_state(dense, state)
+        state = drill.apply(dense, state, step, [0])
+        wal.log_step(step, [0], pre, drill.pub_state(dense, state))
+        if step == 1:
+            wal.flush()  # watermark 1; steps 2..3 unacked
+    del wal  # crash
+
+    wal2 = ElasticWal(str(tmp_path), "w0", dense, drill.publish_name,
+                      durability="group")
+    assert wal2.log.last_seq == 1  # truncated to the watermark
+    assert not os.path.isdir(os.path.join(tmp_path, "wal-w0", "wm"))
+    wal2.close()
+
+
+# --- per-partition parallel streams -----------------------------------------
+
+
+def _route_by_step(monkeypatch, nparts=4):
+    """Make the partition tag deterministic per logged step so records
+    round-robin across streams (the real `delta_parts` projection is
+    data-dependent; routing policy, not partition math, is under test)."""
+    from antidote_ccrdt_tpu.core import partition as pt
+
+    counter = iter(range(10_000))
+    monkeypatch.setattr(
+        pt, "delta_parts", lambda *a, **k: {next(counter) % nparts}
+    )
+
+
+def test_multistream_round_trip_merges_by_seq(tmp_path, monkeypatch):
+    _freeze_backstops(monkeypatch)
+    _route_by_step(monkeypatch)
+    drill, dense, state = _drill("topk_rmv")
+    wal = ElasticWal(str(tmp_path), "w0", dense, drill.publish_name,
+                     partitions=4, durability="group")
+    assert wal.nstreams == 4
+    state = _log_steps(drill, dense, state, wal, 8, [0])
+    wal.close()
+    wal_dir = os.path.join(tmp_path, "wal-w0")
+    # Round-robin routing: stream 0 stays the top-level dir, streams
+    # 1..3 are subdirs, each holding its share of the records.
+    for s in (1, 2, 3):
+        sdir = os.path.join(wal_dir, f"stream-{s:02d}")
+        assert os.path.isdir(sdir)
+        assert any(f.endswith(".wal") for f in os.listdir(sdir))
+
+    # A LEGACY reader (no partitions configured) still discovers every
+    # on-disk stream and recovers the seq-merged whole.
+    drill2, dense2, state2 = _drill("topk_rmv")
+    m = Metrics()
+    wal2 = ElasticWal(str(tmp_path), "w0", dense2, drill2.publish_name,
+                      metrics=m)
+    assert wal2.nstreams == 4  # forced up by the on-disk layout
+    rec, last_step, _ = wal2.recover(drill2.pub_state(dense2, state2))
+    wal2.close()
+    assert last_step == 7
+    assert m.counters.get("wal.recovered_records") == 8
+    state2 = drill2.set_view(dense2, state2, rec)
+    assert drill2.digest(dense2, state2) == drill.digest(dense, state)
+
+
+def test_multistream_torn_tail_loses_only_that_streams_tail(
+    tmp_path, monkeypatch
+):
+    """A crash tears ONE stream's final record: the other streams'
+    records survive, recovery lands one step short, and redoing the lost
+    step reproduces the full run — the per-stream analog of
+    test_recover_with_torn_final_record."""
+    _freeze_backstops(monkeypatch)
+    _route_by_step(monkeypatch)
+    drill, dense, state = _drill("topk_rmv")
+    wal = ElasticWal(str(tmp_path), "w0", dense, drill.publish_name,
+                     partitions=4, durability="group")
+    state = _log_steps(drill, dense, state, wal, 8, [0])
+    wal.close()
+    # Step 7 routed to stream 3 (7 % 4); tear its segment tail.
+    sdir = os.path.join(tmp_path, "wal-w0", "stream-03")
+    seg = os.path.join(
+        sdir, sorted(f for f in os.listdir(sdir) if f.endswith(".wal"))[-1]
+    )
+    os.truncate(seg, os.path.getsize(seg) - 7)
+
+    drill2, dense2, state2 = _drill("topk_rmv")
+    m = Metrics()
+    wal2 = ElasticWal(str(tmp_path), "w0", dense2, drill2.publish_name,
+                      partitions=4, metrics=m)
+    rec, last_step, _ = wal2.recover(drill2.pub_state(dense2, state2))
+    wal2.close()
+    assert last_step == 6  # only stream 3's torn record (seq 7) is gone
+    assert m.counters.get("wal.recovered_records") == 7
+    state2 = drill2.set_view(dense2, state2, rec)
+    state2 = drill2.apply(dense2, state2, 7, [0])
+    assert drill2.digest(dense2, state2) == drill.digest(dense, state)
+
+
+def test_multistream_checkpoint_compacts_every_stream(tmp_path, monkeypatch):
+    _freeze_backstops(monkeypatch)
+    _route_by_step(monkeypatch, nparts=2)
+    drill, dense, state = _drill("topk_rmv")
+    m = Metrics()
+    wal = ElasticWal(str(tmp_path), "w0", dense, drill.publish_name,
+                     partitions=2, streams=2, segment_bytes=1 << 12,
+                     metrics=m, durability="group")
+    for step in range(8):
+        pre = drill.pub_state(dense, state)
+        state = drill.apply(dense, state, step, [0])
+        wal.log_step(step, [0], pre, drill.pub_state(dense, state))
+    wal.checkpoint(drill.pub_state(dense, state), 7)
+    # The checkpoint's pre-compaction flush acked the batch first.
+    assert wal.durable_seq == 7
+    assert m.counters.get("wal.segments_compacted", 0) > 0
+    wal.close()
+
+    drill2, dense2, state2 = _drill("topk_rmv")
+    wal2 = ElasticWal(str(tmp_path), "w0", dense2, drill2.publish_name,
+                      partitions=2, streams=2)
+    rec, last_step, _ = wal2.recover(drill2.pub_state(dense2, state2))
+    wal2.close()
+    assert last_step == 7
+    state2 = drill2.set_view(dense2, state2, rec)
+    assert drill2.digest(dense2, state2) == drill.digest(dense, state)
+
+
 def test_garbage_snapshot_does_not_block_wal_replay(tmp_path):
     drill, dense, state = _drill("topk_rmv")
     wal = ElasticWal(str(tmp_path), "w0", dense, drill.publish_name)

@@ -23,7 +23,7 @@ PY ?= python
 # meaningful.
 COVER_THRESHOLD ?= 88
 
-.PHONY: all compile test cover typecheck xref native bench benchall dryrun net-demo chaos crash-demo obs-demo topo-demo spans-demo overlap-demo partition-demo serve-demo audit-demo bench-gate clean
+.PHONY: all compile test cover typecheck xref native bench benchall dryrun net-demo chaos crash-demo obs-demo topo-demo spans-demo overlap-demo partition-demo serve-demo audit-demo multichip-demo bench-gate clean
 
 all: compile xref typecheck cover
 
@@ -167,6 +167,18 @@ serve-demo:
 # `make chaos` via scripts/chaos_gate.py.
 audit-demo:
 	env JAX_PLATFORMS=cpu $(PY) scripts/audit_demo.py
+
+# Mesh-sharding gate (slow, real processes, 8 forced host devices): a
+# 2-slice fleet of mesh-sharded workers (mesh/: state pinned to a
+# (dc,key) device mesh, one batched ICI JOIN all-reduce per publish
+# boundary, per-shard anchors) with one worker SIGKILLed mid-load —
+# gated on bit-identical convergence vs the unsharded sequential
+# reference, cross-slice anti-entropy shipping only shard-local psnap
+# slices (>=5x fewer bytes than whole-instance), and the PR 10 replay
+# certificate verifying over the sharded flight logs. Writes
+# MULTICHIP_r06.json (the carrier bench_gate's evaluate_mesh compares).
+multichip-demo:
+	$(PY) scripts/multichip_demo.py
 
 # Span-tracing demo (slow, real processes): a 3-worker TCP fleet with
 # the round-phase span plane armed (CCRDT_SPANS=1) — every worker's

@@ -64,6 +64,18 @@ def _check_i32(x: int) -> int:
 _SLOTS: Dict[Any, Any] = {}
 
 
+def merge_slot_key(merge) -> Any:
+    """The cache identity of an engine merge fn — the (__func__,
+    __self__) id pair described above. Shared with mesh/reduce.py's
+    collective slots so every jit cache in the tree keys merges the
+    same way; any cache using it must pin the bound method itself to
+    keep the ids live."""
+    return (
+        id(getattr(merge, "__func__", merge)),
+        id(getattr(merge, "__self__", None)),
+    )
+
+
 def merge_slots(merge):
     """The double-buffer device slots of the overlap pipeline (PR 7):
     three cached jitted compilations of one engine merge —
@@ -86,10 +98,7 @@ def merge_slots(merge):
     either way, which tests/test_overlap.py pins bit-identically."""
     import jax
 
-    key = (
-        id(getattr(merge, "__func__", merge)),
-        id(getattr(merge, "__self__", None)),
-    )
+    key = merge_slot_key(merge)
     hit = _SLOTS.get(key)
     if hit is None:
         hit = (

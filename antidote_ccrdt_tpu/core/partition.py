@@ -138,24 +138,42 @@ def _item_plan(state: Any) -> Tuple[List[Tuple[str, Any, int]], List[Tuple[str, 
 # --- per-partition digest vectors ------------------------------------------
 
 
+def digest_entries(
+    state: Any, P: int, parts: Sequence[int]
+) -> Dict[int, int]:
+    """crc32 digest entries for a SUBSET of partitions — ``{part: crc}``,
+    ``part == P`` being the meta partition. This is the byte walk
+    `state_digests` runs for every entry, exposed per-partition so a
+    mesh shard (mesh/plan.py) can produce exactly the slice of the
+    vector it owns; slices stitched back together are bitwise equal to
+    the full vector because they ARE the full vector's entries."""
+    items, whole, extent = _item_plan(state)
+    id_parts = part_of(np.arange(extent), P) if extent else np.zeros(0, np.int32)
+    host_items = [(path, np.asarray(leaf), axis) for path, leaf, axis in items]
+    out: Dict[int, int] = {}
+    for part in parts:
+        part = int(part)
+        crc = 0
+        if part == P:
+            for path, leaf in whole:
+                arr = np.ascontiguousarray(np.asarray(leaf))
+                crc = zlib.crc32(arr.tobytes(), zlib.crc32(path.encode(), crc))
+        else:
+            idx = np.nonzero(id_parts == part)[0]
+            for path, leaf, axis in host_items:
+                sl = np.ascontiguousarray(np.take(leaf, idx, axis=axis))
+                crc = zlib.crc32(sl.tobytes(), zlib.crc32(path.encode(), crc))
+        out[part] = crc & 0xFFFFFFFF
+    return out
+
+
 def state_digests(state: Any, P: int) -> np.ndarray:
     """``uint32[P+1]`` crc32 digest vector; entry P is the meta partition
     (whole-instance leaves). Pure function of the state's leaves."""
-    items, whole, extent = _item_plan(state)
-    parts = part_of(np.arange(extent), P) if extent else np.zeros(0, np.int32)
+    entries = digest_entries(state, P, range(P + 1))
     vec = np.zeros(P + 1, np.uint32)
-    for p in range(P):
-        idx = np.nonzero(parts == p)[0]
-        crc = 0
-        for path, leaf, axis in items:
-            sl = np.ascontiguousarray(np.take(np.asarray(leaf), idx, axis=axis))
-            crc = zlib.crc32(sl.tobytes(), zlib.crc32(path.encode(), crc))
-        vec[p] = crc & 0xFFFFFFFF
-    crc = 0
-    for path, leaf in whole:
-        arr = np.ascontiguousarray(np.asarray(leaf))
-        crc = zlib.crc32(arr.tobytes(), zlib.crc32(path.encode(), crc))
-    vec[P] = crc & 0xFFFFFFFF
+    for part, crc in entries.items():
+        vec[part] = crc
     return vec
 
 

@@ -346,13 +346,19 @@ def _write_shard(
 def save_partitioned_checkpoint(
     root: str, name: str, state: Any, dense: Any, step: int,
     partitions: Optional[int] = None,
+    parts: Optional[List[int]] = None,
 ) -> int:
     """Shard `state` into per-partition checkpoint files (P id
     partitions + the meta partition) and commit with a manifest.
     Returns total bytes written. Shards first, manifest last: the
     manifest is the whole-checkpoint commit point, but each shard is
     individually durable the moment it lands (what the rejoin streamer
-    relies on)."""
+    relies on). `parts` restricts the write to a subset — the mesh
+    path (`save_mesh_checkpoint`) saves each key shard's owned
+    partitions separately; because `_write_shard` is a pure function of
+    (state, part), the union of per-shard saves is byte-identical to
+    one whole save. A subset save writes no manifest (it is a slice,
+    not a commit point)."""
     import json
 
     from ..core import partition as pt
@@ -360,9 +366,21 @@ def save_partitioned_checkpoint(
     P = partitions if partitions else pt.n_partitions()
     os.makedirs(root, exist_ok=True)
     total = 0
-    for part in range(P + 1):
+    todo = sorted(int(p) for p in parts) if parts is not None else range(P + 1)
+    for part in todo:
         total += _write_shard(root, name, dense, state, part, P, step)
+    if parts is not None:
+        return total
     digests = pt.state_digests(state, P)
+    _write_manifest(root, name, step, P, digests)
+    return total
+
+
+def _write_manifest(
+    root: str, name: str, step: int, P: int, digests: Any
+) -> None:
+    import json
+
     manifest = {
         "name": name,
         "step": int(step),
@@ -375,6 +393,27 @@ def save_partitioned_checkpoint(
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, os.path.join(root, _MANIFEST))
+
+
+def save_mesh_checkpoint(
+    root: str, name: str, state: Any, dense: Any, step: int, plan: Any,
+) -> int:
+    """Shard-grouped checkpoint: each key shard of a `mesh.MeshPlan`
+    persists exactly the partitions it owns (`parts=owned_parts(s)`),
+    then the manifest commits the whole — the mesh counterpart of
+    `save_partitioned_checkpoint`, producing byte-identical files
+    (pinned by test_mesh.py). The digest vector in the manifest is
+    produced shard-by-shard and stitched (mesh/gossip.py)."""
+    from ..mesh import gossip as mesh_gossip
+
+    total = 0
+    for s in range(plan.n_key):
+        total += save_partitioned_checkpoint(
+            root, name, state, dense, step,
+            partitions=plan.P, parts=plan.owned_parts(s),
+        )
+    digests = mesh_gossip.sharded_digest_vector(state, plan)
+    _write_manifest(root, name, step, plan.P, digests)
     return total
 
 

@@ -404,6 +404,7 @@ class ElasticWal:
         partitions: Optional[int] = None,
         durability: Optional[str] = None,
         streams: Optional[int] = None,
+        mesh_plan: Optional[Any] = None,
     ):
         self.dir = os.path.join(root, f"wal-{member}")
         self.member = member
@@ -412,12 +413,20 @@ class ElasticWal:
         self.partitions = partitions
         self.metrics = metrics if metrics is not None else Metrics()
         self.durability = durability_mode(durability)
+        # mesh/plan.MeshPlan: stream count follows the key-shard count
+        # and routing follows shard ownership, so each stream holds
+        # exactly one shard's partitions — a shard's WAL slice is
+        # self-contained (the per-shard recombination test_mesh.py pins).
+        # Explicit `streams`/env still wins: operators outrank the plan.
+        self.mesh_plan = mesh_plan
         env_streams = os.environ.get("CCRDT_WAL_STREAMS", "")
         if streams is None and env_streams:
             try:
                 streams = int(env_streams)
             except ValueError:
                 streams = None
+        if streams is None and mesh_plan is not None:
+            streams = mesh_plan.n_key
         if streams is None:
             streams = min(4, partitions) if partitions else 1
         # A reader must open every stream that EXISTS on disk, however
@@ -520,12 +529,21 @@ class ElasticWal:
             "wal.durability_lag", float(max(0, self._last_appended - d))
         )
 
+    def stream_for_part(self, part: int) -> int:
+        """Partition -> stream index. With a mesh plan this is shard
+        ownership (`MeshPlan.shard_of`, clamped to the streams that
+        exist); without one it is the same `% nstreams` fold — identical
+        routes when nstreams == n_key, by construction of `shard_of`."""
+        if self.mesh_plan is not None:
+            return self.mesh_plan.shard_of(int(part)) % self.nstreams
+        return int(part) % self.nstreams
+
     def _stream_for(self, parts) -> WriteAheadLog:
         """Partition tag -> stream route. Untagged / unknown-partition
         records go to stream 0 (the legacy layout)."""
         if self.nstreams <= 1 or not parts:
             return self.streams[0]
-        return self.streams[min(int(p) for p in parts) % self.nstreams]
+        return self.streams[self.stream_for_part(min(int(p) for p in parts))]
 
     # -- write path --------------------------------------------------------
 

@@ -1,0 +1,182 @@
+"""Intra-slice reconciliation as ICI collectives.
+
+The dryrun harness (surface [1] of __graft_entry__.py) proved the
+pattern: `shard_map` the state over a (dc, key) mesh and run
+`parallel.dist.lattice_all_reduce` — recursive-doubling `ppermute`
+exchanges whose combiner is the engine's own JOIN merge — over the dc
+axis, so every replica row becomes the join of its dc-block *in one
+device dispatch* instead of N gossip rounds. This module lifts that
+into the product with the `core/batch_merge` slot discipline: one
+cached jitted compilation per (merge fn, plan, tree structure), a
+plain and a donating variant.
+
+Correctness: the dc all-reduce replaces each replica row r with
+join({rows in r's dc block}). JOIN merges are associative, commutative,
+and idempotent, so (a) the reduce is itself idempotent — re-reducing a
+reduced state is a no-op; (b) the observable state (fold of all rows)
+is unchanged — the fold already joined every row; and (c) gossip
+convergence arguments are untouched: peers exchange pre-joined rows and
+the fleet fixpoint is still the global join. MONOID engines are
+excluded (`supports`): + is not idempotent, so pre-summing rows that
+gossip will sum again double-counts (the same reason psnaps refuse bare
+monoids). MONOID reconciliation over the mesh is a `psum` — exposed as
+`psum_reduce` for the bench/dryrun surface — but it must consume
+disjoint op histories, which the elastic worker's row-per-replica
+gossip does not provide.
+
+Fault point: `mesh.reduce` fires before each collective dispatch
+(utils/faults.py). The reduce is a pure optimization — callers treat an
+injected failure as "skip this round's reduce" (`try_ici_reduce`),
+counting `mesh.reduce_failures`; convergence falls back to plain
+gossip.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ..core import batch_merge
+from ..obs import spans as obs_spans
+from ..utils import faults
+from ..utils.jaxcompat import shard_map
+
+SPAN_ICI = obs_spans.ICI_REDUCE  # "round.ici_reduce"
+
+# (merge identity, plan identity, treedef) -> {"plain": fn, "donate": fn}
+# Same pinning rule as batch_merge._SLOTS: the value keeps the bound
+# method + plan alive so the id()-based parts of the key stay valid.
+_SLOTS: Dict[Any, Any] = {}
+
+
+def _slots(dense: Any, plan: Any, state: Any) -> Dict[str, Any]:
+    import jax
+
+    merge = dense.merge
+    key = (
+        batch_merge.merge_slot_key(merge),
+        plan.slot_key(),
+        jax.tree.structure(state),
+    )
+    hit = _SLOTS.get(key)
+    if hit is None:
+        specs = plan.specs(state)
+
+        def _local(s):
+            from ..parallel.dist import lattice_all_reduce
+
+            return lattice_all_reduce(s, "dc", merge, plan.n_dc)
+
+        mapped = shard_map(
+            _local, mesh=plan.mesh, in_specs=(specs,), out_specs=specs,
+            check_vma=False,
+        )
+        hit = (
+            (merge, plan),  # pinned — see _SLOTS comment
+            {
+                "plain": jax.jit(mapped),
+                # Donate only when the caller owns the operand outright
+                # (serial round loop); the overlap pipeline's host stage
+                # may still be serializing the previous buffers.
+                "donate": jax.jit(mapped, donate_argnums=(0,)),
+            },
+        )
+        _SLOTS[key] = hit
+    return hit[1]
+
+
+def supports(dense: Any) -> bool:
+    """JOIN engines only — see the MONOID caveat in the module doc."""
+    from ..core.behaviour import MergeKind
+    from ..parallel.monoid import MonoidLift
+
+    if isinstance(dense, MonoidLift):
+        return False
+    return getattr(dense, "merge_kind", None) != MergeKind.MONOID
+
+
+def ici_reduce(
+    dense: Any, plan: Any, state: Any, *, donate: bool = False,
+    metrics: Optional[Any] = None,
+) -> Any:
+    """One batched JOIN all-reduce of `state` over the dc axis. May
+    raise `faults.InjectedFault` (point `mesh.reduce`); a "drop" action
+    skips the collective and returns the state unchanged."""
+    if faults.ACTIVE:
+        act = faults.fire("mesh.reduce")
+        if act == "drop":
+            if metrics is not None:
+                metrics.count("mesh.reduce_skipped")
+            return state
+    state = plan.ensure_placed(state)
+    fn = _slots(dense, plan, state)["donate" if donate else "plain"]
+    tok = (
+        obs_spans.begin(SPAN_ICI, n_dc=plan.n_dc, n_key=plan.n_key)
+        if obs_spans.ACTIVE
+        else None
+    )
+    try:
+        if metrics is not None:
+            with metrics.timer("mesh.ici_reduce"):
+                out = fn(state)
+        else:
+            out = fn(state)
+    finally:
+        obs_spans.end(tok)
+    if metrics is not None:
+        metrics.count("mesh.ici_reduces")
+    return out
+
+
+def try_ici_reduce(
+    dense: Any, plan: Any, state: Any, *, donate: bool = False,
+    metrics: Optional[Any] = None,
+) -> Any:
+    """Total variant: an injected/real reduce failure degrades to plain
+    gossip (the reduce is an optimization, never load-bearing)."""
+    try:
+        return ici_reduce(
+            dense, plan, state, donate=donate, metrics=metrics
+        )
+    except faults.InjectedFault:
+        if metrics is not None:
+            metrics.count("mesh.reduce_failures")
+        return state
+
+
+# -- MONOID psum (bench / dryrun parity) ------------------------------------
+
+_PSUM_SLOTS: Dict[Any, Any] = {}
+
+
+def psum_reduce(plan: Any, tree: Any) -> Any:
+    """All-reduce a MONOID accumulator pytree (leading axis = replica
+    rows, sharded over dc) with `lax.psum` — the collective MONOID
+    merges lower to when histories are disjoint. Bench surface only;
+    the elastic worker path is JOIN-gated by `supports`."""
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    key = (plan.slot_key(), jax.tree.structure(tree))
+    fn = _PSUM_SLOTS.get(key)
+    if fn is None:
+        def spec_of(leaf):
+            dims = [None] * leaf.ndim
+            if leaf.ndim and leaf.shape[0] % plan.n_dc == 0:
+                dims[0] = "dc"
+            while dims and dims[-1] is None:
+                dims.pop()
+            return P(*dims)
+
+        specs = jax.tree.map(spec_of, tree)
+        fn = jax.jit(
+            shard_map(
+                lambda t: jax.tree.map(
+                    lambda a: lax.psum(a, "dc"), t
+                ),
+                mesh=plan.mesh, in_specs=(specs,), out_specs=specs,
+                check_vma=False,
+            )
+        )
+        _PSUM_SLOTS[key] = fn
+    return fn(tree)

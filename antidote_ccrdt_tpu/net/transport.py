@@ -34,6 +34,7 @@ from typing import Any, List, Optional, Protocol, Tuple, runtime_checkable
 
 from ..obs import events as obs_events
 from ..obs import spans as obs_spans
+from ..topo import zones as topo_zones
 from ..utils import faults
 from ..utils.metrics import Metrics
 
@@ -340,10 +341,17 @@ class GossipNode:
     def __init__(self, transport: Transport, metrics: Optional[Metrics] = None):
         self.transport = transport
         self.member = transport.member
-        # Zone passthrough for transports running the topo/ layer (None
-        # for zone-less media like FsTransport) — drills and dashboards
-        # read it off the node instead of reaching into the transport.
-        self.zone = getattr(transport, "zone", None)
+        # Zone passthrough for transports running the topo/ layer, with
+        # the CCRDT_ZONE env fallback for zone-less media (FsTransport):
+        # a mesh-sharded worker gossiping through a shared directory is
+        # still ON a slice (topo/zones.py slice_zone), and drills and
+        # dashboards read the label off the node instead of reaching
+        # into the transport. None when neither source names one.
+        self.zone = (
+            getattr(transport, "zone", None)
+            or os.environ.get(topo_zones.ENV_ZONE)
+            or None
+        )
         self.metrics = (
             metrics
             if metrics is not None
@@ -501,14 +509,20 @@ class GossipNode:
         )
 
     def publish_partitioned(
-        self, name: str, state: Any, seq: int, dense: Any, P: int
+        self, name: str, state: Any, seq: int, dense: Any, P: int,
+        plan: Optional[Any] = None,
     ) -> Optional[Any]:
         """Anchor-time partition publish: the P+1 digest vector (pushed
         like a snapshot — tiny) plus psnap blobs for every partition whose
         digest changed since the last anchor (ALL partitions on the first;
         the psnap store is cumulative, so it is complete from then on).
-        Returns the digest vector, or None when the medium has no
-        partition surface."""
+        With a `mesh.MeshPlan`, the digest vector and the psnaps are
+        produced shard by shard — each key shard contributes exactly the
+        slice it owns, stitched back into the same wire blobs (the
+        artifacts are byte-identical either way, which test_mesh.py
+        pins), billing per-shard counters for the chaos gate. Returns
+        the digest vector, or None when the medium has no partition
+        surface."""
         from ..core import partition as pt
         from ..core import serial
 
@@ -516,7 +530,14 @@ class GossipNode:
         pub_ps = getattr(self.transport, "publish_psnap", None)
         if pub_dig is None or pub_ps is None:
             return None
-        vec = pt.state_digests(state, P)
+        if plan is not None:
+            from ..mesh import gossip as mesh_gossip
+
+            vec = mesh_gossip.sharded_digest_vector(
+                state, plan, metrics=self.metrics
+            )
+        else:
+            vec = pt.state_digests(state, P)
         cache = getattr(self, "_last_digests", None)
         if cache is None:
             cache = self._last_digests = {}
@@ -526,6 +547,19 @@ class GossipNode:
             if prev is None or len(prev) != len(vec)
             else pt.divergent_parts(prev, vec)
         )
+        if plan is not None:
+            from ..mesh import gossip as mesh_gossip
+
+            for shard, _parts in mesh_gossip.group_parts_by_shard(
+                plan, changed
+            ):
+                for part, blob in mesh_gossip.shard_psnap_blobs(
+                    name, state, seq, dense, plan, shard, parts=changed
+                ):
+                    self.metrics.count("net.psnap_publishes")
+                    self.metrics.count(f"mesh.shard{shard:02d}.psnap_publishes")
+                    pub_ps(part, blob)
+            changed = []
         for part in changed:
             payload = serial.dumps_dense(
                 f"{name}_psnap", pt.restrict_psnap(dense, state, part, P)

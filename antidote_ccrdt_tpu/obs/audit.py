@@ -136,13 +136,47 @@ def reconcile_op_counts(
     that watermark at end of run has silently lost ops (the causal audit
     catches mis-ordering; this catches truncation). Members are judged
     on the union of their incarnations, so a recovered worker's
-    coverage carries across its restart."""
+    coverage carries across its restart.
+
+    A member DEAD at quiesce — its last incarnation is a crash dump (no
+    `proc.exit`, see obs/events.py) with no successor — is excluded
+    from the applier side and reported in `dead_members`: its final
+    state never existed, so "covered through" is not defined for it.
+    Its PUBLISHED stream stays fully audited — the survivors must still
+    cover everything it shipped before dying (replica adoption), which
+    is exactly the loss this check hunts. The exclusion activates only
+    when some log carries the proc lifecycle discipline (a `proc.exit`
+    somewhere); in-process sim spills without lifecycle events keep
+    every member on the hook."""
     published: Dict[str, List[int]] = {}
     for evs in logs.values():
         for e in evs:
             if e.get("kind") == "delta.publish" and e.get("dseq") is not None:
                 o = str(e.get("origin") or e.get("member") or "?")
                 published.setdefault(o, []).append(int(e["dseq"]))
+
+    lifecycle = any(
+        e.get("kind") == "proc.exit" for evs in logs.values() for e in evs
+    )
+    incarnations: Dict[str, List[Tuple[float, bool]]] = {}
+    for fname, evs in sorted(logs.items()):
+        member = next(
+            (str(e["member"]) for e in evs if e.get("member")), fname
+        )
+        start_t = next(
+            (
+                float(e.get("t", 0.0))
+                for e in evs
+                if e.get("kind") == "proc.start"
+            ),
+            min((float(e.get("t", 0.0)) for e in evs), default=0.0),
+        )
+        exited = any(e.get("kind") == "proc.exit" for e in evs)
+        incarnations.setdefault(member, []).append((start_t, exited))
+    dead = {
+        m for m, incs in incarnations.items()
+        if lifecycle and not sorted(incs)[-1][1]
+    }
 
     coverage: Dict[str, Dict[str, int]] = {}
     applied_n: Dict[str, Dict[str, int]] = {}
@@ -170,7 +204,7 @@ def reconcile_op_counts(
     for origin, seqs in sorted(published.items()):
         want = max(seqs)
         for member, cov in sorted(coverage.items()):
-            if member == origin:
+            if member == origin or member in dead:
                 continue
             pairs += 1
             have = cov.get(origin, -1)
@@ -191,6 +225,7 @@ def reconcile_op_counts(
             for o, s in sorted(published.items())
         },
         "pairs_checked": pairs,
+        "dead_members": sorted(dead),
         "uncovered": uncovered,
     }
 

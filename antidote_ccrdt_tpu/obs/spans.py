@@ -84,6 +84,13 @@ PHASES = (
 
 E2E = "round.e2e"
 
+# Conditional phases, deliberately NOT in PHASES: they only light when
+# their subsystem is armed, so requiring them fleet-wide would fail
+# every non-mesh / non-serve run. `round.serve_swap` is emitted by
+# serve/replica.py; `round.ici_reduce` (ICI_REDUCE) by mesh/reduce.py —
+# chaos_gate's mesh leg requires the latter lit *in mesh drills only*.
+ICI_REDUCE = "round.ici_reduce"
+
 # Hot-path gate — call sites must check `if spans.ACTIVE:` first.
 ACTIVE = False
 

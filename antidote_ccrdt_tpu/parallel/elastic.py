@@ -88,6 +88,7 @@ class DeltaPublisher:
         lag_threshold: float = 8.0,
         lag_full_every: int = 2,
         partitions: Optional[int] = None,
+        mesh_plan: Optional[Any] = None,
     ):
         from ..core import serial
         from ..core.behaviour import MergeKind
@@ -120,6 +121,11 @@ class DeltaPublisher:
         # None = whole-instance gossip only (the legacy path, and what a
         # mixed-version fleet degrades to).
         self.partitions = partitions
+        # mesh/plan.MeshPlan: anchors produce digest slices + psnaps
+        # shard by shard (mesh/gossip.py) instead of in one whole-state
+        # walk; the published wire blobs are byte-identical, so peers
+        # never see the difference. None = unsharded production.
+        self.mesh_plan = mesh_plan
         self.seq = -1
         self._prev: Any = None
         self._serial = serial
@@ -210,7 +216,8 @@ class DeltaPublisher:
                 # psnap-exhausted fallback read it), digests + changed
                 # psnaps go alongside.
                 self.store.publish_partitioned(
-                    self.name, state, self.seq, self.dense, self.partitions
+                    self.name, state, self.seq, self.dense, self.partitions,
+                    plan=self.mesh_plan,
                 )
             kind, nbytes = "full", -1
         else:
@@ -276,6 +283,7 @@ class PartialAntiEntropy:
     def __init__(
         self, store: GossipNode, partitions: Optional[int] = None,
         max_tries: int = 3, watchdog: Optional[Any] = None,
+        mesh_plan: Optional[Any] = None,
     ):
         from ..core import partition as pt
 
@@ -283,6 +291,12 @@ class PartialAntiEntropy:
         self.partitions = partitions if partitions else pt.n_partitions()
         self.max_tries = max(1, max_tries)
         self._pt = pt
+        # mesh/plan.MeshPlan: divergent-partition fetches are grouped by
+        # owning key shard (mesh/gossip.group_parts_by_shard) so a
+        # repair pulls shard-local psnap slices and stitches them back
+        # together, billing `mesh.cross_slice_fetches` / `.cross_slice_
+        # bytes`. None = the flat fetch order (unsharded behavior).
+        self.mesh_plan = mesh_plan
         # member -> consecutive incomplete partial-resync attempts; reset
         # on completion, tripped into full-snap fallback at max_tries.
         self._tries: Dict[str, int] = {}
@@ -331,10 +345,21 @@ class PartialAntiEntropy:
                 self.store.metrics.count("net.psnap_wasted")
                 continue
             fetch_parts.append(p)
+        if self.mesh_plan is not None:
+            # Shard-local slices: fetch in owning-shard order, one
+            # shard's partitions at a time, and stitch the repairs back
+            # together (the join is order-free, so grouping is free).
+            from ..mesh import gossip as mesh_gossip
+
+            groups = mesh_gossip.group_parts_by_shard(
+                self.mesh_plan, fetch_parts
+            )
+            fetch_parts = [p for _s, ps in groups for p in ps]
         self.store.request_psnaps(member, fetch_parts)
         like = like_delta_for(dense, state)
         repaired_by_seq = set()
         fetched = 0
+        bytes_before = self.store.metrics.counters.get("net.psnap_bytes", 0.0)
         for p in fetch_parts:
             r = self.store.fetch_psnap(
                 member, p, like,
@@ -348,8 +373,17 @@ class PartialAntiEntropy:
             except Exception:  # noqa: BLE001 — total, same as sweep
                 continue
             fetched += 1
+            if self.mesh_plan is not None:
+                self.store.metrics.count("mesh.cross_slice_fetches")
             if ps_seq >= dig_seq:
                 repaired_by_seq.add(p)
+        if self.mesh_plan is not None and fetched:
+            bytes_after = self.store.metrics.counters.get(
+                "net.psnap_bytes", 0.0
+            )
+            self.store.metrics.count(
+                "mesh.cross_slice_bytes", float(bytes_after - bytes_before)
+            )
         post_vec = pt.state_digests(state, P)
         outstanding = [
             p for p in fetch_parts

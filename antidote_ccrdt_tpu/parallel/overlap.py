@@ -383,15 +383,26 @@ class ApplyQueue:
         """Enqueue a full-snapshot anchor (latest-wins per member). A
         snapshot covers every partition through `seq`, so it heals each
         hole it reaches (seq >= that partition's hole); an anchor below
-        ALL open holes is refused (it cannot cover any gap)."""
+        ALL open holes is refused (it cannot cover any gap). A stale
+        anchor with the member's DELTAS queued behind it is kept, not
+        replaced: those deltas chain from the anchor's seq, and popping
+        them without it would emit a dseq jump the flight-log causal
+        audit reads as a gap-skip (applying the old anchor too is just
+        an extra join)."""
         with self._lock:
             holes = self._holes.get(member)
             if holes and all(seq < h for h in holes.values()):
                 return False
+            q = list(self._q)
             stale = [
-                e for e in self._q if e.kind == "snap" and e.member == member
+                e for e in q if e.kind == "snap" and e.member == member
             ]
             for e in stale:
+                if any(
+                    e2.kind == "delta" and e2.member == member
+                    for e2 in q[q.index(e) + 1:]
+                ):
+                    continue
                 self._q.remove(e)
             if len(self._q) >= self.depth:
                 self._shed_locked()
@@ -593,8 +604,16 @@ class OverlapPipeline:
                  fold_cap: Optional[int] = None,
                  host_depth: Optional[int] = None,
                  start_thread: bool = True,
-                 partitions: Optional[int] = None):
+                 partitions: Optional[int] = None,
+                 post_fold: Optional[Any] = None):
         self.metrics = metrics if metrics is not None else store.metrics
+        # Mesh hook (mesh/reduce.py): called as post_fold(state) on the
+        # ROUND thread after a drain actually folded windows in —
+        # exactly where the intra-slice ICI reduce belongs (fresh peer
+        # rows just landed; pre-join them before the next publish).
+        # Must be total and must NOT donate its operand: the host stage
+        # may still be serializing buffers of the state it receives.
+        self.post_fold = post_fold
         self.apq = ApplyQueue(
             depth if depth is not None else queue_depth(),
             metrics=self.metrics,
@@ -699,6 +718,8 @@ class OverlapPipeline:
         finally:
             obs_spans.end(tok)
         self.metrics.count("overlap.windows", len(entries))
+        if self.post_fold is not None:
+            state = self.post_fold(state)
         return state
 
     def close(self, state: Any) -> Any:

@@ -97,6 +97,22 @@ class ReadReplica:
             obs_spans.end(tok)
         if self.metrics is not None:
             self.metrics.count("serve.swaps")
+            # Mesh-sharded states: the jitted copy preserves the input
+            # sharding (an identity keeps its operand's layout), so the
+            # replica holds per-device shards, never a gathered whole —
+            # gauge how many device shards the live snapshot spans so
+            # the obs plane can prove reads stayed shard-resident.
+            try:
+                import jax
+
+                leaf = next(iter(jax.tree_util.tree_leaves(snap.state)), None)
+                sharding = getattr(leaf, "sharding", None)
+                if sharding is not None:
+                    self.metrics.set(
+                        "serve.replica_shards", float(len(sharding.device_set))
+                    )
+            except Exception:  # noqa: BLE001 — gauge only, stay total
+                pass
         obs_events.emit(
             "serve.swap", seq=snap.seq, lag_bound_s=round(snap.lag_bound_s, 6)
         )

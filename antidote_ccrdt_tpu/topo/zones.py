@@ -38,6 +38,16 @@ def zone_from_env(
     return (env if env is not None else os.environ).get(ENV_ZONE) or default
 
 
+def slice_zone(index: int) -> str:
+    """The canonical zone label for mesh slice `index` — how the mesh
+    plane (mesh/) maps device-mesh slices onto the topo/ gossip
+    topology: each mesh-sharded worker process is one slice, its
+    CCRDT_ZONE is `slice_zone(i)`, and cross-slice anti-entropy rides
+    the existing zone-aware routers (anchors, O(zones) crossings)
+    unchanged. scripts/multichip_demo.py is the reference user."""
+    return f"slice{int(index)}"
+
+
 class ZoneMap:
     """member -> zone, shared by a transport and its router.
 

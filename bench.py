@@ -1118,6 +1118,175 @@ def bench_audit_overhead(P=8, rounds=12, repeats=3):
     }
 
 
+# The mesh microbench body, run in a hermetic forced-8-device CPU child:
+# this process's backend is already initialized with its own device count
+# (1 on the CI fallback, the real topology on an accelerator), and the
+# (2,4) MeshPlan needs 8 visible devices. Fixed protocol geometry on the
+# same virtual rig every time, so rounds compare across backends — like
+# the other protocol-bound microbenches, NOT an accelerator measurement.
+_MESH_BENCH_CHILD = r"""
+import json, math, os, shutil, sys, tempfile, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from antidote_ccrdt_tpu.core import partition as pt
+from antidote_ccrdt_tpu.mesh import MeshPlan
+from antidote_ccrdt_tpu.mesh import reduce as mesh_reduce
+from antidote_ccrdt_tpu.models.topk_rmv_dense import TopkRmvOps, make_dense
+from antidote_ccrdt_tpu.net.transport import FsTransport, GossipNode
+from antidote_ccrdt_tpu.parallel.elastic import (
+    DeltaPublisher, PartialAntiEntropy, sweep_deltas,
+)
+
+ITERS = int(os.environ.get("CCRDT_MESH_BENCH_ITERS", "30"))
+RESYNCS = int(os.environ.get("CCRDT_MESH_BENCH_RESYNCS", "4"))
+P = 8
+R, NK, I, DCS, K, M, B = 4, 1, 256, 4, 8, 2, 32
+dense = make_dense(n_ids=I, n_dcs=DCS, size=K, slots_per_id=M)
+part_map = pt.part_of(np.arange(I), P)
+p_star = int(np.bincount(part_map, minlength=P).argmax())
+pools = {
+    "all": np.arange(I, dtype=np.int32),
+    "hot": np.arange(I, dtype=np.int32)[part_map == p_star],
+}
+
+def apply_ops(state, step, pool):
+    rng = np.random.default_rng(66_000 + step)
+    ids = pools[pool][rng.integers(0, len(pools[pool]), (R, B))]
+    z = np.zeros((R, B), np.int32)
+    ops = TopkRmvOps(
+        add_key=jnp.asarray(z),
+        add_id=jnp.asarray(ids.astype(np.int32)),
+        add_score=jnp.asarray(rng.integers(1, 500, (R, B)).astype(np.int32)),
+        add_dc=jnp.asarray(z),
+        add_ts=jnp.asarray(np.broadcast_to(
+            step * B + np.arange(B) + 1, (R, B)
+        ).astype(np.int32)),
+        rmv_key=jnp.asarray(np.zeros((R, 1), np.int32)),
+        rmv_id=jnp.asarray(np.full((R, 1), -1, np.int32)),
+        rmv_vc=jnp.asarray(np.zeros((R, 1, DCS), np.int32)),
+    )
+    state, _ = dense.apply_ops(state, ops, collect_dominated=False)
+    return state
+
+plan = MeshPlan.build(n_dc=2, n_key=4, partitions=P)
+
+# Arm 1: jitted ICI JOIN all-reduce latency on a placed, row-divergent
+# state (the per-publish-boundary reconciliation cost).
+state = dense.init(R, NK)
+for step in range(3):
+    state = apply_ops(state, step, "all")
+placed = plan.place(state)
+jax.block_until_ready(mesh_reduce.ici_reduce(dense, plan, placed))  # jit
+times = []
+t_all0 = time.perf_counter()
+for _ in range(ITERS):
+    t0 = time.perf_counter()
+    jax.block_until_ready(mesh_reduce.ici_reduce(dense, plan, placed))
+    times.append((time.perf_counter() - t0) * 1000.0)
+elapsed = time.perf_counter() - t_all0
+elems = sum(
+    int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(placed)
+)
+stages = max(1, math.ceil(math.log2(plan.n_dc)))
+
+# Arm 2: cross-slice anti-entropy byte bill — writer advances one hot
+# partition per round and anchors per-shard; the reader repairs each gap
+# through the mesh-grouped PartialAntiEntropy (shard-local psnap slices
+# only), billing mesh.cross_slice_bytes.
+root = tempfile.mkdtemp(prefix="ccrdt_mesh_bench_")
+try:
+    a = GossipNode(FsTransport(root, "a"))
+    b = GossipNode(FsTransport(root, "b"))
+    a.heartbeat(), b.heartbeat()
+    pub = DeltaPublisher(
+        a, dense, name="topk_rmv", full_every=1, partitions=P,
+        mesh_plan=plan,
+    )
+    pae = PartialAntiEntropy(b, partitions=P, mesh_plan=plan)
+    st_a, curs = placed, {}
+    step = 3
+    pub.publish(st_a)
+    st_b, _ = sweep_deltas(b, dense, plan.place(dense.init(R, NK)), curs,
+                           partial=pae)
+    whole_bytes = 0
+    for _ in range(RESYNCS):
+        st_a = apply_ops(st_a, step, "hot")
+        step += 1
+        pub.publish(st_a)
+        whole_bytes += len(b.transport.fetch("a"))
+        st_b, _ = sweep_deltas(b, dense, st_b, curs, partial=pae)
+    if not np.array_equal(
+        pt.state_digests(st_b, P), pt.state_digests(st_a, P)
+    ):
+        raise RuntimeError("mesh bench diverged — shard repair broken")
+    cross_bytes = int(b.metrics.counters.get("mesh.cross_slice_bytes", 0))
+    cross_fetches = int(
+        b.metrics.counters.get("mesh.cross_slice_fetches", 0)
+    )
+    wasted = int(b.metrics.counters.get("net.psnap_wasted", 0))
+finally:
+    shutil.rmtree(root, ignore_errors=True)
+if wasted:
+    raise RuntimeError(f"mesh bench wasted {wasted} psnap fetches")
+
+print(json.dumps({
+    "n_devices": len(jax.devices()),
+    "mesh": {"n_dc": plan.n_dc, "n_key": plan.n_key},
+    "iters": ITERS,
+    "ici_reduce_ms_p50": round(sorted(times)[len(times) // 2], 3),
+    "mesh_merges_per_sec": round(
+        elems * stages * ITERS / max(elapsed, 1e-9), 1
+    ),
+    "resyncs": RESYNCS,
+    "cross_slice_bytes": cross_bytes,
+    "cross_slice_fetches": cross_fetches,
+    "cross_slice_bytes_per_resync": round(cross_bytes / max(1, RESYNCS), 1),
+    "whole_bytes_per_resync": round(whole_bytes / max(1, RESYNCS), 1),
+}))
+"""
+
+
+def bench_mesh_scaling(iters=30, resyncs=4):
+    """Mesh-plane microbench (mesh/): ICI JOIN all-reduce latency and
+    the cross-slice anti-entropy byte bill, both on the (2,4) plan over
+    8 forced host devices in a hermetic CPU subprocess (see
+    `_MESH_BENCH_CHILD`). Returns the child's metric dict, or a
+    ``{"skipped": reason}`` stub when the rig cannot run (the summary
+    keys then ride as null — report-only; the gated carrier for
+    `bench_gate.evaluate_mesh` is MULTICHIP_r*.json)."""
+    import subprocess
+    import sys as _sys
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = ":".join(
+        p for p in env.get("PYTHONPATH", "").split(":") if "axon" not in p
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["CCRDT_MESH_BENCH_ITERS"] = str(int(iters))
+    env["CCRDT_MESH_BENCH_RESYNCS"] = str(int(resyncs))
+    try:
+        proc = subprocess.run(
+            [_sys.executable, "-c", _MESH_BENCH_CHILD],
+            env=env, capture_output=True, text=True, timeout=600,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return {"skipped": f"mesh bench child failed to run: {e}"}
+    if proc.returncode != 0:
+        return {
+            "skipped": "mesh bench child rc="
+            f"{proc.returncode}: {(proc.stderr or proc.stdout)[-500:]}"
+        }
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"skipped": f"mesh bench child output torn: {proc.stdout[-500:]}"}
+
+
 def main():
     import jax
 
@@ -1228,6 +1397,10 @@ def main():
         rounds=3 if (backend == "cpu" or os.environ.get("CCRDT_BENCH_TINY"))
         else 6,
     )
+    mesh_scaling = bench_mesh_scaling(
+        iters=5 if os.environ.get("CCRDT_BENCH_TINY") else 30,
+        resyncs=2 if os.environ.get("CCRDT_BENCH_TINY") else 4,
+    )
 
     # The driver records only the TAIL of stdout (<=2,000 chars) as
     # BENCH_r{N}.json and parses the LAST line; round 4's single fat line
@@ -1264,6 +1437,11 @@ def main():
         # certified costs per gossip round; the gated headline pct rides
         # the summary line.
         "audit": audit_ov,
+        # Mesh-plane costs (bench_mesh_scaling, forced-8-device child):
+        # ICI reduce latency + the cross-slice shard-repair byte bill.
+        # Report-only on the summary line; the gated carrier is the
+        # MULTICHIP_r*.json round (scripts/bench_gate.py evaluate_mesh).
+        "mesh_scaling": mesh_scaling,
         "dispatch_overhead_ms_p50": round(dispatch_overhead_ms, 2),
         "batch_per_replica_round": f"{B} adds + {Br} rmvs",
         "backend": backend,
@@ -1314,6 +1492,9 @@ def main():
         "serve_reads_per_sec": serving["serve_reads_per_sec"],
         "serve_read_p99_ms": serving["serve_read_p99_ms"],
         "audit_overhead_pct": audit_ov["audit_overhead_pct"],
+        "mesh_merges_per_sec": mesh_scaling.get("mesh_merges_per_sec"),
+        "ici_reduce_ms_p50": mesh_scaling.get("ici_reduce_ms_p50"),
+        "cross_slice_bytes": mesh_scaling.get("cross_slice_bytes"),
         "backend": backend,
         "details_file": "benchmarks/bench_details.json" if sidecar else "stdout",
     }

@@ -630,6 +630,134 @@ def evaluate_wal(
     return code, "\n".join(lines)
 
 
+def load_mesh_rounds(
+    bench_dir: str,
+) -> List[Tuple[int, str, float, float, float]]:
+    """[(round_no, path, mesh_merges_per_sec, ici_reduce_ms_p50,
+    cross_slice_bytes)] for every ``MULTICHIP_r<NN>.json`` carrier
+    committed by scripts/multichip_demo.py (r6+). The r01-r05 carriers
+    are the legacy dryrun dumps (n_devices/rc/tail only) and carry none
+    of the metric keys — skipped, not zeros. Fixed 8-virtual-device
+    protocol geometry on every backend, so rounds compare without
+    backend grouping."""
+    out: List[Tuple[int, str, float, float, float]] = []
+    for p in sorted(glob.glob(os.path.join(bench_dir, "MULTICHIP_r*.json"))):
+        m = re.search(r"MULTICHIP_r(\d+)\.json$", os.path.basename(p))
+        if not m:
+            continue
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        keys = ("mesh_merges_per_sec", "ici_reduce_ms_p50",
+                "cross_slice_bytes")
+        if not all(isinstance(doc.get(k), (int, float)) for k in keys):
+            continue
+        out.append((
+            int(m.group(1)), p,
+            float(doc["mesh_merges_per_sec"]),
+            float(doc["ici_reduce_ms_p50"]),
+            float(doc["cross_slice_bytes"]),
+        ))
+    out.sort(key=lambda r: r[0])
+    return out
+
+
+def evaluate_mesh(
+    rounds: List[Tuple[int, str, float, float, float]],
+    tolerance: float = 0.20,
+    merges_floor_abs: float = 200.0,
+    ici_floor_ms: float = 2.0,
+    bytes_floor: float = 2048.0,
+) -> Tuple[int, str]:
+    """(exit_code, verdict) for the mesh-plane gate over the MULTICHIP
+    carriers, three claims with the shared double-threshold shape (both
+    the relative AND the absolute bar must trip — CPU-rig reduce
+    latencies are single-digit ms and jitter, and the byte bill moves
+    with codec framing):
+
+    * ``mesh_merges_per_sec`` must not FALL more than `tolerance`
+      relative and `merges_floor_abs` absolute under the best prior;
+    * ``ici_reduce_ms_p50`` must not GROW more than `tolerance` and
+      `ici_floor_ms` over the best (lowest) prior — the batched
+      collective sliding back toward per-row dispatch fails here;
+    * ``cross_slice_bytes`` must not GROW more than `tolerance` and
+      `bytes_floor` over the best (lowest) prior — anti-entropy
+      fattening from shard-local slices back toward whole-instance
+      snapshots fails here.
+
+    Fewer than two carriers pass vacuously."""
+    if len(rounds) < 2:
+        return 0, (
+            f"mesh-gate: only {len(rounds)} round(s) carry the mesh "
+            "metrics — nothing to compare, passing vacuously"
+        )
+    latest_n, _p, latest_mps, latest_ici, latest_bytes = rounds[-1]
+    prior = rounds[:-1]
+    best_mps_n, _mp, best_mps, _i, _b = max(prior, key=lambda r: r[2])
+    best_ici_n, _ip, _m, best_ici, _b2 = min(prior, key=lambda r: r[3])
+    best_byt_n, _bp, _m2, _i2, best_bytes = min(prior, key=lambda r: r[4])
+    code = 0
+    lines: List[str] = []
+
+    mps_floor = min(
+        best_mps * (1.0 - tolerance), best_mps - merges_floor_abs
+    )
+    verdict = (
+        f"mesh-gate: r{latest_n:02d} mesh_merges_per_sec = "
+        f"{latest_mps:,.0f} vs best prior r{best_mps_n:02d} = "
+        f"{best_mps:,.0f} (floor -{tolerance:.0%} and "
+        f"-{merges_floor_abs:,.0f}/s: {mps_floor:,.0f})"
+    )
+    if latest_mps < mps_floor:
+        code = 1
+        lines.append(
+            f"{verdict}\nFAIL: the ICI reduce lost "
+            f"{best_mps - latest_mps:,.0f} merges/sec over the best "
+            "prior carrier"
+        )
+    else:
+        lines.append(f"{verdict}\nOK: within tolerance")
+
+    ici_ceiling = max(best_ici * (1.0 + tolerance), best_ici + ici_floor_ms)
+    verdict = (
+        f"mesh-gate: r{latest_n:02d} ici_reduce_ms_p50 = {latest_ici:.3f} "
+        f"vs best prior r{best_ici_n:02d} = {best_ici:.3f} "
+        f"(ceiling +{tolerance:.0%} and +{ici_floor_ms}ms: "
+        f"{ici_ceiling:.3f})"
+    )
+    if latest_ici > ici_ceiling:
+        code = 1
+        lines.append(
+            f"{verdict}\nFAIL: the intra-slice reduce slowed "
+            f"{latest_ici - best_ici:+.3f}ms — the batched collective "
+            "is regressing toward per-row dispatch"
+        )
+    else:
+        lines.append(f"{verdict}\nOK: within tolerance")
+
+    byt_ceiling = max(
+        best_bytes * (1.0 + tolerance), best_bytes + bytes_floor
+    )
+    verdict = (
+        f"mesh-gate: r{latest_n:02d} cross_slice_bytes = "
+        f"{latest_bytes:,.0f} vs best prior r{best_byt_n:02d} = "
+        f"{best_bytes:,.0f} (ceiling +{tolerance:.0%} and "
+        f"+{bytes_floor:.0f}B: {byt_ceiling:,.0f})"
+    )
+    if latest_bytes > byt_ceiling:
+        code = 1
+        lines.append(
+            f"{verdict}\nFAIL: a cross-slice repair moves "
+            f"{latest_bytes - best_bytes:+,.0f} bytes more — shard-local "
+            "slices are fattening back toward whole-instance snapshots"
+        )
+    else:
+        lines.append(f"{verdict}\nOK: within tolerance")
+    return code, "\n".join(lines)
+
+
 def attribution_drift(
     rounds: List[Tuple[int, str, float, float]]
 ) -> List[str]:
@@ -702,6 +830,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"  audit r{n:02d} {os.path.basename(p)}: "
             f"overhead {ov:.2f}% per round"
         )
+    mesh = load_mesh_rounds(args.bench_dir)
+    for n, p, mps, ici, byt in mesh:
+        print(
+            f"  mesh r{n:02d} {os.path.basename(p)}: "
+            f"{mps:,.0f} merges/s, ici p50 {ici:.3f}ms, "
+            f"cross-slice {byt:,.0f} B"
+        )
     wal = load_wal_rounds(args.bench_dir)
     for n, p, p99, wal_ms, grp, rank, be in wal:
         wal_note = (
@@ -726,7 +861,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     print(audit_verdict)
     wal_code, wal_verdict = evaluate_wal(wal, args.tolerance)
     print(wal_verdict)
-    return max(code, gap_code, part_code, serve_code, audit_code, wal_code)
+    mesh_code, mesh_verdict = evaluate_mesh(mesh, args.tolerance)
+    print(mesh_verdict)
+    return max(code, gap_code, part_code, serve_code, audit_code, wal_code,
+               mesh_code)
 
 
 if __name__ == "__main__":

@@ -53,6 +53,15 @@ OK, and a deliberately fabricated pre-fsync-loss flight log (appended
 through seq 9, acked through 5, no successor) must FAIL certification
 with a counterexample naming the uncovered seq range.
 
+The mesh leg (PR 12) re-runs tests/test_mesh.py's seeded chaos drill —
+every worker mesh-sharded over a (2,4) device mesh, one ICI JOIN
+all-reduce per publish boundary, per-shard anchors, mesh-grouped
+partial repairs — in a subprocess with 8 forced host devices (this
+gate's own process initialized its backend single-device). It must
+converge to the sequential reference with `mesh.ici_reduces` and
+`mesh.cross_slice_fetches` nonzero, `net.psnap_wasted` still exactly
+zero, and the conditional `round.ici_reduce` span lit.
+
 Run:  python scripts/chaos_gate.py
 Make: part of `make chaos` (after the pytest leg).
 """
@@ -128,6 +137,19 @@ AUDIT_REQUIRED_NONZERO = (
     "audit.divergences",   # the watchdog flagged the divergence at all
     "audit.wedge_alarms",  # ...escalated once repair stalled past bound
     "audit.agreements",    # ...and closed the episode with a tta sample
+)
+
+# Mesh leg (tests/test_mesh.py's seeded drill, subprocessed onto 8
+# forced host devices): the intra-slice collective and the cross-slice
+# shard-local anti-entropy must both actually fire — a refactor that
+# silently drops the reduce or regresses fetches to whole-instance
+# resyncs keeps convergence green but zeroes these.
+MESH_REQUIRED_NONZERO = (
+    "mesh.ici_reduces",         # the ICI JOIN all-reduce actually dispatched
+    "mesh.cross_slice_fetches", # shard-local psnap slices crossed slices
+    "mesh.cross_slice_bytes",   # ...with the byte bill counted
+    "mesh.shard_digest_slices", # anchors produced per-shard digest slices
+    "net.psnap_publishes",      # ...and published the per-partition psnaps
 )
 
 # Same contract for the zone-topology leg (tests/test_topo_chaos.py:
@@ -400,6 +422,72 @@ def main() -> int:
           "and certified (loss re-derived by the successor); fabricated "
           "loss flagged with uncovered range "
           f"{dur['fabricated_exposures'][0]['uncovered']}")
+
+    # -- leg 8: the mesh plane (ICI reduces + cross-slice anti-entropy) ----
+    # This process's backend initialized single-device (the gate must not
+    # inherit the test rig's forced device count — legs 1-7 pin the
+    # UNSHARDED paths); the mesh drill needs 8 virtual devices, so it
+    # runs hermetically in a child with the conftest-built env.
+    import json as _json
+    import subprocess
+
+    from conftest import cpu_mesh_subprocess_env
+
+    child_src = (
+        "import json, sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        f"sys.path.insert(0, {os.path.join(REPO, 'tests')!r})\n"
+        f"sys.path.insert(0, {os.path.join(REPO, 'scripts')!r})\n"
+        "from test_mesh import run_mesh_chaos\n"
+        "from elastic_demo import reference_digest\n"
+        "digests, counters, span_names = run_mesh_chaos(seed=7, spans=True)\n"
+        "ref = reference_digest('topk_rmv')\n"
+        "print(json.dumps({\n"
+        "    'diverged': sorted(m for m, d in digests.items() if d != ref),\n"
+        "    'survivors': len(digests),\n"
+        "    'counters': counters,\n"
+        "    'span_names': sorted(span_names),\n"
+        "}))\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", child_src],
+        env=cpu_mesh_subprocess_env(8),
+        capture_output=True, text=True, timeout=600,
+    )
+    print("== mesh chaos drill (seed=7, (2,4) mesh × 8 forced host "
+          "devices, subprocess) ==")
+    if proc.returncode != 0:
+        print("FAIL: mesh drill subprocess crashed:\n"
+              + (proc.stderr or proc.stdout)[-2000:])
+        return 1
+    mesh = _json.loads(proc.stdout.strip().splitlines()[-1])
+    mc = mesh["counters"]
+    m_zeroed = sorted(n for n in MESH_REQUIRED_NONZERO if not mc.get(n, 0))
+    m_wasted = int(mc.get("net.psnap_wasted", 0))
+    print("  " + " ".join(
+        f"{n}={int(mc.get(n, 0))}"
+        for n in MESH_REQUIRED_NONZERO + ("net.psnap_wasted",)
+    ))
+    if mesh["diverged"]:
+        print("FAIL: mesh-sharded members diverged from the sequential "
+              f"reference: {mesh['diverged']}")
+        return 1
+    if m_zeroed:
+        print("FAIL: mesh counters regressed to zero (the ICI reduce or "
+              f"the shard-local anti-entropy went dark): {m_zeroed}")
+        return 1
+    if m_wasted:
+        print(f"FAIL: {m_wasted} psnap fetch(es) covered a partition whose "
+              "digests already agreed — sharding broke the divergence math")
+        return 1
+    if "round.ici_reduce" not in mesh["span_names"]:
+        print("FAIL: the conditional round.ici_reduce span never lit in a "
+              f"mesh drill (spans seen: {mesh['span_names']})")
+        return 1
+    print(f"OK: mesh leg — {mesh['survivors']} mesh-sharded survivors "
+          f"converged via {int(mc.get('mesh.ici_reduces', 0))} ICI reduces "
+          f"and {int(mc.get('mesh.cross_slice_fetches', 0))} cross-slice "
+          "shard fetches, 0 wasted psnaps, round.ici_reduce lit")
     return 0
 
 

@@ -331,6 +331,14 @@ def main() -> None:
         "published (wal.durable_seq) and recovery truncates to it. "
         "Empty = CCRDT_WAL_DURABILITY env, else group",
     )
+    ap.add_argument(
+        "--mesh", action="store_true",
+        help="force the device-mesh plane on (mesh/): state pins to a "
+        "(dc, key) device mesh, intra-slice reconciliation runs as one "
+        "batched ICI JOIN all-reduce per publish boundary, and anchors "
+        "publish per-shard digest slices + psnaps. Default: CCRDT_MESH "
+        "env; either way needs >1 visible device and a JOIN engine",
+    )
     args = ap.parse_args()
 
     import jax
@@ -371,6 +379,7 @@ def run_worker(store, drill, dense, state, args, result_dir):
         DeltaPublisher,
         PartialAntiEntropy,
         my_replicas,
+        owners,
         sweep,
         sweep_deltas,
     )
@@ -475,6 +484,37 @@ def run_worker(store, drill, dense, state, args, result_dir):
         # TCP fleets additionally answer {query} frames in-band.
         tr.install_serve(plane)
 
+    # --- mesh plane (tentpole, PR 12): CCRDT_MESH=1 (or --mesh) pins this
+    # worker's state onto a (dc, key) device mesh. Partitions map whole
+    # onto key shards (MeshPlan.shard_of — digests/psnaps/WAL tags/sharded
+    # checkpoints keep working per-shard as-is), intra-slice
+    # reconciliation is one batched ICI JOIN all-reduce per publish
+    # boundary (mesh/reduce), and the anchor/anti-entropy plumbing below
+    # goes per-shard. JOIN engines only (mesh.supports). With the flag
+    # off, on 1 device, or for MONOID engines this is None and every code
+    # path below is bit-identical to the pre-mesh worker.
+    from antidote_ccrdt_tpu import mesh as mesh_mod
+
+    mplan = mesh_mod.install_from_env(
+        dense,
+        partitions=int(getattr(args, "partitions", 0) or 0) or None,
+        override=(True if getattr(args, "mesh", False) else None),
+        metrics=store.metrics,
+    )
+
+    def _mesh_tick(st, donate=False):
+        """One intra-slice reduce at a publish boundary. Total: an
+        injected `mesh.reduce` failure degrades to plain gossip. Donate
+        only on the serial path — the overlap host stage may still be
+        serializing buffers a submitted WAL task holds."""
+        if mplan is None:
+            return st
+        view = drill.pub_state(dense, st)
+        red = mesh_mod.try_ici_reduce(
+            dense, mplan, view, donate=donate, metrics=store.metrics
+        )
+        return drill.set_view(dense, st, red) if red is not view else st
+
     # --- crash-consistent WAL (tentpole, PR 2): recover checkpoint ⊔
     # delta suffix, resume AFTER the last durable step. Peer adoption
     # stays the fallback: with no (or a deleted) WAL this block recovers
@@ -491,6 +531,7 @@ def run_worker(store, drill, dense, state, args, result_dir):
             metrics=store.metrics,
             partitions=int(getattr(args, "partitions", 0) or 0) or None,
             durability=getattr(args, "wal_durability", "") or None,
+            mesh_plan=mplan,
         )
         ctx["wal"] = wal
         from antidote_ccrdt_tpu.parallel.overlap import CommitCoalescer
@@ -513,6 +554,15 @@ def run_worker(store, drill, dense, state, args, result_dir):
             # absorbed as peer rows (set_view), and the adopt path below
             # regenerates the own-side contribution with versions identical
             # to the lost incarnation's — row-replace dedups the overlap.
+
+    if mplan is not None:
+        # Pin the (possibly WAL-recovered) state onto the mesh once up
+        # front; host-side folds later in the run may drift leaves off
+        # their shardings, and `ici_reduce` re-pins those lazily
+        # (ensure_placed) at each boundary.
+        state = drill.set_view(
+            dense, state, mplan.place(drill.pub_state(dense, state))
+        )
 
     def do_publish(store, seq_hint):
         view = drill.pub_state(dense, state)
@@ -614,6 +664,11 @@ def run_worker(store, drill, dense, state, args, result_dir):
                 ctx["wal"].durability if ctx["wal"] is not None else None
             ),
             "serve": serve_doc,
+            "mesh": {
+                k[len("mesh."):]: v
+                for k, v in counters.items()
+                if k.startswith("mesh.")
+            },
             "audit": watchdog.status_fields(),
         }
         path = os.path.join(result_dir, f"obs-{args.member}.json")
@@ -639,13 +694,17 @@ def run_worker(store, drill, dense, state, args, result_dir):
         pub = DeltaPublisher(
             store, dense, name=drill.publish_name, full_every=4,
             lag_source=lag_source, lag_threshold=lag_anchor_ops,
-            partitions=P or None,
+            partitions=P or None, mesh_plan=mplan,
         )
         pub.on_publish = _serve_swap
         if P:
             # Gap repairs go partition-granular, and every digest fetch
             # feeds the watchdog's per-peer divergence state machine.
-            pae = PartialAntiEntropy(store, partitions=P, watchdog=watchdog)
+            # With a mesh plan the fetches additionally group by owning
+            # shard — cross-slice anti-entropy ships shard-local slices.
+            pae = PartialAntiEntropy(
+                store, partitions=P, watchdog=watchdog, mesh_plan=mplan
+            )
         if start_step > 0:
             # Resume the delta-seq lineage PAST anything the lost
             # incarnation published (old seq <= old step < start_step):
@@ -667,7 +726,14 @@ def run_worker(store, drill, dense, state, args, result_dir):
     ovl = None
     if overlap_mod.enabled(getattr(args, "overlap", None)):
         ovl = overlap_mod.OverlapPipeline(
-            store, dense, drill.pub_state(dense, state)
+            store, dense, drill.pub_state(dense, state),
+            post_fold=(
+                (lambda s: mesh_mod.try_ici_reduce(
+                    dense, mplan, s, donate=False, metrics=store.metrics
+                ))
+                if mplan is not None
+                else None
+            ),
         )
         # feed_lag's applied watermarks are now the pipeline's (what
         # drain_into actually folded), not sweep_deltas' cursor dict.
@@ -794,6 +860,11 @@ def run_worker(store, drill, dense, state, args, result_dir):
                     drill.pub_state(dense, state),
                 )
             if step % args.publish_every == 0:
+                # Pre-join the dc blocks BEFORE the boundary ships: the
+                # published anchor carries reduced rows. No donation —
+                # the WAL submit above may still hold these buffers on
+                # the host stage.
+                state = _mesh_tick(state, donate=False)
                 ovl.submit(
                     _overlap_boundary, drill.pub_state(dense, state),
                     step, sorted(owned),
@@ -810,6 +881,11 @@ def run_worker(store, drill, dense, state, args, result_dir):
                     drill.pub_state(dense, state),
                 )
             if step % args.publish_every == 0:
+                # Pre-join the dc blocks before publishing. Donation is
+                # safe here: log_step above serialized its record bytes
+                # synchronously, so this round thread holds the only
+                # live reference to the state buffers.
+                state = _mesh_tick(state, donate=True)
                 with store.metrics.timer("net.round"):
                     if wal is not None and wal.durability != "async":
                         coalescer.flush()  # durable before visible
@@ -894,6 +970,29 @@ def run_worker(store, drill, dense, state, args, result_dir):
             # A dead peer's frozen digest vector must not age into a
             # wedged-divergence alarm; adoption already owns its ops.
             watchdog.drop(m)
+        # Adopt under the SAME belief the publish below advertises. The
+        # my_replicas pass above reads heartbeats at args.timeout, the
+        # death confirmation reads them at confident_stale — two separate
+        # samples. A heartbeat that ages past BOTH thresholds between
+        # them lets this worker publish STEPS + dead_n in an iteration
+        # whose adopt pass never saw the death; a peer satisfies its
+        # barrier on that seq, final-sweeps the pre-adoption snapshot
+        # (the post-adoption republish reuses the SAME seq, so a
+        # seq-gated fetch skips it), and exits missing the victim's
+        # trailing steps for the replicas that hashed here. Re-deriving
+        # ownership over the advertised alive view before publishing
+        # closes the gap; over-adoption is idempotent (ownership only
+        # grows, regeneration dedups), so the extra pass is free.
+        owned = owned_prev | {
+            r for r, m in owners(
+                sorted((alive_now | {args.member}) - confirmed_dead), R
+            ).items()
+            if m == args.member
+        }
+        gained = owned - owned_prev
+        if gained:
+            state = drill.adopt(dense, state, sorted(gained), STEPS)
+        owned_prev = owned
         final_view = drill.pub_state(dense, state)
         store.publish(drill.publish_name, final_view, STEPS + dead_n)
         _serve_swap(final_view, STEPS + dead_n)

@@ -80,7 +80,14 @@ except ModuleNotFoundError:
 
     st = _AnyStrategy()
 
-__all__ = ["HealthCheck", "given", "settings", "st", "cpu_subprocess_env"]
+__all__ = [
+    "HealthCheck",
+    "given",
+    "settings",
+    "st",
+    "cpu_subprocess_env",
+    "cpu_mesh_subprocess_env",
+]
 
 
 def cpu_subprocess_env(**extra):
@@ -99,4 +106,19 @@ def cpu_subprocess_env(**extra):
     )
     env["JAX_PLATFORMS"] = "cpu"
     env.update(extra)
+    return env
+
+
+def cpu_mesh_subprocess_env(n: int = 8, **extra):
+    """Env for a subprocess that needs a FORCED n-device CPU mesh.
+
+    Same hermetic CPU isolation as `cpu_subprocess_env`, but instead of
+    stripping XLA_FLAGS it pins exactly the virtual-device-count flag —
+    any other inherited XLA flags are dropped so the child's backend
+    state matches this test process's (which got its 8 devices from the
+    module-top env mutation above), not whatever wrapper launched
+    pytest. Mesh drills that fork workers (multichip demo, chaos leg 8)
+    build their worker envs through this."""
+    env = cpu_subprocess_env(**extra)
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={int(n)}"
     return env

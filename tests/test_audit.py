@@ -213,6 +213,58 @@ def test_reconcile_coverage_spans_incarnations():
     assert reconcile_op_counts(logs)["ok"]
 
 
+def test_reconcile_excludes_member_dead_at_quiesce():
+    # The mesh drill's shape: b SIGKILLed mid-run (crash dump: no
+    # proc.exit) and never restarted. Its final state does not exist,
+    # so it owes no coverage — but its PUBLISHED stream stays on the
+    # books: a must still cover everything b shipped before dying.
+    def _life(member, t, exit_):
+        evs = [{"kind": "proc.start", "member": member, "t": t, "seq": 0}]
+        if exit_:
+            evs.append(
+                {"kind": "proc.exit", "member": member, "t": t + 9,
+                 "seq": 99})
+        return evs
+
+    logs = {
+        "flight-a-1.jsonl": _life("a", 0.0, True) + [
+            _pub("a", 1, 1), _pub("a", 2, 2),
+            _app("a", "b", 1, 3),
+        ],
+        "flight-b-1.jsonl": _life("b", 0.0, False) + [
+            _pub("b", 1, 1), _app("b", "a", 1, 2),
+        ],
+    }
+    rec = reconcile_op_counts(logs)
+    assert rec["ok"], rec
+    assert rec["dead_members"] == ["b"]
+    # ...but drop a's coverage of b's stream: the dead member's ops
+    # were LOST, and the check must still catch exactly that.
+    logs["flight-a-1.jsonl"] = _life("a", 0.0, True) + [
+        _pub("a", 1, 1), _pub("a", 2, 2)]
+    rec = reconcile_op_counts(logs)
+    assert not rec["ok"]
+    assert rec["uncovered"][0] == {
+        "applier": "a", "origin": "b",
+        "covered_through": -1, "published_through": 1, "applied": 0,
+    }
+    # A RESTARTED member (crash dump + successor incarnation) is not
+    # dead — its union coverage is judged as before.
+    logs["flight-a-1.jsonl"] = _life("a", 0.0, True) + [
+        _pub("a", 1, 1), _pub("a", 2, 2), _app("a", "b", 1, 3)]
+    logs["flight-b-2.jsonl"] = _life("b", 5.0, True) + [
+        _app("b", "a", 1, 1)]
+    rec = reconcile_op_counts(logs)
+    assert rec["dead_members"] == []
+    assert not rec["ok"]  # b's union coverage of a stops at dseq 1 < 2
+    # Without the proc lifecycle discipline anywhere in the spill
+    # (in-process sim drills), nobody is excused.
+    assert reconcile_op_counts({
+        "flight-a-1.jsonl": [_pub("a", 1, 0)],
+        "flight-b-1.jsonl": [],
+    })["dead_members"] == []
+
+
 # -- divergence watchdog -----------------------------------------------------
 
 

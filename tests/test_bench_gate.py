@@ -133,5 +133,81 @@ def test_gap_gate_absolute_floor_absorbs_noise(tmp_path):
     assert code == 1 and "FAIL" in verdict
 
 
+def _mesh_round(tmp_path, n, merges=None, ici=None, bytes_=None,
+                legacy=False):
+    path = str(tmp_path / f"MULTICHIP_r{n:02d}.json")
+    if legacy:
+        # The r01-r05 dryrun dumps: no mesh metric keys at all.
+        doc = {"n_devices": 8, "rc": 0, "ok": True, "tail": "dryrun"}
+    else:
+        doc = {
+            "drill": "multichip_demo",
+            "mesh_merges_per_sec": merges,
+            "ici_reduce_ms_p50": ici,
+            "cross_slice_bytes": bytes_,
+        }
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+def test_mesh_rounds_skip_legacy_and_sort(tmp_path):
+    _mesh_round(tmp_path, 1, legacy=True)
+    _mesh_round(tmp_path, 10, merges=2000.0, ici=1.0, bytes_=4000)
+    _mesh_round(tmp_path, 6, merges=1000.0, ici=2.0, bytes_=3000)
+    _mesh_round(tmp_path, 7, merges=None, ici=1.0, bytes_=3000)  # partial
+    rounds = gate.load_mesh_rounds(str(tmp_path))
+    assert [r[0] for r in rounds] == [6, 10]
+    assert rounds[0][2] == 1000.0 and rounds[1][4] == 4000.0
+
+
+def test_mesh_gate_vacuous_with_single_carrier(tmp_path):
+    _mesh_round(tmp_path, 1, legacy=True)
+    _mesh_round(tmp_path, 6, merges=1000.0, ici=1.0, bytes_=2000)
+    code, verdict = gate.evaluate_mesh(gate.load_mesh_rounds(str(tmp_path)))
+    assert code == 0 and "vacuous" in verdict
+
+
+def test_mesh_gate_double_threshold(tmp_path):
+    # Baseline r06; r07 moves on every metric but each move clears only
+    # ONE of the two bars — all three claims must stay OK.
+    _mesh_round(tmp_path, 6, merges=100_000.0, ici=1.0, bytes_=4000.0)
+    _mesh_round(
+        tmp_path, 7,
+        merges=99_700.0,   # -300/s abs > 200 floor, but -0.3% < 20%
+        ici=1.15,          # +15% < 20%, and +0.15ms < 2ms floor
+        bytes_=4500.0,     # +12.5% < 20%, +500B < 2048B floor
+    )
+    code, verdict = gate.evaluate_mesh(gate.load_mesh_rounds(str(tmp_path)))
+    assert code == 0 and "FAIL" not in verdict
+
+
+def test_mesh_gate_fails_each_metric(tmp_path):
+    base = dict(merges=100_000.0, ici=1.0, bytes_=4000.0)
+    # merges collapse: -30% AND -30k/s → both bars tripped.
+    _mesh_round(tmp_path, 6, **base)
+    _mesh_round(tmp_path, 7, merges=70_000.0, ici=1.0, bytes_=4000.0)
+    code, verdict = gate.evaluate_mesh(gate.load_mesh_rounds(str(tmp_path)))
+    assert code == 1 and "merges" in verdict
+    # ici regression: +300% and +3ms.
+    _mesh_round(tmp_path, 7, merges=100_000.0, ici=4.0, bytes_=4000.0)
+    code, verdict = gate.evaluate_mesh(gate.load_mesh_rounds(str(tmp_path)))
+    assert code == 1 and "ici" in verdict
+    # anti-entropy fattening: +150% and +6000B.
+    _mesh_round(tmp_path, 7, merges=100_000.0, ici=1.0, bytes_=10_000.0)
+    code, verdict = gate.evaluate_mesh(gate.load_mesh_rounds(str(tmp_path)))
+    assert code == 1 and "cross_slice" in verdict
+
+
+def test_mesh_gate_compares_against_best_prior(tmp_path):
+    _mesh_round(tmp_path, 6, merges=100_000.0, ici=1.0, bytes_=4000.0)
+    _mesh_round(tmp_path, 7, merges=40_000.0, ici=9.0, bytes_=90_000.0)
+    # r08 within tolerance of the BEST priors (r06 on all three), even
+    # though r07 — the latest prior — was a disaster round.
+    _mesh_round(tmp_path, 8, merges=95_000.0, ici=1.1, bytes_=4100.0)
+    code, verdict = gate.evaluate_mesh(gate.load_mesh_rounds(str(tmp_path)))
+    assert code == 0 and "FAIL" not in verdict
+
+
 def test_main_against_repo_rounds():
     assert gate.main([]) == 0  # the committed BENCH_r*.json must pass

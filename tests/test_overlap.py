@@ -202,6 +202,24 @@ def test_apply_queue_shed_purges_member_chain_and_opens_hole():
     assert q.put_delta("a", 2, "a2")
 
 
+def test_apply_queue_keeps_anchor_with_deltas_chained_behind_it():
+    # A stale snap with same-member deltas queued AFTER it is load-
+    # bearing: those deltas chained from its seq, and popping them
+    # without it would emit delta.apply events past a gap the flight-
+    # log causal audit reads as a gap-skip (the mesh drill caught this
+    # live: anchor 8 replaced by anchor 11 landing after delta 9).
+    q = ApplyQueue(depth=8, metrics=Metrics())
+    assert q.put_snap("a", 8, "anchor8")
+    assert q.put_delta("a", 9, "a9")
+    assert q.put_snap("a", 11, "anchor11")  # must NOT displace anchor8
+    got = [(e.kind, e.seq) for e in q.pop_all()]
+    assert got == [("snap", 8), ("delta", 9), ("snap", 11)]
+    # With no deltas behind it, latest-wins replacement still applies.
+    assert q.put_snap("b", 1, "b-old")
+    assert q.put_snap("b", 2, "b-new")
+    assert [(e.kind, e.seq) for e in q.pop_all()] == [("snap", 2)]
+
+
 def test_apply_queue_snapshots_latest_wins_and_all_snap_overflow():
     m = Metrics()
     q = ApplyQueue(depth=2, metrics=m)
@@ -392,7 +410,7 @@ def test_sigkill_mid_window_with_overlap_recovers_via_wal():
     p = subprocess.run(
         [sys.executable,
          os.path.join(REPO, "scripts", "crash_recovery_demo.py"),
-         "--mode", "wal"],
+         "--mode", "wal", "--durability", "group"],
         capture_output=True, text=True, env=env, timeout=420,
     )
     assert p.returncode == 0, (

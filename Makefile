@@ -23,7 +23,7 @@ PY ?= python
 # meaningful.
 COVER_THRESHOLD ?= 88
 
-.PHONY: all compile test cover typecheck xref native bench benchall dryrun net-demo chaos crash-demo obs-demo topo-demo spans-demo overlap-demo partition-demo serve-demo audit-demo multichip-demo bench-gate clean
+.PHONY: all compile test cover typecheck xref native bench benchall dryrun net-demo chaos crash-demo obs-demo topo-demo spans-demo overlap-demo partition-demo serve-demo audit-demo multichip-demo working-set-demo bench-gate clean
 
 all: compile xref typecheck cover
 
@@ -84,8 +84,12 @@ net-demo:
 # the scrape-under-fault matrix (tcp.send / bridge.read must degrade a
 # live scrape, never hang) and the trace-CLI unit surface; the fourth
 # is the bench regression gate over the committed BENCH_r*.json rounds;
-# the last is the real-process span demo (3 TCP workers, one merged
-# Perfetto timeline, dispatch-gap attribution gated).
+# then the real-process span demo (3 TCP workers, one merged Perfetto
+# timeline, dispatch-gap attribution gated) and the overlap demo. The
+# final leg is the out-of-core working-set demo: chaos_gate's
+# working-set leg already ran the same drill on a fresh seed; this one
+# adds the two-arm CCRDT_PAGER=0 kill-switch comparison and refreshes
+# WORKSET_r01.json.
 chaos:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py tests/test_wal.py tests/test_fault_matrix.py -q -p no:cacheprovider
 	env JAX_PLATFORMS=cpu $(PY) scripts/chaos_gate.py
@@ -93,6 +97,7 @@ chaos:
 	$(PY) scripts/bench_gate.py
 	env JAX_PLATFORMS=cpu $(PY) scripts/spans_demo.py
 	env JAX_PLATFORMS=cpu $(PY) scripts/overlap_demo.py
+	env JAX_PLATFORMS=cpu $(PY) scripts/working_set_demo.py
 
 # Throughput regression gate: best merges_per_sec of the latest
 # BENCH_r*.json round must stay within 20% of the best prior round —
@@ -179,6 +184,20 @@ audit-demo:
 # MULTICHIP_r06.json (the carrier bench_gate's evaluate_mesh compares).
 multichip-demo:
 	$(PY) scripts/multichip_demo.py
+
+# Out-of-core paging gate (in-process fleet over a shared-fs
+# transport): a 3-worker fleet whose per-worker device residency is
+# forced to ONE TENTH of the instance (core/pager.py clock pager),
+# zipf-skewed ops through the `ensure_resident` front door, full
+# partition-plane gossip with anchors/psnaps served from cold CCPT
+# blobs — gated on bit-identical convergence vs the all-resident
+# sequential reference AND vs a CCRDT_PAGER=0 kill-switch rerun,
+# steady-state hit rate >= 0.9, state >= 10x budget, pager counters
+# nonzero, zero wasted psnaps, and the round.pager_hydrate span lit.
+# Writes WORKSET_r01.json. Also part of `make chaos` via
+# scripts/chaos_gate.py's working-set leg (fresh seed there).
+working-set-demo:
+	env JAX_PLATFORMS=cpu $(PY) scripts/working_set_demo.py
 
 # Span-tracing demo (slow, real processes): a 3-worker TCP fleet with
 # the round-phase span plane armed (CCRDT_SPANS=1) — every worker's

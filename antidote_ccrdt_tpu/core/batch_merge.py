@@ -34,7 +34,8 @@ exactly that property).
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Sequence
+import contextlib
+from typing import Any, Dict, Iterator, List, Sequence
 
 import numpy as np
 
@@ -161,6 +162,35 @@ def merge_into(merge, state, incoming, donate_incoming: bool = True):
         return slot(state, incoming)
     finally:
         obs_spans.end(tok)
+
+
+@contextlib.contextmanager
+def host_device() -> Iterator[None]:
+    """Pin jit dispatch + array creation to the host CPU backend for the
+    enclosed region — the pager's cold-fold tier (core/pager.py) runs the
+    SAME jitted merge slots as the device hot path, just compiled for and
+    executed on CPU-backed arrays. On a CPU-only process (tests, drills)
+    this is a no-op by construction."""
+    import jax
+
+    try:
+        cpus = jax.devices("cpu")
+    except RuntimeError:
+        cpus = []
+    if not cpus:
+        yield
+        return
+    with jax.default_device(cpus[0]):
+        yield
+
+
+def host_merge_into(merge, state, incoming, donate_incoming: bool = True):
+    """`merge_into`, but dispatched on the host CPU backend: the cold
+    tier's fold primitive. `state`/`incoming` created inside the
+    `host_device` region stay CPU-committed, so the jit slot compiles a
+    CPU executable and the fold never touches HBM."""
+    with host_device():
+        return merge_into(merge, state, incoming, donate_incoming=donate_incoming)
 
 
 def fold_states(merge, states: Sequence[Any]):

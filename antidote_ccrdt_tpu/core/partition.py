@@ -368,6 +368,86 @@ def delta_parts(dense: Any, like_state: Any, delta: Any, P: int) -> Set[int]:
     return parts | {P}
 
 
+def split_delta(
+    dense: Any, like_state: Any, delta: Any, P: int, parts: Sequence[int]
+) -> Tuple[Any, Optional[Any]]:
+    """Split a decoded delta (or delta-shaped psnap) into ``(hot, cold)``
+    halves around a partition set: rows/entries whose id hashes into
+    `parts` go to the cold half, everything else stays hot. The meta
+    payload (vc / whole leaves) rides the HOT half — the meta partition
+    is pinned resident by the pager — and the cold half asserts nothing
+    about it (join-identity leaves, same move as a non-meta psnap).
+    Either return slot may be the original delta / None when one side is
+    empty. Joining both halves into the same state equals joining the
+    original delta: the split is along the item axis, where every leaf
+    row joins independently.
+
+    Lifted monoid row deltas are rejected: a lifted state partitions by
+    replica row, which the pager does not page (core/pager.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.delta import TopkRmvDelta, _is_monoid_row_delta, _split_leaves
+
+    cold_set = np.asarray(sorted(int(p) for p in parts), np.int64)
+    if cold_set.size == 0:
+        return delta, None
+    if isinstance(delta, TopkRmvDelta):
+        rows = np.asarray(delta.rows)
+        in_cold = np.isin(part_of(rows % dense.I, P), cold_set)
+        if not in_cold.any():
+            return delta, None
+
+        def _take(mask: np.ndarray) -> Dict[str, Any]:
+            sel = np.nonzero(mask)[0]
+            return {
+                "rows": jnp.asarray(rows[sel].astype(np.int32)),
+                "slot_score": jnp.asarray(np.asarray(delta.slot_score)[sel]),
+                "slot_dc": jnp.asarray(np.asarray(delta.slot_dc)[sel]),
+                "slot_ts": jnp.asarray(np.asarray(delta.slot_ts)[sel]),
+                "rmv_vc": jnp.asarray(np.asarray(delta.rmv_vc)[sel]),
+            }
+
+        hot = TopkRmvDelta(**_take(~in_cold), vc=delta.vc, lossy=delta.lossy)
+        cold = TopkRmvDelta(
+            **_take(in_cold),
+            vc=jnp.zeros_like(delta.vc),
+            lossy=jnp.zeros_like(delta.lossy),
+        )
+        return hot, cold
+    if _is_monoid_row_delta(delta):
+        raise ValueError("cannot split a lifted monoid row delta by partition")
+    _items, _whole, extent = _item_plan(like_state)
+    idx = np.asarray(delta.get("idx", np.zeros(0, np.int64)))
+    if extent == 0 or idx.size == 0:
+        return delta, None
+    in_cold = np.isin(part_of(idx % extent, P), cold_set)
+    if not in_cold.any():
+        return delta, None
+    hot_sel = np.nonzero(~in_cold)[0]
+    cold_sel = np.nonzero(in_cold)[0]
+    hot = {
+        "idx": jnp.asarray(idx[hot_sel].astype(np.int32)),
+        "table": {
+            p: jnp.asarray(np.asarray(v)[hot_sel])
+            for p, v in delta["table"].items()
+        },
+        "whole": dict(delta["whole"]),
+    }
+    R, NK = jax.tree_util.tree_leaves(like_state)[0].shape[:2]
+    ipaths, ileaves, _t, _ = _split_leaves(dense.init(R, NK))
+    ident_by = dict(zip(ipaths, ileaves))
+    cold = {
+        "idx": jnp.asarray(idx[cold_sel].astype(np.int32)),
+        "table": {
+            p: jnp.asarray(np.asarray(v)[cold_sel])
+            for p, v in delta["table"].items()
+        },
+        "whole": {p: ident_by[p] for p in delta["whole"]},
+    }
+    return hot, cold
+
+
 # --- CCPT blob container ---------------------------------------------------
 # First-bytes disambiguation, same move as topo/codec.py's bare-ETF
 # fallback: new blobs open with b"CCPT"; legacy whole-instance snapshot

@@ -322,14 +322,20 @@ def _shard_path(root: str, part: int) -> str:
 
 def _write_shard(
     root: str, name: str, dense: Any, state: Any, part: int, P: int,
-    step: int,
+    step: int, pager: Any = None,
 ) -> int:
-    """Atomically persist partition `part` of `state`; returns bytes."""
+    """Atomically persist partition `part` of `state`; returns bytes.
+    With a pager, a demoted partition's shard is written straight from
+    its stored CCPT payload (transfer format is storage format) — no
+    hydration to checkpoint."""
     from ..core import partition as pt
 
-    payload = serial.dumps_dense(
-        f"{name}_psnap", pt.restrict_psnap(dense, state, part, P)
-    )
+    if pager is not None:
+        payload = pager.psnap_payload(state, part)
+    else:
+        payload = serial.dumps_dense(
+            f"{name}_psnap", pt.restrict_psnap(dense, state, part, P)
+        )
     blob = pt.encode_psnap_blob(step, part, payload)
     path = _shard_path(root, part)
     tmp = f"{path}.tmp"
@@ -347,6 +353,7 @@ def save_partitioned_checkpoint(
     root: str, name: str, state: Any, dense: Any, step: int,
     partitions: Optional[int] = None,
     parts: Optional[List[int]] = None,
+    pager: Any = None,
 ) -> int:
     """Shard `state` into per-partition checkpoint files (P id
     partitions + the meta partition) and commit with a manifest.
@@ -368,10 +375,14 @@ def save_partitioned_checkpoint(
     total = 0
     todo = sorted(int(p) for p in parts) if parts is not None else range(P + 1)
     for part in todo:
-        total += _write_shard(root, name, dense, state, part, P, step)
+        total += _write_shard(root, name, dense, state, part, P, step,
+                              pager=pager)
     if parts is not None:
         return total
-    digests = pt.state_digests(state, P)
+    if pager is not None and pager.has_cold():
+        digests = pager.digest_vector(state)
+    else:
+        digests = pt.state_digests(state, P)
     _write_manifest(root, name, step, P, digests)
     return total
 
@@ -397,6 +408,7 @@ def _write_manifest(
 
 def save_mesh_checkpoint(
     root: str, name: str, state: Any, dense: Any, step: int, plan: Any,
+    pager: Any = None,
 ) -> int:
     """Shard-grouped checkpoint: each key shard of a `mesh.MeshPlan`
     persists exactly the partitions it owns (`parts=owned_parts(s)`),
@@ -410,9 +422,9 @@ def save_mesh_checkpoint(
     for s in range(plan.n_key):
         total += save_partitioned_checkpoint(
             root, name, state, dense, step,
-            partitions=plan.P, parts=plan.owned_parts(s),
+            partitions=plan.P, parts=plan.owned_parts(s), pager=pager,
         )
-    digests = mesh_gossip.sharded_digest_vector(state, plan)
+    digests = mesh_gossip.sharded_digest_vector(state, plan, pager=pager)
     _write_manifest(root, name, step, plan.P, digests)
     return total
 

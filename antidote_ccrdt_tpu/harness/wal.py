@@ -706,8 +706,19 @@ class ElasticWal:
         (-1 = nothing recovered); owned_union is every replica id the
         lost incarnation logged ownership of. In async mode the open
         already truncated every stream to the wm watermark, so what we
-        replay here is precisely the certified-durable prefix."""
+        replay here is precisely the certified-durable prefix.
+
+        Pager spill blobs (core/pager.py) under this WAL dir are
+        discarded first: a spill file is a residency cache of state that
+        is durable here, and the dead incarnation may have been killed
+        mid-spill — recovery rebuilds all-resident from checkpoint+WAL
+        and must never resurrect a possibly-torn blob."""
+        from ..core import pager as pg
         from ..parallel.delta import apply_any_delta, like_delta_for
+
+        dropped = pg.discard_spill(self.dir)
+        if dropped:
+            self.metrics.count("pager.spills_discarded", dropped)
 
         state: Optional[Any] = None
         last_step = -1

@@ -34,11 +34,16 @@ from ..core import partition as pt
 
 
 def shard_digest_entries(
-    state: Any, plan: Any, shard: int
+    state: Any, plan: Any, shard: int, pager: Optional[Any] = None
 ) -> Dict[int, int]:
     """Digest entries for the partitions `shard` owns — the shard-local
-    slice of the P+1 vector."""
-    return pt.digest_entries(state, plan.P, plan.owned_parts(shard))
+    slice of the P+1 vector. With a pager, demoted partitions answer
+    from their cached blob digests instead of the (cleared) device
+    slices, so the stitched vector still describes the logical state."""
+    owned = plan.owned_parts(shard)
+    if pager is not None and pager.has_cold():
+        return pager.digest_entries_for(state, owned)
+    return pt.digest_entries(state, plan.P, owned)
 
 
 def stitch_digests(plan: Any, entries: Dict[int, int]) -> np.ndarray:
@@ -60,13 +65,15 @@ def stitch_digests(plan: Any, entries: Dict[int, int]) -> np.ndarray:
 
 
 def sharded_digest_vector(
-    state: Any, plan: Any, metrics: Optional[Any] = None
+    state: Any, plan: Any, metrics: Optional[Any] = None,
+    pager: Optional[Any] = None,
 ) -> np.ndarray:
     """The full digest vector, produced shard by shard and stitched —
-    bitwise equal to `core.partition.state_digests(state, P)`."""
+    bitwise equal to `core.partition.state_digests(state, P)` of the
+    logical (pager-reassembled) state."""
     entries: Dict[int, int] = {}
     for s in range(plan.n_key):
-        entries.update(shard_digest_entries(state, plan, s))
+        entries.update(shard_digest_entries(state, plan, s, pager=pager))
         if metrics is not None:
             metrics.count("mesh.shard_digest_slices")
     return stitch_digests(plan, entries)
@@ -85,18 +92,23 @@ def group_parts_by_shard(
 
 def shard_psnap_blobs(
     name: str, state: Any, seq: int, dense: Any, plan: Any, shard: int,
-    parts: Optional[Iterable[int]] = None,
+    parts: Optional[Iterable[int]] = None, pager: Optional[Any] = None,
 ) -> List[Tuple[int, bytes]]:
     """[(part, CCPT blob)…] for the owned partitions of `shard` (or the
     subset `parts` ∩ owned). Same encode path as the unsharded anchor
     (`restrict_psnap` → `dumps_dense` → `encode_psnap_blob`), so the
-    blobs are byte-identical to the whole-producer's."""
+    blobs are byte-identical to the whole-producer's. With a pager,
+    demoted partitions are served straight from their stored payloads
+    (transfer format is storage format — no hydration to publish)."""
     from ..core import serial
 
     owned = set(plan.owned_parts(shard))
     todo = sorted(owned if parts is None else owned & {int(p) for p in parts})
     out = []
     for part in todo:
+        if pager is not None:
+            out.append((part, pager.psnap_blob(state, seq, part)))
+            continue
         payload = serial.dumps_dense(
             f"{name}_psnap", pt.restrict_psnap(dense, state, part, plan.P)
         )

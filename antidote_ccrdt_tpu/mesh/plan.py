@@ -201,6 +201,25 @@ class MeshPlan:
 
         return jax.tree.map(fix, state, self.shardings(state))
 
+    # -- out-of-core (core/pager.py) ----------------------------------------
+
+    def build_pager(
+        self, dense: Any, state: Any, shard: int, *,
+        name: str = "state", metrics: Optional[Any] = None,
+        spill_dir: Optional[str] = None,
+    ) -> Optional[Any]:
+        """A `PartitionPager` scoped to `shard`'s owned partitions — the
+        per-chip hot/cold residency manager for this plan. Budgets come
+        from `CCRDT_PAGER_HBM_BUDGET` / `CCRDT_PAGER_HOST_BUDGET`;
+        returns None when paging is disabled, unconfigured, or the
+        engine is unpageable (lifted rows / bare monoids)."""
+        from ..core import pager as pg
+
+        return pg.maybe_pager(
+            dense, state, owned=self.owned_parts(shard), metrics=metrics,
+            spill_dir=spill_dir, P=self.P, name=name,
+        )
+
     # -- identity ------------------------------------------------------------
 
     def slot_key(self):

@@ -510,7 +510,7 @@ class GossipNode:
 
     def publish_partitioned(
         self, name: str, state: Any, seq: int, dense: Any, P: int,
-        plan: Optional[Any] = None,
+        plan: Optional[Any] = None, pager: Optional[Any] = None,
     ) -> Optional[Any]:
         """Anchor-time partition publish: the P+1 digest vector (pushed
         like a snapshot — tiny) plus psnap blobs for every partition whose
@@ -520,9 +520,12 @@ class GossipNode:
         produced shard by shard — each key shard contributes exactly the
         slice it owns, stitched back into the same wire blobs (the
         artifacts are byte-identical either way, which test_mesh.py
-        pins), billing per-shard counters for the chaos gate. Returns
-        the digest vector, or None when the medium has no partition
-        surface."""
+        pins), billing per-shard counters for the chaos gate. With a
+        `core.pager.PartitionPager`, digests and psnaps for demoted
+        partitions come from the pager's stored CCPT blobs — the
+        transfer format is the storage format, so a cold partition is
+        served without hydrating. Returns the digest vector, or None
+        when the medium has no partition surface."""
         from ..core import partition as pt
         from ..core import serial
 
@@ -534,8 +537,10 @@ class GossipNode:
             from ..mesh import gossip as mesh_gossip
 
             vec = mesh_gossip.sharded_digest_vector(
-                state, plan, metrics=self.metrics
+                state, plan, metrics=self.metrics, pager=pager
             )
+        elif pager is not None and pager.has_cold():
+            vec = pager.digest_vector(state)
         else:
             vec = pt.state_digests(state, P)
         cache = getattr(self, "_last_digests", None)
@@ -554,17 +559,21 @@ class GossipNode:
                 plan, changed
             ):
                 for part, blob in mesh_gossip.shard_psnap_blobs(
-                    name, state, seq, dense, plan, shard, parts=changed
+                    name, state, seq, dense, plan, shard, parts=changed,
+                    pager=pager,
                 ):
                     self.metrics.count("net.psnap_publishes")
                     self.metrics.count(f"mesh.shard{shard:02d}.psnap_publishes")
                     pub_ps(part, blob)
             changed = []
         for part in changed:
-            payload = serial.dumps_dense(
-                f"{name}_psnap", pt.restrict_psnap(dense, state, part, P)
-            )
-            blob = pt.encode_psnap_blob(seq, part, payload)
+            if pager is not None:
+                blob = pager.psnap_blob(state, seq, part)
+            else:
+                payload = serial.dumps_dense(
+                    f"{name}_psnap", pt.restrict_psnap(dense, state, part, P)
+                )
+                blob = pt.encode_psnap_blob(seq, part, payload)
             self.metrics.count("net.psnap_publishes")
             pub_ps(part, blob)
         dig_blob = pt.encode_digest_blob(seq, vec)

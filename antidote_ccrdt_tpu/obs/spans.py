@@ -88,8 +88,11 @@ E2E = "round.e2e"
 # their subsystem is armed, so requiring them fleet-wide would fail
 # every non-mesh / non-serve run. `round.serve_swap` is emitted by
 # serve/replica.py; `round.ici_reduce` (ICI_REDUCE) by mesh/reduce.py —
-# chaos_gate's mesh leg requires the latter lit *in mesh drills only*.
+# chaos_gate's mesh leg requires the latter lit *in mesh drills only*;
+# `round.pager_hydrate` (PAGER_HYDRATE) by core/pager.py page-ins —
+# chaos_gate's working-set leg requires it lit *in pager drills only*.
 ICI_REDUCE = "round.ici_reduce"
+PAGER_HYDRATE = "round.pager_hydrate"
 
 # Hot-path gate — call sites must check `if spans.ACTIVE:` first.
 ACTIVE = False

@@ -89,6 +89,7 @@ class DeltaPublisher:
         lag_full_every: int = 2,
         partitions: Optional[int] = None,
         mesh_plan: Optional[Any] = None,
+        pager: Optional[Any] = None,
     ):
         from ..core import serial
         from ..core.behaviour import MergeKind
@@ -126,6 +127,14 @@ class DeltaPublisher:
         # walk; the published wire blobs are byte-identical, so peers
         # never see the difference. None = unsharded production.
         self.mesh_plan = mesh_plan
+        # core/pager.PartitionPager: under out-of-core paging the device
+        # state is only the HOT slice of the logical state. Anchors then
+        # publish `pager.full_state` (the logical join) so whole-snapshot
+        # consumers see no hole, and the partition surface serves cold
+        # digests/psnaps straight from the pager's stored CCPT blobs.
+        # Deltas are untouched: they are cut device-side, where cold
+        # slices never change between publishes. None = all-resident.
+        self.pager = pager
         self.seq = -1
         self._prev: Any = None
         self._serial = serial
@@ -204,12 +213,18 @@ class DeltaPublisher:
             self._next_plan = None
             is_full = self._branch(self.seq)
         if is_full:
+            # Under paging the anchor must carry the LOGICAL state —
+            # a device-only snapshot would publish identity holes where
+            # the cold partitions live.
+            pub_state = state
+            if self.pager is not None and self.pager.has_cold():
+                pub_state = self.pager.full_state(state)
             if obs_spans.ACTIVE:
                 # Full-snapshot anchor: serialize + hand to the medium.
                 with obs_spans.span("round.snapshot", seq=self.seq):
-                    self.store.publish(self.name, state, self.seq)
+                    self.store.publish(self.name, pub_state, self.seq)
             else:
-                self.store.publish(self.name, state, self.seq)
+                self.store.publish(self.name, pub_state, self.seq)
             if self.partitions:
                 # Partition artifacts ride the anchor cadence: the full
                 # snapshot stays published (legacy peers and the
@@ -217,7 +232,7 @@ class DeltaPublisher:
                 # psnaps go alongside.
                 self.store.publish_partitioned(
                     self.name, state, self.seq, self.dense, self.partitions,
-                    plan=self.mesh_plan,
+                    plan=self.mesh_plan, pager=self.pager,
                 )
             kind, nbytes = "full", -1
         else:
@@ -283,7 +298,7 @@ class PartialAntiEntropy:
     def __init__(
         self, store: GossipNode, partitions: Optional[int] = None,
         max_tries: int = 3, watchdog: Optional[Any] = None,
-        mesh_plan: Optional[Any] = None,
+        mesh_plan: Optional[Any] = None, pager: Optional[Any] = None,
     ):
         from ..core import partition as pt
 
@@ -305,6 +320,17 @@ class PartialAntiEntropy:
         # wedge clock (note_repair_progress) — this resync loop IS the
         # repair whose absence the wedged-divergence alarm detects.
         self.watchdog = watchdog
+        # core/pager.PartitionPager: digest vectors come from
+        # `pager.digest_vector` (device entries for hot partitions,
+        # cached CCPT digests for cold) and fetched psnaps targeting
+        # cold partitions fold host-side instead of hydrating — partial
+        # anti-entropy never blocks on a page-in. None = all-resident.
+        self.pager = pager
+
+    def _own_vec(self, state: Any) -> Any:
+        if self.pager is not None and self.pager.has_cold():
+            return self.pager.digest_vector(state)
+        return self._pt.state_digests(state, self.partitions)
 
     def try_resync(
         self, member: str, dense: Any, state: Any, cur: int
@@ -323,7 +349,7 @@ class PartialAntiEntropy:
             # fleet disagreeing on P: partial resync can't certify
             # anything — use the full snapshot.
             return state, cur, False
-        own_vec = pt.state_digests(state, P)
+        own_vec = self._own_vec(state)
         div = pt.divergent_parts(own_vec, peer_vec)
         self.store.metrics.set("part.divergent", float(len(div)))
         if self.watchdog is not None:
@@ -369,7 +395,12 @@ class PartialAntiEntropy:
                 continue  # not served yet (push media) — next sweep
             ps_seq, payload = r
             try:
-                state = apply_any_delta(dense, state, payload)
+                if self.pager is not None:
+                    # Cold-targeting psnaps fold host-side (or queue);
+                    # hot ones join on device — never a forced page-in.
+                    state = self.pager.apply_delta(state, payload)
+                else:
+                    state = apply_any_delta(dense, state, payload)
             except Exception:  # noqa: BLE001 — total, same as sweep
                 continue
             fetched += 1
@@ -384,7 +415,7 @@ class PartialAntiEntropy:
             self.store.metrics.count(
                 "mesh.cross_slice_bytes", float(bytes_after - bytes_before)
             )
-        post_vec = pt.state_digests(state, P)
+        post_vec = self._own_vec(state)
         outstanding = [
             p for p in fetch_parts
             if post_vec[p] != peer_vec[p] and p not in repaired_by_seq
@@ -416,6 +447,7 @@ class PartialAntiEntropy:
 def sweep_deltas(
     store: GossipNode, dense: Any, state: Any, cursors: Dict[str, int],
     partial: Optional[PartialAntiEntropy] = None,
+    pager: Optional[Any] = None,
 ) -> Tuple[Any, Dict[str, Any]]:
     """Delta-aware sweep: per peer, chain contiguous deltas from the
     cursor; on a gap (pruned, torn, or never-seen member) resync from the
@@ -426,12 +458,21 @@ def sweep_deltas(
     With `partial` (a `PartialAntiEntropy`), the gap branch first tries
     partition-granular repair — digest-vector compare, then psnaps for
     only the divergent partitions — and falls back to the whole snapshot
-    when the peer has no partition surface or the partial repair stalls."""
+    when the peer has no partition surface or the partial repair stalls.
+
+    With `pager` (a `core.pager.PartitionPager`), deltas and snapshots
+    targeting cold partitions fold host-side through the pager instead
+    of joining on device — the sweep never forces a hydration."""
     from .delta import apply_any_delta, delta_in_bounds, like_delta_for
 
     dense, state = _resolve_monoid(dense, state, "sweep_deltas")
     like_delta = like_delta_for(dense, state)
     stats = {"deltas": 0, "fulls": 0, "skipped": 0}
+
+    def _apply(st: Any, delta: Any) -> Any:
+        if pager is not None:
+            return pager.apply_delta(st, delta)
+        return apply_any_delta(dense, st, delta)
 
     def chain(member: str, cur: int) -> int:
         nonlocal state, stats
@@ -457,9 +498,9 @@ def sweep_deltas(
                 try:
                     if profile.ACTIVE:
                         with profile.dispatch("elastic.delta_apply", operands=(delta,)):
-                            state = apply_any_delta(dense, state, delta)
+                            state = _apply(state, delta)
                     else:
-                        state = apply_any_delta(dense, state, delta)
+                        state = _apply(state, delta)
                 finally:
                     obs_spans.end(tok)
             except Exception:  # noqa: BLE001 — deliberately total
@@ -502,6 +543,10 @@ def sweep_deltas(
                         else None
                     )
                     try:
+                        if pager is not None and pager.has_cold():
+                            # Cold slices of the peer fold host-side;
+                            # the device merge sees only the hot rest.
+                            peer = pager.absorb_peer(peer)
                         if profile.ACTIVE:
                             with profile.dispatch(
                                 "elastic.snap_merge", fn=dense.merge, operands=(peer,)
@@ -560,7 +605,9 @@ def _resolve_monoid(dense: Any, state: Any, where: str) -> Tuple[Any, Any]:
     return dense, state
 
 
-def sweep(store: GossipNode, dense: Any, state: Any) -> Tuple[Any, int]:
+def sweep(
+    store: GossipNode, dense: Any, state: Any, pager: Optional[Any] = None
+) -> Tuple[Any, int]:
     """Fold every peer's latest snapshot into `state` with the engine
     join. Returns (state, n_merged). Self's snapshot is skipped (already
     reflected); stale or concurrent publishes are safe by idempotence
@@ -575,6 +622,8 @@ def sweep(store: GossipNode, dense: Any, state: Any) -> Tuple[Any, int]:
         if got is None:
             continue
         _step, peer = got
+        if pager is not None and pager.has_cold():
+            peer = pager.absorb_peer(peer)
         tok = (
             obs_spans.begin("round.delta_apply", origin=m, step=_step, via="sweep")
             if obs_spans.ACTIVE

@@ -605,8 +605,15 @@ class OverlapPipeline:
                  host_depth: Optional[int] = None,
                  start_thread: bool = True,
                  partitions: Optional[int] = None,
-                 post_fold: Optional[Any] = None):
+                 post_fold: Optional[Any] = None,
+                 pager: Optional[Any] = None):
         self.metrics = metrics if metrics is not None else store.metrics
+        # Out-of-core residency (core/pager.py). While any partition is
+        # cold, inbound payloads must route through the pager (hot half
+        # on device, cold half folded host-side) — merging a full
+        # expanded window straight into the device state would land rows
+        # the pager's cached cold digests can't see.
+        self.pager = pager
         # Mesh hook (mesh/reduce.py): called as post_fold(state) on the
         # ROUND thread after a drain actually folded windows in —
         # exactly where the intra-slice ICI reduce belongs (fresh peer
@@ -641,9 +648,17 @@ class OverlapPipeline:
         crash the round)."""
         from .delta import apply_any_delta
 
+        pager = self.pager
         for e in entries:
             try:
-                if e.kind == "snap":
+                if pager is not None and pager.has_cold():
+                    if e.kind == "snap":
+                        state = self.dense.merge(
+                            state, pager.absorb_peer(e.payload)
+                        )
+                    else:
+                        state = pager.apply_delta(state, e.payload)
+                elif e.kind == "snap":
                     state = self.dense.merge(state, e.payload)
                 else:
                     state = apply_any_delta(self.dense, state, e.payload)
@@ -673,8 +688,14 @@ class OverlapPipeline:
             return state
         from ..core.batch_merge import fold_states, merge_into
 
-        mergeable = [e for e in entries if e.merged is not None]
-        rest = [e for e in entries if e.merged is None]
+        if self.pager is not None and self.pager.has_cold():
+            # Mixed residency: the batched fold would write cold
+            # partitions' rows onto the device behind the pager's back.
+            # Everything goes through the pager-aware sequential path.
+            mergeable, rest = [], entries
+        else:
+            mergeable = [e for e in entries if e.merged is not None]
+            rest = [e for e in entries if e.merged is None]
         tok = (
             obs_spans.begin(
                 "round.delta_apply", via="overlap", n=len(entries)

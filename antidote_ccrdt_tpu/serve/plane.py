@@ -157,11 +157,18 @@ class ServePlane:
         cache_cap: int = 1024,
         queue_max: int = 4096,
         meta_keep: int = 8,
+        pager: Any = None,
     ):
         self.dense = dense
         self.member = member
         self.metrics = metrics if metrics is not None else Metrics()
         self.lag_tracker = lag_tracker
+        # Out-of-core residency (core/pager.py): swaps resolve the
+        # LOGICAL state (device ⊔ cold substrate) so reads never see a
+        # demoted partition's hole, and answered row ids feed the
+        # pager's recency clock — the serve plane IS the access stream
+        # the eviction policy ranks partitions by.
+        self.pager = pager
         self.mono = mono  # injectable: frozen in parity tests, virtual in sim
         self.replica = ReadReplica(metrics=self.metrics, mono=mono)
         self.cache = HotKeyCache(cap=cache_cap, metrics=self.metrics)
@@ -190,7 +197,10 @@ class ServePlane:
     def swap(self, state: Any, seq: int) -> None:
         """Publish-boundary hook: snapshot `state` as the live read
         replica at `seq`, stamped with the current lag bound."""
-        snap = self.replica.swap(state, seq, self.lag_bound_s())
+        resolve = None
+        if self.pager is not None and self.pager.has_cold():
+            resolve = self.pager.full_state
+        snap = self.replica.swap(state, seq, self.lag_bound_s(), resolve=resolve)
         with self._meta_lock:
             self._meta[snap.seq] = (snap.swap_mono, snap.lag_bound_s)
             while len(self._meta) > self.meta_keep:
@@ -322,24 +332,52 @@ class ServePlane:
         except ValueError as e:
             self.metrics.count("serve.errors")
             return {"error": str(e)}
+        self._note_access(q, val)
         self.cache.put(kq, val, live.seq)
         bounds.append(b6)
         return {"value": val, "as_of_seq": live.seq, "staleness_bound_s": b6}
+
+    def _note_access(self, q: Dict[str, Any], val: Any) -> None:
+        """Feed the pager's recency clock with the row ids this answer
+        touched (topk/range answers are [id, score] pairs; a value query
+        names its id directly)."""
+        if self.pager is None:
+            return
+        try:
+            ids: List[int] = []
+            if q.get("op") == "value" and isinstance(q.get("key"), int):
+                ids.append(int(q["key"]))
+            if isinstance(val, list):
+                ids.extend(
+                    int(pair[0]) for pair in val
+                    if isinstance(pair, (list, tuple)) and len(pair) >= 1
+                )
+            if ids:
+                self.pager.note_ids(ids)
+        except Exception:  # noqa: BLE001 — policy feed only, stay total
+            pass
 
     # -- health --------------------------------------------------------------
 
     def health_fields(self) -> Dict[str, Any]:
         """Readiness view for /healthz: what seq the replica serves and
-        how stale it could be — what an LB needs to drain stale replicas."""
+        how stale it could be — what an LB needs to drain stale replicas,
+        plus the pager's residency picture when paging is on."""
         live = self.replica.live()
         if live is None:
-            return {"serve_seq": -1, "serve_staleness_bound_s": None,
-                    "serve_cache_entries": len(self.cache)}
-        b = self._bound(live.seq)
-        if b is None:
-            b = (self.mono() - live.swap_mono) + live.lag_bound_s
-        return {
-            "serve_seq": live.seq,
-            "serve_staleness_bound_s": _ceil6(b),
-            "serve_cache_entries": len(self.cache),
-        }
+            out: Dict[str, Any] = {
+                "serve_seq": -1, "serve_staleness_bound_s": None,
+                "serve_cache_entries": len(self.cache),
+            }
+        else:
+            b = self._bound(live.seq)
+            if b is None:
+                b = (self.mono() - live.swap_mono) + live.lag_bound_s
+            out = {
+                "serve_seq": live.seq,
+                "serve_staleness_bound_s": _ceil6(b),
+                "serve_cache_entries": len(self.cache),
+            }
+        if self.pager is not None:
+            out.update(self.pager.health_fields())
+        return out

@@ -72,17 +72,27 @@ class ReadReplica:
         self._bufs: list = [None, None]
         self._live = 0
 
-    def swap(self, state: Any, seq: int, lag_bound_s: float = 0.0) -> Snapshot:
+    def swap(
+        self,
+        state: Any,
+        seq: int,
+        lag_bound_s: float = 0.0,
+        resolve: Optional[Callable[[Any], Any]] = None,
+    ) -> Snapshot:
         """Copy `state` to a fresh device buffer and make it the live
         snapshot. Called from the worker's round thread at publish
         boundaries; queries racing the swap keep reading the old slot
-        until the single reference flip below."""
+        until the single reference flip below. `resolve` maps the carried
+        state to the logical state first — the pager hook (`full_state`)
+        joins demoted partitions back in so reads never see a hole."""
         tok = (
             obs_spans.begin("round.serve_swap", seq=int(seq))
             if obs_spans.ACTIVE
             else None
         )
         try:
+            if resolve is not None:
+                state = resolve(state)
             with self._swap_lock:
                 snap = Snapshot(
                     batch_merge.snapshot_state(state),

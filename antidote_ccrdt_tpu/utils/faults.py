@@ -15,6 +15,7 @@ until a plan is installed. The canonical points:
     bridge.read              BridgeClient reply read
     wal.fsync                WriteAheadLog record fsync
     ckpt.replace             checkpoint/WAL atomic-replace commit
+    pager.hydrate            out-of-core partition page-in (core/pager.py)
 
 (Any other dotted name works — the registry is generic; these are the
 wired ones.)

@@ -1010,6 +1010,119 @@ def bench_partition_antientropy(P=8, resync_rounds=4):
     }
 
 
+def bench_working_set(P=64, ids=4096, batches=30, B=256, zipf_a=2.2):
+    """Out-of-core pager microbench (core/pager.py).
+
+    One worker whose state is ~10x its device budget by construction
+    (``hbm_budget = state_bytes // 10``), serving zipfian op traffic:
+    every batch declares its touched ids up front
+    (`ensure_resident` over the PER-ACCESS partition list, so hit/miss
+    accounting is per access, not per unique partition), applies the
+    ops device-side, then folds one uniform peer delta through
+    `apply_delta` so the cold tier absorbs merges host-side. Reports
+    the three gated headline numbers — ``pager_hit_rate`` (fraction of
+    accesses that found their partition resident, post-warmup),
+    ``resident_miss_ms_p50`` (page-in latency, raw ms samples from the
+    `pager.miss_ms` histogram — NOT LatencyRecorder.summary(), which
+    assumes seconds), ``cold_merges_per_sec`` (host-side partition
+    folds) — plus the residency ratio for the record. Protocol-bound:
+    geometry stays fixed and small on every backend so rounds compare."""
+    from antidote_ccrdt_tpu.core import pager as pg
+    from antidote_ccrdt_tpu.core import partition as pt
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import (
+        TopkRmvOps, make_dense,
+    )
+    from antidote_ccrdt_tpu.parallel.delta import make_delta
+
+    import jax.numpy as jnp
+
+    R, NK, I, DCS, K, M = 2, 1, int(ids), 4, 8, 2
+    dense = make_dense(n_ids=I, n_dcs=DCS, size=K, slots_per_id=M)
+    rng = np.random.default_rng(77_000)
+
+    def apply_ids(state, a_id, step):
+        b = a_id.shape[1]
+        ops = TopkRmvOps(
+            add_key=jnp.zeros((R, b), jnp.int32),
+            add_id=jnp.asarray(a_id.astype(np.int32)),
+            add_score=jnp.asarray(rng.integers(1, 500, (R, b)).astype(np.int32)),
+            add_dc=jnp.zeros((R, b), jnp.int32),
+            add_ts=jnp.asarray(np.broadcast_to(
+                step * b + np.arange(b) + 1, (R, b)
+            ).astype(np.int32)),
+            rmv_key=jnp.zeros((R, 1), jnp.int32),
+            rmv_id=jnp.full((R, 1), -1, jnp.int32),
+            rmv_vc=jnp.zeros((R, 1, DCS), jnp.int32),
+        )
+        state, _ = dense.apply_ops(state, ops, collect_dominated=False)
+        return state
+
+    def zipf_ids(n):
+        return ((rng.zipf(zipf_a, size=(R, n)) - 1) % I).astype(np.int32)
+
+    # Seed the whole id space so every partition has real content to
+    # demote, then size the budget off the measured footprint.
+    state = dense.init(R, NK)
+    for s in range(2):
+        state = apply_ids(state, rng.integers(0, I, (R, 512)), s)
+    probe = pg.PartitionPager(dense, state, P=P, name="workset_probe")
+    total = probe.meta_bytes + sum(probe.part_bytes[p] for p in range(P))
+    budget = max(1, total // 10)
+    pager = pg.PartitionPager(
+        dense, state, P=P, name="workset", hbm_budget_bytes=budget
+    )
+    peer = dense.init(R, NK)
+    step = 2
+
+    def one_batch(state, peer, step):
+        a_id = zipf_ids(B)
+        # Per-access partition list (not unique): hit/miss accounting
+        # per access, and the clock sees zipf frequency, not presence.
+        state = pager.ensure_resident(state, pt.part_of(a_id.ravel(), P))
+        state = apply_ids(state, a_id, step)
+        # Uniform peer delta: mostly-cold partitions, folded host-side.
+        prev = peer
+        peer = apply_ids(peer, rng.integers(0, I, (R, 64)), step)
+        state = pager.apply_delta(state, make_delta(dense, prev, peer))
+        return state, peer
+
+    for _ in range(3):  # warmup: jit compiles + demote-to-budget
+        state, peer = one_batch(state, peer, step)
+        step += 1
+    pager.hits = pager.misses = 0
+    rec = pager.metrics.latencies.get("pager.miss_ms")
+    warm_samples = len(rec.samples) if rec is not None else 0
+    folds0 = pager.metrics.counters.get("pager.cold_folds", 0)
+    t0 = time.perf_counter()
+    for _ in range(batches):
+        state, peer = one_batch(state, peer, step)
+        step += 1
+    elapsed = time.perf_counter() - t0
+    folds = pager.metrics.counters.get("pager.cold_folds", 0) - folds0
+    rec = pager.metrics.latencies.get("pager.miss_ms")
+    miss_samples = list(rec.samples)[warm_samples:] if rec is not None else []
+    miss_p50 = float(np.percentile(miss_samples, 50)) if miss_samples else 0.0
+
+    # Sanity: the mixed-residency digest vector must match a full
+    # reassembly — a silent cold-digest desync would make every number
+    # above a lie about a diverging store.
+    full = pager.full_state(state)
+    if not np.array_equal(pager.digest_vector(state), pt.state_digests(full, P)):
+        raise RuntimeError("working-set bench diverged — cold digests desynced")
+
+    return {
+        "partitions": P,
+        "state_bytes": int(total),
+        "hbm_budget_bytes": int(budget),
+        "state_over_budget_x": round(total / budget, 1),
+        "pager_hit_rate": round(pager.hit_rate(), 4),
+        "resident_miss_ms_p50": round(miss_p50, 3),
+        "cold_merges_per_sec": round(folds / max(elapsed, 1e-9), 1),
+        "hydrations": int(pager.metrics.counters.get("pager.hydrations", 0)),
+        "evictions": int(pager.metrics.counters.get("pager.evictions", 0)),
+    }
+
+
 def bench_audit_overhead(P=8, rounds=12, repeats=3):
     """Audit-plane overhead microbench (obs/audit.py).
 
@@ -1401,6 +1514,11 @@ def main():
         iters=5 if os.environ.get("CCRDT_BENCH_TINY") else 30,
         resyncs=2 if os.environ.get("CCRDT_BENCH_TINY") else 4,
     )
+    working_set = (
+        bench_working_set(P=16, ids=1024, batches=4, B=64)
+        if os.environ.get("CCRDT_BENCH_TINY")
+        else bench_working_set()
+    )
 
     # The driver records only the TAIL of stdout (<=2,000 chars) as
     # BENCH_r{N}.json and parses the LAST line; round 4's single fat line
@@ -1442,6 +1560,10 @@ def main():
         # Report-only on the summary line; the gated carrier is the
         # MULTICHIP_r*.json round (scripts/bench_gate.py evaluate_mesh).
         "mesh_scaling": mesh_scaling,
+        # Out-of-core pager working-set drill (bench_working_set): state
+        # 10x the device budget by construction; the three gated headline
+        # numbers ride the summary line (bench_gate.evaluate_pager).
+        "working_set": working_set,
         "dispatch_overhead_ms_p50": round(dispatch_overhead_ms, 2),
         "batch_per_replica_round": f"{B} adds + {Br} rmvs",
         "backend": backend,
@@ -1492,6 +1614,9 @@ def main():
         "serve_reads_per_sec": serving["serve_reads_per_sec"],
         "serve_read_p99_ms": serving["serve_read_p99_ms"],
         "audit_overhead_pct": audit_ov["audit_overhead_pct"],
+        "pager_hit_rate": working_set["pager_hit_rate"],
+        "resident_miss_ms_p50": working_set["resident_miss_ms_p50"],
+        "cold_merges_per_sec": working_set["cold_merges_per_sec"],
         "mesh_merges_per_sec": mesh_scaling.get("mesh_merges_per_sec"),
         "ici_reduce_ms_p50": mesh_scaling.get("ici_reduce_ms_p50"),
         "cross_slice_bytes": mesh_scaling.get("cross_slice_bytes"),

@@ -116,6 +116,22 @@ def load_rounds(
     return [(round_number(p), p, *round_metrics(p)) for p in paths]
 
 
+def best_prior_carrier(
+    rounds: List[tuple], idx: int, mode: str = "min"
+) -> Tuple[int, float]:
+    """(round_no, value) of the best PRIOR carrier for tuple column
+    `idx`: the min (cost metrics — lower is better) or the max
+    (throughput metrics) over every round but the last. Every
+    double-threshold gate below compares rounds[-1][idx] against exactly
+    this baseline; one helper instead of a per-gate copy of the
+    min/max-over-prefix loop. Requires len(rounds) >= 2 (the callers'
+    vacuous-pass checks guarantee it)."""
+    prior = rounds[:-1]
+    pick = min if mode == "min" else max
+    best = pick(prior, key=lambda r: r[idx])
+    return int(best[0]), float(best[idx])
+
+
 def evaluate(
     rounds: List[Tuple[int, str, Optional[float], Optional[str]]],
     tolerance: float,
@@ -147,8 +163,7 @@ def evaluate(
             )
             continue
         latest_n, _latest_p, latest_v, _ = grp[-1]
-        prior = grp[:-1]
-        best_n, _best_p, best_v, _ = max(prior, key=lambda r: r[2])
+        best_n, best_v = best_prior_carrier(grp, 2, "max")
         floor = best_v * (1.0 - tolerance)
         verdict = (
             f"bench-gate{tag}: r{latest_n:02d} best merges_per_sec = "
@@ -246,7 +261,7 @@ def evaluate_gap(
             "dispatch_gap_ms_p50 — nothing to compare, passing vacuously"
         )
     latest_n, _p, latest_gap, _cov = rounds[-1]
-    best_n, _bp, best_gap, _bcov = min(rounds[:-1], key=lambda r: r[2])
+    best_n, best_gap = best_prior_carrier(rounds, 2, "min")
     ceiling = max(best_gap * (1.0 + tolerance), best_gap + abs_floor_ms)
     verdict = (
         f"gap-gate: r{latest_n:02d} dispatch_gap_ms_p50 = {latest_gap:.2f} "
@@ -311,9 +326,8 @@ def evaluate_partition(
             "anti-entropy metrics — nothing to compare, passing vacuously"
         )
     latest_n, _p, latest_ae, latest_rj = rounds[-1]
-    prior = rounds[:-1]
-    best_ae_n, _ap, best_ae, _ = min(prior, key=lambda r: r[2])
-    best_rj_n, _rp, _x, best_rj = min(prior, key=lambda r: r[3])
+    best_ae_n, best_ae = best_prior_carrier(rounds, 2, "min")
+    best_rj_n, best_rj = best_prior_carrier(rounds, 3, "min")
     code = 0
     lines: List[str] = []
     ae_ceiling = max(best_ae * (1.0 + tolerance), best_ae + ae_floor_bytes)
@@ -395,9 +409,8 @@ def evaluate_serve(
             "metrics — nothing to compare, passing vacuously"
         )
     latest_n, _p, latest_rps, latest_p99 = rounds[-1]
-    prior = rounds[:-1]
-    best_rps_n, _rp, best_rps, _ = max(prior, key=lambda r: r[2])
-    best_p99_n, _pp, _x, best_p99 = min(prior, key=lambda r: r[3])
+    best_rps_n, best_rps = best_prior_carrier(rounds, 2, "max")
+    best_p99_n, best_p99 = best_prior_carrier(rounds, 3, "min")
     code = 0
     lines: List[str] = []
     rps_floor = min(best_rps * (1.0 - tolerance), best_rps - rps_floor_abs)
@@ -476,7 +489,7 @@ def evaluate_audit(
             "audit_overhead_pct — nothing to compare, passing vacuously"
         )
     latest_n, _p, latest_ov = rounds[-1]
-    best_n, _bp, best_ov = min(rounds[:-1], key=lambda r: r[2])
+    best_n, best_ov = best_prior_carrier(rounds, 2, "min")
     ceiling = max(best_ov * (1.0 + tolerance), best_ov + abs_floor_pp)
     verdict = (
         f"audit-gate: r{latest_n:02d} audit_overhead_pct = {latest_ov:.2f} "
@@ -582,9 +595,7 @@ def evaluate_wal(
         )
     else:
         latest_n, _p, latest_p99, _w, _g, _r, _be = grp_rounds[-1]
-        best_n, _bp, best_p99, _bw, _bg, _br, _bbe = min(
-            grp_rounds[:-1], key=lambda r: r[2]
-        )
+        best_n, best_p99 = best_prior_carrier(grp_rounds, 2, "min")
         ceiling = max(best_p99 * (1.0 + tolerance), best_p99 + p99_floor_ms)
         verdict = (
             f"wal-gate{tag}: r{latest_n:02d} p99_round_ms_e2e = "
@@ -627,6 +638,113 @@ def evaluate_wal(
         else:
             lines.append(f"{verdict}\nOK: wal_append off the top of the "
                          "critical path")
+    return code, "\n".join(lines)
+
+
+_PAGER_HIT_RE = re.compile(r'"pager_hit_rate":\s*([0-9][0-9_.eE+-]*)')
+_PAGER_MISS_RE = re.compile(
+    r'"resident_miss_ms_p50":\s*([0-9][0-9_.eE+-]*)'
+)
+_PAGER_CM_RE = re.compile(r'"cold_merges_per_sec":\s*([0-9][0-9_.eE+-]*)')
+
+
+def load_pager_rounds(
+    bench_dir: str,
+) -> List[Tuple[int, str, float, float, Optional[float]]]:
+    """[(round_no, path, pager_hit_rate, resident_miss_ms_p50,
+    cold_merges_per_sec)] for every BENCH round whose summary line
+    carries the out-of-core working-set metrics (bench.bench_working_set,
+    r13+). Fixed zipfian geometry on every backend, so rounds compare
+    without backend grouping; cold_merges_per_sec rides report-only."""
+    out: List[Tuple[int, str, float, float, Optional[float]]] = []
+    for p in sorted(
+        glob.glob(os.path.join(bench_dir, "BENCH_r*.json")), key=round_number
+    ):
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        tail = str(doc.get("tail", ""))
+        hit = _PAGER_HIT_RE.findall(tail)
+        miss = _PAGER_MISS_RE.findall(tail)
+        cm = _PAGER_CM_RE.findall(tail)
+        if hit and miss:
+            out.append((
+                round_number(p), p, float(hit[-1]), float(miss[-1]),
+                float(cm[-1]) if cm else None,
+            ))
+    return out
+
+
+def evaluate_pager(
+    rounds: List[Tuple[int, str, float, float, Optional[float]]],
+    tolerance: float = 0.20,
+    hit_floor_pp: float = 0.05,
+    miss_floor_ms: float = 2.0,
+) -> Tuple[int, str]:
+    """(exit_code, verdict) for the out-of-core pager gate, two claims
+    with the shared double-threshold shape (relative AND absolute must
+    both trip):
+
+    * ``pager_hit_rate`` — the zipfian working-set hit rate must not
+      FALL more than `tolerance` relative and `hit_floor_pp` (5pp)
+      absolute under the best prior carrier: the clock policy drifting
+      away from the hot set is the regression out-of-core serving
+      cannot survive;
+    * ``resident_miss_ms_p50`` — the median page-in stall must not GROW
+      more than `tolerance` relative and `miss_floor_ms` absolute over
+      the best (lowest) prior carrier: hydration sliding from one
+      decode+join toward whole-state rebuilds fails here.
+
+    ``cold_merges_per_sec`` rides the same summary line report-only.
+    Fewer than two carriers pass vacuously."""
+    if len(rounds) < 2:
+        return 0, (
+            f"pager-gate: only {len(rounds)} round(s) carry the "
+            "working-set metrics — nothing to compare, passing vacuously"
+        )
+    latest_n, _p, latest_hit, latest_miss, _cm = rounds[-1]
+    best_hit_n, best_hit = best_prior_carrier(rounds, 2, "max")
+    best_miss_n, best_miss = best_prior_carrier(rounds, 3, "min")
+    code = 0
+    lines: List[str] = []
+
+    hit_floor = min(best_hit * (1.0 - tolerance), best_hit - hit_floor_pp)
+    verdict = (
+        f"pager-gate: r{latest_n:02d} pager_hit_rate = {latest_hit:.3f} "
+        f"vs best prior r{best_hit_n:02d} = {best_hit:.3f} "
+        f"(floor -{tolerance:.0%} and -{hit_floor_pp * 100:.0f}pp: "
+        f"{hit_floor:.3f})"
+    )
+    if latest_hit < hit_floor:
+        code = 1
+        lines.append(
+            f"{verdict}\nFAIL: the residency policy lost "
+            f"{(best_hit - latest_hit) * 100:.1f}pp of working-set hits "
+            "— eviction is drifting away from the hot set"
+        )
+    else:
+        lines.append(f"{verdict}\nOK: within tolerance")
+
+    miss_ceiling = max(
+        best_miss * (1.0 + tolerance), best_miss + miss_floor_ms
+    )
+    verdict = (
+        f"pager-gate: r{latest_n:02d} resident_miss_ms_p50 = "
+        f"{latest_miss:.3f} vs best prior r{best_miss_n:02d} = "
+        f"{best_miss:.3f} (ceiling +{tolerance:.0%} and "
+        f"+{miss_floor_ms}ms: {miss_ceiling:.3f})"
+    )
+    if latest_miss > miss_ceiling:
+        code = 1
+        lines.append(
+            f"{verdict}\nFAIL: the median page-in stall slowed "
+            f"{latest_miss - best_miss:+.3f}ms over the best prior "
+            "carrier"
+        )
+    else:
+        lines.append(f"{verdict}\nOK: within tolerance")
     return code, "\n".join(lines)
 
 
@@ -694,10 +812,9 @@ def evaluate_mesh(
             "metrics — nothing to compare, passing vacuously"
         )
     latest_n, _p, latest_mps, latest_ici, latest_bytes = rounds[-1]
-    prior = rounds[:-1]
-    best_mps_n, _mp, best_mps, _i, _b = max(prior, key=lambda r: r[2])
-    best_ici_n, _ip, _m, best_ici, _b2 = min(prior, key=lambda r: r[3])
-    best_byt_n, _bp, _m2, _i2, best_bytes = min(prior, key=lambda r: r[4])
+    best_mps_n, best_mps = best_prior_carrier(rounds, 2, "max")
+    best_ici_n, best_ici = best_prior_carrier(rounds, 3, "min")
+    best_byt_n, best_bytes = best_prior_carrier(rounds, 4, "min")
     code = 0
     lines: List[str] = []
 
@@ -837,6 +954,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"{mps:,.0f} merges/s, ici p50 {ici:.3f}ms, "
             f"cross-slice {byt:,.0f} B"
         )
+    pgr = load_pager_rounds(args.bench_dir)
+    for n, p, hit, miss, cm in pgr:
+        cm_note = f", {cm:,.0f} cold merges/s" if cm is not None else ""
+        print(
+            f"  pager r{n:02d} {os.path.basename(p)}: "
+            f"hit {hit:.3f}, miss p50 {miss:.3f}ms{cm_note}"
+        )
     wal = load_wal_rounds(args.bench_dir)
     for n, p, p99, wal_ms, grp, rank, be in wal:
         wal_note = (
@@ -863,8 +987,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     print(wal_verdict)
     mesh_code, mesh_verdict = evaluate_mesh(mesh, args.tolerance)
     print(mesh_verdict)
+    pager_code, pager_verdict = evaluate_pager(pgr, args.tolerance)
+    print(pager_verdict)
     return max(code, gap_code, part_code, serve_code, audit_code, wal_code,
-               mesh_code)
+               mesh_code, pager_code)
 
 
 if __name__ == "__main__":

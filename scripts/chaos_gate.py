@@ -62,6 +62,16 @@ converge to the sequential reference with `mesh.ici_reduces` and
 `mesh.cross_slice_fetches` nonzero, `net.psnap_wasted` still exactly
 zero, and the conditional `round.ici_reduce` span lit.
 
+The working-set leg (PR 13) re-runs scripts/working_set_demo.py's
+drill under a fresh seed — a 3-worker fleet whose per-worker HBM
+budget is forced to a tenth of the instance, zipf-skewed ops through
+the pager front door, full partition-plane gossip — and requires
+bit-identical convergence against the all-resident sequential
+reference, a steady-state hit rate >= 0.9, every pager heartbeat
+counter (`pager.evictions` / `pager.hydrations` / `pager.cold_folds` /
+`pager.blob_serves`) nonzero, `net.psnap_wasted` still exactly zero,
+and the conditional `round.pager_hydrate` span lit.
+
 Run:  python scripts/chaos_gate.py
 Make: part of `make chaos` (after the pytest leg).
 """
@@ -150,6 +160,17 @@ MESH_REQUIRED_NONZERO = (
     "mesh.cross_slice_bytes",   # ...with the byte bill counted
     "mesh.shard_digest_slices", # anchors produced per-shard digest slices
     "net.psnap_publishes",      # ...and published the per-partition psnaps
+)
+
+# Working-set leg (scripts/working_set_demo.py's drill, fresh seed):
+# the out-of-core pager must actually page under the forced 10x
+# overcommit — a refactor that silently falls back to all-resident
+# keeps convergence green (that IS the legacy path) but zeroes these.
+PAGER_REQUIRED_NONZERO = (
+    "pager.evictions",   # the clock actually demoted cold partitions
+    "pager.hydrations",  # ...and misses pulled them back device-side
+    "pager.cold_folds",  # inbound cold deltas folded host-side
+    "pager.blob_serves", # cold psnaps answered straight from storage
 )
 
 # Same contract for the zone-topology leg (tests/test_topo_chaos.py:
@@ -488,6 +509,56 @@ def main() -> int:
           f"converged via {int(mc.get('mesh.ici_reduces', 0))} ICI reduces "
           f"and {int(mc.get('mesh.cross_slice_fetches', 0))} cross-slice "
           "shard fetches, 0 wasted psnaps, round.ici_reduce lit")
+
+    # -- leg 9: out-of-core paging (10x-overcommitted working set) ---------
+    from working_set_demo import run_drill
+
+    ws = run_drill(seed=11, spans=True)
+    wc = ws.get("counters", {})
+    print("== working-set drill (seed=11, 3 workers, HBM budget = "
+          "state/10, zipf ops) ==")
+    print("  " + " ".join(
+        f"{n}={int(wc.get(n, 0))}"
+        for n in PAGER_REQUIRED_NONZERO + ("net.psnap_wasted",)
+    ) + f" min_hit_rate={ws.get('min_hit_rate', 0.0)}")
+    if not ws.get("converged"):
+        print("FAIL: working-set fleet never agreed on a digest vector "
+              f"({ws.get('error', 'tail exhausted')})")
+        return 1
+    if not ws.get("matches_reference"):
+        print("FAIL: paged fleet converged but is NOT bit-identical to "
+              "the all-resident sequential reference — paging leaked "
+              "into semantics")
+        return 1
+    if ws.get("state_over_budget_x", 0.0) < 10.0:
+        print("FAIL: drill lost its memory pressure — state is only "
+              f"{ws.get('state_over_budget_x')}x the HBM budget (< 10x)")
+        return 1
+    if ws.get("min_hit_rate", 0.0) < 0.9:
+        print("FAIL: steady-state pager hit rate degraded to "
+              f"{ws.get('min_hit_rate')} (< 0.9) — the clock stopped "
+              "keeping the zipf working set resident")
+        return 1
+    w_zeroed = sorted(n for n in PAGER_REQUIRED_NONZERO if not wc.get(n, 0))
+    if w_zeroed:
+        print("FAIL: pager counters regressed to zero (the drill "
+              f"silently ran all-resident): {w_zeroed}")
+        return 1
+    w_wasted = int(wc.get("net.psnap_wasted", 0))
+    if w_wasted:
+        print(f"FAIL: {w_wasted} psnap fetch(es) covered a partition whose "
+              "digests already agreed — cold digest caching broke the "
+              "divergence math")
+        return 1
+    if "round.pager_hydrate" not in ws.get("span_names", []):
+        print("FAIL: the conditional round.pager_hydrate span never lit "
+              f"in a paging drill (spans seen: {ws.get('span_names')})")
+        return 1
+    print(f"OK: working-set leg — {ws['state_over_budget_x']}x "
+          f"over-budget fleet converged bit-identically at hit rate "
+          f"{ws['min_hit_rate']} via {int(wc.get('pager.hydrations', 0))} "
+          f"hydrations / {int(wc.get('pager.evictions', 0))} evictions, "
+          "0 wasted psnaps, round.pager_hydrate lit")
     return 0
 
 

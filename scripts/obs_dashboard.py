@@ -191,6 +191,30 @@ def _fmt_serve(status: Optional[Dict[str, Any]], member: str) -> str:
     )
 
 
+def _fmt_pager(status: Optional[Dict[str, Any]]) -> str:
+    """Pager column group (out-of-core residency, core/pager.py):
+    resident/total partitions, resident item bytes, and the page-in hit
+    rate — from the worker's pager block (pager.status_fields()). "-"
+    means paging is off (all-resident legacy)."""
+    pg = (status or {}).get("pager") or {}
+    if not pg:
+        return "-"
+    res = int(pg.get("resident_parts", 0))
+    tot = int(pg.get("total_parts", 0))
+    nbytes = float(pg.get("resident_bytes", 0))
+    hit = pg.get("hit_rate")
+    unit = "b"
+    for u in ("k", "m", "g"):
+        if nbytes < 1024:
+            break
+        nbytes /= 1024.0
+        unit = u
+    out = f"r:{res}/{tot} {nbytes:.0f}{unit}"
+    if hit is not None:
+        out += f" hit {float(hit):.0%}"
+    return out
+
+
 def _fmt_audit(status: Optional[Dict[str, Any]]) -> str:
     """Audit column group: divergence-watchdog verdict, how long the
     worst divergence has been open, and the time-to-agreement p50 — from
@@ -221,7 +245,8 @@ def render_frame(root: str, clear: bool = True) -> str:
     hdr = (
         f"{'member':<10}{'zone':<6}{'hb-age':>8} {'state':<9}{'snap':>5} "
         f"{'delta-window':<14}{'wal m:last/dur':>14}  {'sendq':<16}"
-        f"{'lag (peer:ops/secs)':<26}  {'serving':<34}  {'audit'}"
+        f"{'lag (peer:ops/secs)':<26}  {'serving':<34}  "
+        f"{'pager':<18}  {'audit'}"
     )
     lines.append(hdr)
     lines.append("-" * len(hdr))
@@ -256,7 +281,8 @@ def render_frame(root: str, clear: bool = True) -> str:
             f"{'-' if r['snap'] is None else r['snap']:>5} "
             f"{window:<14}{_fmt_wal(st):>14}  "
             f"{_fmt_sendq(st):<16}{_fmt_lag(st):<26}  "
-            f"{_fmt_serve(st, m):<34}  {_fmt_audit(st)}"
+            f"{_fmt_serve(st, m):<34}  {_fmt_pager(st):<18}  "
+            f"{_fmt_audit(st)}"
         )
     return "\n".join(lines)
 

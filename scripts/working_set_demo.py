@@ -1,0 +1,423 @@
+"""Out-of-core working-set acceptance drill (core/pager.py tentpole gate).
+
+Three workers gossip the topk_rmv grid over a shared-filesystem
+transport while every worker's device residency is capped at ONE TENTH
+of the instance: each worker owns a `PartitionPager` whose HBM budget
+is forced to `state_bytes // 10`, so most partitions live as CCPT
+blobs in the host tier and only the zipfian working set stays
+device-resident. Every op batch goes through the pager front door
+(`ensure_resident` on the per-access partition list) BEFORE the ops
+touch the device state — the invariant that keeps cold digests honest.
+
+Gossip runs the full partition plane — `DeltaPublisher` anchors carry
+the logical (device ⊔ cold) state and serve cold psnaps straight from
+stored blobs, `PartialAntiEntropy` compares pager digest vectors, and
+`sweep_deltas` folds inbound cold deltas host-side — so no path ever
+blocks on a page-in it didn't need.
+
+Gates (all must hold):
+
+* convergence: after the steps + a bounded tail, all three workers'
+  P+1 digest vectors agree AND are BIT-IDENTICAL to an all-resident
+  sequential single-process reference (paging is a residency
+  optimization, never a semantic one);
+* pressure:   state_bytes >= 10x the HBM budget, and the pager
+  actually paged (evictions, hydrations, cold folds all nonzero);
+* speed:      steady-state hit rate >= 0.9 on every worker (zipfian
+  skew keeps the hot set resident);
+* kill switch: a second fleet run under CCRDT_PAGER=0 (all-resident
+  legacy path, pagers never constructed) produces the bit-identical
+  digest vector and observable;
+* hygiene:    net.psnap_wasted == 0 (same invariant chaos_gate
+  enforces everywhere else), and the conditional
+  `round.pager_hydrate` span is lit in the paged arm.
+
+Writes the measurements to WORKSET_r01.json (committed as the carrier
+for regression comparison) and exits nonzero if any gate fails.
+
+Run:  make working-set-demo
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts.cover import install_child_cover  # noqa: E402
+
+install_child_cover()  # no-op outside `make cover` runs
+
+# Drill geometry. I is large enough that one partition (~I/P ids) is a
+# meaningful page, and the zipf exponent keeps ~90% of accesses inside
+# a handful of partitions so a 10x-overcommitted budget can still hit.
+R, NK, I, DCS, K, M, B, Br = 3, 1, 2048, 4, 8, 2, 96, 8
+STEPS = 10
+WARM_STEPS = 2  # hit/miss counters reset after these (steady-state rate)
+ZIPF_A = 2.2
+
+MIN_HIT = 0.9     # acceptance gate from ISSUE
+MIN_RATIO = 10.0  # state must be >= 10x the device budget
+
+
+def _build():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import make_dense
+
+    return make_dense(n_ids=I, n_dcs=DCS, size=K, slots_per_id=M)
+
+
+def _zipf_ids(rng, n):
+    import numpy as np
+
+    return ((rng.zipf(ZIPF_A, size=n) - 1) % I).astype(np.int32)
+
+
+def gen_ops(step: int, owned, seed: int):
+    """Deterministic [R, ...] batch, zipf-skewed ids. Row r's stream
+    depends only on (seed, step, r), so the fleet (each worker applying
+    its own row) and the sequential reference (all rows at once) see
+    byte-identical op streams."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import TopkRmvOps
+
+    owned = set(owned)
+    a_key = np.zeros((R, B), np.int32)
+    a_id = np.zeros((R, B), np.int32)
+    a_score = np.zeros((R, B), np.int32)
+    a_dc = np.zeros((R, B), np.int32)
+    a_ts = np.zeros((R, B), np.int32)
+    r_key = np.zeros((R, Br), np.int32)
+    # Add-only on purpose: a rmv whose vc lands AFTER an add has already
+    # gossiped prunes that add at apply time in the sequential reference
+    # but merge (by design) only joins vc tables and re-prunes at READ
+    # time, so the raw bytes legitimately differ. Add-only keeps the
+    # drill a pure max-lattice where the bitwise gate is meaningful;
+    # rmv races are partition_demo/test_elastic territory.
+    r_id = np.full((R, Br), -1, np.int32)
+    r_vc = np.zeros((R, Br, DCS), np.int32)
+    for r in range(R):
+        rng = np.random.default_rng(seed * 1_000_003 + 9_100 * (step + 1) + r)
+        ids = _zipf_ids(rng, B)
+        scores = rng.integers(1, 500, B)
+        if r in owned:
+            a_id[r], a_score[r] = ids, scores
+            a_dc[r] = r % DCS
+            a_ts[r] = step * B + np.arange(B) + 1
+    return TopkRmvOps(
+        add_key=jnp.asarray(a_key), add_id=jnp.asarray(a_id),
+        add_score=jnp.asarray(a_score), add_dc=jnp.asarray(a_dc),
+        add_ts=jnp.asarray(a_ts),
+        rmv_key=jnp.asarray(r_key), rmv_id=jnp.asarray(r_id),
+        rmv_vc=jnp.asarray(r_vc),
+    )
+
+
+def access_ids(ops, row: int):
+    """The per-ACCESS id stream for one row's batch (adds then rmvs,
+    every occurrence kept): this is what feeds `ensure_resident`, so
+    hit/miss accounting bills each access, not each unique partition."""
+    import numpy as np
+
+    adds = np.asarray(ops.add_id)[row]
+    rmvs = np.asarray(ops.rmv_id)[row]
+    return np.concatenate([adds, rmvs[rmvs >= 0]])
+
+
+def observable(dense, state):
+    from antidote_ccrdt_tpu.harness.dense_replay import fold_rows
+
+    obs = dense.value(fold_rows(dense, state, range(R)))[0][0]
+    return sorted((int(i), int(s)) for (i, s) in obs)
+
+
+def run_drill(seed: int = 7, *, P: int = 32, spans: bool = False,
+              status_dir: str = None, keep_state: bool = False) -> dict:
+    """One fleet run: 3 workers, zipfian ops, pager per worker when the
+    CCRDT_PAGER kill switch allows it (all-resident legacy otherwise),
+    converge, and compare against the all-resident sequential
+    reference. Returns the full measurement dict; `main` and
+    chaos_gate's working-set leg both gate on it."""
+    import contextlib
+
+    import numpy as np
+
+    from antidote_ccrdt_tpu.core import pager as pg
+    from antidote_ccrdt_tpu.core import partition as pt
+    from antidote_ccrdt_tpu.net.transport import FsTransport, GossipNode
+    from antidote_ccrdt_tpu.obs import spans as obs_spans
+    from antidote_ccrdt_tpu.parallel.elastic import (
+        DeltaPublisher, PartialAntiEntropy, sweep_deltas,
+    )
+
+    dense = _build()
+    use_pager = pg.enabled()
+    members = ["w0", "w1", "w2"]
+    row_of = {"w0": 0, "w1": 1, "w2": 2}
+
+    out: dict = {"seed": seed, "pager": use_pager, "partitions": P}
+    with tempfile.TemporaryDirectory(prefix="workset-") as root:
+        transports = {m: FsTransport(root, m) for m in members}
+        stores = {m: GossipNode(transports[m]) for m in members}
+        states = {m: dense.init(R, NK) for m in members}
+        cursors: dict = {m: {} for m in members}
+
+        pagers: dict = {m: None for m in members}
+        if use_pager:
+            for m in members:
+                probe = pg.PartitionPager(
+                    dense, states[m], P=P, name="workset",
+                    metrics=stores[m].metrics,
+                )
+                total = probe.meta_bytes + sum(probe.part_bytes.values())
+                budget = max(1, total // 10)  # forced 10x overcommit
+                pagers[m] = pg.PartitionPager(
+                    dense, states[m], P=P, name="workset",
+                    hbm_budget_bytes=budget, metrics=stores[m].metrics,
+                )
+                out["state_bytes"] = total
+                out["hbm_budget_bytes"] = budget
+                out["state_over_budget_x"] = round(total / budget, 3)
+
+        pubs = {
+            m: DeltaPublisher(
+                stores[m], dense, name="topk_rmv", full_every=2, keep=8,
+                partitions=P, pager=pagers[m],
+            )
+            for m in members
+        }
+        partials = {
+            m: PartialAntiEntropy(
+                stores[m], partitions=P, max_tries=12, pager=pagers[m]
+            )
+            for m in members
+        }
+
+        def digest_vec(m):
+            if pagers[m] is not None:
+                return pagers[m].digest_vector(states[m])
+            return pt.state_digests(states[m], P)
+
+        def drop_status(m, step):
+            if status_dir is None or pagers[m] is None:
+                return
+            path = os.path.join(status_dir, f"obs-{m}.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(
+                    {"member": m, "step": step,
+                     "pager": pagers[m].status_fields()},
+                    fh,
+                )
+            os.replace(tmp, path)
+
+        def round_of(step):
+            for m in members:
+                stores[m].heartbeat()
+                pubs[m].publish(states[m])
+            time.sleep(0.05)
+            for m in members:
+                states[m], _ = sweep_deltas(
+                    stores[m], dense, states[m], cursors[m],
+                    partial=partials[m], pager=pagers[m],
+                )
+                drop_status(m, step)
+
+        span_cm = (
+            obs_spans.installed("workset", metrics=stores["w0"].metrics)
+            if spans else contextlib.nullcontext()
+        )
+        span_names = set()
+        try:
+            with span_cm:
+                # Start barrier: fs heartbeats are heard-from evidence.
+                deadline = time.time() + 10.0
+                while any(
+                    len(stores[m].members()) < len(members) for m in members
+                ):
+                    for m in members:
+                        stores[m].heartbeat()
+                    if time.time() > deadline:
+                        out["converged"] = False
+                        out["error"] = "start barrier timed out"
+                        return out
+                    time.sleep(0.05)
+
+                for step in range(STEPS):
+                    for m in members:
+                        ops = gen_ops(step, {row_of[m]}, seed)
+                        if pagers[m] is not None:
+                            # Front door BEFORE device writes: hydrate
+                            # the batch's partitions (per-access billing)
+                            # so ops never scatter into a cold hole.
+                            acc = access_ids(ops, row_of[m])
+                            states[m] = pagers[m].ensure_resident(
+                                states[m], pt.part_of(acc, P)
+                            )
+                        states[m], _ = dense.apply_ops(
+                            states[m], ops, collect_dominated=False
+                        )
+                    if step == WARM_STEPS and use_pager:
+                        for m in members:
+                            pagers[m].hits = pagers[m].misses = 0
+                    round_of(step)
+
+                # Convergence tail: republish/sweep until the digest
+                # vectors agree fleet-wide (bounded).
+                agree = False
+                for _ in range(80):
+                    vecs = [digest_vec(m) for m in members]
+                    if all(np.array_equal(vecs[0], v) for v in vecs[1:]):
+                        agree = True
+                        break
+                    round_of(STEPS)
+                out["converged"] = agree
+
+                if spans:
+                    span_names = {
+                        r.get("name")
+                        for r in obs_spans.drain()
+                        if r.get("k") == "span"
+                    }
+
+            # All-resident sequential reference: same op streams, one
+            # process, no pager — the semantic ground truth.
+            ref = dense.init(R, NK)
+            for step in range(STEPS):
+                ref, _ = dense.apply_ops(
+                    ref, gen_ops(step, range(R), seed),
+                    collect_dominated=False,
+                )
+            ref_vec = pt.state_digests(ref, P)
+            ref_obs = observable(dense, ref)
+
+            vec = digest_vec("w0")
+            finals = {
+                m: observable(
+                    dense,
+                    pagers[m].full_state(states[m])
+                    if pagers[m] is not None else states[m],
+                )
+                for m in members
+            }
+            if keep_state:  # debug/forensics only: the logical w0 state
+                out["_state"] = (
+                    pagers["w0"].full_state(states["w0"])
+                    if pagers["w0"] is not None else states["w0"]
+                )
+            out["digest_vector"] = [int(x) for x in vec]
+            out["observable"] = finals["w0"]
+            out["matches_reference"] = bool(
+                np.array_equal(vec, ref_vec)
+            ) and all(finals[m] == ref_obs for m in members)
+
+            counters: dict = {}
+            for m in members:
+                for k, v in stores[m].metrics.counters.items():
+                    if k.startswith(("pager.", "net.psnap", "net.partition")):
+                        counters[k] = counters.get(k, 0) + int(v)
+            out["counters"] = dict(sorted(counters.items()))
+            if use_pager:
+                out["hit_rates"] = {
+                    m: round(pagers[m].hit_rate(), 4) for m in members
+                }
+                out["min_hit_rate"] = min(out["hit_rates"].values())
+            if spans:
+                out["span_names"] = sorted(
+                    n for n in span_names if n is not None
+                )
+            return out
+        finally:
+            for t in transports.values():
+                t.close()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--partitions", type=int, default=32)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument(
+        "--out",
+        default=os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "WORKSET_r01.json",
+        ),
+    )
+    args = ap.parse_args()
+
+    from antidote_ccrdt_tpu.core import pager as pg
+
+    # Paged arm (spans armed: the conditional hydrate span must be lit).
+    os.environ.pop(pg.ENV_FLAG, None)
+    paged = run_drill(args.seed, P=args.partitions, spans=True)
+    # Kill-switch arm: CCRDT_PAGER=0 means pagers are never constructed
+    # and the drill runs the bit-identical all-resident legacy path.
+    os.environ[pg.ENV_FLAG] = "0"
+    try:
+        legacy = run_drill(args.seed, P=args.partitions)
+    finally:
+        os.environ.pop(pg.ENV_FLAG, None)
+
+    c = paged.get("counters", {})
+    checks = {
+        "fleet_converged": bool(paged.get("converged")),
+        "matches_sequential_reference": bool(paged.get("matches_reference")),
+        "kill_switch_bit_identical": bool(legacy.get("converged"))
+        and legacy.get("digest_vector") == paged.get("digest_vector")
+        and legacy.get("observable") == paged.get("observable"),
+        "state_ge_10x_budget": paged.get("state_over_budget_x", 0) >= MIN_RATIO,
+        "hit_rate_ge_min": paged.get("min_hit_rate", 0.0) >= MIN_HIT,
+        "pager_paged": all(
+            c.get(k, 0) > 0
+            for k in ("pager.evictions", "pager.hydrations", "pager.cold_folds")
+        ),
+        "cold_psnaps_served_from_blobs": c.get("pager.blob_serves", 0) > 0,
+        "no_wasted_psnaps": c.get("net.psnap_wasted", 0) == 0,
+        "hydrate_span_lit": "round.pager_hydrate"
+        in paged.get("span_names", []),
+    }
+    report = {
+        "drill": "working_set_demo",
+        "geometry": {
+            "R": R, "NK": NK, "I": I, "DCS": DCS, "K": K, "M": M,
+            "B": B, "Br": Br, "steps": STEPS, "zipf_a": ZIPF_A,
+        },
+        "partitions": args.partitions,
+        "state_bytes": paged.get("state_bytes"),
+        "hbm_budget_bytes": paged.get("hbm_budget_bytes"),
+        "state_over_budget_x": paged.get("state_over_budget_x"),
+        "hit_rates": paged.get("hit_rates"),
+        "min_hit_rate": paged.get("min_hit_rate"),
+        "counters": c,
+        "span_names": paged.get("span_names"),
+        "checks": checks,
+        "pass": all(checks.values()),
+    }
+    with open(args.out, "w") as fh:
+        json.dump(report, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    print(json.dumps(report, indent=2, sort_keys=True))
+    if not report["pass"]:
+        failed = [k for k, ok in checks.items() if not ok]
+        print(f"FAIL: {', '.join(failed)}", file=sys.stderr)
+        return 1
+    print(
+        f"PASS: {paged['state_over_budget_x']}x over-budget instance "
+        f"converged bit-identically at hit rate {paged['min_hit_rate']:.3f} "
+        f"({c.get('pager.hydrations', 0)} hydrations, "
+        f"{c.get('pager.evictions', 0)} evictions)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,481 @@
+"""Out-of-core partition pager (core/pager.py).
+
+The invariant under test everywhere: **logical state = device state ⊔
+cold substrate**, bit-exactly. Residency is a pure performance axis —
+demote/hydrate in any order must never change a digest, a psnap, a
+checkpoint, or a converged peer. Covers the CCPT residency round-trip
+(demote → digests/psnaps answered from the stored blob → hydrate →
+bit-identical vs never-demoted), cold folds vs an all-resident
+reference, the queue-until-hydration mode, clock eviction under an HBM
+budget, the kill-switch, mixed-residency partitioned checkpoints, the
+partial anti-entropy surface serving cold psnaps straight from blobs,
+the disk spill tier, a SIGKILL-mid-hydration drill (recovery must
+discard — never resurrect — spill blobs), and a hypothesis property
+over arbitrary demote/hydrate interleavings.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+import pytest
+from conftest import HealthCheck, given, settings, st  # noqa: E402  (hypothesis or skip-stub)
+from conftest import cpu_subprocess_env
+
+from antidote_ccrdt_tpu.core import pager as pg
+from antidote_ccrdt_tpu.core import partition as pt
+from antidote_ccrdt_tpu.core import serial
+from antidote_ccrdt_tpu.models.topk_rmv_dense import TopkRmvOps, make_dense
+from antidote_ccrdt_tpu.parallel.delta import (
+    apply_any_delta, like_delta_for, make_delta,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+R, NK, I, DCS, K, M, B, P = 2, 1, 64, 4, 8, 2, 32, 8
+
+DENSE = make_dense(n_ids=I, n_dcs=DCS, size=K, slots_per_id=M)
+
+
+def gen_ops(step, rng, ids=None):
+    a_id = (
+        rng.integers(0, I, (R, B)).astype(np.int32)
+        if ids is None
+        else ids[rng.integers(0, len(ids), (R, B))].astype(np.int32)
+    )
+    return TopkRmvOps(
+        add_key=jnp.zeros((R, B), jnp.int32),
+        add_id=jnp.asarray(a_id),
+        add_score=jnp.asarray(rng.integers(1, 500, (R, B)).astype(np.int32)),
+        add_dc=jnp.zeros((R, B), jnp.int32),
+        add_ts=jnp.asarray(np.broadcast_to(
+            step * B + np.arange(B) + 1, (R, B)
+        ).astype(np.int32)),
+        rmv_key=jnp.zeros((R, 1), jnp.int32),
+        rmv_id=jnp.full((R, 1), -1, jnp.int32),
+        rmv_vc=jnp.zeros((R, 1, DCS), jnp.int32),
+    )
+
+
+def seeded_state(steps=4, seed=0, ids=None):
+    rng = np.random.default_rng(seed)
+    state = DENSE.init(R, NK)
+    for s in range(steps):
+        state, _ = DENSE.apply_ops(
+            state, gen_ops(s, rng, ids), collect_dominated=False
+        )
+    return state
+
+
+def leaves_equal(a, b):
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b))
+    )
+
+
+# --- CCPT residency round-trip ---------------------------------------------
+
+
+def test_demote_serves_digests_and_psnaps_from_blob():
+    state = seeded_state()
+    ref = jax.tree_util.tree_map(lambda x: np.asarray(x).copy(), state)
+    ref_dig = pt.state_digests(state, P)
+    pager = pg.PartitionPager(DENSE, state, P=P, name="topk_rmv")
+    for p in (0, 2, 4, 6):
+        state = pager.demote(state, p)
+    assert pager.cold_parts() == {0, 2, 4, 6}
+    # Mixed-residency digest vector is bit-equal to the all-resident one
+    # (the device state's own digests are NOT — the content moved out).
+    assert np.array_equal(pager.digest_vector(state), ref_dig)
+    assert not np.array_equal(pt.state_digests(state, P), ref_dig)
+    # Cold psnap blobs answer straight from storage, round-tripping the
+    # CCPT container, and decode to the partition's exact content.
+    blob = pager.psnap_blob(state, 7, 0)
+    seq, part, payload = pt.decode_psnap_blob(blob)
+    assert (seq, part) == (7, 0)
+    _name, psnap = serial.loads_dense(payload, like_delta_for(DENSE, state))
+    fresh = pt.apply_psnap(DENSE, DENSE.init(R, NK), psnap)
+    assert pt.digest_entries(fresh, P, [0])[0] == int(ref_dig[0])
+    assert pager.metrics.counters.get("pager.blob_serves", 0) >= 1
+    # full_state reassembles the logical state bit-identically, without
+    # changing residency.
+    assert leaves_equal(pager.full_state(state), ref)
+    assert pager.cold_parts() == {0, 2, 4, 6}
+
+
+def test_hydrate_all_is_bit_identical_to_never_demoted():
+    state = seeded_state()
+    ref = jax.tree_util.tree_map(lambda x: np.asarray(x).copy(), state)
+    pager = pg.PartitionPager(DENSE, state, P=P, name="topk_rmv")
+    for p in range(P):
+        state = pager.demote(state, p)
+    assert pager.cold_parts() == set(range(P))
+    for p in sorted(pager.cold_parts()):
+        state = pager.hydrate(state, p)
+    assert not pager.has_cold()
+    assert leaves_equal(state, ref)
+    assert pager.metrics.counters.get("pager.hydrations") == P
+    # Every hydration billed a miss-latency sample (milliseconds).
+    assert len(pager.metrics.latencies["pager.miss_ms"].samples) == P
+
+
+def test_hot_writes_keep_mixed_digests_consistent():
+    """Ops against RESIDENT partitions while others are cold: the mixed
+    digest vector must track the all-resident reference exactly."""
+    state = seeded_state()
+    pager = pg.PartitionPager(DENSE, state, P=P, name="topk_rmv")
+    part_map = pt.part_of(np.arange(I), P)
+    hot = int(sorted(set(range(P)) - {0, 1, 2})[0])
+    for p in (0, 1, 2):
+        state = pager.demote(state, p)
+    rng = np.random.default_rng(41)
+    hot_ids = np.arange(I)[part_map == hot]
+    state, _ = DENSE.apply_ops(
+        state, gen_ops(9, rng, hot_ids), collect_dominated=False
+    )
+    full = pager.full_state(state)
+    assert np.array_equal(pager.digest_vector(state), pt.state_digests(full, P))
+
+
+# --- gossip: cold folds and queueing ---------------------------------------
+
+
+def test_cold_fold_matches_all_resident_reference():
+    state = seeded_state()
+    ref = jax.tree_util.tree_map(lambda x: np.asarray(x).copy(), state)
+    pager = pg.PartitionPager(DENSE, state, P=P, name="topk_rmv")
+    for p in (0, 2, 4, 6):
+        state = pager.demote(state, p)
+    # A peer delta over the whole id space: cold half folds host-side,
+    # hot half joins on device — no hydration.
+    rng = np.random.default_rng(99)
+    peer0 = DENSE.init(R, NK)
+    peer1, _ = DENSE.apply_ops(
+        peer0, gen_ops(10, rng), collect_dominated=False
+    )
+    delta = make_delta(DENSE, peer0, peer1)
+    ref2 = apply_any_delta(
+        DENSE, DENSE.merge(DENSE.init(R, NK), jax.tree_util.tree_map(jnp.asarray, ref)),
+        delta,
+    )
+    state = pager.apply_delta(state, delta)
+    assert pager.cold_parts() == {0, 2, 4, 6}  # never hydrated
+    assert pager.metrics.counters.get("pager.cold_folds", 0) >= 1
+    assert leaves_equal(pager.full_state(state), ref2)
+    assert np.array_equal(pager.digest_vector(state), pt.state_digests(ref2, P))
+
+
+def test_queue_mode_defers_cold_deltas_until_hydration():
+    state = seeded_state()
+    pager = pg.PartitionPager(
+        DENSE, state, P=P, name="topk_rmv", fold_cold=False
+    )
+    cold = (0, 2, 4, 6)
+    for p in cold:
+        state = pager.demote(state, p)
+    rng = np.random.default_rng(99)
+    peer0 = DENSE.init(R, NK)
+    peer1, _ = DENSE.apply_ops(peer0, gen_ops(10, rng), collect_dominated=False)
+    delta = make_delta(DENSE, peer0, peer1)
+    ref2 = apply_any_delta(DENSE, pager.full_state(state), delta)
+    state = pager.apply_delta(state, delta)
+    assert pager.metrics.counters.get("pager.queued_deltas", 0) >= 1
+    assert pager.metrics.counters.get("pager.cold_folds", 0) == 0
+    # Hydration drains the queue: the deferred cold slices land then.
+    for p in cold:
+        state = pager.hydrate(state, p)
+    assert not pager._queued
+    assert pager.metrics.counters.get("pager.queue_drains", 0) >= 1
+    assert leaves_equal(pager.full_state(state), ref2)
+
+
+def test_partial_antientropy_serves_cold_psnaps_without_hydrating(tmp_path):
+    """A cold-heavy writer repairs an empty reader through the partition
+    surface: digest vector and psnaps come from the pager (cold entries
+    straight from stored CCPT blobs), the reader converges to the full
+    LOGICAL state, and the writer never hydrates."""
+    from antidote_ccrdt_tpu.net.transport import FsTransport, GossipNode
+    from antidote_ccrdt_tpu.parallel.elastic import (
+        DeltaPublisher, PartialAntiEntropy, sweep_deltas,
+    )
+
+    state = seeded_state()
+    ref_dig = pt.state_digests(state, P)
+    pager = pg.PartitionPager(DENSE, state, P=P, name="topk_rmv")
+    for p in (0, 2, 4, 6):
+        state = pager.demote(state, p)
+
+    a = GossipNode(FsTransport(str(tmp_path), "a"))
+    b = GossipNode(FsTransport(str(tmp_path), "b"))
+    a.heartbeat(), b.heartbeat()
+    pub = DeltaPublisher(
+        a, DENSE, name="topk_rmv", full_every=1, partitions=P, pager=pager
+    )
+    hydr0 = pager.metrics.counters.get("pager.hydrations", 0)
+    pub.publish(state)
+    partial = PartialAntiEntropy(b, partitions=P)
+    st_b, _ = sweep_deltas(b, DENSE, DENSE.init(R, NK), {}, partial=partial)
+    assert np.array_equal(pt.state_digests(st_b, P), ref_dig)
+    assert pager.cold_parts() == {0, 2, 4, 6}
+    assert pager.metrics.counters.get("pager.hydrations", 0) == hydr0
+    assert b.metrics.counters.get("net.psnap_wasted", 0) == 0
+
+
+# --- policy: budget, clock, accounting --------------------------------------
+
+
+def test_budget_eviction_and_hit_accounting():
+    state = seeded_state()
+    probe = pg.PartitionPager(DENSE, state, P=P, name="probe")
+    ref_dig = pt.state_digests(state, P)
+    budget = probe.meta_bytes + sum(probe.part_bytes[p] for p in range(4))
+    pager = pg.PartitionPager(
+        DENSE, state, P=P, name="topk_rmv", hbm_budget_bytes=budget
+    )
+    state = pager.enforce_budget(state)
+    assert pager.resident_bytes() <= budget
+    assert pager.has_cold()
+    want = sorted(pager.cold_parts())[:2]
+    state = pager.ensure_resident(state, want)
+    assert pager.misses == 2
+    assert all(pager.is_resident(p) for p in want)
+    # Re-enforced: paging the misses in paged something else out.
+    assert pager.resident_bytes() <= budget
+    state = pager.ensure_resident(state, want)
+    assert pager.misses == 2 and pager.hits >= 2
+    assert 0.0 < pager.hit_rate() < 1.0
+    assert np.array_equal(
+        pt.state_digests(pager.full_state(state), P), ref_dig
+    )
+
+
+def test_kill_switch_and_budget_gate(monkeypatch):
+    state = seeded_state(steps=1)
+    # Kill-switch: CCRDT_PAGER=0 forces the all-resident legacy path
+    # even with a budget configured.
+    monkeypatch.setenv(pg.ENV_FLAG, "0")
+    monkeypatch.setenv(pg.ENV_HBM, "64k")
+    assert pg.maybe_pager(DENSE, state, P=P) is None
+    # Default-off without a budget: no CCRDT_PAGER_HBM_BUDGET, no pager.
+    monkeypatch.delenv(pg.ENV_FLAG)
+    monkeypatch.delenv(pg.ENV_HBM)
+    assert pg.maybe_pager(DENSE, state, P=P) is None
+    assert pg.maybe_pager(DENSE, state, P=P, require_budget=False) is not None
+    # Budget parsing: k/m/g suffixes land in hbm_budget.
+    monkeypatch.setenv(pg.ENV_HBM, "64k")
+    pager = pg.maybe_pager(DENSE, state, P=P)
+    assert pager is not None and pager.hbm_budget == 64 << 10
+
+
+def test_unpageable_engines_are_rejected():
+    from antidote_ccrdt_tpu.models.average import AverageDense
+
+    avg = AverageDense()
+    st_avg = avg.init(R, NK)
+    with pytest.raises(ValueError):
+        pg.PartitionPager(avg, st_avg, P=P)
+    assert pg.maybe_pager(avg, st_avg, P=P, require_budget=False) is None
+
+
+# --- persistence: checkpoints and the spill tier ----------------------------
+
+
+def test_partitioned_checkpoint_round_trips_mixed_residency(tmp_path):
+    from antidote_ccrdt_tpu.harness.checkpoint import (
+        load_partitioned_checkpoint, save_partitioned_checkpoint,
+    )
+
+    state = seeded_state()
+    ref_dig = pt.state_digests(state, P)
+    pager = pg.PartitionPager(DENSE, state, P=P, name="topk_rmv")
+    for p in (1, 3, 5):
+        state = pager.demote(state, p)
+    save_partitioned_checkpoint(
+        str(tmp_path), "topk_rmv", state, DENSE, step=5,
+        partitions=P, pager=pager,
+    )
+    step, name, restored, parts = load_partitioned_checkpoint(
+        str(tmp_path), DENSE.init(R, NK), DENSE
+    )
+    assert (step, name) == (5, "topk_rmv")
+    assert set(parts) >= set(range(P))
+    assert np.array_equal(pt.state_digests(restored, P), ref_dig)
+
+
+def test_spill_tier_round_trips_and_discard(tmp_path):
+    state = seeded_state()
+    ref_dig = pt.state_digests(state, P)
+    pager = pg.PartitionPager(
+        DENSE, state, P=P, name="topk_rmv",
+        spill_dir=str(tmp_path), host_budget_bytes=1,
+    )
+    for p in (0, 2):
+        state = pager.demote(state, p)
+    spilled = [f for f in os.listdir(tmp_path) if f.startswith(pg.SPILL_PREFIX)]
+    assert len(spilled) == 2  # host budget of 1 byte spills every payload
+    assert pager.metrics.counters.get("pager.spills", 0) >= 2
+    # Hydration reads the blob back from disk and deletes the file.
+    state = pager.hydrate(state, 0)
+    state = pager.hydrate(state, 2)
+    assert not [f for f in os.listdir(tmp_path) if f.startswith(pg.SPILL_PREFIX)]
+    assert np.array_equal(pt.state_digests(state, P), ref_dig)
+    # discard_spill: the recovery-path sweep removes every pager blob.
+    for p in (4, 6):
+        state = pager.demote(state, p)
+    assert pg.discard_spill(str(tmp_path)) == 2
+    assert pg.discard_spill(str(tmp_path)) == 0
+
+
+# --- SIGKILL mid-hydration drill -------------------------------------------
+
+_SIGKILL_CHILD = r"""
+import json, os, sys
+import numpy as np
+import jax.numpy as jnp
+
+from antidote_ccrdt_tpu.core import pager as pg
+from antidote_ccrdt_tpu.core import partition as pt
+from antidote_ccrdt_tpu.harness.wal import ElasticWal
+from antidote_ccrdt_tpu.models.topk_rmv_dense import TopkRmvOps, make_dense
+from antidote_ccrdt_tpu.utils import faults
+
+root = os.environ["CCRDT_DRILL_ROOT"]
+R, NK, I, DCS, K, M, B, P = 2, 1, 64, 4, 8, 2, 32, 8
+dense = make_dense(n_ids=I, n_dcs=DCS, size=K, slots_per_id=M)
+
+def gen_ops(step, rng):
+    a_id = rng.integers(0, I, (R, B)).astype(np.int32)
+    return TopkRmvOps(
+        add_key=jnp.zeros((R, B), jnp.int32), add_id=jnp.asarray(a_id),
+        add_score=jnp.asarray(rng.integers(1, 500, (R, B)).astype(np.int32)),
+        add_dc=jnp.zeros((R, B), jnp.int32),
+        add_ts=jnp.asarray(np.broadcast_to(
+            step * B + np.arange(B) + 1, (R, B)).astype(np.int32)),
+        rmv_key=jnp.zeros((R, 1), jnp.int32),
+        rmv_id=jnp.full((R, 1), -1, jnp.int32),
+        rmv_vc=jnp.zeros((R, 1, DCS), jnp.int32),
+    )
+
+rng = np.random.default_rng(7)
+wal = ElasticWal(root, "victim", dense, "topk_rmv", partitions=P,
+                 durability="sync")
+state = dense.init(R, NK)
+for s in range(4):
+    prev = state
+    state, _ = dense.apply_ops(state, gen_ops(s, rng), collect_dominated=False)
+    wal.log_step(s, [0], prev, state)
+ref = [int(x) for x in pt.state_digests(state, P)]
+with open(os.path.join(root, "ref.json"), "w") as f:
+    json.dump(ref, f)
+    f.flush()
+    os.fsync(f.fileno())
+
+# Spill every demoted payload to disk under the WAL dir (the recovery
+# sweep's search root), then stall inside a hydration so the parent's
+# SIGKILL lands mid-page-in.
+pager = pg.PartitionPager(dense, state, P=P, name="topk_rmv",
+                          spill_dir=wal.dir, host_budget_bytes=1)
+for p in (0, 2, 4):
+    state = pager.demote(state, p)
+assert pager._spilled, "expected disk spill files"
+faults.install(
+    {"pager.hydrate": [{"action": "delay", "at": [0], "delay_s": 120.0}]}
+)
+open(os.path.join(root, "hydrating"), "w").close()
+state = pager.hydrate(state, 0)  # stalls 120s; SIGKILL arrives here
+print("UNREACHABLE: hydration completed before the kill", file=sys.stderr)
+sys.exit(3)
+"""
+
+
+def test_sigkill_mid_hydration_recovery_discards_spill(tmp_path):
+    """Kill a worker inside a page-in (the `pager.hydrate` fault point
+    stalls it there). Recovery must rebuild all-resident from the WAL
+    and DISCARD the dead incarnation's spill blobs — never resurrect a
+    possibly-torn resident copy."""
+    from antidote_ccrdt_tpu.harness.wal import ElasticWal
+
+    env = cpu_subprocess_env(
+        CCRDT_DRILL_ROOT=str(tmp_path), PYTHONPATH=REPO
+    )
+    log = open(tmp_path / "child.log", "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _SIGKILL_CHILD],
+        env=env, cwd=REPO, stdout=log, stderr=subprocess.STDOUT,
+    )
+    try:
+        marker = tmp_path / "hydrating"
+        deadline = time.time() + 180
+        while time.time() < deadline and not marker.exists():
+            assert proc.poll() is None, (
+                f"child died before hydrating:\n{(tmp_path / 'child.log').read_text()[-3000:]}"
+            )
+            time.sleep(0.05)
+        assert marker.exists(), "child never reached the hydration stall"
+        time.sleep(0.3)  # let it enter the injected delay
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        log.close()
+        if proc.poll() is None:
+            proc.kill()
+
+    wal_dir = tmp_path / "wal-victim"
+    spills = [
+        f for f in os.listdir(wal_dir) if f.startswith(pg.SPILL_PREFIX)
+    ]
+    assert spills, "the killed incarnation should have left spill blobs"
+    # Simulate a tear on one blob for good measure: recovery must not
+    # even look at the content.
+    with open(wal_dir / spills[0], "ab") as f:
+        f.write(b"\x00garbage")
+
+    ref = json.loads((tmp_path / "ref.json").read_text())
+    dense = DENSE
+    wal2 = ElasticWal(str(tmp_path), "victim", dense, "topk_rmv", partitions=P)
+    recovered, last_step, _owned = wal2.recover(dense.init(R, NK))
+    wal2.close()
+    assert last_step == 3
+    assert wal2.metrics.counters.get("pager.spills_discarded", 0) >= len(spills)
+    assert not [
+        f for f in os.listdir(wal_dir) if f.startswith(pg.SPILL_PREFIX)
+    ]
+    assert [int(x) for x in pt.state_digests(recovered, P)] == ref
+
+
+# --- hypothesis: arbitrary interleavings ------------------------------------
+
+_BASE = None
+
+
+def _base():
+    global _BASE
+    if _BASE is None:
+        state = seeded_state(seed=3)
+        _BASE = (state, pt.state_digests(state, P))
+    return _BASE
+
+
+@settings(
+    max_examples=12, deadline=None,
+    suppress_health_check=[HealthCheck.too_slow, HealthCheck.data_too_large],
+)
+@given(st.lists(st.tuples(st.booleans(), st.integers(0, P - 1)), max_size=16))
+def test_property_interleavings_preserve_digests(script):
+    """Any demote/hydrate interleaving (including no-op repeats) leaves
+    the logical digest vector untouched at every step, and the final
+    full_state reassembles bit-identically."""
+    base, ref_dig = _base()
+    pager = pg.PartitionPager(DENSE, base, P=P, name="topk_rmv")
+    state = base
+    for is_demote, p in script:
+        state = pager.demote(state, p) if is_demote else pager.hydrate(state, p)
+        assert np.array_equal(pager.digest_vector(state), ref_dig)
+    assert leaves_equal(pager.full_state(state), base)

@@ -23,7 +23,7 @@ PY ?= python
 # meaningful.
 COVER_THRESHOLD ?= 88
 
-.PHONY: all compile test cover typecheck xref native bench benchall dryrun net-demo chaos crash-demo obs-demo topo-demo spans-demo overlap-demo partition-demo serve-demo audit-demo multichip-demo working-set-demo bench-gate clean
+.PHONY: all compile test cover typecheck xref native bench benchall dryrun net-demo chaos crash-demo obs-demo topo-demo spans-demo overlap-demo partition-demo serve-demo audit-demo multichip-demo working-set-demo read-tier-demo bench-gate clean
 
 all: compile xref typecheck cover
 
@@ -86,10 +86,16 @@ net-demo:
 # is the bench regression gate over the committed BENCH_r*.json rounds;
 # then the real-process span demo (3 TCP workers, one merged Perfetto
 # timeline, dispatch-gap attribution gated) and the overlap demo. The
-# final leg is the out-of-core working-set demo: chaos_gate's
+# next leg is the out-of-core working-set demo: chaos_gate's
 # working-set leg already ran the same drill on a fresh seed; this one
 # adds the two-arm CCRDT_PAGER=0 kill-switch comparison and refreshes
-# WORKSET_r01.json.
+# WORKSET_r01.json. The final leg is the fleet read tier
+# (scripts/read_tier_demo.py): a 4-worker TCP fleet with one serving
+# peer SIGKILLed mid-load — every routed query must complete or error
+# honestly (zero hangs, zero bound violations), the router counters the
+# dashboard renders must be lit, and certify_sessions must sign a
+# clean certificate while the deliberately token-violating arm FAILS
+# with a counterexample; refreshes READTIER_r01.json.
 chaos:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py tests/test_wal.py tests/test_fault_matrix.py -q -p no:cacheprovider
 	env JAX_PLATFORMS=cpu $(PY) scripts/chaos_gate.py
@@ -98,6 +104,7 @@ chaos:
 	env JAX_PLATFORMS=cpu $(PY) scripts/spans_demo.py
 	env JAX_PLATFORMS=cpu $(PY) scripts/overlap_demo.py
 	env JAX_PLATFORMS=cpu $(PY) scripts/working_set_demo.py
+	env JAX_PLATFORMS=cpu $(PY) scripts/read_tier_demo.py
 
 # Throughput regression gate: best merges_per_sec of the latest
 # BENCH_r*.json round must stay within 20% of the best prior round —
@@ -198,6 +205,21 @@ multichip-demo:
 # scripts/chaos_gate.py's working-set leg (fresh seed there).
 working-set-demo:
 	env JAX_PLATFORMS=cpu $(PY) scripts/working_set_demo.py
+
+# Fleet read-tier gate (slow, real processes): a 4-worker TCP gossip
+# fleet serving in-band {query} frames through serve/router.py — HRW
+# rendezvous routing with staleness-aware peer picking, hedged retries,
+# per-peer breakers, and session tokens (read-your-writes + monotonic
+# reads) — with the rendezvous-head worker SIGKILLed mid-load. Gated on
+# zero hung queries, zero staleness-bound violations, bounded failover
+# blip, the router counters lit, survivors converging bit-identically,
+# certify_sessions signing a clean certificate over the router flight
+# log, and the deliberately token-violating arm FAILING certification
+# with a minimal counterexample. Writes READTIER_r01.json (the carrier
+# bench_gate's evaluate_router compares). Also the final leg of
+# `make chaos`.
+read-tier-demo:
+	env JAX_PLATFORMS=cpu $(PY) scripts/read_tier_demo.py
 
 # Span-tracing demo (slow, real processes): a 3-worker TCP fleet with
 # the round-phase span plane armed (CCRDT_SPANS=1) — every worker's

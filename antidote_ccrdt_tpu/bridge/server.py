@@ -1285,7 +1285,11 @@ class BridgeServer:
     def install_serve(self, plane) -> None:
         """Attach a serve plane (or any bytes->bytes handler); the
         {query} op starts answering. Mirrors TcpTransport.install_serve."""
-        self.query_handler = getattr(plane, "handle", plane)
+        handler_for = getattr(plane, "handler_for", None)
+        if callable(handler_for):
+            self.query_handler = handler_for("bridge")
+        else:
+            self.query_handler = getattr(plane, "handle", plane)
 
     # -- dispatch ----------------------------------------------------------
 

@@ -194,6 +194,13 @@ class SimTransport:
         # analog of tcp's reply-on-inbound-connection).
         self.query_handler = None
         self.query_resps: List[Tuple[str, bytes]] = []
+        # Router metadata: qid-keyed responses + cancellation. A reply
+        # whose qid was cancelled before delivery is DROPPED (counted,
+        # `net.query_cancelled_drops`) — the sim analog of the router
+        # reaping a hedge loser / failed-over attempt, and what the
+        # zero-duplicate-answer drill asserts on.
+        self.query_results: Dict[bytes, Tuple[str, bytes]] = {}
+        self._query_cancelled: set = set()
 
     def local_clock(self) -> float:
         """This member's view of time: virtual clock + its skew."""
@@ -211,14 +218,32 @@ class SimTransport:
         """Attach a serve plane (or any bytes->bytes handler), exactly
         as `TcpTransport.install_serve` — sim drills exercise the same
         query path chaos-deterministically."""
-        self.query_handler = getattr(plane, "handle", plane)
+        handler_for = getattr(plane, "handler_for", None)
+        if callable(handler_for):
+            self.query_handler = handler_for("sim")
+        else:
+            self.query_handler = getattr(plane, "handle", plane)
 
-    def query(self, peer: str, payload: bytes) -> None:
+    def query(self, peer: str, payload: bytes,
+              qid: Optional[bytes] = None) -> None:
         """Send one serve-plane read to `peer`; the response arrives in
-        `self.query_resps` as (peer, bytes) once the net delivers it."""
+        `self.query_resps` as (peer, bytes) once the net delivers it.
+        With `qid` (opaque router metadata, echoed by the peer) it ALSO
+        lands in `self.query_results[qid]` — unless `cancel_query(qid)`
+        ran first, in which case the late answer is dropped."""
         self._check_live()
-        self._send(peer, ("query", self.member, bytes(payload)), False,
-                   len(payload))
+        msg = (
+            ("query", self.member, bytes(payload)) if qid is None
+            else ("query", self.member, bytes(payload), bytes(qid))
+        )
+        self._send(peer, msg, False, len(payload))
+
+    def cancel_query(self, qid: bytes) -> None:
+        """Abandon an in-flight qid: its response, if it ever arrives,
+        is dropped instead of delivered — the sim's router-cancellation
+        analog (a hedge loser must not surface a duplicate answer)."""
+        self._query_cancelled.add(bytes(qid))
+        self.query_results.pop(bytes(qid), None)
 
     def install_router(self, timeout_s: float = 2.0) -> ZoneRouter:
         """Switch from full-mesh to the zone-aware topology, exactly as
@@ -484,6 +509,10 @@ class SimTransport:
             self._store_psnap(src, int(part), blob)
         elif kind == "query":
             payload = msg[2]
+            # Every frame carries the piggybacked heard-ages dict as its
+            # last element, so a qid-bearing query is a 5-tuple and a
+            # legacy qid-less one a 4-tuple.
+            qid = bytes(msg[3]) if len(msg) > 4 else None
             handler = self.query_handler
             self.metrics.count("net.queries")
             if handler is not None:
@@ -497,9 +526,21 @@ class SimTransport:
                 import json as _json
 
                 resp = _json.dumps({"error": "no serve plane"}).encode("utf-8")
-            self._send(src, ("query_resp", self.member, resp), False, len(resp))
+            out = (
+                ("query_resp", self.member, resp) if qid is None
+                else ("query_resp", self.member, resp, qid)
+            )
+            self._send(src, out, False, len(resp))
         elif kind == "query_resp":
-            self.query_resps.append((src, bytes(msg[2])))
+            qid = bytes(msg[3]) if len(msg) > 4 else None
+            if qid is not None and qid in self._query_cancelled:
+                # Cancelled in flight: the router already moved on; a
+                # late duplicate answer must not surface.
+                self.metrics.count("net.query_cancelled_drops")
+            else:
+                self.query_resps.append((src, bytes(msg[2])))
+                if qid is not None:
+                    self.query_results[qid] = (src, bytes(msg[2]))
         elif kind == "psnap_req":
             parts = msg[2]
             self.metrics.count("net.psnap_reqs_recv")

@@ -149,27 +149,68 @@ def scrape_metrics(addr: Tuple[str, int], timeout: float = 2.0) -> Tuple[str, st
                     return member, term[2].decode("utf-8")
 
 
+class QueryCancelled(ConnectionError):
+    """`query_peer` abandoned because its `cancel` event was set — the
+    router reaped a hedge loser or failed over off this peer."""
+
+
 def query_peer(
-    addr: Tuple[str, int], payload: bytes, timeout: float = 2.0
+    addr: Tuple[str, int],
+    payload: bytes,
+    timeout: float = 2.0,
+    cancel: Optional[threading.Event] = None,
+    connect_timeout: Optional[float] = None,
+    qid: Optional[bytes] = None,
 ) -> Tuple[str, bytes]:
     """One-shot serve-plane read against a live `TcpTransport`: connect
-    to its gossip listener, send `{query, Payload}`, return (member,
-    response bytes — the serve plane's canonical JSON, verbatim).
-    Bounded by `timeout` end-to-end like `scrape_metrics`: a wedged or
-    fault-injected worker yields `socket.timeout`/`ConnectionError`,
-    never a hang. The querier never joins the gossip membership."""
+    to its gossip listener, send `{query, Payload[, Qid]}`, return
+    (member, response bytes — the serve plane's canonical JSON,
+    verbatim). Bounded by `timeout` end-to-end: the deadline is checked
+    explicitly on EVERY loop turn, so a peer that accepts the frame and
+    then drips unrelated traffic (or nothing) without ever answering
+    still surfaces `socket.timeout` — connection-level faults are not
+    the only escape hatch. The fleet router leans on this: a
+    never-answering peer must time out so it can fail over instead of
+    hanging. `cancel` (a threading.Event) aborts the wait early with
+    `QueryCancelled` — how a hedged/failed-over attempt's loser is
+    reaped. `qid` is opaque router metadata echoed back in the response
+    frame (correlation under failover). The querier never joins the
+    gossip membership."""
     deadline = time.monotonic() + timeout
-    with socket.create_connection(addr, timeout=timeout) as s:
-        s.sendall(pack_frame((A_QUERY, bytes(payload))))
+    frame: Tuple[Any, ...] = (
+        (A_QUERY, bytes(payload)) if qid is None
+        else (A_QUERY, bytes(payload), bytes(qid))
+    )
+    with socket.create_connection(
+        addr, timeout=(connect_timeout if connect_timeout is not None
+                       else timeout)
+    ) as s:
+        s.sendall(pack_frame(frame))
         buf = bytearray()
         while True:
-            s.settimeout(max(0.01, deadline - time.monotonic()))
-            data = s.recv(1 << 16)
+            now = time.monotonic()
+            if now >= deadline:
+                raise socket.timeout(
+                    f"query deadline exceeded ({timeout}s, no query_resp)"
+                )
+            if cancel is not None and cancel.is_set():
+                raise QueryCancelled("query cancelled by router")
+            # Short recv slices so cancellation and the hard deadline
+            # are both honored even while frames keep trickling in.
+            s.settimeout(max(0.01, min(0.1, deadline - now)))
+            try:
+                data = s.recv(1 << 16)
+            except socket.timeout:
+                continue  # no bytes this slice; deadline check re-arms
             if not data:
                 raise ConnectionError("query connection closed before reply")
             buf.extend(data)
             for term in unpack_frames(buf):
                 if term[0] == A_QUERY_RESP:
+                    if qid is not None and (
+                        len(term) < 4 or bytes(term[3]) != bytes(qid)
+                    ):
+                        continue  # someone else's (stale) answer
                     return term[1].decode("utf-8"), bytes(term[2])
 
 
@@ -492,8 +533,14 @@ class TcpTransport:
     def install_serve(self, plane: Any) -> None:
         """Attach a serve plane (or any bytes->bytes handler): inbound
         `{query, Payload}` frames are answered with `{query_resp,
-        Member, ResponseBytes}` on the same connection."""
-        self.query_handler = getattr(plane, "handle", plane)
+        Member, ResponseBytes}` on the same connection. A real
+        `ServePlane` gets its "tcp"-labelled handler so sheds on this
+        surface are countable apart from bridge/HTTP ones."""
+        handler_for = getattr(plane, "handler_for", None)
+        if callable(handler_for):
+            self.query_handler = handler_for("tcp")
+        else:
+            self.query_handler = getattr(plane, "handle", plane)
 
     def learn_zone(self, name: str, zone: str) -> None:
         """Feed static zone config (address files, CLI) into the map —
@@ -824,9 +871,12 @@ class TcpTransport:
             return
         if tag == A_QUERY:
             # Serve-plane read: same reply-on-inbound-connection contract
-            # as the scrape — the querier never joins the membership.
+            # as the scrape — the querier never joins the membership. An
+            # optional 3rd element is opaque router metadata (qid),
+            # echoed back for correlation under failover/hedging.
             if conn is not None and len(term) > 1:
-                self._send_query_resp(conn, bytes(term[1]))
+                qid = bytes(term[2]) if len(term) > 2 else None
+                self._send_query_resp(conn, bytes(term[1]), qid=qid)
             return
         if tag == A_HELLO:
             # Link setup from a topo-aware peer: learn its zone, answer
@@ -1069,7 +1119,10 @@ class TcpTransport:
             except OSError:
                 pass
 
-    def _send_query_resp(self, conn: socket.socket, payload: bytes) -> None:
+    def _send_query_resp(
+        self, conn: socket.socket, payload: bytes,
+        qid: Optional[bytes] = None,
+    ) -> None:
         """Answer one `{query, Payload}` via the installed serve plane.
         Degrade-never-hang, exactly like `_send_metrics_resp`: a handler
         failure (including an injected `serve.query` fault) or the
@@ -1094,6 +1147,8 @@ class TcpTransport:
             return
         frame = pack_frame(
             (A_QUERY_RESP, self.member.encode("utf-8"), resp)
+            if qid is None
+            else (A_QUERY_RESP, self.member.encode("utf-8"), resp, qid)
         )
         try:
             if faults.ACTIVE and faults.fire("tcp.send") == "drop":

@@ -502,6 +502,129 @@ def certify(
     return doc
 
 
+# -- session-guarantee certification -----------------------------------------
+
+
+SESSION_CERTIFICATE_KIND = "ccrdt-session-certificate"
+SESSION_CERTIFICATE_VERSION = 1
+
+
+def certify_sessions(
+    obs_dir: Optional[str] = None,
+    logs: Optional[Dict[str, List[Dict[str, Any]]]] = None,
+    meta: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Replay the flight log's ``session.write`` / ``session.read``
+    events and certify the read tier's two session guarantees — the
+    replication-aware-spec replay idea of arxiv 2502.19967 applied to
+    the session taxonomy of arxiv 2310.18220:
+
+    * **read-your-writes**: every read in a session must be served with
+      watermarks covering every (origin, wseq) the session wrote
+      BEFORE it — replayed as a running per-origin write floor;
+    * **monotonic-reads**: every read must cover the pointwise max of
+      the watermarks every earlier read in the session observed.
+
+    The floors are recomputed here independently from the raw events —
+    the router's in-flight `require` stamps are NOT trusted (a router
+    in ``session_mode="ignore"``, the deliberately-violating arm, still
+    records truthful writes/reads, and this replay is what convicts it).
+    Events are ordered per session by (log file, recorder seq): a
+    session lives in one process, so the process-local recorder order
+    IS its program order.
+
+    Returns a signed certificate (`sign_certificate`); on violation,
+    `ok` is False and `counterexample` names the minimal first offense
+    per guarantee: session, peer, origin, and the [have, want] seq
+    range."""
+    if logs is None:
+        logs = obs_events.scan_dir(obs_dir) if obs_dir else {}
+    # Gather each session's events in replay order.
+    per_session: Dict[str, List[Tuple[str, int, Dict[str, Any]]]] = {}
+    for fname in sorted(logs):
+        for ev in logs[fname]:
+            k = ev.get("kind")
+            if k not in ("session.write", "session.read"):
+                continue
+            sid = str(ev.get("session"))
+            per_session.setdefault(sid, []).append(
+                (fname, int(ev.get("seq", 0)), ev)
+            )
+    violations: List[Dict[str, Any]] = []
+    n_reads = n_writes = 0
+    for sid in sorted(per_session):
+        evs = sorted(per_session[sid], key=lambda x: (x[0], x[1]))
+        wfloor: Dict[str, int] = {}  # writes this session has seen land
+        rfloor: Dict[str, int] = {}  # watermarks earlier reads observed
+        for _f, _s, ev in evs:
+            if ev["kind"] == "session.write":
+                n_writes += 1
+                o = str(ev.get("origin"))
+                w = int(ev.get("wseq", -1))
+                if w > wfloor.get(o, -1):
+                    wfloor[o] = w
+                continue
+            n_reads += 1
+            served = {
+                str(o): int(s)
+                for o, s in (ev.get("served") or {}).items()
+            }
+            checks = []
+            if ev.get("rw", True):
+                checks.append(("read_your_writes", wfloor))
+            if ev.get("mono", True):
+                checks.append(("monotonic_reads", rfloor))
+            for guarantee, floor in checks:
+                for o, want in floor.items():
+                    have = int(served.get(o, -1))
+                    if have < want:
+                        violations.append({
+                            "guarantee": guarantee,
+                            "session": sid,
+                            "peer": str(ev.get("peer")),
+                            "origin": o,
+                            "have": have,
+                            "want": want,
+                        })
+            if ev.get("mono", True):
+                for o, s in served.items():
+                    if s > rfloor.get(o, -1):
+                        rfloor[o] = s
+    by_guarantee = {
+        g: [v for v in violations if v["guarantee"] == g]
+        for g in ("read_your_writes", "monotonic_reads")
+    }
+    checks = {g: not vs for g, vs in by_guarantee.items()}
+    ok = all(checks.values())
+    doc: Dict[str, Any] = {
+        "kind": SESSION_CERTIFICATE_KIND,
+        "version": SESSION_CERTIFICATE_VERSION,
+        "t": round(time.time(), 3),
+        "ok": ok,
+        "checks": checks,
+        "n_sessions": len(per_session),
+        "n_reads": n_reads,
+        "n_writes": n_writes,
+        "n_violations": len(violations),
+        "n_flight_logs": len(logs),
+        "meta": meta or {},
+    }
+    if not ok:
+        # The minimal counterexample: the FIRST violation per guarantee
+        # (replay order), enough to name the offending token scope.
+        doc["counterexample"] = {
+            g: vs[0] for g, vs in by_guarantee.items() if vs
+        }
+        doc["violations"] = violations[:16]
+    sign_certificate(doc)
+    obs_events.emit(
+        "audit.session_certificate", ok=ok,
+        n_violations=len(violations),
+        signature=doc["signature"][:16],
+    )
+    return doc
+
+
 # -- lattice-law checking ----------------------------------------------------
 
 

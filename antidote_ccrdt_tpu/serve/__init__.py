@@ -27,8 +27,16 @@ from typing import Any, Optional
 
 from .cache import HotKeyCache
 from .kernels import SnapshotView, answer, answer_one, materialize, query_key
-from .plane import Overloaded, ServePlane, encode, request_bytes
+from .plane import (
+    Overloaded,
+    ServePlane,
+    SessionUncovered,
+    encode,
+    request_bytes,
+)
 from .replica import ReadReplica, Snapshot
+from .router import CircuitBreaker, FleetRouter, tcp_query_fn
+from .session import ClientSession, SessionToken, covers, session_doc
 
 ENV_FLAG = "CCRDT_SERVE"
 
@@ -36,19 +44,27 @@ _FALSE = {"", "0", "false", "no", "off"}
 
 __all__ = [
     "ENV_FLAG",
+    "CircuitBreaker",
+    "ClientSession",
+    "FleetRouter",
     "HotKeyCache",
     "Overloaded",
     "ReadReplica",
     "ServePlane",
+    "SessionToken",
+    "SessionUncovered",
     "Snapshot",
     "SnapshotView",
     "answer",
     "answer_one",
+    "covers",
     "encode",
     "install_from_env",
     "materialize",
     "query_key",
     "request_bytes",
+    "session_doc",
+    "tcp_query_fn",
 ]
 
 

@@ -28,10 +28,27 @@ identical bytes on the TCP frame, the bridge op, and POST /query.
 
 Request:  ``{"queries": [{"op": "value"|"topk"|"range", "key": int,
             "k"?: int, "lo"?: int, "hi"?: int}, ...],
-            "max_staleness_s"?: float}``
+            "max_staleness_s"?: float,
+            "session"?: {origin: seq}}``
 Response: ``{"member": str, "n": int, "results": [
             {"value": ..., "as_of_seq": int, "staleness_bound_s": float}
-            | {"error": ...}, ...]}``
+            | {"error": ...}, ...],
+            "watermarks": {origin: seq}}``
+
+The ``watermarks`` field is the session-guarantee carrier: the
+per-origin applied seqs of the snapshot the answers came from (captured
+at swap time from `obs/lag.py`, conservatively the OLDEST snapshot any
+result in the batch used). A request's ``session`` token — a
+``{origin: seq}`` floor from `serve.session` — is enforced here as the
+last line of defense: if the live snapshot's watermarks don't cover the
+token, the plane answers ``session_uncovered`` (with its watermarks, so
+the router learns how far behind this replica is) rather than serving a
+token-violating value. Shed (``overloaded``) responses carry a
+``retry_after_ms`` hint derived from current queue depth over the
+drain-rate EWMA, and `handle` takes a ``surface`` label ("tcp" /
+"bridge" / "http" / ...) so sheds are countable per surface
+(``serve.queue_shed.<surface>``) without breaking the byte-identity
+contract (the label never enters the response).
 
 `utils.faults` point ``serve.query`` fires at the top of `handle` on
 every surface, so injected stalls/raises exercise each listener's own
@@ -54,10 +71,27 @@ from ..utils.metrics import Metrics
 from . import kernels
 from .cache import HotKeyCache
 from .replica import ReadReplica
+from .session import gaps as session_gaps
 
 
 class Overloaded(RuntimeError):
-    """The bounded query queue is full; the caller is shed."""
+    """The bounded query queue is full; the caller is shed. Carries the
+    `retry_after_ms` hint the shed response propagates fleet-wide."""
+
+    def __init__(self, msg: str, retry_after_ms: int = 50):
+        super().__init__(msg)
+        self.retry_after_ms = int(retry_after_ms)
+
+
+class SessionUncovered(RuntimeError):
+    """The live snapshot's applied watermarks don't cover the request's
+    session token — answering would violate the client's session
+    guarantee. Carries this replica's watermarks so the router can
+    learn and route elsewhere."""
+
+    def __init__(self, msg: str, watermarks: Dict[str, int]):
+        super().__init__(msg)
+        self.watermarks = dict(watermarks)
 
 
 def encode(doc: Dict[str, Any]) -> bytes:
@@ -69,11 +103,15 @@ def encode(doc: Dict[str, Any]) -> bytes:
 
 
 def request_bytes(
-    queries: List[Dict[str, Any]], max_staleness_s: Optional[float] = None
+    queries: List[Dict[str, Any]],
+    max_staleness_s: Optional[float] = None,
+    session: Optional[Dict[str, int]] = None,
 ) -> bytes:
     doc: Dict[str, Any] = {"queries": list(queries)}
     if max_staleness_s is not None:
         doc["max_staleness_s"] = float(max_staleness_s)
+    if session:
+        doc["session"] = {str(o): int(s) for o, s in session.items()}
     return encode(doc)
 
 
@@ -84,14 +122,26 @@ def _ceil6(x: float) -> float:
 
 
 class _Pending:
-    __slots__ = ("queries", "max_staleness", "done", "results", "error")
+    __slots__ = (
+        "queries", "max_staleness", "session", "done", "results", "error",
+        "watermarks",
+    )
 
-    def __init__(self, queries: List[Dict[str, Any]], max_staleness: Optional[float]):
+    def __init__(
+        self,
+        queries: List[Dict[str, Any]],
+        max_staleness: Optional[float],
+        session: Optional[Dict[str, int]] = None,
+    ):
         self.queries = queries
         self.max_staleness = max_staleness
+        self.session = session
         self.done = False
         self.results: Optional[List[Any]] = None
         self.error: Optional[BaseException] = None
+        # The applied-watermark claim for THIS caller's results: the wm
+        # of the oldest snapshot any of its answers came from.
+        self.watermarks: Optional[Dict[str, int]] = None
 
 
 class _Batcher:
@@ -110,16 +160,29 @@ class _Batcher:
         self._cv = threading.Condition()
         self._pending: List[_Pending] = []
         self._busy = False
+        # Drain-rate EWMA (queries/s) behind the shed retry_after hint.
+        self._drain_rate = 0.0
+
+    def retry_after_ms(self, depth: int) -> int:
+        """How long a shed caller should wait before retrying: the time
+        the current backlog takes to drain at the observed rate, clamped
+        to [1ms, 5s]. Before any drain has been timed, a flat 50ms."""
+        rate = self._drain_rate
+        if rate <= 0.0:
+            return 50
+        return max(1, min(5000, int(1000.0 * depth / rate)))
 
     def run(self, queries: List[Dict[str, Any]],
-            max_staleness: Optional[float]) -> List[Any]:
-        p = _Pending(queries, max_staleness)
+            max_staleness: Optional[float],
+            session: Optional[Dict[str, int]] = None) -> _Pending:
+        p = _Pending(queries, max_staleness, session)
         with self._cv:
             depth = sum(len(x.queries) for x in self._pending)
             if depth + len(queries) > self.queue_max:
                 self.metrics.count("serve.queue_shed")
                 raise Overloaded(
-                    f"query queue full ({depth}+{len(queries)} > {self.queue_max})"
+                    f"query queue full ({depth}+{len(queries)} > {self.queue_max})",
+                    retry_after_ms=self.retry_after_ms(depth + len(queries)),
                 )
             self._pending.append(p)
             while not p.done and self._busy:
@@ -128,8 +191,16 @@ class _Batcher:
                 self._busy = True
                 batch, self._pending = self._pending, []
         if not p.done:
+            t0 = time.perf_counter()
             try:
                 self._exec(batch)
+                dt = time.perf_counter() - t0
+                if dt > 0:
+                    inst = sum(len(x.queries) for x in batch) / dt
+                    self._drain_rate = (
+                        inst if self._drain_rate == 0.0
+                        else 0.8 * self._drain_rate + 0.2 * inst
+                    )
             finally:
                 # A drainer that died mid-batch must not strand followers.
                 for x in batch:
@@ -141,7 +212,7 @@ class _Batcher:
                     self._cv.notify_all()
         if p.error is not None:
             raise p.error
-        return p.results or []
+        return p
 
 
 class ServePlane:
@@ -173,10 +244,13 @@ class ServePlane:
         self.replica = ReadReplica(metrics=self.metrics, mono=mono)
         self.cache = HotKeyCache(cap=cache_cap, metrics=self.metrics)
         self.meta_keep = max(1, int(meta_keep))
-        # seq -> (swap_mono, lag_bound_s): the staleness pedigree window
-        # cached answers are bounded against. Guarded: swap() runs on the
-        # round thread, _bound() on whichever listener thread drains.
-        self._meta: "OrderedDict[int, Tuple[float, float]]" = OrderedDict()
+        # seq -> (swap_mono, lag_bound_s, watermarks): the staleness +
+        # session pedigree window cached answers are bounded against.
+        # Guarded: swap() runs on the round thread, _bound() /
+        # _watermarks_at() on whichever listener thread drains.
+        self._meta: "OrderedDict[int, Tuple[float, float, Dict[str, int]]]" = (
+            OrderedDict()
+        )
         self._meta_lock = threading.Lock()
         self._batcher = _Batcher(self._exec_batch, queue_max, self.metrics)
 
@@ -194,15 +268,30 @@ class ServePlane:
             (r["lag_s"] + r["staleness_s"] for r in rep.values()), default=0.0
         )
 
+    def applied_watermarks(self, seq: int) -> Dict[str, int]:
+        """The per-origin applied watermarks a snapshot at `seq` covers:
+        this worker's own stream through `seq`, plus — via the lag
+        tracker — each peer's stream through what has been applied
+        locally. This is the session-token coverage claim responses
+        carry."""
+        wm: Dict[str, int] = {self.member: int(seq)}
+        lt = self.lag_tracker
+        if lt is not None:
+            for peer, r in lt.report().items():
+                wm[str(peer)] = int(r.get("applied", -1))
+        return wm
+
     def swap(self, state: Any, seq: int) -> None:
         """Publish-boundary hook: snapshot `state` as the live read
-        replica at `seq`, stamped with the current lag bound."""
+        replica at `seq`, stamped with the current lag bound and the
+        applied watermarks (the session pedigree)."""
         resolve = None
         if self.pager is not None and self.pager.has_cold():
             resolve = self.pager.full_state
         snap = self.replica.swap(state, seq, self.lag_bound_s(), resolve=resolve)
+        wm = self.applied_watermarks(snap.seq)
         with self._meta_lock:
-            self._meta[snap.seq] = (snap.swap_mono, snap.lag_bound_s)
+            self._meta[snap.seq] = (snap.swap_mono, snap.lag_bound_s, wm)
             while len(self._meta) > self.meta_keep:
                 self._meta.popitem(last=False)
             horizon = min(self._meta)
@@ -210,9 +299,10 @@ class ServePlane:
 
     # -- read side: listener threads ----------------------------------------
 
-    def handle(self, raw: bytes) -> bytes:
+    def handle(self, raw: bytes, surface: str = "local") -> bytes:
         """The one entry point every wire surface calls; response bytes
-        are carried verbatim (byte-identical across surfaces)."""
+        are carried verbatim (byte-identical across surfaces — `surface`
+        only labels shed metrics, it never enters the response)."""
         if faults.ACTIVE:
             faults.fire("serve.query")  # injected stall/raise per surface
         t0 = time.perf_counter()
@@ -226,32 +316,62 @@ class ServePlane:
                 raise ValueError("queries must be a list of objects")
             ms = req.get("max_staleness_s")
             ms = None if ms is None else float(ms)
+            sess = req.get("session")
+            if sess is not None:
+                if not isinstance(sess, dict):
+                    raise ValueError("session must be an {origin: seq} object")
+                sess = {str(o): int(s) for o, s in sess.items()}
         except Exception as e:  # noqa: BLE001 — malformed input degrades
             self.metrics.count("serve.errors")
             return encode({"member": self.member, "error": f"bad request: {e}"})
         try:
-            results = self._batcher.run(queries, ms)
+            p = self._batcher.run(queries, ms, sess)
         except Overloaded as e:
-            return encode({"member": self.member, "error": f"overloaded: {e}"})
+            self.metrics.count(f"serve.queue_shed.{surface}")
+            return encode({
+                "member": self.member, "error": f"overloaded: {e}",
+                "retry_after_ms": e.retry_after_ms,
+            })
+        except SessionUncovered as e:
+            # Honest refusal: serving would violate the session token.
+            # The watermarks tell the router exactly how far behind we
+            # are so it can route (or wait) intelligently.
+            self.metrics.count("serve.session_uncovered")
+            return encode({
+                "member": self.member, "error": f"session_uncovered: {e}",
+                "watermarks": e.watermarks,
+            })
         except Exception as e:  # noqa: BLE001 — the batch never hangs a caller
             self.metrics.count("serve.errors")
             return encode({"member": self.member, "error": str(e)})
+        results = p.results or []
         self.metrics.merge(
             {"latencies": {"serve.read": [time.perf_counter() - t0]}}
         )
         obs_events.emit("serve.query", n=len(queries), max_staleness_s=ms)
-        return encode(
-            {"member": self.member, "n": len(results), "results": results}
-        )
+        doc: Dict[str, Any] = {
+            "member": self.member, "n": len(results), "results": results,
+        }
+        if p.watermarks is not None:
+            doc["watermarks"] = p.watermarks
+        return encode(doc)
+
+    def handler_for(self, surface: str) -> Callable[[bytes], bytes]:
+        """A `handle` bound to a surface label — what `install_serve`
+        sites register so sheds are attributable per surface."""
+        return lambda raw: self.handle(raw, surface=surface)
 
     def query(
         self,
         queries: List[Dict[str, Any]],
         max_staleness_s: Optional[float] = None,
+        session: Optional[Dict[str, int]] = None,
     ) -> Dict[str, Any]:
         """In-process convenience: encode, handle, decode."""
         return json.loads(
-            self.handle(request_bytes(queries, max_staleness_s)).decode("utf-8")
+            self.handle(
+                request_bytes(queries, max_staleness_s, session)
+            ).decode("utf-8")
         )
 
     # -- batch execution (single drainer at a time) --------------------------
@@ -261,18 +381,52 @@ class ServePlane:
             meta = self._meta.get(seq)
         if meta is None:
             return None
-        swap_mono, lag_bound = meta
+        swap_mono, lag_bound = meta[0], meta[1]
         return (self.mono() - swap_mono) + lag_bound
+
+    def _watermarks_at(self, seq: int) -> Optional[Dict[str, int]]:
+        with self._meta_lock:
+            meta = self._meta.get(seq)
+        return dict(meta[2]) if meta is not None else None
 
     def _exec_batch(self, batch: List[_Pending]) -> None:
         nq = sum(len(p.queries) for p in batch)
         self.metrics.count("serve.batches")
         self.metrics.count("serve.queries", nq)
         live = self.replica.live()
+        live_wm = (
+            self._watermarks_at(live.seq) if live is not None else None
+        )
+        if live_wm is None and live is not None:
+            live_wm = self.applied_watermarks(live.seq)
         bounds: List[float] = []
         for p in batch:
-            p.results = [self._one(q, p.max_staleness, live, bounds)
+            if p.session:
+                gp = session_gaps(live_wm or {}, p.session)
+                if gp:
+                    origin, (have, want) = next(iter(sorted(gp.items())))
+                    p.error = SessionUncovered(
+                        f"{origin} applied {have} < required {want}",
+                        live_wm or {},
+                    )
+                    p.done = True
+                    continue
+            seqs: List[int] = []
+            p.results = [self._one(q, p.max_staleness, live, bounds, seqs,
+                                   p.session)
                          for q in p.queries]
+            # The response-level coverage claim must hold for EVERY
+            # result, so it is the wm of the OLDEST snapshot used —
+            # watermarks are monotone in seq, so that is the pointwise
+            # minimum (conservative for the rest).
+            if seqs:
+                p.watermarks = self._watermarks_at(min(seqs))
+                if p.watermarks is None:
+                    # Pedigree raced out of the window: claim only what
+                    # is true by construction — our own stream.
+                    p.watermarks = {self.member: int(min(seqs))}
+            elif live_wm is not None:
+                p.watermarks = dict(live_wm)
             p.done = True
         if bounds:
             self.metrics.merge({"latencies": {"serve.staleness_bound": bounds}})
@@ -283,6 +437,8 @@ class ServePlane:
         ms: Optional[float],
         live: Any,
         bounds: List[float],
+        seqs: Optional[List[int]] = None,
+        session: Optional[Dict[str, int]] = None,
     ) -> Dict[str, Any]:
         try:
             kq = kernels.query_key(q)
@@ -303,9 +459,17 @@ class ServePlane:
                     if ms is not None
                     else (live is None or seq == live.seq)
                 )
+                if ok and session and not (live is None or seq == live.seq):
+                    # An aged cached answer must ALSO cover the session
+                    # token at ITS OWN snapshot — the batch-level check
+                    # only vouched for the live one.
+                    wm = self._watermarks_at(seq)
+                    ok = wm is not None and not session_gaps(wm, session)
                 if ok:
                     self.metrics.count("serve.cache_hits")
                     bounds.append(b6)
+                    if seqs is not None:
+                        seqs.append(int(seq))
                     return {"value": val, "as_of_seq": seq,
                             "staleness_bound_s": b6}
         # Fall through to the fresh replica.
@@ -335,6 +499,8 @@ class ServePlane:
         self._note_access(q, val)
         self.cache.put(kq, val, live.seq)
         bounds.append(b6)
+        if seqs is not None:
+            seqs.append(int(live.seq))
         return {"value": val, "as_of_seq": live.seq, "staleness_bound_s": b6}
 
     def _note_access(self, q: Dict[str, Any], val: Any) -> None:

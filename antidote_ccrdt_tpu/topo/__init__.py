@@ -32,7 +32,7 @@ full-mesh baseline digest by tests/test_topo_chaos.py and
 This package must not import from `net/` (the transports import us).
 """
 
-from .anchor import anchor_rank, rendezvous_anchor
+from .anchor import anchor_rank, rendezvous_anchor, rendezvous_order
 from .codec import (
     CODEC_RAW,
     CODEC_ZLIB,
@@ -50,6 +50,7 @@ __all__ = [
     "zone_from_env",
     "anchor_rank",
     "rendezvous_anchor",
+    "rendezvous_order",
     "ZoneRouter",
     "CODEC_RAW",
     "CODEC_ZLIB",

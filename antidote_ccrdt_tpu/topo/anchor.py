@@ -24,7 +24,7 @@ and stable across processes/runs (unlike `hash()`, which is salted).
 from __future__ import annotations
 
 import hashlib
-from typing import Iterable, Optional, Tuple
+from typing import Iterable, List, Optional, Tuple
 
 
 def anchor_rank(zone: str, member: str) -> Tuple[int, str]:
@@ -46,3 +46,16 @@ def rendezvous_anchor(zone: str, members: Iterable[str]) -> Optional[str]:
         if best_rank is None or r > best_rank:
             best, best_rank = m, r
     return best
+
+
+def rendezvous_order(key: str, members: Iterable[str]) -> List[str]:
+    """The FULL rendezvous preference list for `key`: members sorted by
+    descending HRW rank (so ``rendezvous_order(k, ms)[0] ==
+    rendezvous_anchor(k, ms)``). This is the shared candidate ordering
+    the serve-plane fleet router (`serve/router.py`) walks on failover:
+    every client computes the same list from the same member set, and
+    removing a dead candidate never reorders the survivors — the
+    stability rendezvous hashing buys the anchor election buys query
+    affinity too (the same key keeps hitting the same replica's hot-key
+    cache until that replica actually dies)."""
+    return sorted(members, key=lambda m: anchor_rank(key, m), reverse=True)

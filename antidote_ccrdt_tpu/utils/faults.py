@@ -16,6 +16,9 @@ until a plan is installed. The canonical points:
     wal.fsync                WriteAheadLog record fsync
     ckpt.replace             checkpoint/WAL atomic-replace commit
     pager.hydrate            out-of-core partition page-in (core/pager.py)
+    router.route             fleet-router per-attempt routing decision
+                             (serve/router.py: drop == connection loss,
+                             raise == attempt failure, delay == stall)
 
 (Any other dotted name works — the registry is generic; these are the
 wired ones.)

@@ -880,6 +880,89 @@ def bench_serve(frames=400, batch=512):
     }
 
 
+def bench_read_tier(frames=300, batch=64):
+    """Fleet read-tier microbench (serve/router.py).
+
+    Three in-process `ServePlane` replicas behind a `FleetRouter` with
+    direct dispatch (no sockets): measures what the ROUTING layer costs
+    on top of serving — HRW candidate choice, attempt threads, breaker
+    and watermark bookkeeping, the response re-decode — plus the
+    failover blip when one peer's SWIM verdict flips to dead mid-run
+    (the longest gap between consecutive successful responses around
+    the flip). Report-only in the committed details; the gated carrier
+    is READTIER_r*.json from scripts/read_tier_demo.py (real sockets,
+    real SIGKILL, chaos on)."""
+    import random
+
+    from antidote_ccrdt_tpu import serve as serve_mod
+    from antidote_ccrdt_tpu.harness.opgen import TopkRmvEffectGen, Workload
+    from antidote_ccrdt_tpu.models.topk_rmv_dense import make_dense
+    from antidote_ccrdt_tpu.utils.metrics import Metrics
+
+    R, I, D_DCS, K, M = 4, 256, 4, 8, 2
+    dense = make_dense(n_ids=I, n_dcs=D_DCS, size=K, slots_per_id=M)
+    gen = TopkRmvEffectGen(
+        Workload(n_replicas=R, n_ids=I, zipf_a=1.2, score_max=10_000, seed=3)
+    )
+    state = dense.init(n_replicas=R, n_keys=1)
+    for _ in range(4):
+        state, _ = dense.apply_ops(
+            state, gen.next_batch(64, 8), collect_dominated=False
+        )
+    members = ["b0", "b1", "b2"]
+    planes = {}
+    for m in members:
+        planes[m] = serve_mod.ServePlane(dense, member=m)
+        planes[m].swap(state, 0)
+    dead: set = set()
+
+    def qfn(peer, payload, timeout_s, cancel):
+        return planes[peer].handle(payload)
+
+    router = serve_mod.FleetRouter(
+        members, qfn, metrics=Metrics(), hedge=False, retries=1,
+        poll_s=0.0005,
+        verdict_fn=lambda p: "dead" if p in dead else "alive",
+    )
+    rng = random.Random(11)
+    qs = [{"op": "value", "key": 0} for _ in range(batch)]
+    router.query(qs, key="warm")  # warm: compiles the fold, fills the memo
+
+    from antidote_ccrdt_tpu.topo import rendezvous_order
+
+    victim = rendezvous_order("k0", members)[0]
+    lat = []
+    ok_t = []
+    flip_at = frames // 2
+    t_flip = None
+    t0 = time.perf_counter()
+    for i in range(frames):
+        if i == flip_at:
+            dead.add(victim)  # SWIM buries a replica mid-run
+            t_flip = time.perf_counter()
+        t = time.perf_counter()
+        out = router.query(qs, key=f"k{rng.randrange(16)}")
+        dt = time.perf_counter() - t
+        lat.append(dt)
+        if "peer" in out and "error" not in out:
+            ok_t.append(time.perf_counter())
+    total = time.perf_counter() - t0
+    lat.sort()
+    blip_ms = 0.0
+    if t_flip is not None and ok_t:
+        window = [t_flip] + [x for x in ok_t if x >= t_flip][:20]
+        gaps = [b - a for a, b in zip(window, window[1:])]
+        blip_ms = max(gaps) * 1e3 if gaps else 0.0
+    return {
+        "frames": frames,
+        "batch": batch,
+        "fleet_reads_per_sec": round(len(ok_t) * batch / total),
+        "read_p99_ms": round(lat[int(0.99 * (len(lat) - 1))] * 1e3, 3),
+        "failover_blip_ms": round(blip_ms, 3),
+        "killed": victim,
+    }
+
+
 def bench_partition_antientropy(P=8, resync_rounds=4):
     """Partition-plane anti-entropy microbench (core/partition.py).
 
@@ -1501,6 +1584,10 @@ def main():
     serving = bench_serve(
         frames=5 if os.environ.get("CCRDT_BENCH_TINY") else 400
     )
+    read_tier = bench_read_tier(
+        frames=5 if os.environ.get("CCRDT_BENCH_TINY") else 300,
+        batch=8 if os.environ.get("CCRDT_BENCH_TINY") else 64,
+    )
     audit_ov = bench_audit_overhead(
         rounds=4 if os.environ.get("CCRDT_BENCH_TINY") else 12,
         repeats=1 if os.environ.get("CCRDT_BENCH_TINY") else 3,
@@ -1551,6 +1638,11 @@ def main():
         # Read-serving plane microbench (bench_serve): same story — fixed
         # frame shape, two gated headline numbers on the summary line.
         "serve": serving,
+        # Fleet read-tier microbench (bench_read_tier): the routing
+        # layer's cost over direct serving + the in-process failover
+        # blip. Report-only: the gated carrier is READTIER_r*.json from
+        # scripts/read_tier_demo.py (bench_gate.evaluate_router).
+        "read_tier": read_tier,
         # Audit-plane overhead (bench_audit_overhead): what running
         # certified costs per gossip round; the gated headline pct rides
         # the summary line.

@@ -875,6 +875,129 @@ def evaluate_mesh(
     return code, "\n".join(lines)
 
 
+def load_router_rounds(
+    bench_dir: str,
+) -> List[Tuple[int, str, float, float, float]]:
+    """[(round_no, path, fleet_reads_per_sec, read_p99_ms,
+    failover_blip_ms)] for every ``READTIER_r<NN>.json`` carrier
+    committed by scripts/read_tier_demo.py. Carriers missing any of the
+    three metric keys are skipped, not zeros."""
+    out: List[Tuple[int, str, float, float, float]] = []
+    for p in sorted(glob.glob(os.path.join(bench_dir, "READTIER_r*.json"))):
+        m = re.search(r"READTIER_r(\d+)\.json$", os.path.basename(p))
+        if not m:
+            continue
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        keys = ("fleet_reads_per_sec", "read_p99_ms", "failover_blip_ms")
+        if not all(isinstance(doc.get(k), (int, float)) for k in keys):
+            continue
+        out.append((
+            int(m.group(1)), p,
+            float(doc["fleet_reads_per_sec"]),
+            float(doc["read_p99_ms"]),
+            float(doc["failover_blip_ms"]),
+        ))
+    out.sort(key=lambda r: r[0])
+    return out
+
+
+def evaluate_router(
+    rounds: List[Tuple[int, str, float, float, float]],
+    tolerance: float = 0.20,
+    reads_floor_abs: float = 2000.0,
+    p99_floor_ms: float = 2.0,
+    blip_floor_ms: float = 250.0,
+) -> Tuple[int, str]:
+    """(exit_code, verdict) for the fleet read tier over the READTIER
+    carriers — three claims with the shared double-threshold shape
+    (both the relative AND the absolute bar must trip; the drill runs
+    real sockets under seeded chaos, so single-run jitter is large):
+
+    * ``fleet_reads_per_sec`` must not FALL more than `tolerance`
+      relative and `reads_floor_abs` absolute under the best prior;
+    * ``read_p99_ms`` must not GROW more than `tolerance` and
+      `p99_floor_ms` over the best (lowest) prior — routing overhead
+      creeping into every read fails here;
+    * ``failover_blip_ms`` must not GROW more than `tolerance` and
+      `blip_floor_ms` over the best (lowest) prior — mid-query failover
+      sliding back toward timeout-waiting fails here.
+
+    Fewer than two carriers pass vacuously."""
+    if len(rounds) < 2:
+        return 0, (
+            f"router-gate: only {len(rounds)} round(s) carry the read-tier "
+            "metrics — nothing to compare, passing vacuously"
+        )
+    latest_n, _p, latest_rps, latest_p99, latest_blip = rounds[-1]
+    best_rps_n, best_rps = best_prior_carrier(rounds, 2, "max")
+    best_p99_n, best_p99 = best_prior_carrier(rounds, 3, "min")
+    best_blip_n, best_blip = best_prior_carrier(rounds, 4, "min")
+    code = 0
+    lines: List[str] = []
+
+    rps_floor = min(
+        best_rps * (1.0 - tolerance), best_rps - reads_floor_abs
+    )
+    verdict = (
+        f"router-gate: r{latest_n:02d} fleet_reads_per_sec = "
+        f"{latest_rps:,.0f} vs best prior r{best_rps_n:02d} = "
+        f"{best_rps:,.0f} (floor -{tolerance:.0%} and "
+        f"-{reads_floor_abs:,.0f}/s: {rps_floor:,.0f})"
+    )
+    if latest_rps < rps_floor:
+        code = 1
+        lines.append(
+            f"{verdict}\nFAIL: the routed fleet lost "
+            f"{best_rps - latest_rps:,.0f} reads/sec over the best "
+            "prior carrier"
+        )
+    else:
+        lines.append(f"{verdict}\nOK: within tolerance")
+
+    p99_ceiling = max(
+        best_p99 * (1.0 + tolerance), best_p99 + p99_floor_ms
+    )
+    verdict = (
+        f"router-gate: r{latest_n:02d} read_p99_ms = {latest_p99:.3f} "
+        f"vs best prior r{best_p99_n:02d} = {best_p99:.3f} "
+        f"(ceiling +{tolerance:.0%} and +{p99_floor_ms}ms: "
+        f"{p99_ceiling:.3f})"
+    )
+    if latest_p99 > p99_ceiling:
+        code = 1
+        lines.append(
+            f"{verdict}\nFAIL: the routed read tail slowed "
+            f"{latest_p99 - best_p99:+.3f}ms — routing overhead is "
+            "leaking into every read"
+        )
+    else:
+        lines.append(f"{verdict}\nOK: within tolerance")
+
+    blip_ceiling = max(
+        best_blip * (1.0 + tolerance), best_blip + blip_floor_ms
+    )
+    verdict = (
+        f"router-gate: r{latest_n:02d} failover_blip_ms = "
+        f"{latest_blip:,.0f} vs best prior r{best_blip_n:02d} = "
+        f"{best_blip:,.0f} (ceiling +{tolerance:.0%} and "
+        f"+{blip_floor_ms:.0f}ms: {blip_ceiling:,.0f})"
+    )
+    if latest_blip > blip_ceiling:
+        code = 1
+        lines.append(
+            f"{verdict}\nFAIL: the SIGKILL blip grew "
+            f"{latest_blip - best_blip:+,.0f}ms — mid-query failover is "
+            "regressing toward waiting out dead-peer timeouts"
+        )
+    else:
+        lines.append(f"{verdict}\nOK: within tolerance")
+    return code, "\n".join(lines)
+
+
 def attribution_drift(
     rounds: List[Tuple[int, str, float, float]]
 ) -> List[str]:
@@ -954,6 +1077,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"{mps:,.0f} merges/s, ici p50 {ici:.3f}ms, "
             f"cross-slice {byt:,.0f} B"
         )
+    rtr = load_router_rounds(args.bench_dir)
+    for n, p, rps, p99, blip in rtr:
+        print(
+            f"  router r{n:02d} {os.path.basename(p)}: "
+            f"{rps:,.0f} routed reads/s, p99 {p99:.1f}ms, "
+            f"failover blip {blip:,.0f}ms"
+        )
     pgr = load_pager_rounds(args.bench_dir)
     for n, p, hit, miss, cm in pgr:
         cm_note = f", {cm:,.0f} cold merges/s" if cm is not None else ""
@@ -989,8 +1119,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     print(mesh_verdict)
     pager_code, pager_verdict = evaluate_pager(pgr, args.tolerance)
     print(pager_verdict)
+    router_code, router_verdict = evaluate_router(rtr, args.tolerance)
+    print(router_verdict)
     return max(code, gap_code, part_code, serve_code, audit_code, wal_code,
-               mesh_code, pager_code)
+               mesh_code, pager_code, router_code)
 
 
 if __name__ == "__main__":

@@ -476,7 +476,7 @@ def run_worker(store, drill, dense, state, args, result_dir):
         store.metrics,
         args.member,
         addr_dir=result_dir,
-        query_handler=plane.handle if plane is not None else None,
+        query_handler=plane.handler_for("http") if plane is not None else None,
         health_extra=health_extra,
     )
     tr = getattr(store, "transport", None)

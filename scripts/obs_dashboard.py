@@ -236,6 +236,42 @@ def _fmt_audit(status: Optional[Dict[str, Any]]) -> str:
     return out
 
 
+# Same rate trick as _SERVE_PREV: the router's status drop carries
+# cumulative counters, so the renderer keeps the previous frame's
+# (time, router.queries) per member to show a routed-QPS rate.
+_ROUTER_PREV: Dict[str, Any] = {}
+
+
+def _fmt_router(status: Optional[Dict[str, Any]], member: str) -> str:
+    """Router column group (serve/router.py, from the obs-router.json
+    drop read_tier_demo publishes): routed query rate, per-peer breaker
+    state (only non-closed peers are listed — "ok" means every breaker
+    is closed), failovers, hedge rate, and session waits. "-" means no
+    router is publishing into this obs dir."""
+    rt = (status or {}).get("router") or {}
+    c = rt.get("counters") or {}
+    if not c:
+        return "-"
+    now = time.time()
+    q = float(c.get("router.queries", 0))
+    prev = _ROUTER_PREV.get(member)
+    _ROUTER_PREV[member] = (now, q)
+    qps = "-"
+    if prev and now > prev[0]:
+        qps = f"{max(0.0, (q - prev[1]) / (now - prev[0])):,.0f}"
+    brs = rt.get("breakers") or {}
+    tripped = " ".join(
+        f"{p}:{str(s)[:4]}" for p, s in sorted(brs.items()) if s != "closed"
+    )
+    hedges = float(c.get("router.hedges", 0))
+    hrate = f"{hedges / q:.0%}" if q else "-"
+    return (
+        f"q/s {qps} br {tripped or 'ok'} "
+        f"fo {int(c.get('router.failovers', 0))} hdg {hrate} "
+        f"sw {int(c.get('router.session_waits', 0))}"
+    )
+
+
 def render_frame(root: str, clear: bool = True) -> str:
     rows = scrape_root(root)
     lines = []
@@ -246,7 +282,7 @@ def render_frame(root: str, clear: bool = True) -> str:
         f"{'member':<10}{'zone':<6}{'hb-age':>8} {'state':<9}{'snap':>5} "
         f"{'delta-window':<14}{'wal m:last/dur':>14}  {'sendq':<16}"
         f"{'lag (peer:ops/secs)':<26}  {'serving':<34}  "
-        f"{'pager':<18}  {'audit'}"
+        f"{'pager':<18}  {'audit':<32}  {'router'}"
     )
     lines.append(hdr)
     lines.append("-" * len(hdr))
@@ -282,7 +318,7 @@ def render_frame(root: str, clear: bool = True) -> str:
             f"{window:<14}{_fmt_wal(st):>14}  "
             f"{_fmt_sendq(st):<16}{_fmt_lag(st):<26}  "
             f"{_fmt_serve(st, m):<34}  {_fmt_pager(st):<18}  "
-            f"{_fmt_audit(st)}"
+            f"{_fmt_audit(st):<32}  {_fmt_router(st, m)}"
         )
     return "\n".join(lines)
 

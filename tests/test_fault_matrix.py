@@ -276,3 +276,176 @@ def test_matrix_schedule_replays_bit_identically(tmp_path):
     assert t1 == t2
     assert len(t1) > 0
     assert {p for p, _, _ in t1} >= {"transport.publish", "wal.fsync"}
+
+
+# --- the fleet read tier ----------------------------------------------------
+
+
+def _drip_server(stop):
+    """A peer that ACCEPTS `{query}` frames and then drips unrelated
+    frames forever without ever answering — the failure mode that used
+    to defeat `query_peer`'s timeout (only connection-level faults
+    surfaced; steady inbound bytes kept the recv loop alive)."""
+    import socket
+    import threading
+    import time as _time
+
+    from antidote_ccrdt_tpu.bridge.protocol import pack_frame
+    from antidote_ccrdt_tpu.core.etf import Atom
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    srv.settimeout(0.1)
+    ping = pack_frame((Atom("ping"), b"drip", {}))
+
+    def loop():
+        conns = []
+        while not stop.is_set():
+            try:
+                c, _ = srv.accept()
+                c.settimeout(0.05)
+                conns.append(c)
+            except OSError:
+                pass
+            for c in list(conns):
+                try:
+                    c.recv(4096)
+                except socket.timeout:
+                    pass
+                except OSError:
+                    conns.remove(c)
+                    continue
+                try:
+                    c.sendall(ping)  # traffic, but never a query_resp
+                except OSError:
+                    conns.remove(c)
+            _time.sleep(0.02)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        srv.close()
+
+    import threading as _threading
+
+    t = _threading.Thread(target=loop, daemon=True)
+    t.start()
+    return srv.getsockname()
+
+
+def test_query_peer_deadline_fires_on_never_answering_peer():
+    """Satellite: a peer that accepts the query but never answers must
+    surface socket.timeout at the per-query deadline — even while it
+    keeps the connection busy with unrelated frames."""
+    import socket
+    import threading
+    import time as _time
+
+    from antidote_ccrdt_tpu.net.tcp import query_peer
+    from antidote_ccrdt_tpu.serve import request_bytes
+
+    stop = threading.Event()
+    addr = _drip_server(stop)
+    try:
+        t0 = _time.monotonic()
+        with pytest.raises(socket.timeout):
+            query_peer(addr, request_bytes([{"op": "value", "key": 0}]),
+                       timeout=0.4)
+        assert _time.monotonic() - t0 < 3.0  # deadline, not a hang
+    finally:
+        stop.set()
+
+
+def test_router_fails_over_from_never_answering_peer():
+    """The router consequence: the hung peer burns its per-query
+    deadline, the router bills a timeout and fails over to the healthy
+    HRW runner-up instead of hanging."""
+    import threading
+    import time as _time
+
+    from antidote_ccrdt_tpu.net.tcp import TcpTransport
+    from antidote_ccrdt_tpu.serve import request_bytes
+    from antidote_ccrdt_tpu.serve.router import FleetRouter, tcp_query_fn
+    from antidote_ccrdt_tpu.topo import rendezvous_order
+
+    from tests.test_serve_parity import _frozen_plane
+
+    stop = threading.Event()
+    drip_addr = _drip_server(stop)
+    plane = _frozen_plane()
+    # Warm the serve path (first query pays JIT/materialization) so the
+    # per-query deadline below measures the transport, not compilation.
+    plane.handle(request_bytes([{"op": "value", "key": 0}]))
+    t = TcpTransport("good")
+    t.install_serve(plane)
+    try:
+        addrs = {"hung": drip_addr, "good": t.address}
+        # Pick a key whose HRW head is the hung peer, so the test
+        # actually exercises failover (not first-try luck).
+        key = next(
+            k for k in (f"k{i}" for i in range(64))
+            if rendezvous_order(k, ["hung", "good"])[0] == "hung"
+        )
+        r = FleetRouter(
+            ["hung", "good"], tcp_query_fn(addrs), metrics=Metrics(),
+            hedge=False, timeout_s=0.4, retries=0, poll_s=0.01,
+        )
+        t0 = _time.monotonic()
+        out = r.query([{"op": "value", "key": 0}], key=key)
+        assert out.get("peer") == "good" and out["results"][0]["value"]
+        assert _time.monotonic() - t0 < 5.0
+        c = r.metrics.snapshot()["counters"]
+        # The timeout may surface either as the worker thread's own
+        # socket.timeout (peer_timeouts) or the router-side deadline
+        # (timeouts) depending on which poll fires first.
+        timeouts = c.get("router.timeouts", 0) + c.get("router.peer_timeouts", 0)
+        assert timeouts >= 1 and c["router.failovers"] >= 1
+    finally:
+        stop.set()
+        t.close()
+
+
+def test_router_route_drop_schedule_replays(tmp_path):
+    """router.route joins the matrix: an injected drop at the routing
+    point reroutes (same blast radius as connection loss) and the
+    seeded schedule replays bit-identically."""
+    import json as _json
+
+    from antidote_ccrdt_tpu.serve.router import FleetRouter
+
+    def resp(peer):
+        return (_json.dumps({
+            "member": peer, "n": 1,
+            "results": [{"value": 1, "as_of_seq": 1,
+                         "staleness_bound_s": 0.0}],
+        }) + "\n").encode()
+
+    plan = {"router.route": [{"action": "drop", "rate": 0.5}]}
+
+    def scenario():
+        r = FleetRouter(
+            ["a", "b", "c"],
+            lambda peer, payload, timeout, cancel: resp(peer),
+            metrics=Metrics(), hedge=False, retries=2,
+            backoff_base_s=0.0, poll_s=0.001,
+            # Drops are billed as connection failures; leave the breakers
+            # effectively disabled so the drill measures rerouting, not
+            # breaker lockout under a 50% drop rate.
+            breaker_failures=10**6,
+        )
+        answered = 0
+        for i in range(20):
+            out = r.query([{"op": "value", "key": i}], key=f"k{i}")
+            answered += 1 if "peer" in out else 0
+        return answered, faults.trace()
+
+    with faults.injected(plan, seed=2024):
+        a1, t1 = scenario()
+    with faults.injected(plan, seed=2024):
+        a2, t2 = scenario()
+    assert (a1, t1) == (a2, t2)
+    assert any(p == "router.route" and act == "drop" for p, _, act in t1)
+    assert a1 == 20  # drops reroute; every query still answers

@@ -1,0 +1,574 @@
+"""Unit + chaos tests for the fleet read tier (serve/router.py +
+serve/session.py): circuit-breaker transitions on a fake clock,
+SWIM-death-mid-query failover, hedged requests, shed propagation with
+retry-after hints, session-token routing/enforcement, the flight-log
+session certifier, and a seeded `net/sim.py` drill asserting
+deterministic replay and zero duplicate-answer divergence."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from antidote_ccrdt_tpu import serve
+from antidote_ccrdt_tpu.models.topk_rmv_dense import TopkRmvOps, make_dense
+from antidote_ccrdt_tpu.net.sim import SimNet
+from antidote_ccrdt_tpu.obs import audit
+from antidote_ccrdt_tpu.serve.router import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+    FleetRouter,
+)
+from antidote_ccrdt_tpu.serve.session import ClientSession, SessionToken, covers
+from antidote_ccrdt_tpu.topo import rendezvous_order
+from antidote_ccrdt_tpu.utils import faults
+from antidote_ccrdt_tpu.utils.metrics import Metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+def _fake_clock(t0=100.0):
+    cell = [t0]
+    return cell, (lambda: cell[0])
+
+
+def _resp(member, value=1, wm=None, **extra):
+    doc = {
+        "member": member, "n": 1,
+        "results": [{"value": value, "as_of_seq": 1,
+                     "staleness_bound_s": 0.0}],
+    }
+    if wm is not None:
+        doc["watermarks"] = wm
+    doc.update(extra)
+    return (json.dumps(doc, sort_keys=True, separators=(",", ":")) + "\n").encode()
+
+
+def _router(peers, query_fn, **kw):
+    kw.setdefault("hedge", False)
+    kw.setdefault("retries", 1)
+    kw.setdefault("timeout_s", 2.0)
+    kw.setdefault("backoff_base_s", 0.001)
+    kw.setdefault("poll_s", 0.001)
+    return FleetRouter(peers, query_fn, metrics=Metrics(), **kw)
+
+
+# --- circuit breaker --------------------------------------------------------
+
+
+def test_breaker_transitions_on_fake_clock():
+    cell, mono = _fake_clock()
+    br = CircuitBreaker(fail_threshold=3, cooldown_s=5.0, mono=mono)
+    assert br.state == CLOSED and br.allow()
+    assert not br.record_failure()
+    assert not br.record_failure()
+    assert br.record_failure()          # threshold crossed -> OPEN
+    assert br.state == OPEN and not br.allow()
+    cell[0] += 4.9
+    assert not br.allow()               # still cooling down
+    cell[0] += 0.2
+    assert br.state == HALF_OPEN
+    assert br.allow()                   # the single half-open probe
+    assert not br.allow()               # second probe refused
+    assert br.record_success()          # probe succeeded -> CLOSED
+    assert br.state == CLOSED and br.allow()
+    # A failed half-open probe re-opens immediately (no threshold).
+    for _ in range(3):
+        br.record_failure()
+    cell[0] += 5.1
+    assert br.allow()
+    assert br.record_failure()
+    assert br.state == OPEN and not br.allow()
+
+
+def test_consecutive_failures_only_successes_reset():
+    cell, mono = _fake_clock()
+    br = CircuitBreaker(fail_threshold=3, cooldown_s=5.0, mono=mono)
+    for _ in range(10):
+        br.record_failure()
+        br.record_failure()
+        br.record_success()             # never 3 in a row
+    assert br.state == CLOSED
+
+
+# --- candidate ordering -----------------------------------------------------
+
+
+def test_hrw_order_stable_under_removal():
+    members = [f"w{i}" for i in range(6)]
+    full = rendezvous_order("key-7", members)
+    survivors = [m for m in full if m != full[1]]
+    again = rendezvous_order("key-7", [m for m in members if m != full[1]])
+    assert again == survivors  # dead candidate never reorders the rest
+
+
+def test_route_skips_dead_open_breaker_and_demotes_stale():
+    order = rendezvous_order("k", ["a", "b", "c"])
+    verdicts = {order[0]: "dead"}
+    stale = {order[1]: 9.9}
+    r = _router(
+        ["a", "b", "c"], lambda *a: _resp("x"),
+        verdict_fn=lambda p: verdicts.get(p, "alive"),
+        staleness_fn=lambda p: stale.get(p, 0.0),
+        stale_soft_s=1.0,
+    )
+    got, starved = r.route("k")
+    # Dead head dropped; stale candidate demoted behind the fresh one.
+    assert got == [order[2], order[1]] and not starved
+    for _ in range(5):
+        r.breaker(order[2]).record_failure()
+    got2, _ = r.route("k")
+    assert got2 == [order[1]]  # open breaker skipped too
+
+
+# --- failover / retries / timeouts -----------------------------------------
+
+
+def test_failover_on_error_then_success():
+    order = rendezvous_order("k", ["a", "b", "c"])
+    calls = []
+
+    def qfn(peer, payload, timeout, cancel):
+        calls.append(peer)
+        if peer == order[0]:
+            raise ConnectionError("boom")
+        return _resp(peer, wm={})
+
+    r = _router(["a", "b", "c"], qfn)
+    out = r.query([{"op": "value", "key": 0}], key="k")
+    assert out["peer"] == order[1] and calls == order[:2]
+    c = r.metrics.snapshot()["counters"]
+    assert c["router.failovers"] == 1 and c["router.successes"] == 1
+
+
+def test_never_answering_peer_times_out_and_fails_over():
+    """Satellite: a peer that accepts the query but never answers must
+    surface a timeout to the router — which fails over, not hangs."""
+    order = rendezvous_order("k", ["a", "b"])
+    release = threading.Event()
+
+    def qfn(peer, payload, timeout, cancel):
+        if peer == order[0]:
+            # Hung peer: blocks until cancelled (never answers).
+            cancel.wait(timeout=10.0)
+            raise ConnectionError("cancelled")
+        return _resp(peer, wm={})
+
+    r = _router(["a", "b"], qfn, timeout_s=0.15, retries=0)
+    t0 = time.monotonic()
+    out = r.query([{"op": "value", "key": 0}], key="k")
+    assert out["peer"] == order[1]
+    assert time.monotonic() - t0 < 5.0  # bounded, not a hang
+    c = r.metrics.snapshot()["counters"]
+    assert c["router.timeouts"] >= 1 and c["router.failovers"] >= 1
+    release.set()
+
+
+def test_all_peers_down_returns_unavailable_not_hang():
+    def qfn(peer, payload, timeout, cancel):
+        raise ConnectionError("down")
+
+    r = _router(["a", "b"], qfn, retries=1)
+    out = r.query([{"op": "value", "key": 0}], key="k")
+    assert out["error"] == "unavailable"
+    c = r.metrics.snapshot()["counters"]
+    assert c["router.retries"] == 1 and c["router.exhausted"] == 1
+
+
+# --- SWIM death mid-query ---------------------------------------------------
+
+
+def test_dead_verdict_mid_query_cancels_and_reroutes():
+    order = rendezvous_order("k", ["a", "b"])
+    started = threading.Event()
+    cancelled = threading.Event()
+    dead = threading.Event()
+
+    def qfn(peer, payload, timeout, cancel):
+        if peer == order[0]:
+            started.set()
+            cancel.wait(timeout=10.0)
+            cancelled.set()
+            raise ConnectionError("peer died")
+        return _resp(peer, wm={})
+
+    def verdict(peer):
+        if peer == order[0] and dead.is_set():
+            return "dead"
+        return "alive"
+
+    def arm():
+        started.wait(timeout=5.0)
+        dead.set()  # SWIM confirms death while the query is in flight
+
+    threading.Thread(target=arm, daemon=True).start()
+    r = _router(["a", "b"], qfn, verdict_fn=verdict, timeout_s=5.0, retries=0)
+    t0 = time.monotonic()
+    out = r.query([{"op": "value", "key": 0}], key="k")
+    assert out["peer"] == order[1]
+    # Rerouted on the verdict, way before the 5s transport deadline.
+    assert time.monotonic() - t0 < 3.0
+    assert cancelled.wait(timeout=2.0)  # the in-flight loser was reaped
+    c = r.metrics.snapshot()["counters"]
+    assert c["router.dead_reroutes"] >= 1 and c["router.successes"] == 1
+
+
+# --- hedging ----------------------------------------------------------------
+
+
+def test_hedge_fires_on_slow_peer_and_wins():
+    order = rendezvous_order("k", ["a", "b"])
+
+    def qfn(peer, payload, timeout, cancel):
+        if peer == order[0]:
+            cancel.wait(timeout=1.0)  # slow primary
+            raise ConnectionError("cancelled")
+        return _resp(peer, wm={})
+
+    r = _router(
+        ["a", "b"], qfn, hedge=True, hedge_after_s=0.02,
+        timeout_s=3.0, retries=0,
+    )
+    out = r.query([{"op": "value", "key": 0}], key="k")
+    assert out["peer"] == order[1]
+    c = r.metrics.snapshot()["counters"]
+    assert c["router.hedges"] == 1 and c["router.hedge_wins"] == 1
+    assert "router.hedge_wasted" not in c
+
+
+def test_hedge_loser_billed_when_primary_wins():
+    order = rendezvous_order("k", ["a", "b"])
+    hedge_asked = threading.Event()
+
+    def qfn(peer, payload, timeout, cancel):
+        if peer == order[0]:
+            time.sleep(0.08)  # slow enough to trigger the hedge...
+            return _resp(peer, wm={})
+        hedge_asked.set()
+        cancel.wait(timeout=5.0)  # ...but the hedge is slower still
+        raise ConnectionError("cancelled")
+
+    r = _router(
+        ["a", "b"], qfn, hedge=True, hedge_after_s=0.02,
+        timeout_s=3.0, retries=0,
+    )
+    out = r.query([{"op": "value", "key": 0}], key="k")
+    assert out["peer"] == order[0]
+    assert hedge_asked.is_set()
+    c = r.metrics.snapshot()["counters"]
+    assert c["router.hedges"] == 1 and c["router.hedge_wasted"] == 1
+    assert "router.hedge_wins" not in c
+
+
+# --- admission control ------------------------------------------------------
+
+
+def test_fleet_wide_shed_propagates_retry_after():
+    def qfn(peer, payload, timeout, cancel):
+        return (json.dumps({
+            "member": peer, "error": "overloaded: queue full",
+            "retry_after_ms": 120 if peer == "a" else 40,
+        }) + "\n").encode()
+
+    r = _router(["a", "b"], qfn, retries=1)
+    out = r.query([{"op": "value", "key": 0}], key="k")
+    assert out["error"] == "overloaded"
+    assert out["retry_after_ms"] == 120  # the largest hint wins
+    c = r.metrics.snapshot()["counters"]
+    assert c["router.sheds"] >= 2 and c["router.shed_returns"] == 1
+    # Shedding is load, not sickness: breakers stay closed.
+    assert r.breaker("a").state == CLOSED
+
+
+# --- sessions ---------------------------------------------------------------
+
+
+def test_session_token_covers_and_merge():
+    t = SessionToken()
+    t.advance("w0", 5)
+    t.absorb({"w1": 3, "w0": 2})  # absorb never regresses
+    assert t.floor() == {"w0": 5, "w1": 3}
+    assert covers({"w0": 5, "w1": 3}, t.floor())
+    assert not covers({"w0": 4, "w1": 9}, t.floor())
+
+
+def test_router_routes_around_uncovered_peer():
+    order = rendezvous_order("k", ["a", "b"])
+    wm = {order[0]: {"w0": 1}, order[1]: {"w0": 9}}
+
+    def qfn(peer, payload, timeout, cancel):
+        req = json.loads(payload.decode())
+        tok = req.get("session") or {}
+        if not covers(wm[peer], tok):
+            return (json.dumps({
+                "member": peer,
+                "error": "session_uncovered: w0 behind",
+                "watermarks": wm[peer],
+            }) + "\n").encode()
+        return _resp(peer, wm=wm[peer])
+
+    r = _router(["a", "b"], qfn, retries=0)
+    sess = ClientSession("s-test")
+    sess.note_write("w0", 5)
+    out = r.query([{"op": "value", "key": 0}], key="k", session=sess)
+    assert out["peer"] == order[1]
+    # The rejection taught the router the stale peer's watermarks:
+    # the next query skips it at routing time.
+    assert r.peer_watermarks(order[0]) == {"w0": 1}
+    got, _ = r.route("k", sess.requirement())
+    assert got == [order[1]]
+
+
+def test_session_unsatisfiable_fails_honestly_with_gaps():
+    clock = [0.0]
+
+    def qfn(peer, payload, timeout, cancel):
+        return (json.dumps({
+            "member": peer, "error": "session_uncovered: behind",
+            "watermarks": {"w0": 2},
+        }) + "\n").encode()
+
+    r = _router(
+        ["a"], qfn, retries=0, session_wait_s=0.05, session_poll_s=0.01,
+    )
+    out = r.query(
+        [{"op": "value", "key": 0}], key="k", session={"w0": 10},
+    )
+    assert out["error"] == "session_unsatisfiable"
+    assert out["gaps"] == {"w0": {"have": 2, "want": 10}}
+    c = r.metrics.snapshot()["counters"]
+    assert c["router.session_waits"] >= 1
+    assert c["router.session_unsatisfiable"] == 1
+
+
+# --- the serve plane's side -------------------------------------------------
+
+R, NK, I, DCS, K, M, B, Br = 2, 1, 8, 2, 10, 2, 4, 2
+
+
+def _engine():
+    return make_dense(n_ids=I, n_dcs=DCS, size=K, slots_per_id=M)
+
+
+def _ops(ids, scores, replica=0, ts0=1):
+    a_key = np.zeros((R, B), np.int32)
+    a_id = np.zeros((R, B), np.int32)
+    a_score = np.zeros((R, B), np.int32)
+    a_dc = np.zeros((R, B), np.int32)
+    a_ts = np.zeros((R, B), np.int32)
+    a_id[replica, : len(ids)] = ids
+    a_score[replica, : len(ids)] = scores
+    a_ts[replica, : len(ids)] = np.arange(ts0, ts0 + len(ids))
+    return TopkRmvOps(
+        add_key=jnp.asarray(a_key), add_id=jnp.asarray(a_id),
+        add_score=jnp.asarray(a_score), add_dc=jnp.asarray(a_dc),
+        add_ts=jnp.asarray(a_ts),
+        rmv_key=jnp.zeros((R, Br), jnp.int32),
+        rmv_id=jnp.full((R, Br), -1, jnp.int32),
+        rmv_vc=jnp.zeros((R, Br, DCS), jnp.int32),
+    )
+
+
+class _FakeLag:
+    def __init__(self, applied):
+        self.applied = applied
+
+    def report(self):
+        return {
+            p: {"published": a, "applied": a, "lag_ops": 0,
+                "lag_s": 0.0, "staleness_s": 0.0}
+            for p, a in self.applied.items()
+        }
+
+
+def _plane(member, applied, seq=5, mono=None):
+    dense = _engine()
+    state, _ = dense.apply_ops(
+        dense.init(R, NK), _ops([1, 2], [50, 40]), collect_dominated=False
+    )
+    kw = {} if mono is None else {"mono": mono}
+    p = serve.ServePlane(
+        dense, member=member, lag_tracker=_FakeLag(applied), **kw
+    )
+    p.swap(state, seq)
+    return p
+
+
+def test_plane_responses_carry_applied_watermarks():
+    p = _plane("w0", {"w1": 7, "w2": 3})
+    doc = p.query([{"op": "value", "key": 0}])
+    assert doc["watermarks"] == {"w0": 5, "w1": 7, "w2": 3}
+
+
+def test_plane_enforces_session_token():
+    p = _plane("w0", {"w1": 2})
+    m = p.metrics
+    ok = p.query([{"op": "value", "key": 0}], session={"w1": 2})
+    assert "error" not in ok
+    bad = p.query([{"op": "value", "key": 0}], session={"w1": 8})
+    assert bad["error"].startswith("session_uncovered")
+    assert bad["watermarks"] == {"w0": 5, "w1": 2}
+    assert m.snapshot()["counters"]["serve.session_uncovered"] == 1
+
+
+def test_plane_shed_carries_retry_after_and_surface_label():
+    p = _plane("w0", {})
+    p._batcher.queue_max = 2
+    handler = p.handler_for("tcp")
+    raw = handler(serve.request_bytes(
+        [{"op": "value", "key": 0}] * 5
+    ))
+    doc = json.loads(raw.decode())
+    assert doc["error"].startswith("overloaded")
+    assert isinstance(doc["retry_after_ms"], int) and doc["retry_after_ms"] >= 1
+    c = p.metrics.snapshot()["counters"]
+    assert c["serve.queue_shed"] == 1
+    assert c["serve.queue_shed.tcp"] == 1
+
+
+# --- certification ----------------------------------------------------------
+
+
+def _w(seq, sid, origin, wseq):
+    return {"kind": "session.write", "seq": seq, "session": sid,
+            "origin": origin, "wseq": wseq}
+
+
+def _r(seq, sid, peer, served, rw=True, mono=True):
+    return {"kind": "session.read", "seq": seq, "session": sid,
+            "peer": peer, "served": served, "rw": rw, "mono": mono}
+
+
+def test_certify_sessions_clean_and_violating():
+    logs = {"local": [
+        # Clean session: write lands at w0:4, read served with w0:5.
+        _w(0, "clean", "w0", 4),
+        _r(1, "clean", "peer1", {"w0": 5}),
+        # Violating session: write at w0:9 but served only w0:2.
+        _w(2, "viol", "w0", 9),
+        _r(3, "viol", "peer2", {"w0": 2}),
+    ]}
+    cert = audit.certify_sessions(logs=logs)
+    assert not cert["ok"]
+    assert cert["checks"]["monotonic_reads"]
+    assert not cert["checks"]["read_your_writes"]
+    cx = cert["counterexample"]["read_your_writes"]
+    assert cx["session"] == "viol" and cx["peer"] == "peer2"
+    assert cx["origin"] == "w0" and (cx["have"], cx["want"]) == (2, 9)
+    assert audit.verify_certificate(cert)
+    cert["n_reads"] = 999
+    assert not audit.verify_certificate(cert)  # tamper-evident
+
+
+def test_certify_sessions_monotonic_reads_violation():
+    logs = {"local": [
+        _r(0, "mono", "p1", {"w0": 7}, rw=False),
+        _r(1, "mono", "p2", {"w0": 3}, rw=False),  # observes LESS
+    ]}
+    cert = audit.certify_sessions(logs=logs)
+    assert not cert["checks"]["monotonic_reads"]
+    cx = cert["counterexample"]["monotonic_reads"]
+    assert cx["peer"] == "p2" and (cx["have"], cx["want"]) == (3, 7)
+
+
+def test_client_session_events_feed_certifier():
+    """The live emit path: field names ClientSession writes are exactly
+    what certify_sessions replays (guards the recorder's seq-clobber
+    convention — writes carry `wseq`, never `seq`)."""
+    from antidote_ccrdt_tpu.obs import events as obs_events
+
+    s = ClientSession("rt-evt-clean")
+    s.note_write("o1", 3)
+    s.note_read("pX", {"o1": 3})
+    evs = [e for e in obs_events.events()
+           if e.get("session") == "rt-evt-clean"]
+    cert = audit.certify_sessions(logs={"x": evs})
+    assert cert["ok"] and cert["n_reads"] == 1 and cert["n_writes"] == 1
+
+
+# --- router.route fault point -----------------------------------------------
+
+
+def test_router_route_fault_point_fails_over_and_replays():
+    order = rendezvous_order("k", ["a", "b"])
+
+    def qfn(peer, payload, timeout, cancel):
+        return _resp(peer, wm={})
+
+    plan = {"router.route": [{"action": "raise", "at": [0]}]}
+    with faults.injected(plan, seed=7):
+        r = _router(["a", "b"], qfn, retries=0)
+        out = r.query([{"op": "value", "key": 0}], key="k")
+        trace1 = faults.trace()
+    assert out["peer"] == order[1]  # injected failure -> failover
+    with faults.injected(plan, seed=7):
+        r2 = _router(["a", "b"], qfn, retries=0)
+        r2.query([{"op": "value", "key": 0}], key="k")
+        trace2 = faults.trace()
+    assert trace1 == trace2 and trace1  # seeded schedule replays
+
+
+# --- seeded sim chaos drill -------------------------------------------------
+
+
+@pytest.mark.slow
+def test_sim_query_chaos_deterministic_replay_no_duplicate_divergence():
+    """The seeded net/sim drill: three serving members + one querier on
+    a lossy, duplicating medium. Two runs with the same seed must
+    produce byte-identical response streams; cancelled qids must never
+    surface an answer; duplicated deliveries must never produce two
+    DIFFERENT answers for one qid (zero duplicate-answer divergence)."""
+
+    def run(seed):
+        net = SimNet(seed=seed, latency=(0.001, 0.05), loss=0.15, dup=0.2)
+        servers = {}
+        for w in ("w0", "w1", "w2"):
+            tr = net.join(w)
+            plane = _plane(w, {}, seq=3, mono=(lambda: net.time))
+            tr.install_serve(plane)
+            servers[w] = tr
+        q = net.join("client")
+        divergence = []
+        seen = {}
+        cancelled = set()
+        for i in range(40):
+            qid = b"q%d" % i
+            payload = serve.request_bytes([{"op": "value", "key": 0}])
+            q.query(f"w{i % 3}", payload, qid=qid)
+            if i % 5 == 4:
+                q.cancel_query(qid)
+                cancelled.add(qid)
+            net.advance(0.03)
+            for k, v in q.query_results.items():
+                if k in seen and seen[k] != v:
+                    divergence.append(k)
+                seen[k] = v
+        net.advance(5.0)
+        for k, v in q.query_results.items():
+            if k in seen and seen[k] != v:
+                divergence.append(k)
+        return q, divergence, cancelled
+
+    q1, div1, cancelled = run(42)
+    q2, div2, _ = run(42)
+    # Deterministic replay: identical response streams, byte for byte.
+    assert q1.query_resps == q2.query_resps
+    assert q1.query_results == q2.query_results
+    # Zero duplicate-answer divergence despite dup=0.2.
+    assert div1 == [] and div2 == []
+    # Cancelled queries never surface an answer.
+    assert not (cancelled & set(q1.query_results))
+    counters = q1.net.metrics.snapshot()["counters"]
+    assert counters.get("net.sim_duplicated", 0) > 0  # chaos actually ran
+    assert counters.get("net.query_cancelled_drops", 0) >= 0

@@ -200,7 +200,11 @@ class SimTransport:
         # reaping a hedge loser / failed-over attempt, and what the
         # zero-duplicate-answer drill asserts on.
         self.query_results: Dict[bytes, Tuple[str, bytes]] = {}
-        self._query_cancelled: set = set()
+        # Insertion-ordered so the cap in `cancel_query` evicts the
+        # oldest cancellations first — it only needs to cover qids whose
+        # (possibly dup-delivered) responses can still be in flight, so
+        # long chaos drills don't accumulate spent qids forever.
+        self._query_cancelled: Dict[bytes, None] = {}
 
     def local_clock(self) -> float:
         """This member's view of time: virtual clock + its skew."""
@@ -241,9 +245,15 @@ class SimTransport:
     def cancel_query(self, qid: bytes) -> None:
         """Abandon an in-flight qid: its response, if it ever arrives,
         is dropped instead of delivered — the sim's router-cancellation
-        analog (a hedge loser must not surface a duplicate answer)."""
-        self._query_cancelled.add(bytes(qid))
-        self.query_results.pop(bytes(qid), None)
+        analog (a hedge loser must not surface a duplicate answer). The
+        set only needs to cover in-flight qids, so it is bounded: beyond
+        the cap the oldest cancellations (whose replies are long since
+        dropped or never coming) are forgotten."""
+        qid = bytes(qid)
+        self._query_cancelled[qid] = None
+        while len(self._query_cancelled) > 1024:
+            self._query_cancelled.pop(next(iter(self._query_cancelled)))
+        self.query_results.pop(qid, None)
 
     def install_router(self, timeout_s: float = 2.0) -> ZoneRouter:
         """Switch from full-mesh to the zone-aware topology, exactly as
@@ -535,7 +545,9 @@ class SimTransport:
             qid = bytes(msg[3]) if len(msg) > 4 else None
             if qid is not None and qid in self._query_cancelled:
                 # Cancelled in flight: the router already moved on; a
-                # late duplicate answer must not surface.
+                # late duplicate answer must not surface. Keep the qid
+                # in the (bounded) cancel set: a dup-delivered copy of
+                # this response may still be in flight behind it.
                 self.metrics.count("net.query_cancelled_drops")
             else:
                 self.query_resps.append((src, bytes(msg[2])))

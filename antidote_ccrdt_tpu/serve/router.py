@@ -106,7 +106,9 @@ class CircuitBreaker:
     def allow(self) -> bool:
         """May an attempt go to this peer now? While open: no. After the
         cooldown: exactly ONE in-flight probe (half-open) until it
-        reports success or failure."""
+        reports success or failure — or explicitly releases the slot.
+        RESERVES the probe slot: call only when the attempt actually
+        launches; eligibility filtering must use `would_allow()`."""
         with self._lock:
             if self._state == CLOSED:
                 return True
@@ -118,6 +120,29 @@ class CircuitBreaker:
                 return False
             self._probing = True
             return True
+
+    def would_allow(self) -> bool:
+        """Read-only eligibility: the same verdict `allow()` would give,
+        without reserving the half-open probe slot. `route()` filters
+        candidates with this — a candidate that is listed but never
+        actually tried must not consume (and leak) the probe."""
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN and (
+                self.mono() - self._opened_at < self.cooldown_s
+            ):
+                return False
+            return not self._probing
+
+    def release_probe(self) -> None:
+        """Give back a reserved half-open probe without a verdict — for
+        attempts that were cancelled or abandoned (a hedge loser reaped
+        undone at the deadline, a discarded answer from a SWIM-dead
+        peer). Without this the slot would leak and exclude the peer
+        from routing forever."""
+        with self._lock:
+            self._probing = False
 
     def record_success(self) -> bool:
         """Returns True iff this success CLOSED a non-closed breaker."""
@@ -327,7 +352,7 @@ class FleetRouter:
         for p in ordered:
             if self.verdict_fn is not None and self.verdict_fn(p) == "dead":
                 continue
-            if not self.breaker(p).allow():
+            if not self.breaker(p).would_allow():
                 continue
             if enforce:
                 wm = self.peer_watermarks(p)
@@ -547,6 +572,7 @@ class FleetRouter:
         hedge: Optional[_Attempt] = None
         deadline = primary.t0 + self.timeout_s
         hedge_at = self._hedge_at(peer, primary.t0, hedge_peer)
+        primary_dead = False
         while True:
             if primary.done.is_set() and (
                 primary.error is None or hedge is None or hedge.done.is_set()
@@ -560,21 +586,25 @@ class FleetRouter:
             if now >= deadline:
                 break
             if (
-                not primary.done.is_set()
+                not primary_dead
+                and not primary.done.is_set()
                 and self.verdict_fn is not None
                 and self.verdict_fn(peer) == "dead"
             ):
                 # SWIM buried the peer mid-query: stop waiting for it.
+                # One-shot (guarded by `primary_dead`): later poll ticks
+                # must not re-bill the same death.
+                primary_dead = True
                 primary.cancel.set()
                 self.metrics.count("router.dead_reroutes")
-                if hedge is None or hedge.done.is_set():
-                    if hedge is not None and hedge.done.is_set():
-                        return self._settle(primary, hedge, peer, dead=True)
+                if hedge is None:
                     self._fail(peer, TimeoutError("peer died mid-query"))
                     return ("fail", f"{peer} dead mid-query")
                 # A hedge is still running — let it finish out the deadline.
                 hedge_at = None
                 deadline = min(deadline, now + self.timeout_s)
+            if primary_dead and hedge is not None and hedge.done.is_set():
+                return self._settle(primary, hedge, peer, dead=True)
             if (
                 hedge is None
                 and hedge_at is not None
@@ -584,7 +614,7 @@ class FleetRouter:
                 self.metrics.count("router.hedges")
                 hedge = self._launch(hedge_peer, payload)  # type: ignore[arg-type]
             self.sleep(self.poll_s)
-        return self._settle(primary, hedge, peer)
+        return self._settle(primary, hedge, peer, dead=primary_dead)
 
     def _settle(
         self,
@@ -593,7 +623,10 @@ class FleetRouter:
         peer: str,
         dead: bool = False,
     ) -> Tuple[str, Any]:
-        """Pick the winner, cancel the loser, bill the hedge."""
+        """Pick the winner, cancel the loser, bill the hedge. Every
+        attempt that LAUNCHED resolves its breaker here — success,
+        failure, or an explicit `release_probe` for cancelled/undone
+        attempts — so a half-open probe reservation can never leak."""
         p_ok = primary.done.is_set() and primary.error is None
         h_ok = (
             hedge is not None and hedge.done.is_set() and hedge.error is None
@@ -602,12 +635,20 @@ class FleetRouter:
             if hedge is not None:
                 hedge.cancel.set()
                 self.metrics.count("router.hedge_wasted")
+                self._abandon(hedge)
             self._succeed(primary)
             return ("ok", (primary.result, primary.peer))
         if h_ok:
             primary.cancel.set()
-            if not dead:
-                self._fail(peer, primary.error or TimeoutError("hedged out"))
+            if p_ok:
+                # SWIM-dead primary raced an answer in anyway; we chose
+                # the hedge, so give back any probe the primary held
+                # rather than billing a failure for a discarded success.
+                self.breaker(peer).release_probe()
+            else:
+                self._fail(peer, primary.error or TimeoutError(
+                    "peer died mid-query" if dead else "hedged out"
+                ))
             self.metrics.count("router.hedge_wins")
             self._succeed(hedge)  # type: ignore[arg-type]
             return ("hedge_ok", (hedge.result, hedge.peer))  # type: ignore[union-attr]
@@ -615,16 +656,37 @@ class FleetRouter:
         primary.cancel.set()
         if hedge is not None:
             hedge.cancel.set()
-            if hedge.done.is_set() and hedge.error is not None:
-                self._fail(hedge.peer, hedge.error)
+            self._abandon(hedge)
         if primary.done.is_set() and primary.error is not None:
             self._fail(peer, primary.error)
             return ("fail", f"{peer}: {primary.error}")
+        if p_ok:
+            # (dead=True) The primary answered but SWIM buried it and no
+            # hedge won: discard the answer, give the probe slot back.
+            self.breaker(peer).release_probe()
+            return ("fail", f"{peer} dead mid-query")
         self.metrics.count("router.timeouts")
         self._fail(peer, TimeoutError("query deadline exceeded"))
         return ("fail", f"{peer}: timeout after {self.timeout_s}s")
 
+    def _abandon(self, att: _Attempt) -> None:
+        """Resolve the breaker for a cancelled/discarded attempt: bill
+        what actually happened, or — if it never finished — just release
+        the half-open probe slot it may be holding."""
+        if att.done.is_set():
+            if att.error is None:
+                self._succeed(att)
+            else:
+                self._fail(att.peer, att.error)
+        else:
+            self.breaker(att.peer).release_probe()
+
     def _launch(self, peer: str, payload: bytes) -> _Attempt:
+        # Reserve the half-open probe slot (if any) only now, when the
+        # attempt actually goes out — `route()` filtered read-only, so
+        # listed-but-untried candidates never consume it. `_settle`
+        # guarantees the reservation is resolved or released.
+        self.breaker(peer).allow()
         att = _Attempt(peer)
         att.t0 = self.mono()
 

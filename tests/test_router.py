@@ -92,6 +92,86 @@ def test_breaker_transitions_on_fake_clock():
     assert br.state == OPEN and not br.allow()
 
 
+def test_route_eligibility_is_read_only_on_half_open_probe():
+    """route() must filter with the read-only `would_allow()`: a
+    half-open peer that is listed but never actually tried must NOT
+    consume the probe slot, or one breaker-open would exclude the peer
+    from routing permanently."""
+    cell, mono = _fake_clock()
+    r = _router(["a", "b"], lambda *a: _resp("x"), mono=mono,
+                sleep=lambda s: None)
+    order = rendezvous_order("k", ["a", "b"])
+    peer = order[0]
+    for _ in range(5):
+        r.breaker(peer).record_failure()        # -> OPEN
+    cell[0] += 10.0                             # past cooldown -> HALF_OPEN
+    assert r.breaker(peer).state == HALF_OPEN
+    for _ in range(10):
+        got, _ = r.route("k")
+        assert peer in got                      # still eligible every pass
+    assert r.breaker(peer).allow()              # probe slot never consumed
+
+
+def test_wasted_hedge_releases_half_open_probe():
+    """A hedge reaped undone when the primary wins held the half-open
+    probe; _settle must give the slot back, not leak it (which would
+    silently drop the peer from routing forever)."""
+    order = rendezvous_order("k", ["a", "b"])
+
+    def qfn(peer, payload, timeout, cancel):
+        if peer == order[0]:
+            time.sleep(0.08)           # slow enough to trigger the hedge
+            return _resp(peer, wm={})
+        cancel.wait(timeout=5.0)       # the hedge never answers
+        raise ConnectionError("cancelled")
+
+    r = _router(["a", "b"], qfn, hedge=True, hedge_after_s=0.02,
+                timeout_s=3.0, retries=0, breaker_cooldown_s=0.0)
+    for _ in range(5):
+        r.breaker(order[1]).record_failure()    # cooldown 0 -> HALF_OPEN
+    assert r.breaker(order[1]).state == HALF_OPEN
+    out = r.query([{"op": "value", "key": 0}], key="k")
+    assert out["peer"] == order[0]
+    assert r.metrics.snapshot()["counters"]["router.hedge_wasted"] == 1
+    assert r.breaker(order[1]).would_allow()    # probe released
+    got, _ = r.route("k")
+    assert order[1] in got                      # peer still routable
+
+
+def test_dead_reroute_counted_once_and_breaker_resolved():
+    """While a hedge finishes out the deadline after the primary's SWIM
+    death, the dead branch must be one-shot (no per-poll-tick counter
+    inflation) and the dead primary's breaker must still be resolved —
+    failure billed, half-open probe not leaked."""
+    order = rendezvous_order("k", ["a", "b"])
+    hedge_started = threading.Event()
+    dead = threading.Event()
+
+    def qfn(peer, payload, timeout, cancel):
+        if peer == order[0]:
+            hedge_started.wait(timeout=5.0)
+            dead.set()                  # SWIM verdict lands mid-query
+            cancel.wait(timeout=10.0)
+            raise ConnectionError("peer died")
+        hedge_started.set()
+        time.sleep(0.1)                 # many 1ms poll ticks post-verdict
+        return _resp(peer, wm={})
+
+    def verdict(peer):
+        return "dead" if (peer == order[0] and dead.is_set()) else "alive"
+
+    r = _router(["a", "b"], qfn, hedge=True, hedge_after_s=0.01,
+                verdict_fn=verdict, timeout_s=5.0, retries=0)
+    out = r.query([{"op": "value", "key": 0}], key="k")
+    assert out["peer"] == order[1]
+    c = r.metrics.snapshot()["counters"]
+    assert c["router.dead_reroutes"] == 1       # one death, one count
+    assert c["router.hedge_wins"] == 1
+    br = r.breaker(order[0])
+    assert br._consec_failures >= 1             # failure billed, not skipped
+    assert not br._probing                      # no leaked probe slot
+
+
 def test_consecutive_failures_only_successes_reset():
     cell, mono = _fake_clock()
     br = CircuitBreaker(fail_threshold=3, cooldown_s=5.0, mono=mono)

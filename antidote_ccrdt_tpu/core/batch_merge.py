@@ -212,6 +212,31 @@ def fold_states(merge, states: Sequence[Any]):
     return jax.tree.map(lambda x: x[0], folded)
 
 
+def stage_to_device(tree: Any) -> Any:
+    """Async h2d pre-staging for the ingest fast path: `jax.device_put`
+    enqueues the transfers and returns immediately, so a prefetcher
+    thread can ship decoded window leaves toward the accelerator while
+    the round thread is still mid-dispatch — by the time `fold_states`
+    stacks them, the operands are device-resident and the fold pays no
+    inline h2d. Leaves already on device pass through untouched (the
+    CPU backend therefore makes this a no-op, which is exactly the
+    bit-identity the CCRDT_INGEST_COMPACT=0 drills assert)."""
+    import jax
+
+    return jax.device_put(tree)
+
+
+def tree_nbytes(tree: Any) -> int:
+    """Total payload bytes across a pytree's array leaves (the
+    `ingest.staged_bytes` accounting for staged windows)."""
+    import jax
+
+    return sum(
+        int(getattr(leaf, "nbytes", 0))
+        for leaf in jax.tree.leaves(tree)
+    )
+
+
 def _batched_fold(merge, batch: Any, donate: bool = False):
     """Fold a [N, ...] state pytree down to [1, ...]: each round merges the
     first half against the second half in ONE dispatch (log2(N) dispatches

@@ -506,6 +506,7 @@ class ElasticWal:
         self._pending: Set[int] = set()   # staged seqs awaiting fsync ack
         self._staged_bytes = 0
         self._last_flush = time.monotonic()
+        self._first_staged = self._last_flush  # opens with the group
         self._last_appended = self._last_on_disk()
         self._pool = None  # lazy writer pool for parallel stream fsyncs
         self._publish_gauges()
@@ -592,13 +593,21 @@ class ElasticWal:
         else:
             stream.append(step, payload, sync=False)
             self._last_appended = max(self._last_appended, int(step))
+            if not self._pending:
+                # The undurable window opens when the FIRST record of a
+                # group is staged, not at the previous flush: measuring
+                # from _last_flush made any quiet period >= group_ms
+                # flush the next append solo, so multi-append boundaries
+                # could never form a group.
+                self._first_staged = time.monotonic()
             self._pending.add(int(step))
             self._staged_bytes += len(payload)
             # Byte/time backstop: a run with sparse publish boundaries
             # still bounds its undurable window.
             if (
                 self._staged_bytes >= self.group_bytes
-                or (time.monotonic() - self._last_flush) * 1e3 >= self.group_ms
+                or (time.monotonic() - self._first_staged) * 1e3
+                >= self.group_ms
             ):
                 self.flush()
         self._publish_gauges()

@@ -36,6 +36,7 @@ from ..obs import events as obs_events
 from ..obs import spans as obs_spans
 from ..topo import ZoneMap, ZoneRouter, zone_from_env
 from ..utils.metrics import Metrics
+from . import transport
 from .membership import Membership
 
 
@@ -390,6 +391,12 @@ class SimTransport:
         window = self._deltas.setdefault(src, {})
         fresh = seq not in window
         window[seq] = blob
+        if fresh and blob[:4] == transport.FRAME_MAGIC:
+            # Compacted range frame (CCRF) landed — receive-side mirror
+            # of the publisher's ingest.coalesced_frames counter, so sim
+            # chaos drills can assert compaction actually crossed the
+            # (lossy) wire and not just left the publisher.
+            self.metrics.count("net.sim.coalesced_frames_recv")
         # Prune against the window MAX, not this message's seq: a
         # reordered old delta must not re-enter past the keep bound.
         hi = max(window)

@@ -93,6 +93,7 @@ from ..topo import (
     zone_from_env,
 )
 from ..utils import faults
+from . import transport
 from ..utils.metrics import Metrics
 from .membership import Membership
 
@@ -1229,6 +1230,13 @@ class TcpTransport:
     # -- Transport: deltas -------------------------------------------------
 
     def publish_delta(self, seq: int, blob: bytes, keep: int = 16) -> None:
+        # Compacted range frames (net.transport CCRF framing) ride the
+        # wire as ordinary opaque delta blobs — peek the header only for
+        # send-side observability (the meta `lo` shows up in queue-shed
+        # diagnostics; one frame may carry many windows).
+        lo = transport.frame_range(blob, seq)[0]
+        if lo < seq and self.metrics is not None:
+            self.metrics.count("net.tcp.coalesced_frames_sent")
         with self._lock:
             window = self._deltas.setdefault(self.member, {})
             window[seq] = blob
@@ -1243,13 +1251,16 @@ class TcpTransport:
                 link.enqueue(
                     _DELTA,
                     self._rdelta_frame(self.member, seq, keep, blob, path, link),
-                    meta={"origin": self.member, "dseq": seq, "cross_zone": True},
+                    meta={
+                        "origin": self.member, "dseq": seq, "lo": lo,
+                        "cross_zone": True,
+                    },
                 )
             else:
                 link.enqueue(
                     _DELTA,
                     self._delta_frame(seq, keep, blob, link),
-                    meta={"origin": self.member, "dseq": seq},
+                    meta={"origin": self.member, "dseq": seq, "lo": lo},
                 )
 
     def fetch_delta(self, member: str, seq: int) -> Optional[bytes]:

@@ -38,6 +38,42 @@ from ..topo import zones as topo_zones
 from ..utils import faults
 from ..utils.metrics import Metrics
 
+# -- range-framed delta blobs (ingest fast path) ------------------------------
+# A compacted frame covers the publisher's windows [lo..hi] in ONE blob,
+# published at seq=hi:  b"CCRF" ++ u64le lo ++ u64le hi ++ delta payload.
+# The magic differs from core.serial's b"CCRD" at byte 3, so a legacy
+# receiver handed a framed blob fails serial decode (total-failure policy
+# reads None) and falls back to the snapshot anchor — backward interop
+# without a wire-protocol version bump. A range-aware receiver strips the
+# header and treats a bare payload as the degenerate frame [seq..seq].
+
+FRAME_MAGIC = b"CCRF"
+_FRAME_HDR = len(FRAME_MAGIC) + 16  # magic + u64 lo + u64 hi
+
+
+def encode_range_frame(lo: int, hi: int, payload: bytes) -> bytes:
+    """Wrap a serialized delta covering windows [lo..hi]."""
+    if not 0 <= lo <= hi:
+        raise ValueError(f"bad frame range [{lo}..{hi}]")
+    return FRAME_MAGIC + struct.pack("<QQ", lo, hi) + payload
+
+
+def decode_range_frame(
+    blob: bytes, seq: int
+) -> Tuple[int, int, bytes]:
+    """(lo, hi, payload) of a delta blob fetched at `seq`: framed blobs
+    decode their header, bare (legacy) blobs read as [seq..seq]."""
+    if blob[:4] == FRAME_MAGIC and len(blob) >= _FRAME_HDR:
+        lo, hi = struct.unpack_from("<QQ", blob, 4)
+        return int(lo), int(hi), blob[_FRAME_HDR:]
+    return seq, seq, blob
+
+
+def frame_range(blob: bytes, seq: int) -> Tuple[int, int]:
+    """Header-only peek at the windows a delta blob covers."""
+    lo, hi, _ = decode_range_frame(blob[:_FRAME_HDR], seq)
+    return lo, hi
+
 
 @runtime_checkable
 class Transport(Protocol):
@@ -405,8 +441,20 @@ class GossipNode:
         )
         try:
             blob = self.transport.fetch(member)
-            if blob is None:
-                return None
+        finally:
+            obs_spans.end(tok)
+        if blob is None:
+            return None
+        # Decode + validation are their own phase (round.delta_decode):
+        # gossip_recv is the medium cost, this is the host parse cost —
+        # splitting them is what lets the ingest gate see which side
+        # regressed.
+        dtok = (
+            obs_spans.begin("round.delta_decode", kind="snap", origin=member)
+            if obs_spans.ACTIVE
+            else None
+        )
+        try:
             try:
                 (step,) = struct.unpack("<Q", blob[:8])
                 _name, state = serial.loads_dense(blob[8:], like)
@@ -419,7 +467,7 @@ class GossipNode:
             self.metrics.count("net.snap_fetches")
             return step, state
         finally:
-            obs_spans.end(tok)
+            obs_spans.end(dtok)
 
     def snapshot_seq(self, member: str) -> Optional[int]:
         """Seq/step of `member`'s snapshot from its 8-byte header —
@@ -434,18 +482,31 @@ class GossipNode:
 
     # -- deltas ------------------------------------------------------------
 
-    def publish_delta(self, delta_blob: bytes, seq: int, keep: int = 16) -> None:
+    def publish_delta(
+        self, delta_blob: bytes, seq: int, keep: int = 16,
+        lo: Optional[int] = None,
+    ) -> None:
         """Atomically publish a serialized delta at `seq`; retain only the
         last `keep` (receivers that fall off the window resync from the
-        full snapshot)."""
+        full snapshot). With `lo` < `seq` the blob ships range-framed: one
+        compacted frame covering the publisher's windows [lo..seq] (the
+        ingest fast path — see `encode_range_frame`)."""
+        if lo is not None and lo < seq:
+            delta_blob = encode_range_frame(lo, seq, delta_blob)
+            self.metrics.count("ingest.coalesced_frames")
+            self.metrics.count("ingest.coalesced_ops", seq - lo + 1)
+        else:
+            lo = seq
         self.metrics.count("net.delta_publishes")
         self.metrics.count("net.delta_bytes", len(delta_blob))
         # Stage 1 of the delta propagation path: this replica minted
-        # (origin, dseq). Everything downstream carries the same pair.
+        # (origin, dseq) — a compacted frame mints the whole [lo..dseq]
+        # range at once, and the audit treats `lo` as its chain link.
         obs_events.emit(
             "delta.publish",
             origin=self.member,
             dseq=seq,
+            lo=lo,
             bytes=len(delta_blob),
         )
         if obs_spans.ACTIVE:
@@ -457,16 +518,13 @@ class GossipNode:
         else:
             self.transport.publish_delta(seq, delta_blob, keep=keep)
 
-    def fetch_delta(
-        self, member: str, seq: int, like_delta: Any, validate=None
-    ) -> Optional[Any]:
-        """Deserialized delta at `seq`, or None (missing/torn/pruned/
-        mis-configured — same total-failure policy as `fetch`). `validate`
-        (delta -> bool) rejects structurally-decodable deltas from a peer
-        on a DIFFERENT engine config before expansion can index out of
-        range downstream."""
-        from ..core import serial
-
+    def fetch_delta_blob(
+        self, member: str, seq: int
+    ) -> Optional[Tuple[int, int, bytes]]:
+        """Raw (lo, hi, payload) at `seq` — the fetch half of
+        `fetch_delta_framed`, billed to `round.gossip_recv` only. The
+        prefetcher's batched decode stage pulls blobs through this and
+        decodes them in one `round.delta_decode` pass."""
         tok = (
             obs_spans.begin(
                 "round.gossip_recv", kind="delta", origin=member, dseq=seq
@@ -476,10 +534,33 @@ class GossipNode:
         )
         try:
             blob = self.transport.fetch_delta(member, seq)
-            if blob is None:
-                return None
+        finally:
+            obs_spans.end(tok)
+        if blob is None:
+            return None
+        try:
+            return decode_range_frame(blob, seq)
+        except Exception:  # noqa: BLE001 — torn header reads as missing
+            return None
+
+    def decode_delta_blob(
+        self, member: str, seq: int, payload: bytes, like_delta: Any,
+        validate=None,
+    ) -> Optional[Any]:
+        """Deserialize + validate one fetched delta payload, billed to
+        `round.delta_decode`. Same total-failure policy as `fetch`."""
+        from ..core import serial
+
+        tok = (
+            obs_spans.begin(
+                "round.delta_decode", kind="delta", origin=member, dseq=seq
+            )
+            if obs_spans.ACTIVE
+            else None
+        )
+        try:
             try:
-                _name, delta = serial.loads_dense(blob, like_delta)
+                _name, delta = serial.loads_dense(payload, like_delta)
                 if validate is not None and not validate(delta):
                     return None
             except Exception:  # noqa: BLE001 — see fetch
@@ -489,6 +570,35 @@ class GossipNode:
             return delta
         finally:
             obs_spans.end(tok)
+
+    def fetch_delta_framed(
+        self, member: str, seq: int, like_delta: Any, validate=None
+    ) -> Optional[Tuple[int, int, Any]]:
+        """(lo, hi, delta) of the (possibly range-framed) delta stored at
+        `seq`; bare legacy blobs read as the degenerate frame
+        [seq..seq]. None on any fetch/decode/validate failure."""
+        got = self.fetch_delta_blob(member, seq)
+        if got is None:
+            return None
+        lo, hi, payload = got
+        delta = self.decode_delta_blob(
+            member, seq, payload, like_delta, validate=validate
+        )
+        if delta is None:
+            return None
+        return lo, hi, delta
+
+    def fetch_delta(
+        self, member: str, seq: int, like_delta: Any, validate=None
+    ) -> Optional[Any]:
+        """Deserialized delta at `seq`, or None (missing/torn/pruned/
+        mis-configured — same total-failure policy as `fetch`). `validate`
+        (delta -> bool) rejects structurally-decodable deltas from a peer
+        on a DIFFERENT engine config before expansion can index out of
+        range downstream. Range-framed blobs decode to their inner delta
+        (use `fetch_delta_framed` when the covered range matters)."""
+        got = self.fetch_delta_framed(member, seq, like_delta, validate)
+        return None if got is None else got[2]
 
     def delta_seqs(self, member: str) -> List[int]:
         return self.transport.delta_seqs(member)

@@ -102,8 +102,13 @@ def audit_apply_order(
             if d is None:
                 continue
             d = int(d)
+            # Compacted range frames apply as one event covering
+            # [lo..dseq]; chaining holds iff the frame's LOW edge meets
+            # the cursor (overlap below it is idempotent re-coverage,
+            # not a violation). Legacy events carry no lo: lo == dseq.
+            lo = int(ev.get("lo", d))
             prev = cur.get(origin)
-            if prev is None or d == prev + 1:
+            if prev is None or (lo <= prev + 1 and d > prev):
                 cur[origin] = d
                 continue
             violations.append(

@@ -9,14 +9,19 @@ timestamps, span ids and parent links, recorded into a bounded ring and
 (when ``CCRDT_OBS_DIR`` is set) a line-buffered crash-durable JSONL
 spill, exactly mirroring the flight recorder's conventions.
 
-The worker round is cut into nine load-bearing phases::
+The worker round is cut into ten load-bearing phases::
 
     round.wal_append       harness.wal.ElasticWal.log_step
-    round.delta_encode     parallel.elastic.DeltaPublisher (delta branch)
+    round.delta_encode     parallel.elastic.DeltaPublisher (delta branch,
+                           including wire-window coalescing at flush)
     round.snapshot         parallel.elastic.DeltaPublisher (full branch)
     round.gossip_send      net.transport.GossipNode publish paths + the
                            tcp sender thread's actual wire write
-    round.gossip_recv      GossipNode fetch paths + the tcp reader thread
+    round.gossip_recv      GossipNode fetch paths (wire bytes only) + the
+                           tcp reader thread
+    round.delta_decode     GossipNode decode/validate of fetched blobs —
+                           snapshot loads and the prefetcher's batched
+                           frame decode both bill here
     round.delta_apply      parallel.elastic.sweep_deltas (delta + snap)
     round.device_dispatch  core.batch_merge folds, drill op application
     round.device_sync      explicit block_until_ready (only taken when
@@ -75,6 +80,7 @@ PHASES = (
     "round.delta_encode",
     "round.gossip_send",
     "round.gossip_recv",
+    "round.delta_decode",
     "round.delta_apply",
     "round.device_dispatch",
     "round.device_sync",

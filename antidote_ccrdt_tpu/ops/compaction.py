@@ -710,3 +710,114 @@ def coalesce_topk_rmv_ops(ops_list, n_dcs: int, m_keep: int,
         ),
         n_add_h, n_rmv_h,
     )
+
+
+# -- wire-window delta coalescing (ingest fast path) ------------------------
+# The gossip analog of the pre-ship op pass above: fuse K consecutive
+# pending publish windows' deltas into ONE frame. Every gossip delta ships
+# row/cell VALUES under an idempotent join (topk_rmv slot rows, table JOIN
+# cells, lifted-monoid versioned rows), so last-window-wins per touched
+# row is exact: the coalesced frame produces the bit-identical state the
+# K chained frames would have. (MONOID table *diffs* — which never ride
+# gossip; the lift replaces them with versioned rows — sum instead.)
+# Host-side numpy: window row counts differ every publish, and the frame
+# is serialized to bytes immediately after (same reasoning as
+# parallel.delta.state_delta).
+
+
+def _last_wins(rows_cat: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """(unique_rows_sorted, gather_index_of_LAST_occurrence). The inputs
+    are concatenated in window order, so "last occurrence" is "latest
+    window" — the join-exact winner for value-shipping deltas."""
+    rev = rows_cat[::-1]
+    uniq, first_rev = np.unique(rev, return_index=True)
+    return uniq, rows_cat.shape[0] - 1 - first_rev
+
+
+def coalesce_topk_rmv_deltas(deltas):
+    """Fuse K chained `parallel.delta.TopkRmvDelta` windows (oldest
+    first) into one delta: union of touched rows, latest window's payload
+    per row, latest whole-state leaves (vc/lossy are monotone and each
+    window ships them in full)."""
+    from ..parallel.delta import TopkRmvDelta
+
+    deltas = list(deltas)
+    if len(deltas) == 1:
+        return deltas[0]
+    rows_cat = np.concatenate([np.asarray(d.rows) for d in deltas])
+    uniq, take = _last_wins(rows_cat)
+
+    def cat(field):
+        return np.concatenate([np.asarray(getattr(d, field)) for d in deltas])
+
+    return TopkRmvDelta(
+        rows=jnp.asarray(uniq.astype(np.int32)),
+        slot_score=jnp.asarray(cat("slot_score")[take]),
+        slot_dc=jnp.asarray(cat("slot_dc")[take]),
+        slot_ts=jnp.asarray(cat("slot_ts")[take]),
+        rmv_vc=jnp.asarray(cat("rmv_vc")[take]),
+        vc=deltas[-1].vc,
+        lossy=deltas[-1].lossy,
+    )
+
+
+def coalesce_table_deltas(deltas, monoid: bool = False):
+    """Fuse K chained entrywise table deltas (`parallel.delta.table_delta`
+    dicts, oldest first). JOIN payloads: latest value per touched cell +
+    latest whole leaves. MONOID payloads ship diffs — sum per cell, and
+    sum the integer whole leaves (the non-integer ones ship values)."""
+    deltas = list(deltas)
+    if len(deltas) == 1:
+        return deltas[0]
+    idx_cat = np.concatenate([np.asarray(d["idx"]) for d in deltas])
+    table_paths = list(deltas[-1]["table"])
+    out_table = {}
+    if monoid:
+        uniq = np.unique(idx_cat)
+        pos = {int(v): i for i, v in enumerate(uniq)}
+        scatter = np.asarray([pos[int(v)] for v in idx_cat], np.int64)
+        for p in table_paths:
+            vals = np.concatenate([np.asarray(d["table"][p]) for d in deltas])
+            acc = np.zeros(uniq.shape[0], vals.dtype)
+            np.add.at(acc, scatter, vals)
+            out_table[p] = jnp.asarray(acc)
+    else:
+        uniq, take = _last_wins(idx_cat)
+        for p in table_paths:
+            vals = np.concatenate([np.asarray(d["table"][p]) for d in deltas])
+            out_table[p] = jnp.asarray(vals[take])
+    out_whole = {}
+    for p, last in deltas[-1]["whole"].items():
+        if monoid and np.issubdtype(np.asarray(last).dtype, np.integer):
+            out_whole[p] = jnp.asarray(
+                sum(np.asarray(d["whole"][p]) for d in deltas)
+            )
+        else:
+            out_whole[p] = last
+    return {
+        "idx": jnp.asarray(uniq.astype(np.int32)),
+        "table": out_table,
+        "whole": out_whole,
+    }
+
+
+def coalesce_deltas(dense, deltas):
+    """Engine-generic fuse of K chained gossip deltas (oldest first), or
+    None when this delta flavor has no coalesce kernel (lifted-monoid row
+    deltas — the publisher falls back to re-cutting the interval delta
+    against the last shipped state, which is exact for every engine)."""
+    from ..core.behaviour import MergeKind
+    from ..parallel.delta import TopkRmvDelta, _is_monoid_row_delta
+
+    deltas = list(deltas)
+    if not deltas:
+        return None
+    if all(isinstance(d, TopkRmvDelta) for d in deltas):
+        return coalesce_topk_rmv_deltas(deltas)
+    if all(
+        isinstance(d, dict) and not _is_monoid_row_delta(d) and "idx" in d
+        for d in deltas
+    ):
+        monoid = getattr(dense, "merge_kind", None) == MergeKind.MONOID
+        return coalesce_table_deltas(deltas, monoid=monoid)
+    return None

@@ -50,6 +50,7 @@ scripts/elastic_demo.py + tests/test_elastic.py.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..net.transport import FsTransport, GossipNode
@@ -58,6 +59,27 @@ from ..obs import profile
 from ..obs import spans as obs_spans
 from ..utils.metrics import Metrics
 from .delta import empty_delta  # noqa: F401 — part of this module's API
+
+
+# Ingest fast-path knobs. CCRDT_INGEST_COMPACT=0 is the bit-identical
+# kill switch: deferred publishes ship immediately, one frame per window,
+# exactly the pre-compaction wire trace. CCRDT_INGEST_COALESCE caps how
+# many consecutive pending windows a publisher fuses into one frame.
+ENV_COMPACT = "CCRDT_INGEST_COMPACT"
+ENV_COALESCE = "CCRDT_INGEST_COALESCE"
+_FALSE = ("0", "false", "no", "off")
+
+
+def compact_enabled() -> bool:
+    return os.environ.get(ENV_COMPACT, "1").strip().lower() not in _FALSE
+
+
+def coalesce_max() -> int:
+    try:
+        v = int(os.environ.get(ENV_COALESCE, "4"))
+    except ValueError:
+        return 4
+    return max(1, v)
 
 
 class GossipStore(GossipNode):
@@ -138,6 +160,16 @@ class DeltaPublisher:
         self.seq = -1
         self._prev: Any = None
         self._serial = serial
+        # Wire-window staging (ingest fast path): `publish(..., defer=
+        # True)` parks delta windows here instead of shipping each one;
+        # `flush_wire` fuses them into ONE range frame [lo..hi] via
+        # `ops.compaction.coalesce_deltas` (falling back to re-cutting
+        # the interval delta against `_wire_prev`, the last state that
+        # actually reached the wire — exact for every engine). Entries
+        # are (seq, delta, blob-or-None).
+        self._staged: List[Tuple[int, Any, Optional[bytes]]] = []
+        self._wire_prev: Any = None
+        self._last_state: Any = None
         # encode_delta stash: (seq, is_full) frozen so the publish that
         # consumes a pre-cut blob takes the SAME anchor/pressure branch
         # the encode did (a lag probe flipping between the two calls
@@ -191,8 +223,15 @@ class DeltaPublisher:
         return {"seq": seq, "delta": delta, "blob": blob}
 
     def publish(
-        self, state: Any, encoded: Optional[Dict[str, Any]] = None
+        self, state: Any, encoded: Optional[Dict[str, Any]] = None,
+        defer: bool = False,
     ) -> Dict[str, Any]:
+        """Publish one window. With `defer=True` (and the ingest fast
+        path enabled) a delta window is STAGED instead of shipped; the
+        wire frame goes out when `coalesce_max()` windows are pending,
+        at the next non-deferred publish, or at an explicit
+        `flush_wire()` — whichever comes first. Anchors are never
+        deferred (they supersede any staged windows)."""
         from .delta import make_delta
 
         from .monoid import LiftedMonoidState, MonoidLift
@@ -213,6 +252,11 @@ class DeltaPublisher:
             self._next_plan = None
             is_full = self._branch(self.seq)
         if is_full:
+            # Anchors supersede any staged-but-unshipped windows: the
+            # full snapshot IS their join, published at a higher seq, so
+            # peers that never saw the staged seqs resync through it
+            # (the ordinary gap→anchor path).
+            self._staged.clear()
             # Under paging the anchor must carry the LOGICAL state —
             # a device-only snapshot would publish identity holes where
             # the cold partitions live.
@@ -234,8 +278,11 @@ class DeltaPublisher:
                     self.name, state, self.seq, self.dense, self.partitions,
                     plan=self.mesh_plan, pager=self.pager,
                 )
+            self._wire_prev = state
+            self._last_state = state
             kind, nbytes = "full", -1
         else:
+            staging = defer and compact_enabled()
             if (
                 encoded is not None
                 and encoded.get("seq") == self.seq
@@ -244,21 +291,36 @@ class DeltaPublisher:
                 # Pre-cut by encode_delta (same _prev, same seq): the
                 # extraction cost was already paid — and already
                 # attributed to round.delta_encode — there.
-                blob = encoded["blob"]
-            elif obs_spans.ACTIVE:
-                with obs_spans.span(
-                    "round.delta_encode", origin=self.store.member,
-                    dseq=self.seq,
-                ):
-                    delta = make_delta(self.dense, self._prev, state)
-                    blob = self._serial.dumps_dense(
-                        f"{self.name}_delta", delta
-                    )
+                delta, blob = encoded.get("delta"), encoded["blob"]
             else:
-                delta = make_delta(self.dense, self._prev, state)
-                blob = self._serial.dumps_dense(f"{self.name}_delta", delta)
-            self.store.publish_delta(blob, self.seq, keep=self.keep)
-            kind, nbytes = "delta", len(blob)
+                if obs_spans.ACTIVE:
+                    with obs_spans.span(
+                        "round.delta_encode", origin=self.store.member,
+                        dseq=self.seq,
+                    ):
+                        delta = make_delta(self.dense, self._prev, state)
+                        # A deferred window's bytes may never ship (the
+                        # coalesced frame re-serializes) — skip the dump
+                        # until flush decides.
+                        blob = (
+                            None if staging else self._serial.dumps_dense(
+                                f"{self.name}_delta", delta
+                            )
+                        )
+                else:
+                    delta = make_delta(self.dense, self._prev, state)
+                    blob = (
+                        None if staging else self._serial.dumps_dense(
+                            f"{self.name}_delta", delta
+                        )
+                    )
+            self._staged.append((self.seq, delta, blob))
+            self._last_state = state
+            if staging and len(self._staged) < coalesce_max():
+                kind, nbytes = "staged", 0
+            else:
+                shipped = self.flush_wire()
+                kind, nbytes = "delta", shipped["nbytes"]
         self._prev = state
         if self.on_publish is not None:
             try:
@@ -267,6 +329,64 @@ class DeltaPublisher:
                 # The read plane must never stall the write plane.
                 self.store.metrics.count("serve.swap_errors")
         return {"kind": kind, "seq": self.seq, "nbytes": nbytes}
+
+    @property
+    def staged_windows(self) -> int:
+        return len(self._staged)
+
+    def flush_wire(self) -> Optional[Dict[str, Any]]:
+        """Ship every staged window as ONE range frame [lo..hi] (None
+        when nothing is pending). Multi-window frames fuse through
+        `ops.compaction.coalesce_deltas`; flavors without a coalesce
+        kernel (lifted-monoid row deltas) re-cut the interval delta
+        against `_wire_prev` — the last state that reached the wire —
+        which is exact for every engine. Either way the frame joins to
+        the bit-identical state the chained per-window frames would."""
+        if not self._staged:
+            return None
+        from ..ops.compaction import coalesce_deltas
+        from .delta import make_delta
+
+        lo = self._staged[0][0]
+        hi = self._staged[-1][0]
+        if len(self._staged) == 1:
+            delta, blob = self._staged[0][1], self._staged[0][2]
+            if blob is None:
+                if obs_spans.ACTIVE:
+                    with obs_spans.span(
+                        "round.delta_encode", origin=self.store.member,
+                        dseq=hi,
+                    ):
+                        blob = self._serial.dumps_dense(
+                            f"{self.name}_delta", delta
+                        )
+                else:
+                    blob = self._serial.dumps_dense(
+                        f"{self.name}_delta", delta
+                    )
+        else:
+            def _fuse() -> bytes:
+                fused = coalesce_deltas(
+                    self.dense, [d for _, d, _ in self._staged]
+                )
+                if fused is None:
+                    fused = make_delta(
+                        self.dense, self._wire_prev, self._last_state
+                    )
+                return self._serial.dumps_dense(f"{self.name}_delta", fused)
+
+            if obs_spans.ACTIVE:
+                with obs_spans.span(
+                    "round.delta_encode", origin=self.store.member,
+                    dseq=hi, lo=lo, via="coalesce",
+                ):
+                    blob = _fuse()
+            else:
+                blob = _fuse()
+        self.store.publish_delta(blob, hi, keep=self.keep, lo=lo)
+        self._staged.clear()
+        self._wire_prev = self._last_state
+        return {"kind": "delta", "seq": hi, "lo": lo, "nbytes": len(blob)}
 
 
 class PartialAntiEntropy:
@@ -476,21 +596,32 @@ def sweep_deltas(
 
     def chain(member: str, cur: int) -> int:
         nonlocal state, stats
-        avail = set(store.delta_seqs(member))
-        while cur + 1 in avail:
-            delta = store.fetch_delta(
-                member, cur + 1, like_delta,
+        avail = sorted(store.delta_seqs(member))
+        while True:
+            # Frames are stored under their HIGH seq; a range frame
+            # [lo..hi] is applicable iff lo <= cur+1 (overlapping
+            # coverage below the cursor is harmless — every gossip
+            # delta joins idempotently). Legacy frames are the lo==hi
+            # degenerate case, so this loop subsumes the old cur+1 walk.
+            nxt = next((s for s in avail if s > cur), None)
+            if nxt is None:
+                break
+            got = store.fetch_delta_framed(
+                member, nxt, like_delta,
                 validate=lambda d: delta_in_bounds(dense, state, d),
             )
-            if delta is None:
+            if got is None:
                 break  # torn/mismatched write: retry (or resync) next sweep
+            lo, hi, delta = got
+            if lo > cur + 1:
+                break  # real gap below the frame → anchor resync path
             # Same total-failure policy as fetch/fetch_delta: a decodable-
             # but-malformed delta that slips past delta_in_bounds must not
             # crash the gossip loop — break the chain and resync next sweep.
             try:
                 tok = (
                     obs_spans.begin(
-                        "round.delta_apply", origin=member, dseq=cur + 1
+                        "round.delta_apply", origin=member, dseq=hi, lo=lo
                     )
                     if obs_spans.ACTIVE
                     else None
@@ -507,10 +638,11 @@ def sweep_deltas(
                 stats["skipped"] += 1
                 break
             stats["deltas"] += 1
-            cur += 1
+            cur = hi
             # Terminal stage of the delta trace: (origin, dseq) merged
-            # into THIS member's state.
-            obs_events.emit("delta.apply", origin=member, dseq=cur)
+            # into THIS member's state. `lo` rides along so the audit
+            # accepts the range jump as chained, not a gap-skip.
+            obs_events.emit("delta.apply", origin=member, dseq=cur, lo=lo)
         return cur
 
     for m in sorted(set(store.snapshot_members()) | set(store.delta_members())):

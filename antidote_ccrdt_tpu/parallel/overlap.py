@@ -58,11 +58,23 @@ commutative). Apply order and duplication are therefore free —
 bit-identical convergence is pinned by tests/test_overlap.py and
 `make overlap-demo`.
 
+The ingest fast path (PR 15) tightens the inbound half further: the
+prefetcher fetches a RUN of range frames per peer (compacted wire
+windows, `net.transport` CCRF framing), decodes them as one batch under
+the `round.delta_decode` span (degrading to per-frame decode when the
+`ingest.decode` fault point fires — a corrupt batch must never wedge
+the chain), pre-expands BOTH topk_rmv and entrywise table deltas to
+mergeable states, and pre-stages them to device asynchronously
+(`core.batch_merge.stage_to_device`) so `drain_into`'s folds read
+device-resident operands instead of paying h2d inside
+`round.device_dispatch`.
+
 Env knobs (all read at pipeline construction):
   CCRDT_OVERLAP        on unless set to 0/false/no/off (default ON)
   CCRDT_OVERLAP_QUEUE  apply-queue depth (default 32)
   CCRDT_OVERLAP_BATCH  max windows folded per batched dispatch (default 8)
   CCRDT_OVERLAP_HOSTQ  host-stage queue depth (default 8)
+  CCRDT_INGEST_DECODE_BATCH  max inbound frames decoded per batch (default 8)
 """
 
 from __future__ import annotations
@@ -119,6 +131,13 @@ def batch_cap() -> int:
 
 def host_queue_depth() -> int:
     return _env_int(ENV_HOSTQ, 8)
+
+
+ENV_DECODE_BATCH = "CCRDT_INGEST_DECODE_BATCH"
+
+
+def decode_batch_cap() -> int:
+    return _env_int(ENV_DECODE_BATCH, 8)
 
 
 # -- the background host stage ------------------------------------------------
@@ -248,15 +267,21 @@ _ALL_PARTS = -1  # hole key meaning "every partition" (legacy / unknown)
 
 
 class _Entry:
-    __slots__ = ("kind", "member", "seq", "payload", "merged", "parts")
+    __slots__ = ("kind", "member", "seq", "payload", "merged", "parts", "lo")
 
     def __init__(self, kind: str, member: str, seq: int, payload: Any,
-                 merged: Any, parts: Optional[frozenset] = None):
+                 merged: Any, parts: Optional[frozenset] = None,
+                 lo: Optional[int] = None):
         self.kind = kind          # "delta" | "snap"
         self.member = member
         self.seq = seq
         self.payload = payload    # decoded delta / fetched peer state
         self.merged = merged      # pre-expanded mergeable state, or None
+        # Low edge of a range frame [lo..seq] (compacted wire windows);
+        # lo == seq is the legacy single-window case. Rides into the
+        # delta.apply event so the causal audit reads the jump as
+        # chained coverage, not a gap-skip.
+        self.lo = seq if lo is None else lo
         # Partition set this payload touches (core.partition.delta_parts
         # minus the meta partition — whole-instance leaves are shipped in
         # full by every delta and are join-monotone, so their loss heals
@@ -358,11 +383,12 @@ class ApplyQueue:
 
     def put_delta(self, member: str, seq: int, payload: Any,
                   merged: Any = None,
-                  parts: Optional[frozenset] = None) -> bool:
-        """Enqueue delta `seq` of `member`; False when refused (the
-        delta touches a holed partition — the caller must stop chaining
-        until an anchor covers it; deltas touching only intact
-        partitions are still accepted)."""
+                  parts: Optional[frozenset] = None,
+                  lo: Optional[int] = None) -> bool:
+        """Enqueue delta `seq` of `member` (a range frame when lo < seq);
+        False when refused (the delta touches a holed partition — the
+        caller must stop chaining until an anchor covers it; deltas
+        touching only intact partitions are still accepted)."""
         with self._lock:
             if self._holed(self._holes.get(member, {}), parts):
                 return False
@@ -374,7 +400,7 @@ class ApplyQueue:
                 self._count("overlap.dropped_deltas")
                 return False
             self._q.append(
-                _Entry("delta", member, seq, payload, merged, parts)
+                _Entry("delta", member, seq, payload, merged, parts, lo=lo)
             )
             return True
 
@@ -481,11 +507,116 @@ class DeltaPrefetcher:
             return max(floor, seq)
         return floor
 
+    def _decode(self, member: str, hi: int, payload: bytes) -> Any:
+        """Decode one frame payload (billed `round.delta_decode` inside
+        the node). Returns the delta or None (torn/out-of-bounds)."""
+        from .delta import delta_in_bounds
+
+        return self.store.decode_delta_blob(
+            member, hi, payload, self._like_delta,
+            validate=lambda d: delta_in_bounds(
+                self.dense, self.like_state, d
+            ),
+        )
+
+    def _expand(self, delta: Any) -> Any:
+        """Pre-expand a decoded delta to a mergeable full state and
+        pre-stage its leaves to device (async h2d — `drain_into`'s fold
+        then reads device-resident operands instead of paying the
+        transfer inside `round.device_dispatch`). Best-effort: None
+        keeps the sequential-apply fallback."""
+        from .delta import TopkRmvDelta, expand_delta, expand_table_delta
+
+        if not self._foldable:
+            return None
+        try:
+            if isinstance(delta, TopkRmvDelta):
+                merged = expand_delta(self.dense, delta)
+            elif isinstance(delta, dict) and "idx" in delta:
+                # Entrywise table deltas join the fold path too:
+                # apply_table_delta IS merge(state, expand_table_delta),
+                # so folding the expansion is the same join.
+                merged = expand_table_delta(
+                    self.dense, self.like_state, delta
+                )
+            else:
+                return None
+        except Exception:  # noqa: BLE001 — fold is best-effort
+            return None
+        if merged is not None:
+            try:
+                from ..core.batch_merge import stage_to_device, tree_nbytes
+
+                merged = stage_to_device(merged)
+                self.metrics.count(
+                    "ingest.staged_bytes", tree_nbytes(merged)
+                )
+            except Exception:  # noqa: BLE001 — unstaged operands still
+                pass  # fold; the h2d just moves back inline
+        return merged
+
+    def _parts(self, delta: Any) -> Optional[frozenset]:
+        if not self.partitions:
+            return None
+        from ..core import partition as pt
+
+        try:
+            # Meta partition excluded: whole-instance leaves ride every
+            # delta in full and are join-monotone, so they need no hole
+            # bookkeeping (see _Entry).
+            return frozenset(
+                pt.delta_parts(
+                    self.dense, self.like_state, delta, self.partitions
+                )
+            ) - {pt.meta_part(self.partitions)}
+        except Exception:  # noqa: BLE001 — tag is best-effort
+            return None  # untagged = touches-all (safe)
+
+    def _ingest_frames(self, member: str, cur: int, frames: List) -> tuple:
+        """Decode a collected run of wire frames as ONE batch, then
+        expand + enqueue in chain order. The batch decode degrades to
+        per-frame decode when the `ingest.decode` fault point fires (or
+        the batch pass raises) — a poisoned batch must never wedge the
+        prefetch chain; the per-frame total-failure policy then applies.
+        Returns (new cursor, entries enqueued)."""
+        if not frames:
+            return cur, 0
+        from ..utils import faults
+
+        try:
+            if faults.ACTIVE and faults.fire("ingest.decode") != "ok":
+                raise RuntimeError("ingest.decode: degraded batch")
+            decoded = [
+                self._decode(member, hi, payload)
+                for _lo, hi, payload in frames
+            ]
+        except Exception:  # noqa: BLE001 — degrade, never wedge
+            self.metrics.count("ingest.decode_degraded")
+            decoded = []
+            for _lo, hi, payload in frames:
+                try:
+                    decoded.append(self._decode(member, hi, payload))
+                except Exception:  # noqa: BLE001
+                    decoded.append(None)
+        n = 0
+        for (lo, hi, _payload), delta in zip(frames, decoded):
+            if delta is None:
+                break  # torn/mismatched write: retry next poll
+            merged = self._expand(delta)
+            parts = self._parts(delta)
+            if not self.apq.put_delta(
+                member, hi, delta, merged, parts, lo=lo
+            ):
+                break  # queue holed this member: anchor path next poll
+            cur = hi
+            n += 1
+            self.metrics.count("overlap.prefetched_deltas")
+        return cur, n
+
     def poll(self) -> int:
         """One prefetch pass over every peer; returns entries enqueued."""
-        from .delta import TopkRmvDelta, delta_in_bounds, expand_delta
-
         store = self.store
+        cap = decode_batch_cap()
         n = 0
         members = sorted(
             set(store.snapshot_members()) | set(store.delta_members())
@@ -508,11 +639,15 @@ class DeltaPrefetcher:
                     cur = new
                 self.cursors[m] = cur
                 continue
-            avail = set(store.delta_seqs(m))
-            if cur + 1 not in avail:
-                # First contact (or a pruned tail): the chain cannot
-                # start from here, so land the anchor FIRST — one poll
-                # then yields anchor + the deltas chained behind it,
+            avail = sorted(store.delta_seqs(m))
+            # Frames live under their HIGH seq; [lo..hi] chains from the
+            # cursor iff lo <= cur+1 (the legacy frame is lo == hi).
+            nxt = next((s for s in avail if s > cur), None)
+            head = store.fetch_delta_blob(m, nxt) if nxt is not None else None
+            if head is None or head[0] > cur + 1:
+                # First contact (or a pruned/compacted tail): the chain
+                # cannot start from here, so land the anchor FIRST — one
+                # poll then yields anchor + the frames chained behind it,
                 # instead of burning a second pass. When the chain IS
                 # walkable the anchor is skipped: deltas are cheaper.
                 snap_seq = store.snapshot_seq(m)
@@ -520,42 +655,34 @@ class DeltaPrefetcher:
                     new = self._fetch_snap(m, cur)
                     n += int(new > cur)
                     cur = new
-            while cur + 1 in avail:
-                delta = store.fetch_delta(
-                    m, cur + 1, self._like_delta,
-                    validate=lambda d: delta_in_bounds(
-                        self.dense, self.like_state, d
-                    ),
-                )
-                if delta is None:
-                    break  # torn/mismatched write: retry next poll
-                merged = None
-                if self._foldable and isinstance(delta, TopkRmvDelta):
-                    try:
-                        merged = expand_delta(self.dense, delta)
-                    except Exception:  # noqa: BLE001 — fold is best-effort
-                        merged = None
-                parts = None
-                if self.partitions:
-                    from ..core import partition as pt
-
-                    try:
-                        # Meta partition excluded: whole-instance leaves
-                        # ride every delta in full and are join-monotone,
-                        # so they need no hole bookkeeping (see _Entry).
-                        parts = frozenset(
-                            pt.delta_parts(
-                                self.dense, self.like_state, delta,
-                                self.partitions,
-                            )
-                        ) - {pt.meta_part(self.partitions)}
-                    except Exception:  # noqa: BLE001 — tag is best-effort
-                        parts = None  # untagged = touches-all (safe)
-                if not self.apq.put_delta(m, cur + 1, delta, merged, parts):
-                    break  # queue holed this member: anchor path next poll
-                cur += 1
-                n += 1
-                self.metrics.count("overlap.prefetched_deltas")
+            while True:
+                # Collect the walkable frame run (wire fetches billed
+                # `round.gossip_recv` inside fetch_delta_blob), then
+                # batch-decode + enqueue it.
+                frames = []
+                walk = cur
+                while len(frames) < cap:
+                    if head is not None:
+                        fr, head = head, None
+                        if fr[1] <= walk:
+                            continue  # anchor already covered the head
+                    else:
+                        nx = next((s for s in avail if s > walk), None)
+                        if nx is None:
+                            break
+                        fr = store.fetch_delta_blob(m, nx)
+                    if fr is None or fr[0] > walk + 1:
+                        break
+                    frames.append(fr)
+                    walk = fr[1]
+                if not frames:
+                    break
+                cur2, got = self._ingest_frames(m, cur, frames)
+                n += got
+                stalled = cur2 < frames[-1][1]
+                cur = max(cur, cur2)
+                if stalled:
+                    break  # torn frame or holed queue: resume next poll
             snap_seq = store.snapshot_seq(m)
             if snap_seq is not None and snap_seq > cur:
                 new = self._fetch_snap(m, cur)
@@ -720,6 +847,16 @@ class OverlapPipeline:
                         self.metrics.count(
                             "overlap.folded_windows", len(chunk)
                         )
+                        # Cross-member fused apply: the chunk rides the
+                        # queue in arrival order, so windows from
+                        # DIFFERENT peers stack into the same batched
+                        # dispatch (the join is law-certified
+                        # commutative/associative — member boundaries
+                        # mean nothing to it).
+                        self.metrics.count(
+                            "ingest.fused_members",
+                            len({e.member for e in chunk}),
+                        )
                     else:
                         state = merge_into(merge, state, chunk[0].merged)
                 except Exception:  # noqa: BLE001 — fall back per entry
@@ -728,7 +865,8 @@ class OverlapPipeline:
             for e in entries:
                 if e.kind == "delta":
                     obs_events.emit(
-                        "delta.apply", origin=e.member, dseq=e.seq
+                        "delta.apply", origin=e.member, dseq=e.seq,
+                        lo=e.lo,
                     )
                 else:
                     obs_events.emit(

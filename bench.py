@@ -650,6 +650,18 @@ def bench_round_phases(R, I, D_DCS, K, M, B, Br, rounds=6, overlap=None):
     attribute() reclassifies them serial -> overlappable — the measured
     proof that the dispatch gap collapses. The `overlap` counter block in
     the result is what chaos_gate.py asserts nonzero when the mode is on.
+
+    PR 15 reshapes the drill along the ingest fast path: (1) each round
+    applies TWO op sub-batches and logs TWO WAL steps before the single
+    boundary flush, so `wal.group_size` measures real group commit
+    instead of the degenerate 1-append-per-flush loop; (2) in overlap
+    mode the publisher DEFERS delta windows (`publish(..., defer=True)`)
+    and ships one compacted range frame per `coalesce_max()` windows —
+    the round thread only waits on gossip when a frame actually shipped,
+    and the blocking device sync runs at ship boundaries only; (3) the
+    result carries `ingest_phase_ms_total` (recv+decode+dispatch+apply+
+    sync) and `coalesce_ratio` (windows covered per wire frame) for the
+    bench_gate ingest gate.
     """
     import tempfile
 
@@ -660,6 +672,7 @@ def bench_round_phases(R, I, D_DCS, K, M, B, Br, rounds=6, overlap=None):
     from antidote_ccrdt_tpu.harness.wal import ElasticWal, durability_mode
     from antidote_ccrdt_tpu.obs import lag as obs_lag
     from antidote_ccrdt_tpu.obs import spans
+    from antidote_ccrdt_tpu.parallel import elastic as elastic_mod
     from antidote_ccrdt_tpu.parallel import overlap as overlap_mod
     from antidote_ccrdt_tpu.parallel.elastic import (
         DeltaPublisher,
@@ -675,7 +688,11 @@ def bench_round_phases(R, I, D_DCS, K, M, B, Br, rounds=6, overlap=None):
     gen = TopkRmvEffectGen(
         Workload(n_replicas=R, n_ids=I, zipf_a=1.2, score_max=100_000, seed=23)
     )
-    batches = [gen.next_batch(B, Br) for _ in range(rounds + 1)]
+    # Two op sub-batches per round (half size each): the round loop logs
+    # one WAL step per sub-batch and flushes once at the boundary, so
+    # the group-commit coalescer has a real batch to coalesce.
+    Bh, Brh = max(1, B // 2), max(1, Br // 2)
+    batches = [gen.next_batch(Bh, Brh) for _ in range(2 * rounds + 2)]
 
     @jax.jit
     def run_one(state, ops):
@@ -684,7 +701,25 @@ def bench_round_phases(R, I, D_DCS, K, M, B, Br, rounds=6, overlap=None):
 
     state = D.init(n_replicas=R, n_keys=1)
     state = run_one(state, batches[0])  # compile outside the spanned rounds
+    state = run_one(state, batches[1])
     _sync(state)
+
+    # Warm the peer-side ingest path outside the spans too: the fused
+    # fold compiles one XLA program per merge width (stack depths 3..9
+    # exercise widths 1..4), and the donated merge slots + the delta
+    # cut/expand pair compile on first touch. Cold, that is ~1s of
+    # one-time compile billed inside round.delta_apply/device_sync —
+    # enough to swamp the steady-state attribution this drill exists
+    # to measure (rounds=3 on cpu).
+    from antidote_ccrdt_tpu.core import batch_merge
+    from antidote_ccrdt_tpu.parallel import delta as delta_mod
+
+    for depth in (9, 7):
+        _sync(batch_merge.fold_states(D.merge, [state] * depth))
+    zl, zr = D.init(n_replicas=R, n_keys=1), D.init(n_replicas=R, n_keys=1)
+    _sync(batch_merge.merge_into(D.merge, zl, zr))
+    wd = delta_mod.make_delta(D, zl, state)
+    _sync(delta_mod.expand_delta(D, wd))
 
     with tempfile.TemporaryDirectory(prefix="ccrdt_spanbench_") as root:
         with spans.installed("bench0"):
@@ -709,61 +744,111 @@ def bench_round_phases(R, I, D_DCS, K, M, B, Br, rounds=6, overlap=None):
                     start_thread=False,
                 )
 
-            def _boundary(prev, snap, r):
-                with spans.span("round.device_sync", step=r, via="overlap"):
-                    _sync(snap)
-                # One delta extraction serves both the WAL record and
-                # the gossip blob (PR 11); the group-commit flush sits
-                # between append and publish, so durable-before-visible
-                # holds exactly as in the fsync-per-append days.
-                enc = pub.encode_delta(snap)
+            compact_on = elastic_mod.compact_enabled()
+            coalesce_k = elastic_mod.coalesce_max()
+            # Deterministic mirror of the publisher's ship decision so
+            # the round thread knows — without racing the host stage —
+            # whether this round's publishes put a frame on the wire
+            # (anchor cadence, or the coalesce window filling). Only
+            # ship rounds pay the recv-wait; staged rounds fall
+            # straight through to the next dispatch.
+            ship_model = {"seq": 0, "staged": 0}
+
+            def _round_ships() -> bool:
+                ships = False
+                for _ in range(2):
+                    ship_model["seq"] += 1
+                    s = ship_model["seq"]
+                    if s == 1 or s % pub.full_every == 0:
+                        ship_model["staged"] = 0  # anchor supersedes
+                        ships = True
+                    elif not (ovl_on and compact_on):
+                        ships = True  # kill switch: every window ships
+                    else:
+                        ship_model["staged"] += 1
+                        if ship_model["staged"] >= coalesce_k:
+                            ship_model["staged"] = 0
+                            ships = True
+                return ships
+
+            def _boundary(prev, mid, snap, r, ship):
+                # Blocking device sync only when a frame actually goes
+                # out — staged rounds leave the device chain running
+                # and the publish boundary absorbs the sync.
+                if ship:
+                    with spans.span(
+                        "round.device_sync", step=r, via="overlap"
+                    ):
+                        _sync(snap)
+                # Two WAL appends, ONE group-commit flush: group_size
+                # now measures real coalescing (the 1-append-per-flush
+                # loop through PR 14 pinned the p50 at 1.0). The first
+                # append reuses the publisher's delta (PR 11); the
+                # second interval (mid -> snap) is cut by the WAL —
+                # its publish is deferred, so there is no
+                # pre-serialized blob to share.
+                enc = pub.encode_delta(mid)
                 wal.log_step(
-                    r, owned, prev, snap,
+                    2 * r, owned, prev, mid,
                     delta=enc["delta"] if enc else None,
                     blob=enc["blob"] if enc else None,
                 )
+                wal.log_step(2 * r + 1, owned, mid, snap)
                 coalescer.flush()
-                pub.publish(snap, encoded=enc)
+                pub.publish(mid, encoded=enc, defer=True)
+                pub.publish(snap, defer=True)
 
             for r in range(rounds):
                 e2e = spans.begin("round.e2e", step=r)
                 prev = state
                 with spans.span(
-                    "round.device_dispatch", site="bench.apply_ops", n=B + Br
+                    "round.device_dispatch", site="bench.apply_ops",
+                    n=Bh + Brh,
                 ):
-                    state = run_one(state, batches[1 + r])
+                    mid = run_one(state, batches[2 + 2 * r])
+                with spans.span(
+                    "round.device_dispatch", site="bench.apply_ops",
+                    n=Bh + Brh,
+                ):
+                    state = run_one(mid, batches[3 + 2 * r])
+                ship = _round_ships()
                 if ovl is not None:
-                    ovl.submit(_boundary, prev, state, r)
                     # The wait below is the drill's deterministic
-                    # stand-in for the threaded prefetcher: the round
-                    # thread holds until the boundary's publish is
-                    # visible to the peer so delta_apply has work to
-                    # measure. Billed as a gossip_recv wait — before
-                    # PR 11 the same wall time hid under the stage's
-                    # then-enormous wal_append span, so the gap metric
-                    # read ~0 by accident, not by design.
+                    # stand-in for the threaded prefetcher: on a ship
+                    # round the thread holds until the boundary's
+                    # frame is visible to the peer so delta_apply has
+                    # work to measure. The span opens BEFORE submit —
+                    # a full host queue blocks right there, and that
+                    # backpressure was part of the dark slice in the
+                    # r09 coverage ledger. Staged rounds bill only the
+                    # submit and move on.
                     with spans.span(
-                        "round.gossip_recv", step=r, via="wait"
+                        "round.gossip_recv", step=r,
+                        via="wait" if ship else "backpressure",
                     ):
-                        deadline = time.perf_counter() + 0.25
-                        while (
-                            not ovl.prefetch.poll()
-                            and len(ovl.apq) == 0
-                            and time.perf_counter() < deadline
-                        ):
-                            time.sleep(0.001)
+                        ovl.submit(_boundary, prev, mid, state, r, ship)
+                        if ship:
+                            deadline = time.perf_counter() + 0.25
+                            while (
+                                not ovl.prefetch.poll()
+                                and len(ovl.apq) == 0
+                                and time.perf_counter() < deadline
+                            ):
+                                time.sleep(0.001)
                     peer_state = ovl.drain_into(peer_state)
                 else:
                     with spans.span("round.device_sync", step=r):
                         _sync(state)
-                    enc = pub.encode_delta(state)
+                    enc = pub.encode_delta(mid)
                     wal.log_step(
-                        r, owned, prev, state,
+                        2 * r, owned, prev, mid,
                         delta=enc["delta"] if enc else None,
                         blob=enc["blob"] if enc else None,
                     )
+                    wal.log_step(2 * r + 1, owned, mid, state)
                     coalescer.flush()
-                    pub.publish(state, encoded=enc)
+                    pub.publish(mid, encoded=enc)
+                    pub.publish(state)
                     peer_state, _stats = sweep_deltas(
                         peer, D, peer_state, cursors
                     )
@@ -776,6 +861,7 @@ def bench_round_phases(R, I, D_DCS, K, M, B, Br, rounds=6, overlap=None):
                     tracker.export_to(node.metrics)
                 spans.end(e2e)
             if ovl is not None:
+                ovl.submit(pub.flush_wire)  # ship the staged tail
                 ovl.host.drain()  # last publish visible before final poll
                 # Poll to quiescence: one pass only advances a fresh
                 # member past its anchor — the delta chain behind it
@@ -787,15 +873,44 @@ def bench_round_phases(R, I, D_DCS, K, M, B, Br, rounds=6, overlap=None):
             recs = spans.drain()
     att = spans.attribute({"bench0": recs})
     fleet = att["fleet"]
+    cnt_node = node.metrics.snapshot()["counters"]
+    cnt_peer = peer.metrics.snapshot()["counters"]
     ovl_counters = {
         k: v
-        for src in (node.metrics, peer.metrics)
-        for k, v in src.snapshot()["counters"].items()
+        for src in (cnt_node, cnt_peer)
+        for k, v in src.items()
         if k.startswith("overlap.")
     }
+    ing_counters = {}
+    for src in (cnt_node, cnt_peer):
+        for k, v in src.items():
+            if k.startswith("ingest."):
+                ing_counters[k] = ing_counters.get(k, 0) + v
+    # Windows covered per wire frame: a frame [lo..hi] carries
+    # hi-lo+1 windows (ingest.coalesced_ops counts them for multi-
+    # window frames), a legacy frame carries one. 1.0 = no compaction.
+    frames = cnt_node.get("net.delta_publishes", 0)
+    co_frames = cnt_node.get("ingest.coalesced_frames", 0)
+    co_ops = cnt_node.get("ingest.coalesced_ops", 0)
+    coalesce_ratio = (co_ops + frames - co_frames) / max(1, frames)
+    ingest_ms = sum(
+        fleet["phases_ms_total"].get(p, 0.0)
+        for p in (
+            "round.gossip_recv", "round.delta_decode",
+            "round.device_dispatch", "round.delta_apply",
+            "round.device_sync",
+        )
+    )
     groups = node.metrics.snapshot()["latencies"].get("wal.group_size", [])
     return {
         "overlap": {"enabled": ovl_on, **ovl_counters},
+        "ingest": {
+            "compact": bool(compact_on and ovl_on),
+            "coalesce_max": coalesce_k,
+            **dict(sorted(ing_counters.items())),
+        },
+        "ingest_phase_ms_total": round(ingest_ms, 3),
+        "coalesce_ratio": round(coalesce_ratio, 3),
         "wal_durability": durability_mode(),
         "wal_group_size_p50": (
             float(np.percentile(groups, 50)) if groups else 0.0
@@ -814,6 +929,54 @@ def bench_round_phases(R, I, D_DCS, K, M, B, Br, rounds=6, overlap=None):
         },
         "critical_path": fleet["critical_path"],
     }
+
+
+def bench_ingest():
+    """Standalone ingest fast-path microbench (`python bench.py
+    bench_ingest`): the spanned gossip round drill twice — compact
+    ingest ON, then the `CCRDT_INGEST_COMPACT=0` kill-switch rerun —
+    printed as one JSON line carrying both `ingest_phase_ms_total`
+    figures plus the coalesce ratio and ingest counters. Same keys as
+    the BENCH summary line, so `scripts/bench_gate.py ingest` reads
+    either carrier."""
+    import jax
+
+    backend = jax.default_backend()
+    if os.environ.get("CCRDT_BENCH_TINY"):
+        cfg = dict(R=2, I=256, D_DCS=2, K=100, M=4, B=32, Br=8, rounds=3)
+    elif backend == "cpu":
+        cfg = dict(
+            R=8, I=10_000, D_DCS=8, K=100, M=4, B=1024, Br=64, rounds=3
+        )
+    else:
+        cfg = dict(
+            R=32, I=100_000, D_DCS=32, K=100, M=4, B=32768, Br=2048,
+            rounds=6,
+        )
+    prev_env = os.environ.get("CCRDT_INGEST_COMPACT")
+    try:
+        os.environ["CCRDT_INGEST_COMPACT"] = "1"
+        on = bench_round_phases(**cfg)
+        os.environ["CCRDT_INGEST_COMPACT"] = "0"
+        off = bench_round_phases(**cfg)
+    finally:
+        if prev_env is None:
+            os.environ.pop("CCRDT_INGEST_COMPACT", None)
+        else:
+            os.environ["CCRDT_INGEST_COMPACT"] = prev_env
+    out = {
+        "metric": "ingest_phase_ms_total (compact on vs kill-switch off)",
+        "backend": backend,
+        "ingest_phase_ms_total": on["ingest_phase_ms_total"],
+        "ingest_phase_ms_total_nocompact": off["ingest_phase_ms_total"],
+        "coalesce_ratio": on["coalesce_ratio"],
+        "ingest": on["ingest"],
+        "span_coverage_p50": on["span_coverage_p50"],
+        "wal_group_size_p50": on["wal_group_size_p50"],
+        "dispatch_gap_ms_p50": on["dispatch_gap_ms_p50"],
+    }
+    print(json.dumps(out))
+    return out
 
 
 def bench_serve(frames=400, batch=512):
@@ -1592,11 +1755,32 @@ def main():
         rounds=4 if os.environ.get("CCRDT_BENCH_TINY") else 12,
         repeats=1 if os.environ.get("CCRDT_BENCH_TINY") else 3,
     )
-    round_phases = bench_round_phases(
-        R, I, D_DCS, K, M, B, Br,
-        rounds=3 if (backend == "cpu" or os.environ.get("CCRDT_BENCH_TINY"))
-        else 6,
+    phase_rounds = (
+        3 if (backend == "cpu" or os.environ.get("CCRDT_BENCH_TINY")) else 6
     )
+    round_phases = bench_round_phases(
+        R, I, D_DCS, K, M, B, Br, rounds=phase_rounds,
+    )
+    # Kill-switch arm of the same drill: the raw ingest phase bill is
+    # workload-shaped (the drill applies two op sub-batches and logs two
+    # WAL steps per round since PR 15), so the carrier records the
+    # CCRDT_INGEST_COMPACT=0 rerun alongside it — the within-workload
+    # differential is the number that survives drill reshapes and
+    # machine drift across rounds.
+    _prev_compact = os.environ.get("CCRDT_INGEST_COMPACT")
+    try:
+        os.environ["CCRDT_INGEST_COMPACT"] = "0"
+        _nocompact = bench_round_phases(
+            R, I, D_DCS, K, M, B, Br, rounds=phase_rounds,
+        )
+    finally:
+        if _prev_compact is None:
+            os.environ.pop("CCRDT_INGEST_COMPACT", None)
+        else:
+            os.environ["CCRDT_INGEST_COMPACT"] = _prev_compact
+    round_phases["ingest_phase_ms_total_nocompact"] = _nocompact[
+        "ingest_phase_ms_total"
+    ]
     mesh_scaling = bench_mesh_scaling(
         iters=5 if os.environ.get("CCRDT_BENCH_TINY") else 30,
         resyncs=2 if os.environ.get("CCRDT_BENCH_TINY") else 4,
@@ -1699,6 +1883,11 @@ def main():
         "wal_append_ms_total": round_phases["wal_append_ms_total"],
         "wal_group_size_p50": round_phases["wal_group_size_p50"],
         "wal_durability": round_phases["wal_durability"],
+        "ingest_phase_ms_total": round_phases["ingest_phase_ms_total"],
+        "ingest_phase_ms_total_nocompact": round_phases[
+            "ingest_phase_ms_total_nocompact"
+        ],
+        "coalesce_ratio": round_phases["coalesce_ratio"],
         "antientropy_bytes_per_resync": antientropy[
             "antientropy_bytes_per_resync"
         ],
@@ -1727,4 +1916,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "bench_ingest":
+        bench_ingest()
+    else:
+        main()

@@ -209,10 +209,12 @@ def load_attribution_rounds(
 ) -> List[Tuple[int, str, float, float]]:
     """[(round_no, path, dispatch_gap_ms_p50, span_coverage_p50)] for
     every BENCH round whose summary line carries the span-attribution
-    headline (bench.bench_round_phases, r6+). Coverage stays report-only
-    (coverage sliding down means spans stopped explaining where round
-    time goes — that deserves eyes, not an exit code); the GAP is gated
-    by `evaluate_gap` since PR 7 made it a load-bearing perf claim."""
+    headline (bench.bench_round_phases, r6+). The GAP is gated by
+    `evaluate_gap` since PR 7 made it a load-bearing perf claim; since
+    PR 15 the same gate also asserts the latest round's COVERAGE >=
+    0.90 — the ingest fast path bills the decode stage and the host
+    backpressure wait, so coverage sliding back under 0.90 means spans
+    stopped explaining where round time goes."""
     out: List[Tuple[int, str, float, float]] = []
     for p in sorted(
         glob.glob(os.path.join(bench_dir, "BENCH_r*.json")), key=round_number
@@ -236,6 +238,7 @@ def evaluate_gap(
     rounds: List[Tuple[int, str, float, float]],
     tolerance: float = 0.20,
     abs_floor_ms: float = 40.0,
+    min_coverage: float = 0.90,
 ) -> Tuple[int, str]:
     """(exit_code, verdict) for the dispatch-gap gate: the latest
     attribution-bearing round fails when its ``dispatch_gap_ms_p50``
@@ -254,7 +257,21 @@ def evaluate_gap(
     stall; once PR 11 shrank those spans the noise surfaced. The gate
     still catches what it was built for — a host tail (fsync, encode,
     send) sliding back onto the round thread is a 100ms-class jump,
-    well past floor + best."""
+    well past floor + best.
+
+    The coverage floor applies to the LATEST carrier only (historical
+    rounds predate the billed decode + backpressure spans and sat at
+    ~0.82): under `min_coverage` the attribution itself is lying, so
+    the gap number above it is untrustworthy."""
+    if rounds:
+        cov_n, _cp, _cg, cov = rounds[-1]
+        if cov < min_coverage:
+            return 1, (
+                f"gap-gate: r{cov_n:02d} span_coverage_p50 = {cov:.4f} "
+                f"< {min_coverage:.2f}\nFAIL: spans no longer explain "
+                "where round wall time goes — fix attribution before "
+                "trusting the gap"
+            )
     if len(rounds) < 2:
         return 0, (
             f"gap-gate: only {len(rounds)} round(s) carry "
@@ -273,6 +290,75 @@ def evaluate_gap(
             f"{verdict}\nFAIL: the dispatch gap regressed "
             f"{latest_gap - best_gap:+.2f}ms — host phases are sliding "
             "back onto the round thread"
+        )
+    return 0, f"{verdict}\nOK: within tolerance"
+
+
+_INGEST_RE = re.compile(r'"ingest_phase_ms_total":\s*([0-9][0-9_.eE+-]*)')
+_RATIO_RE = re.compile(r'"coalesce_ratio":\s*([0-9][0-9_.eE+-]*)')
+
+
+def load_ingest_rounds(
+    bench_dir: str,
+) -> List[Tuple[int, str, float, float]]:
+    """[(round_no, path, ingest_phase_ms_total, coalesce_ratio)] for
+    every BENCH round whose summary line carries the ingest fast-path
+    headline (bench.bench_round_phases, r10+): the combined wall time
+    of the five ingest phases (gossip_recv + delta_decode +
+    device_dispatch + delta_apply + device_sync) and the windows-per-
+    wire-frame ratio (1.0 = no compaction)."""
+    out: List[Tuple[int, str, float, float]] = []
+    for p in sorted(
+        glob.glob(os.path.join(bench_dir, "BENCH_r*.json")), key=round_number
+    ):
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        tail = str(doc.get("tail", ""))
+        ing = _INGEST_RE.findall(tail)
+        rat = _RATIO_RE.findall(tail)
+        if ing and rat:
+            out.append(
+                (round_number(p), p, float(ing[-1]), float(rat[-1]))
+            )
+    return out
+
+
+def evaluate_ingest(
+    rounds: List[Tuple[int, str, float, float]],
+    tolerance: float = 0.20,
+    abs_floor_ms: float = 50.0,
+) -> Tuple[int, str]:
+    """(exit_code, verdict) for the ingest-phase gate: the latest
+    carrier fails when its ``ingest_phase_ms_total`` grew more than
+    `tolerance` relative AND more than `abs_floor_ms` absolute over the
+    best (lowest) prior carrier. Double-threshold for the same reason
+    as the gap gate: the drill runs on shared-CPU carriers where a
+    single CFS throttle window is tens of ms of unattributable stall —
+    a relative-only gate would flap, an absolute-only gate would let a
+    slow creep through. Fewer than two carriers pass vacuously."""
+    if len(rounds) < 2:
+        return 0, (
+            f"ingest-gate: only {len(rounds)} round(s) carry "
+            "ingest_phase_ms_total — nothing to compare, passing "
+            "vacuously"
+        )
+    latest_n, _p, latest_ms, latest_ratio = rounds[-1]
+    best_n, best_ms = best_prior_carrier(rounds, 2, "min")
+    ceiling = max(best_ms * (1.0 + tolerance), best_ms + abs_floor_ms)
+    verdict = (
+        f"ingest-gate: r{latest_n:02d} ingest_phase_ms_total = "
+        f"{latest_ms:.1f}ms (coalesce ratio {latest_ratio:.2f}) vs best "
+        f"prior r{best_n:02d} = {best_ms:.1f}ms "
+        f"(ceiling +{tolerance:.0%} and +{abs_floor_ms}ms: {ceiling:.1f})"
+    )
+    if latest_ms > ceiling:
+        return 1, (
+            f"{verdict}\nFAIL: the ingest path regressed "
+            f"{latest_ms - best_ms:+.1f}ms — frames are decoding or "
+            "applying serially again"
         )
     return 0, f"{verdict}\nOK: within tolerance"
 
@@ -1052,6 +1138,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     attr = load_attribution_rounds(args.bench_dir)
     for line in attribution_drift(attr):
         print(line)
+    ing = load_ingest_rounds(args.bench_dir)
+    for n, p, ms, ratio in ing:
+        print(
+            f"  ingest r{n:02d} {os.path.basename(p)}: "
+            f"{ms:,.1f}ms combined, coalesce ratio {ratio:.2f}"
+        )
     part = load_partition_rounds(args.bench_dir)
     for n, p, ae, rj in part:
         print(
@@ -1107,6 +1199,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     print(verdict)
     gap_code, gap_verdict = evaluate_gap(attr, args.gap_tolerance)
     print(gap_verdict)
+    ing_code, ing_verdict = evaluate_ingest(ing, args.tolerance)
+    print(ing_verdict)
     part_code, part_verdict = evaluate_partition(part, args.tolerance)
     print(part_verdict)
     serve_code, serve_verdict = evaluate_serve(srv, args.tolerance)
@@ -1121,8 +1215,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     print(pager_verdict)
     router_code, router_verdict = evaluate_router(rtr, args.tolerance)
     print(router_verdict)
-    return max(code, gap_code, part_code, serve_code, audit_code, wal_code,
-               mesh_code, pager_code, router_code)
+    return max(code, gap_code, ing_code, part_code, serve_code, audit_code,
+               wal_code, mesh_code, pager_code, router_code)
 
 
 if __name__ == "__main__":

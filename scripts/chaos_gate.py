@@ -72,6 +72,16 @@ counter (`pager.evictions` / `pager.hydrations` / `pager.cold_folds` /
 `pager.blob_serves`) nonzero, `net.psnap_wasted` still exactly zero,
 and the conditional `round.pager_hydrate` span lit.
 
+The ingest leg (PR 15) re-runs the overlap chaos drill with the
+publishers DEFERRING delta windows (tests/test_ingest_fastpath.py):
+wire windows coalesce into range frames, the prefetcher batch-decodes
+frame runs, and the tiny apply queue is still forced to shed. The
+seeded drill must converge bit-identically BOTH to the sequential
+reference AND to its own CCRDT_INGEST_COMPACT=0 kill-switch rerun, with
+every fast-path counter lit — coalesced frames/ops on the wire, decoded
+leaves staged to device, cross-member folds fused, and the delta shed
+(hole-healing under compaction) actually exercised.
+
 Run:  python scripts/chaos_gate.py
 Make: part of `make chaos` (after the pytest leg).
 """
@@ -171,6 +181,21 @@ PAGER_REQUIRED_NONZERO = (
     "pager.hydrations",  # ...and misses pulled them back device-side
     "pager.cold_folds",  # inbound cold deltas folded host-side
     "pager.blob_serves", # cold psnaps answered straight from storage
+)
+
+# Ingest leg (tests/test_ingest_fastpath.py's seeded drill: deferred
+# publishers, coalesce cap 2, depth-2 apply queue with drains withheld):
+# the compacted wire path must actually run end to end — a refactor
+# that silently stops staging (every publish ships per-window) or stops
+# batch-decoding keeps convergence green (that IS the legacy path) but
+# zeroes these.
+INGEST_REQUIRED_NONZERO = (
+    "ingest.coalesced_frames",    # multi-window range frames hit the wire
+    "ingest.coalesced_ops",       # ...covering more than one window each
+    "ingest.staged_bytes",        # decoded leaves pre-staged to device
+    "ingest.fused_members",       # cross-member windows folded in one dispatch
+    "overlap.prefetched_deltas",  # the prefetcher pulled the frames
+    "overlap.dropped_deltas",     # the forced shed opened real holes
 )
 
 # Same contract for the zone-topology leg (tests/test_topo_chaos.py:
@@ -559,6 +584,51 @@ def main() -> int:
           f"{ws['min_hit_rate']} via {int(wc.get('pager.hydrations', 0))} "
           f"hydrations / {int(wc.get('pager.evictions', 0))} evictions, "
           "0 wasted psnaps, round.pager_hydrate lit")
+
+    # -- leg 10: the ingest fast path (compacted wire windows) -------------
+    from test_ingest_fastpath import run_ingest_chaos
+
+    i_digests, i_counters = run_ingest_chaos("topk_rmv", seed=7)
+    i_off_digests, i_off_counters = run_ingest_chaos(
+        "topk_rmv", seed=7, compact=False
+    )
+    i_diverged = sorted(m for m, d in i_digests.items() if d != ref)
+    i_mismatch = sorted(
+        m for m, d in i_digests.items() if i_off_digests.get(m) != d
+    )
+    i_zeroed = sorted(
+        n for n in INGEST_REQUIRED_NONZERO if not i_counters.get(n, 0)
+    )
+    print("== ingest chaos drill (seed=7, deferred publishers, compact "
+          "vs kill switch) ==")
+    print("  " + " ".join(
+        f"{n}={int(i_counters.get(n, 0))}" for n in INGEST_REQUIRED_NONZERO
+    ))
+    if i_diverged:
+        print("FAIL: compacted-ingest members diverged from the "
+              f"sequential reference: {i_diverged}")
+        return 1
+    if i_mismatch:
+        print("FAIL: the CCRDT_INGEST_COMPACT=0 rerun disagrees with the "
+              f"compacted run on: {i_mismatch} — the kill switch is no "
+              "longer bit-identical")
+        return 1
+    if i_zeroed:
+        print("FAIL: ingest fast-path counters regressed to zero (the "
+              f"drill silently ran the legacy wire path): {i_zeroed}")
+        return 1
+    if i_off_counters.get("ingest.coalesced_frames", 0):
+        print("FAIL: the kill-switch arm still shipped "
+              f"{int(i_off_counters['ingest.coalesced_frames'])} coalesced "
+              "frame(s) — CCRDT_INGEST_COMPACT=0 no longer disables "
+              "staging")
+        return 1
+    print(f"OK: ingest leg — {len(i_digests)} survivors converged "
+          "bit-identically to the reference AND the kill-switch rerun "
+          f"via {int(i_counters.get('ingest.coalesced_frames', 0))} "
+          f"coalesced frames ({int(i_counters.get('ingest.coalesced_ops', 0))} "
+          f"windows), {int(i_counters.get('overlap.dropped_deltas', 0))} "
+          "shed deltas healed")
     return 0
 
 

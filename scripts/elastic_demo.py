@@ -769,7 +769,13 @@ def run_worker(store, drill, dense, state, args, result_dir):
                 # plus the certifier account for exactly that window.
                 coalescer.flush()
             if pub is not None:
-                pub.publish(view)  # pub.on_publish swaps the read replica
+                # defer=True (ingest fast path): delta windows stage
+                # host-side and ship as ONE coalesced range frame when
+                # the coalesce cap fills or an anchor lands — the
+                # pipeline flush + the explicit flush_wire below bound
+                # how long a window can stay parked. on_publish still
+                # swaps the read replica every boundary.
+                pub.publish(view, defer=True)
             else:
                 store.publish(drill.publish_name, view, step)
                 _serve_swap(view, step)
@@ -912,6 +918,12 @@ def run_worker(store, drill, dense, state, args, result_dir):
         swept = ovl.close(view)
         if swept is not view:
             state = drill.set_view(dense, state, swept)
+        if pub is not None:
+            # Ship any wire windows the deferred boundaries left staged.
+            # The serial loop's own publishes would flush them too, but
+            # a full anchor landing first would DISCARD them — flushing
+            # here lets peers chain the tail instead of resyncing.
+            pub.flush_wire()
 
     # Final convergence: publish/sweep until every member that ever
     # published has either published its FINAL state or is confidently

@@ -1,0 +1,448 @@
+"""Ingest fast path (PR 15): wire-window compaction differential suite.
+
+The tentpole claim is an *equivalence*: a publisher that coalesces K
+pending windows into one range-framed wire blob (`CCRF` + [lo..hi] +
+payload, net/transport.py) and a receiver that decodes frame runs in
+batches (parallel/overlap.py DeltaPrefetcher) must land every member on
+states BIT-IDENTICAL to the per-delta chain — under seeded simulator
+chaos (loss + duplication + partition + crash), with the tiny apply
+queue forced to shed, and with the `CCRDT_INGEST_COMPACT=0` kill switch
+as the reference arm. Alongside the equivalence:
+
+* legacy interop both directions — a compacted frame fed to the legacy
+  decode path (raw `serial.loads_dense`) must FAIL cleanly and the
+  anchor fallback must heal the legacy peer; plain single-seq blobs
+  from a compact-off publisher must chain through the range-aware
+  receiver as the degenerate [seq..seq] frame;
+* the PR 10 replay certificate over a compacted run — `lo` rides the
+  publish/apply events, so `audit_apply_order` accepts the range jump
+  as chained, not a gap-skip, and `certify()` signs ok;
+* the `ingest.decode` fault point — a poisoned batch decode degrades to
+  per-frame decode (`ingest.decode_degraded` billed) and never wedges.
+
+`run_ingest_chaos` is also the drill behind the chaos_gate ingest leg
+(scripts/chaos_gate.py INGEST_REQUIRED_NONZERO).
+"""
+
+import os
+import sys
+import zlib
+
+import pytest
+
+from antidote_ccrdt_tpu.core import serial
+from antidote_ccrdt_tpu.net.sim import SimNet
+from antidote_ccrdt_tpu.net.transport import (
+    FRAME_MAGIC,
+    GossipNode,
+    decode_range_frame,
+    encode_range_frame,
+)
+from antidote_ccrdt_tpu.obs import events as obs_events
+from antidote_ccrdt_tpu.obs.audit import certify, verify_certificate
+from antidote_ccrdt_tpu.parallel.delta import like_delta_for
+from antidote_ccrdt_tpu.parallel.elastic import (
+    DeltaPublisher,
+    GossipStore,
+    my_replicas,
+    sweep_deltas,
+)
+from antidote_ccrdt_tpu.parallel.overlap import OverlapPipeline
+from antidote_ccrdt_tpu.utils import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+from elastic_demo import DRILLS, R, STEPS, reference_digest  # noqa: E402
+
+N = 4
+DT = 0.1
+TIMEOUT = 0.35
+
+
+def _compact_env(on: bool):
+    """Set/restore the kill switch around a drill arm."""
+    prev = os.environ.get("CCRDT_INGEST_COMPACT")
+    os.environ["CCRDT_INGEST_COMPACT"] = "1" if on else "0"
+    return prev
+
+
+def _restore_env(prev):
+    if prev is None:
+        os.environ.pop("CCRDT_INGEST_COMPACT", None)
+    else:
+        os.environ["CCRDT_INGEST_COMPACT"] = prev
+
+
+def run_ingest_chaos(type_name, seed, *, compact=True, loss=0.05, dup=0.05,
+                     depth=2, drain_every=4):
+    """tests/test_overlap.run_overlap_chaos with the publishers DEFERRING
+    delta windows (`publish(..., defer=True)`): windows stage until the
+    coalesce cap fills or an anchor supersedes them, so the wire carries
+    range frames instead of per-window blobs. The tiny queue + withheld
+    drains still force the shed path; the final convergence loop
+    publishes non-deferred (each publish flushes the staged tail first),
+    keeps adopting late-detected deaths, and must land every survivor on
+    the sequential reference digest. `compact=False` is the
+    CCRDT_INGEST_COMPACT=0 kill-switch arm of the differential.
+
+    depth=2/drain_every=4/coalesce-cap 2 (tighter than
+    run_overlap_chaos): coalescing cuts wire entries ~K-fold, so the
+    overlap drill's depth-3 queue never overflows under compaction and
+    the shed keeps hitting snapshots — the DELTA shed (the hole-healing
+    path this differential must cover) needs the smaller queue and
+    several frames per anchor interval to fire at all."""
+    prev_env = _compact_env(compact)
+    prev_k = os.environ.get("CCRDT_INGEST_COALESCE")
+    os.environ["CCRDT_INGEST_COALESCE"] = "2"
+    try:
+        net = SimNet(seed=seed, latency=(0.001, 0.02), loss=loss, dup=dup)
+        drill = DRILLS[type_name]
+        dense = drill.make_engine()
+        names = [f"m{i}" for i in range(N)]
+        nodes = {m: GossipNode(net.join(m)) for m in names}
+        states = {m: drill.init(dense) for m in names}
+        # full_every=8 with a publish EVERY step: the coalesce cap (4)
+        # fills strictly inside an anchor interval, so full range frames
+        # ship mid-chaos — full_every=4 would let every anchor supersede
+        # the staged windows before a frame ever formed.
+        pubs = {
+            m: DeltaPublisher(nodes[m], dense, name=drill.publish_name,
+                              full_every=8)
+            for m in names
+        }
+        owned = {m: set() for m in names}
+        crashed = set()
+
+        for _ in range(3):
+            for m in names:
+                nodes[m].heartbeat()
+            net.advance(DT)
+        for m in names:
+            assert set(nodes[m].members()) == set(names), \
+                "bootstrap incomplete"
+
+        ovls = {
+            m: OverlapPipeline(
+                nodes[m], dense, drill.pub_state(dense, states[m]),
+                depth=depth, start_thread=False,
+            )
+            for m in names
+        }
+
+        def drain(m):
+            view = drill.pub_state(dense, states[m])
+            swept = ovls[m].drain_into(view)
+            if swept is not view:
+                states[m] = drill.set_view(dense, states[m], swept)
+
+        for step in range(STEPS):
+            if step == 3:
+                net.partition({"m0", "m1"}, {"m2", "m3"})
+            if step == 6:
+                net.heal()
+            if step == 7:
+                net.crash("m3")
+                crashed.add("m3")
+            for m in names:
+                if m in crashed:
+                    continue
+                node = nodes[m]
+                node.heartbeat()
+                now_owned = owned[m] | set(my_replicas(node, R, TIMEOUT))
+                gained = now_owned - owned[m]
+                if gained:
+                    states[m] = drill.adopt(
+                        dense, states[m], sorted(gained), step
+                    )
+                owned[m] = now_owned
+                states[m] = drill.apply(
+                    dense, states[m], step, sorted(owned[m])
+                )
+                pubs[m].publish(
+                    drill.pub_state(dense, states[m]), defer=True
+                )
+                ovls[m].prefetch.poll()
+                if step % drain_every == drain_every - 1:
+                    drain(m)
+            net.advance(DT)
+
+        net.loss = net.dup = 0.0
+        ref = reference_digest(type_name)
+        live = [m for m in names if m not in crashed]
+        for _ in range(40):
+            for m in live:
+                node = nodes[m]
+                node.heartbeat()
+                now_owned = owned[m] | set(my_replicas(node, R, TIMEOUT))
+                gained = now_owned - owned[m]
+                if gained:
+                    states[m] = drill.adopt(
+                        dense, states[m], sorted(gained), STEPS
+                    )
+                owned[m] = now_owned
+                # Non-deferred: ships any staged tail (flush_wire runs
+                # inside publish) plus this window — the convergence
+                # loop must never leave windows parked host-side.
+                pubs[m].publish(drill.pub_state(dense, states[m]))
+                ovls[m].prefetch.poll()
+                drain(m)
+            net.advance(DT)
+            if all(drill.digest(dense, states[m]) == ref for m in live):
+                break
+
+        for m in names:
+            ovls[m].host.close()
+        digests = {m: drill.digest(dense, states[m]) for m in live}
+        counters = dict(net.metrics.counters)
+        for m in live:
+            for k, v in nodes[m].metrics.snapshot()["counters"].items():
+                if k.startswith(("overlap.", "ingest.", "net.")):
+                    counters[k] = counters.get(k, 0.0) + v
+        return digests, counters
+    finally:
+        _restore_env(prev_env)
+        if prev_k is None:
+            os.environ.pop("CCRDT_INGEST_COALESCE", None)
+        else:
+            os.environ["CCRDT_INGEST_COALESCE"] = prev_k
+
+
+# -- the differential: compacted chaos vs reference vs kill switch ------------
+
+
+@pytest.mark.slow
+def test_compact_chaos_bit_identical_with_forced_shed():
+    """Compacted ingest under seeded loss/dup/partition/crash with the
+    apply queue forced to overflow: every survivor must land exactly on
+    the sequential reference, range frames must actually have crossed
+    the wire, and the shed path must actually have fired (otherwise the
+    drill proved nothing about hole-healing under compaction)."""
+    digests, counters = run_ingest_chaos("topk_rmv", seed=7)
+    ref = reference_digest("topk_rmv")
+    assert ref, "reference observable is empty — drill is vacuous"
+    for m, d in digests.items():
+        assert d == ref, f"{m} diverged\ngot: {d}\nref: {ref}"
+    assert counters.get("ingest.coalesced_frames", 0) > 0, counters
+    assert counters.get("ingest.coalesced_ops", 0) > 0, counters
+    assert counters.get("overlap.prefetched_deltas", 0) > 0, counters
+    assert counters.get("overlap.dropped_deltas", 0) > 0, counters
+
+
+@pytest.mark.slow
+def test_kill_switch_rerun_is_bit_identical():
+    """CCRDT_INGEST_COMPACT=0 must be a true kill switch: the same
+    seeded chaos schedule replayed with compaction off converges to the
+    same digests, and ships zero compacted frames."""
+    d_on, c_on = run_ingest_chaos("topk_rmv", seed=11)
+    d_off, c_off = run_ingest_chaos("topk_rmv", seed=11, compact=False)
+    ref = reference_digest("topk_rmv")
+    assert d_on == d_off
+    for m, d in d_on.items():
+        assert d == ref, f"{m} diverged under compaction"
+    assert c_on.get("ingest.coalesced_frames", 0) > 0, c_on
+    assert c_off.get("ingest.coalesced_frames", 0) == 0, c_off
+
+
+# -- two-store publisher/receiver fixtures ------------------------------------
+
+
+def _two_stores(tmp_path):
+    drill = DRILLS["topk_rmv"]
+    dense = drill.make_engine()
+    a = GossipStore(str(tmp_path), "a")
+    b = GossipStore(str(tmp_path), "b")
+    return drill, dense, a, b
+
+
+def _publish_windows(drill, dense, pub, steps=5):
+    """Anchor (seq 1, _prev None) + `steps` deferred delta windows; the
+    last flush_wire ships whatever the coalesce cap left staged.
+    Returns the publisher's final engine state."""
+    st = drill.init(dense)
+    st = drill.apply(dense, st, 0, range(R))
+    pub.publish(drill.pub_state(dense, st))          # seq 0: anchor
+    for step in range(1, steps + 1):
+        st = drill.apply(dense, st, step, range(R))
+        pub.publish(drill.pub_state(dense, st), defer=True)
+    pub.flush_wire()
+    return st
+
+
+def test_compacted_sweep_bit_identical_to_per_delta(tmp_path):
+    """Same op stream published twice — deferred/compacted vs per-delta
+    — swept by the range-aware receiver: identical digests, and the
+    compacted arm's cursor lands on the same final seq."""
+    drill, dense, a, b = _two_stores(tmp_path)
+    prev_env = _compact_env(True)
+    try:
+        pub = DeltaPublisher(a, dense, name=drill.publish_name,
+                             full_every=100)
+        st = _publish_windows(drill, dense, pub)
+        cursors = {}
+        pb = drill.pub_state(dense, drill.init(dense))
+        pb, _ = sweep_deltas(b, dense, pb, cursors)
+        got = drill.set_view(dense, drill.init(dense), pb)
+        assert drill.digest(dense, got) == drill.digest(dense, st)
+        # The receiver's cursor jumped ACROSS the range frames to the
+        # publisher's head — no per-seq walk, no gap resync.
+        assert cursors["a"] == pub.seq
+        assert a.metrics.snapshot()["counters"].get(
+            "ingest.coalesced_frames", 0
+        ) > 0
+    finally:
+        _restore_env(prev_env)
+
+
+def test_legacy_blobs_chain_through_range_aware_receiver(tmp_path):
+    """Interop, legacy -> new: a kill-switched publisher ships plain
+    single-seq blobs (no CCRF header anywhere on the wire); the
+    range-aware sweep must chain them as degenerate [seq..seq] frames
+    and converge without a single anchor resync past the bootstrap."""
+    drill, dense, a, b = _two_stores(tmp_path)
+    prev_env = _compact_env(False)
+    try:
+        pub = DeltaPublisher(a, dense, name=drill.publish_name,
+                             full_every=100)
+        st = _publish_windows(drill, dense, pub)
+        for seq in a.delta_seqs("a"):
+            raw = b.transport.fetch_delta("a", seq)
+            assert raw is not None and raw[:4] != FRAME_MAGIC
+        cursors = {}
+        pb = drill.pub_state(dense, drill.init(dense))
+        pb, _ = sweep_deltas(b, dense, pb, cursors)
+        got = drill.set_view(dense, drill.init(dense), pb)
+        assert drill.digest(dense, got) == drill.digest(dense, st)
+        assert cursors["a"] == pub.seq
+    finally:
+        _restore_env(prev_env)
+
+
+def test_compacted_frame_fails_legacy_decode_anchor_heals(tmp_path):
+    """Interop, new -> legacy: a legacy peer's decode path (raw
+    `serial.loads_dense`, no CCRF deframing) must REJECT a compacted
+    frame outright — the magic differs by design — after which the
+    publisher's NEXT full anchor heals it (the frames themselves are
+    invisible to a legacy peer). No torn half-decode, no wedge."""
+    drill, dense, a, b = _two_stores(tmp_path)
+    prev_env = _compact_env(True)
+    try:
+        # full_every=6: seq 0 anchors (first publish), 1..5 are the
+        # framed windows, and the post-frame publish below (seq 6)
+        # lands the anchor a legacy peer resyncs through.
+        pub = DeltaPublisher(a, dense, name=drill.publish_name,
+                             full_every=6)
+        st = _publish_windows(drill, dense, pub)
+        framed = [
+            s for s in a.delta_seqs("a")
+            if b.transport.fetch_delta("a", s)[:4] == FRAME_MAGIC
+        ]
+        assert framed, "no compacted frame reached the wire"
+        raw = b.transport.fetch_delta("a", framed[0])
+        with pytest.raises(Exception):
+            serial.loads_dense(
+                raw, like_delta_for(
+                    dense, drill.pub_state(dense, drill.init(dense))
+                )
+            )
+        # The new-side deframe of the same bytes is exact.
+        lo, hi, payload = decode_range_frame(raw, framed[0])
+        assert lo < hi == framed[0]
+        assert encode_range_frame(lo, hi, payload) == raw
+        # Legacy recovery path: the next anchor publish, then a
+        # full-snapshot fetch of it.
+        st = drill.apply(dense, st, 6, range(R))
+        res = pub.publish(drill.pub_state(dense, st))
+        assert res["kind"] == "full"
+        pb = drill.pub_state(dense, drill.init(dense))
+        got_snap = b.fetch("a", pb, dense=dense)
+        assert got_snap is not None
+        _seq, peer = got_snap
+        healed = drill.set_view(
+            dense, drill.init(dense), dense.merge(pb, peer)
+        )
+        assert drill.digest(dense, healed) == drill.digest(dense, st)
+    finally:
+        _restore_env(prev_env)
+
+
+# -- replay certificate over a compacted run (PR 10 interop) ------------------
+
+
+def test_replay_certificate_over_compacted_run(tmp_path):
+    """The flight-recorder events of a compacted publish/sweep run must
+    replay-certify clean: `delta.publish`/`delta.apply` carry `lo`, the
+    causal-delivery audit accepts the range jumps as chained, and the
+    signed certificate verifies. A compacted frame must actually be in
+    evidence (else the test is the legacy certificate test again)."""
+    drill, dense, a, b = _two_stores(tmp_path)
+    prev_env = _compact_env(True)
+    obs_events.reset("ingest-cert")
+    try:
+        pub = DeltaPublisher(a, dense, name=drill.publish_name,
+                             full_every=100)
+        st = _publish_windows(drill, dense, pub)
+        cursors = {}
+        pb = drill.pub_state(dense, drill.init(dense))
+        pb, _ = sweep_deltas(b, dense, pb, cursors)
+        got = drill.set_view(dense, drill.init(dense), pb)
+        dig = drill.digest(dense, got)
+        assert dig == drill.digest(dense, st)
+        # The drill digest is a list of tuples; the certificate's
+        # agreement probe wants a scalar (or int-vector) digest.
+        dig_crc = zlib.crc32(repr(dig).encode())
+
+        evs = obs_events.events()
+        pubs = [dict(e, member="a") for e in evs
+                if e["kind"] == "delta.publish" and e.get("origin") == "a"]
+        apps = [dict(e, member="b") for e in evs
+                if e["kind"] == "delta.apply" and e.get("origin") == "a"]
+        assert any(e.get("lo", e["dseq"]) < e["dseq"] for e in pubs), \
+            "no compacted frame in evidence"
+        assert any(e.get("lo", e["dseq"]) < e["dseq"] for e in apps)
+        cert = certify(
+            logs={"flight-a-1.jsonl": pubs, "flight-b-1.jsonl": apps},
+            digests={"a": dig_crc, "b": dig_crc},
+            reference=dig_crc,
+            meta={"drill": "ingest-compacted"},
+        )
+        assert cert["ok"], cert
+        assert cert["checks"]["causal_delivery"] is True
+        assert cert["checks"]["op_count_reconciliation"] is True
+        assert verify_certificate(cert)
+    finally:
+        _restore_env(prev_env)
+        obs_events.reset("?")
+
+
+# -- the ingest.decode fault point --------------------------------------------
+
+
+def test_ingest_decode_fault_degrades_never_wedges(tmp_path):
+    """A fired `ingest.decode` fault poisons the batched decode pass;
+    the prefetcher must bill `ingest.decode_degraded`, fall back to
+    per-frame decode, and still converge the receiver bit-identically —
+    a corrupt batch stage degrades, it never wedges the chain."""
+    drill, dense, a, b = _two_stores(tmp_path)
+    prev_env = _compact_env(True)
+    try:
+        pub = DeltaPublisher(a, dense, name=drill.publish_name,
+                             full_every=100)
+        st = _publish_windows(drill, dense, pub)
+        ovl = OverlapPipeline(
+            b, dense, drill.pub_state(dense, drill.init(dense)),
+            start_thread=False,
+        )
+        with faults.injected(
+            {"ingest.decode": [{"action": "drop", "at": [0]}]}, seed=5
+        ):
+            while ovl.prefetch.poll():
+                pass
+        pb = ovl.drain_into(drill.pub_state(dense, drill.init(dense)))
+        got = drill.set_view(dense, drill.init(dense), pb)
+        assert drill.digest(dense, got) == drill.digest(dense, st)
+        cnt = b.metrics.snapshot()["counters"]
+        assert cnt.get("ingest.decode_degraded", 0) > 0, cnt
+        ovl.host.close()
+    finally:
+        _restore_env(prev_env)

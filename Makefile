@@ -23,7 +23,7 @@ PY ?= python
 # meaningful.
 COVER_THRESHOLD ?= 88
 
-.PHONY: all compile test cover typecheck xref native bench benchall dryrun net-demo chaos crash-demo obs-demo topo-demo spans-demo overlap-demo partition-demo serve-demo audit-demo multichip-demo working-set-demo read-tier-demo bench-gate clean
+.PHONY: all compile test cover typecheck xref native bench benchall dryrun net-demo chaos crash-demo obs-demo topo-demo spans-demo overlap-demo partition-demo serve-demo audit-demo multichip-demo working-set-demo read-tier-demo write-tier-demo bench-gate clean
 
 all: compile xref typecheck cover
 
@@ -95,7 +95,16 @@ net-demo:
 # honestly (zero hangs, zero bound violations), the router counters the
 # dashboard renders must be lit, and certify_sessions must sign a
 # clean certificate while the deliberately token-violating arm FAILS
-# with a counterexample; refreshes READTIER_r01.json.
+# with a counterexample; refreshes READTIER_r01.json. The closing leg
+# is the fleet WRITE tier (scripts/write_tier_demo.py): writer sessions
+# batch client effects through serve/write_session.py ->
+# serve/ingest.py into a WAL-armed fleet, the hot key's HRW owner is
+# SIGKILLed mid-load, and the gate requires zero hung / silently
+# dropped writes, nonzero durable AND replicated_to_k acks (including
+# from the victim pre-kill), honest retry_after_ms sheds, the
+# router.write* counters lit, and certify_writes signing ZERO
+# acked-but-lost writes while the ack-before-fsync arm FAILS with the
+# lost seq range named; refreshes WRITETIER_r01.json.
 chaos:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py tests/test_wal.py tests/test_fault_matrix.py -q -p no:cacheprovider
 	env JAX_PLATFORMS=cpu $(PY) scripts/chaos_gate.py
@@ -105,6 +114,7 @@ chaos:
 	env JAX_PLATFORMS=cpu $(PY) scripts/overlap_demo.py
 	env JAX_PLATFORMS=cpu $(PY) scripts/working_set_demo.py
 	env JAX_PLATFORMS=cpu $(PY) scripts/read_tier_demo.py
+	env JAX_PLATFORMS=cpu $(PY) scripts/write_tier_demo.py
 
 # Throughput regression gate: best merges_per_sec of the latest
 # BENCH_r*.json round must stay within 20% of the best prior round —
@@ -220,6 +230,24 @@ working-set-demo:
 # `make chaos`.
 read-tier-demo:
 	env JAX_PLATFORMS=cpu $(PY) scripts/read_tier_demo.py
+
+# Fleet write-tier gate (slow, real processes): writer sessions compact
+# client effect bursts into single CCRF range frames
+# (serve/write_session.py) and route them owner-first through
+# serve/ingest.py's WriteRouter into a 4-worker WAL-armed TCP fleet
+# (CCRDT_INGEST=1) under seeded chaos, with the hot key's HRW owner
+# SIGKILLed mid-load. Gated on zero hung or silently dropped writes,
+# nonzero durable AND replicated_to_k acks (victim included), honest
+# admission sheds (retry_after_ms), cross-tier read-your-writes via
+# shared session tokens, the router.write* counters lit, survivors
+# converging bit-identically, and obs/audit.py's certify_writes
+# signing ZERO acked-but-lost writes — while the deliberately
+# violating ack-before-fsync arm FAILS certification with the lost
+# seq range named. Writes WRITETIER_r01.json (the carrier
+# bench_gate's evaluate_write compares). Also the closing leg of
+# `make chaos`.
+write-tier-demo:
+	env JAX_PLATFORMS=cpu $(PY) scripts/write_tier_demo.py
 
 # Span-tracing demo (slow, real processes): a 3-worker TCP fleet with
 # the round-phase span plane armed (CCRDT_SPANS=1) — every worker's

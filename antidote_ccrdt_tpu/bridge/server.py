@@ -1239,6 +1239,7 @@ class BridgeServer:
         # the bridge is the third query surface (tcp frame, HTTP POST,
         # and this), all carrying the same canonical bytes.
         self.query_handler = None
+        self.write_handler = None
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -1290,6 +1291,15 @@ class BridgeServer:
             self.query_handler = handler_for("bridge")
         else:
             self.query_handler = getattr(plane, "handle", plane)
+
+    def install_ingest(self, plane) -> None:
+        """Attach an ingest plane (or any bytes->bytes handler); the
+        {write} op starts answering. Mirrors TcpTransport.install_ingest."""
+        handler_for = getattr(plane, "handler_for", None)
+        if callable(handler_for):
+            self.write_handler = handler_for("bridge")
+        else:
+            self.write_handler = getattr(plane, "handle", plane)
 
     # -- dispatch ----------------------------------------------------------
 
@@ -1609,6 +1619,16 @@ class BridgeServer:
             if handler is None:
                 raise ValueError("no serve plane installed")
             self.metrics.count("bridge.queries")
+            return bytes(handler(bytes(op[1])))
+        if tag == "write":
+            # {write, Payload} -> ingest-plane ack bytes, verbatim. Same
+            # canonical codec as the tcp {write} frame and POST /write,
+            # so host-language writers get byte-identical acks — and the
+            # same tiered durability contract — on every surface.
+            handler = self.write_handler
+            if handler is None:
+                raise ValueError("no ingest plane installed")
+            self.metrics.count("bridge.writes")
             return bytes(handler(bytes(op[1])))
         raise ValueError(f"unknown op: {tag}")
 

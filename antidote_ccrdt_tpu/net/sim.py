@@ -206,6 +206,15 @@ class SimTransport:
         # (possibly dup-delivered) responses can still be in flight, so
         # long chaos drills don't accumulate spent qids forever.
         self._query_cancelled: Dict[bytes, None] = {}
+        # Ingest plane: in-band "write" messages, the write tier's twin
+        # of the query plumbing above — same synchronous handler, same
+        # wid-keyed results + cancellation-drop discipline, so sim
+        # drills shake owner failover / duplicate delivery exactly as
+        # the sockets would.
+        self.write_handler = None
+        self.write_acks: List[Tuple[str, bytes]] = []
+        self.write_results: Dict[bytes, Tuple[str, bytes]] = {}
+        self._write_cancelled: Dict[bytes, None] = {}
 
     def local_clock(self) -> float:
         """This member's view of time: virtual clock + its skew."""
@@ -242,6 +251,43 @@ class SimTransport:
             else ("query", self.member, bytes(payload), bytes(qid))
         )
         self._send(peer, msg, False, len(payload))
+
+    def install_ingest(self, plane) -> None:
+        """Attach an ingest plane (or any bytes->bytes handler), exactly
+        as `TcpTransport.install_ingest` — sim drills exercise the same
+        write path chaos-deterministically."""
+        handler_for = getattr(plane, "handler_for", None)
+        if callable(handler_for):
+            self.write_handler = handler_for("sim")
+        else:
+            self.write_handler = getattr(plane, "handle", plane)
+
+    def write(self, peer: str, payload: bytes,
+              wid: Optional[bytes] = None) -> None:
+        """Send one ingest-plane write to `peer`; the ack arrives in
+        `self.write_acks` as (peer, bytes) once the net delivers it.
+        With `wid` (opaque router metadata, echoed by the peer) it ALSO
+        lands in `self.write_results[wid]` — unless `cancel_write(wid)`
+        ran first, in which case the late ack is dropped (the payload's
+        write_id still dedups any retry at the plane)."""
+        self._check_live()
+        msg = (
+            ("write", self.member, bytes(payload)) if wid is None
+            else ("write", self.member, bytes(payload), bytes(wid))
+        )
+        self._send(peer, msg, False, len(payload))
+
+    def cancel_write(self, wid: bytes) -> None:
+        """Abandon an in-flight wid: its ack, if it ever arrives, is
+        dropped instead of delivered — same bounded-set discipline as
+        `cancel_query`. Note this abandons only the ACK; whether the
+        write folded is the plane's business, which is why retries
+        carry the same write_id."""
+        wid = bytes(wid)
+        self._write_cancelled[wid] = None
+        while len(self._write_cancelled) > 1024:
+            self._write_cancelled.pop(next(iter(self._write_cancelled)))
+        self.write_results.pop(wid, None)
 
     def cancel_query(self, qid: bytes) -> None:
         """Abandon an in-flight qid: its response, if it ever arrives,
@@ -560,6 +606,43 @@ class SimTransport:
                 self.query_resps.append((src, bytes(msg[2])))
                 if qid is not None:
                     self.query_results[qid] = (src, bytes(msg[2]))
+        elif kind == "write":
+            payload = msg[2]
+            # Same tuple convention as "query": the piggybacked heard
+            # dict is the last element, so wid-bearing writes are
+            # 5-tuples and wid-less ones 4-tuples.
+            wid = bytes(msg[3]) if len(msg) > 4 else None
+            handler = self.write_handler
+            self.metrics.count("net.writes")
+            if handler is not None:
+                try:
+                    resp = bytes(handler(bytes(payload)))
+                except Exception as e:  # noqa: BLE001 — degrade, never wedge
+                    import json as _json
+
+                    resp = _json.dumps({"error": str(e)}).encode("utf-8")
+            else:
+                import json as _json
+
+                resp = _json.dumps(
+                    {"error": "no ingest plane"}
+                ).encode("utf-8")
+            out = (
+                ("write_ack", self.member, resp) if wid is None
+                else ("write_ack", self.member, resp, wid)
+            )
+            self._send(src, out, False, len(resp))
+        elif kind == "write_ack":
+            wid = bytes(msg[3]) if len(msg) > 4 else None
+            if wid is not None and wid in self._write_cancelled:
+                # Cancelled in flight: the router already failed over; a
+                # late duplicate ack must not surface (the successor's
+                # ack is the one the client keeps).
+                self.metrics.count("net.write_cancelled_drops")
+            else:
+                self.write_acks.append((src, bytes(msg[2])))
+                if wid is not None:
+                    self.write_results[wid] = (src, bytes(msg[2]))
         elif kind == "psnap_req":
             parts = msg[2]
             self.metrics.count("net.psnap_reqs_recv")

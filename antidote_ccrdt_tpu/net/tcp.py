@@ -112,6 +112,8 @@ A_PSNAP = Atom("psnap")
 A_PSNAP_REQ = Atom("psnap_req")
 A_QUERY = Atom("query")
 A_QUERY_RESP = Atom("query_resp")
+A_WRITE = Atom("write")
+A_WRITE_ACK = Atom("write_ack")
 
 _SNAP, _DELTA, _PING, _DIG, _PSNAP = "snap", "delta", "ping", "dig", "psnap"
 
@@ -212,6 +214,59 @@ def query_peer(
                         len(term) < 4 or bytes(term[3]) != bytes(qid)
                     ):
                         continue  # someone else's (stale) answer
+                    return term[1].decode("utf-8"), bytes(term[2])
+
+
+def write_peer(
+    addr: Tuple[str, int],
+    payload: bytes,
+    timeout: float = 2.0,
+    cancel: Optional[threading.Event] = None,
+    connect_timeout: Optional[float] = None,
+    wid: Optional[bytes] = None,
+) -> Tuple[str, bytes]:
+    """One-shot ingest-plane write against a live `TcpTransport`: send
+    `{write, Payload[, Wid]}`, return (member, ack bytes — the ingest
+    plane's canonical JSON, verbatim). The SAME deadline/cancel
+    contract as `query_peer`: the deadline is checked on every loop
+    turn so a peer that accepts the frame and never acks surfaces
+    `socket.timeout` (the write router fails over — safely, because the
+    payload's write_id dedups at the successor), and `cancel` aborts
+    with `QueryCancelled`. `wid` is opaque router correlation metadata
+    echoed in the ack frame. The writer never joins the membership."""
+    deadline = time.monotonic() + timeout
+    frame: Tuple[Any, ...] = (
+        (A_WRITE, bytes(payload)) if wid is None
+        else (A_WRITE, bytes(payload), bytes(wid))
+    )
+    with socket.create_connection(
+        addr, timeout=(connect_timeout if connect_timeout is not None
+                       else timeout)
+    ) as s:
+        s.sendall(pack_frame(frame))
+        buf = bytearray()
+        while True:
+            now = time.monotonic()
+            if now >= deadline:
+                raise socket.timeout(
+                    f"write deadline exceeded ({timeout}s, no write_ack)"
+                )
+            if cancel is not None and cancel.is_set():
+                raise QueryCancelled("write cancelled by router")
+            s.settimeout(max(0.01, min(0.1, deadline - now)))
+            try:
+                data = s.recv(1 << 16)
+            except socket.timeout:
+                continue  # no bytes this slice; deadline check re-arms
+            if not data:
+                raise ConnectionError("write connection closed before ack")
+            buf.extend(data)
+            for term in unpack_frames(buf):
+                if term[0] == A_WRITE_ACK:
+                    if wid is not None and (
+                        len(term) < 4 or bytes(term[3]) != bytes(wid)
+                    ):
+                        continue  # someone else's (stale) ack
                     return term[1].decode("utf-8"), bytes(term[2])
 
 
@@ -499,6 +554,7 @@ class TcpTransport:
         # handler (bytes -> bytes) when a plane is installed; None means
         # this worker does not serve reads (error reply, never a hang).
         self.query_handler: Optional[Callable[[bytes], bytes]] = None
+        self.write_handler: Optional[Callable[[bytes], bytes]] = None
         self._closed = False
 
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -542,6 +598,18 @@ class TcpTransport:
             self.query_handler = handler_for("tcp")
         else:
             self.query_handler = getattr(plane, "handle", plane)
+
+    def install_ingest(self, plane: Any) -> None:
+        """Attach an ingest plane (or any bytes->bytes handler): inbound
+        `{write, Payload}` frames are answered with `{write_ack, Member,
+        AckBytes}` on the same connection — the write tier's twin of
+        `install_serve`. A real `IngestPlane` gets its "tcp"-labelled
+        handler so write sheds on this surface count separately."""
+        handler_for = getattr(plane, "handler_for", None)
+        if callable(handler_for):
+            self.write_handler = handler_for("tcp")
+        else:
+            self.write_handler = getattr(plane, "handle", plane)
 
     def learn_zone(self, name: str, zone: str) -> None:
         """Feed static zone config (address files, CLI) into the map —
@@ -879,6 +947,17 @@ class TcpTransport:
                 qid = bytes(term[2]) if len(term) > 2 else None
                 self._send_query_resp(conn, bytes(term[1]), qid=qid)
             return
+        if tag == A_WRITE:
+            # Ingest-plane write: same reply-on-inbound-connection
+            # contract — the writer never joins the membership. The
+            # handler BLOCKS this reader thread until the round loop
+            # drains the write (bounded by the plane's ack timeout);
+            # that is safe here because every inbound connection gets
+            # its own reader thread.
+            if conn is not None and len(term) > 1:
+                wid = bytes(term[2]) if len(term) > 2 else None
+                self._send_write_ack(conn, bytes(term[1]), wid=wid)
+            return
         if tag == A_HELLO:
             # Link setup from a topo-aware peer: learn its zone, answer
             # with ours and the best codec we can decode of its offer.
@@ -1155,6 +1234,48 @@ class TcpTransport:
             if faults.ACTIVE and faults.fire("tcp.send") == "drop":
                 self.metrics.count("net.fault_drops")
                 raise OSError("injected query-reply drop")
+            conn.sendall(frame)
+        except OSError:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _send_write_ack(
+        self, conn: socket.socket, payload: bytes,
+        wid: Optional[bytes] = None,
+    ) -> None:
+        """Answer one `{write, Payload}` via the installed ingest plane.
+        Degrade-never-hang, exactly like `_send_query_resp`: a handler
+        failure or the `tcp.send` fault point closes the connection, so
+        the writer sees EOF/error within its own timeout and retries
+        idempotently by write_id."""
+        self.metrics.count("net.writes")
+        try:
+            handler = self.write_handler
+            if handler is None:
+                from ..serve import plane as serve_plane
+
+                resp = serve_plane.encode(
+                    {"member": self.member, "error": "no ingest plane"}
+                )
+            else:
+                resp = bytes(handler(payload))
+        except Exception:  # noqa: BLE001 — degrade: close, writer times out
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        frame = pack_frame(
+            (A_WRITE_ACK, self.member.encode("utf-8"), resp)
+            if wid is None
+            else (A_WRITE_ACK, self.member.encode("utf-8"), resp, wid)
+        )
+        try:
+            if faults.ACTIVE and faults.fire("tcp.send") == "drop":
+                self.metrics.count("net.fault_drops")
+                raise OSError("injected write-ack drop")
             conn.sendall(frame)
         except OSError:
             try:

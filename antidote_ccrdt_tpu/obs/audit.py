@@ -630,6 +630,176 @@ def certify_sessions(
     return doc
 
 
+# -- write-ack durability certification --------------------------------------
+
+
+WRITE_CERTIFICATE_KIND = "ccrdt-write-durability-certificate"
+WRITE_CERTIFICATE_VERSION = 1
+
+
+def certify_writes(
+    obs_dir: Optional[str] = None,
+    logs: Optional[Dict[str, List[Dict[str, Any]]]] = None,
+    meta: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Replay the flight log's ``ingest.ack`` events (what the write
+    tier TOLD clients they hold) against the fleet's durability and
+    replication evidence, and certify **zero acked-but-lost writes** —
+    the write-path twin of `certify_sessions`, and the check the
+    acceptance drill SIGKILLs a partition owner against.
+
+    An ack at level ``durable`` or ``replicated_to_k`` for ``(origin,
+    wseq)`` is a contract: the write must survive the origin's death.
+    Coverage is recomputed here from raw events — the acking plane is
+    NOT trusted (the deliberately-violating ack-before-fsync arm still
+    records truthful ``wal.durable`` watermarks, and this replay is
+    what convicts it). ``(origin, s)`` is covered iff any of:
+
+    * every incarnation of `origin` exited cleanly (``proc.exit``:
+      close() flushed, nothing was lost);
+    * some incarnation of `origin` fsynced through s (``wal.durable``
+      through >= s — the honest plane never acks ``durable`` before
+      this watermark passes);
+    * a restarted `origin` recovered its WAL tail through s
+      (``wal.recover`` last_step >= s: the record survived on disk —
+      `harness.wal.log_step` serialized the post-fold view, so the
+      client write is inside it);
+    * a SURVIVOR holds it: another member applied origin's delta/snap
+      stream through s (``delta.apply`` dseq / ``snap.apply`` step /
+      ``psnap.resync`` dig_seq for that origin) — the state outlives
+      the owner in the fleet even if the owner's disk burned.
+
+    Note what does NOT count: a later incarnation's own re-run
+    ``wal.append`` trail (valid for the step-replay audit in
+    `reconcile_durability`, but a re-run regenerates DRILL load, not
+    client writes). ``applied``-level acks promise nothing across a
+    crash and are reported but never convicted.
+
+    Returns a signed certificate; on failure `ok` is False and
+    `counterexample` names the lost seq range per origin plus the
+    acked write_ids inside it."""
+    if logs is None:
+        logs = obs_events.scan_dir(obs_dir) if obs_dir else {}
+    # -- the promises: client-side acks, grouped by origin ------------
+    acks: Dict[str, List[Tuple[int, str, str]]] = {}
+    n_acks = 0
+    by_level: Dict[str, int] = {}
+    for fname in sorted(logs):
+        for ev in logs[fname]:
+            if ev.get("kind") != "ingest.ack":
+                continue
+            n_acks += 1
+            lvl = str(ev.get("level", ""))
+            by_level[lvl] = by_level.get(lvl, 0) + 1
+            o = str(ev.get("origin"))
+            s = int(ev.get("wseq", -1))
+            if s >= 0:
+                acks.setdefault(o, []).append(
+                    (s, lvl, str(ev.get("write_id", "")))
+                )
+    # -- the evidence: per-origin coverage floors ---------------------
+    exposures: List[Dict[str, Any]] = []
+    per_origin: Dict[str, Dict[str, Any]] = {}
+    for origin in sorted(acks):
+        hard = [
+            (s, lvl, wid) for s, lvl, wid in acks[origin]
+            if lvl in ("durable", "replicated_to_k")
+        ]
+        own_logs = [
+            evs for evs in logs.values()
+            if any(str(e.get("member")) == origin for e in evs
+                   if e.get("member"))
+        ]
+        clean = bool(own_logs) and all(
+            any(e.get("kind") == "proc.exit" for e in evs)
+            for evs in own_logs
+        )
+        durable_floor = max(
+            (
+                int(e["through"])
+                for evs in own_logs for e in evs
+                if e.get("kind") == "wal.durable"
+                and e.get("through") is not None
+            ),
+            default=-1,
+        )
+        recover_floor = max(
+            (
+                int(e.get("last_step", -1))
+                for evs in own_logs for e in evs
+                if e.get("kind") == "wal.recover"
+            ),
+            default=-1,
+        )
+        survivor_floor = -1
+        for fname, evs in logs.items():
+            applier = next(
+                (str(e["member"]) for e in evs if e.get("member")), fname
+            )
+            if applier == origin:
+                continue
+            for e in evs:
+                k = e.get("kind")
+                if str(e.get("origin")) != origin:
+                    continue
+                s = None
+                if k == "delta.apply":
+                    s = e.get("dseq")
+                elif k == "snap.apply":
+                    s = e.get("step")
+                elif k == "psnap.resync":
+                    s = e.get("dig_seq")
+                if s is not None:
+                    survivor_floor = max(survivor_floor, int(s))
+        max_acked = max((s for s, _l, _w in hard), default=-1)
+        cover = max(durable_floor, recover_floor, survivor_floor)
+        if clean:
+            cover = max(cover, max_acked)
+        per_origin[origin] = {
+            "acked_through": max_acked,
+            "n_hard_acks": len(hard),
+            "clean_exit": clean,
+            "durable_floor": durable_floor,
+            "recover_floor": recover_floor,
+            "survivor_floor": survivor_floor,
+            "covered_through": cover,
+        }
+        if max_acked > cover:
+            exposures.append({
+                "origin": origin,
+                "acked_through": max_acked,
+                "covered_through": cover,
+                "uncovered": [cover + 1, max_acked],
+                "lost_write_ids": sorted(
+                    wid for s, _l, wid in hard if s > cover and wid
+                )[:8],
+            })
+    checks = {"acked_durability_coverage": not exposures}
+    ok = all(checks.values())
+    doc: Dict[str, Any] = {
+        "kind": WRITE_CERTIFICATE_KIND,
+        "version": WRITE_CERTIFICATE_VERSION,
+        "t": round(time.time(), 3),
+        "ok": ok,
+        "checks": checks,
+        "n_acks": n_acks,
+        "acks_by_level": by_level,
+        "n_origins": len(acks),
+        "origins": per_origin,
+        "n_flight_logs": len(logs),
+        "meta": meta or {},
+    }
+    if not ok:
+        doc["counterexample"] = {"acked_but_lost": exposures[:5]}
+    sign_certificate(doc)
+    obs_events.emit(
+        "audit.write_certificate", ok=ok,
+        n_exposed=len(exposures),
+        signature=doc["signature"][:16],
+    )
+    return doc
+
+
 # -- lattice-law checking ----------------------------------------------------
 
 

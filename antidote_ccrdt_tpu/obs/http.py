@@ -20,6 +20,10 @@ framework) serving:
   is the canonical query payload, the response the canonical answer
   bytes (byte-identical to the tcp ``{query}`` frame and the bridge op
   for the same request). 404 until a handler is installed.
+* ``POST /write``   — the ingest plane's HTTP surface (PR 16): the body
+  is the canonical write payload (bare JSON or a ``CCRF`` range frame),
+  the response the canonical tiered ack bytes — byte-identical to the
+  tcp ``{write}`` frame and the bridge op. 404 until installed.
 
 Failure behavior mirrors the transports' "degrade, never hang" rule: a
 snapshot/render failure returns a 500 with the error text — the scrape
@@ -71,12 +75,14 @@ class MetricsHttpServer:
         labels: Optional[Dict[str, str]] = None,
         query_handler: Optional[Callable[[bytes], bytes]] = None,
         health_extra: Optional[Callable[[], Dict[str, Any]]] = None,
+        write_handler: Optional[Callable[[bytes], bytes]] = None,
     ):
         self.member = member
         self._source = source
         self._labels = dict(labels) if labels else {"member": member}
         self._t0 = time.time()
         self.query_handler = query_handler
+        self.write_handler = write_handler
         self.health_extra = health_extra
         outer = self
 
@@ -94,8 +100,11 @@ class MetricsHttpServer:
                     self._reply(404, b"not found\n", "text/plain")
 
             def do_POST(self):  # noqa: N802 - http.server API
-                if self.path.split("?", 1)[0] == "/query":
+                path = self.path.split("?", 1)[0]
+                if path == "/query":
                     outer._serve_query(self)
+                elif path == "/write":
+                    outer._serve_write(self)
                 else:
                     self._reply(404, b"not found\n", "text/plain")
 
@@ -176,6 +185,23 @@ class MetricsHttpServer:
             return
         handler._reply(200, resp, "application/json")
 
+    def _serve_write(self, handler) -> None:
+        fn = self.write_handler
+        if fn is None:
+            handler._reply(404, b"no ingest plane\n", "text/plain")
+            return
+        try:
+            n = int(handler.headers.get("Content-Length", "0"))
+            body = handler.rfile.read(n) if n > 0 else b""
+            resp = bytes(fn(body))
+        except Exception as e:  # noqa: BLE001 — degrade to an error
+            # response; the writer retries idempotently by write_id.
+            handler._reply(
+                500, f"write failed: {e}\n".encode("utf-8"), "text/plain"
+            )
+            return
+        handler._reply(200, resp, "application/json")
+
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "MetricsHttpServer":
@@ -232,6 +258,7 @@ def install_from_env(
     addr_dir: Optional[str] = None,
     query_handler: Optional[Callable[[bytes], bytes]] = None,
     health_extra: Optional[Callable[[], Dict[str, Any]]] = None,
+    write_handler: Optional[Callable[[bytes], bytes]] = None,
 ) -> Optional[MetricsHttpServer]:
     """Start a metrics endpoint iff ``CCRDT_HTTP_PORT`` is set (port 0 =
     kernel-assigned). Returns the running server, or None when the env
@@ -251,6 +278,7 @@ def install_from_env(
         port=port,
         query_handler=query_handler,
         health_extra=health_extra,
+        write_handler=write_handler,
     ).start()
     if addr_dir:
         write_addr_file(addr_dir, member, srv.address)

@@ -229,9 +229,10 @@ class DeltaPublisher:
         """Publish one window. With `defer=True` (and the ingest fast
         path enabled) a delta window is STAGED instead of shipped; the
         wire frame goes out when `coalesce_max()` windows are pending,
-        at the next non-deferred publish, or at an explicit
+        at the next non-deferred publish, at an anchor (which flushes
+        the staged tail before it lands), or at an explicit
         `flush_wire()` — whichever comes first. Anchors are never
-        deferred (they supersede any staged windows)."""
+        deferred."""
         from .delta import make_delta
 
         from .monoid import LiftedMonoidState, MonoidLift
@@ -252,11 +253,17 @@ class DeltaPublisher:
             self._next_plan = None
             is_full = self._branch(self.seq)
         if is_full:
-            # Anchors supersede any staged-but-unshipped windows: the
-            # full snapshot IS their join, published at a higher seq, so
-            # peers that never saw the staged seqs resync through it
-            # (the ordinary gap→anchor path).
-            self._staged.clear()
+            # Ship any staged-but-unshipped windows BEFORE the anchor
+            # lands. Discarding them (the anchor IS their join, at a
+            # higher seq) looks like a free optimization, but with the
+            # default coalesce cap (4) >= the drills' full_every (4)
+            # the cap can never fill inside an anchor interval — every
+            # window would be superseded and NO delta ever reaches the
+            # wire: peers resync through full anchors only and the
+            # fast path goes dark. Flushing keeps the chain continuous;
+            # a peer that already swept the anchor skips the older
+            # frame seqs by cursor, so the join is unchanged.
+            self.flush_wire()
             # Under paging the anchor must carry the LOGICAL state —
             # a device-only snapshot would publish identity holes where
             # the cold partitions live.

@@ -769,6 +769,14 @@ class OverlapPipeline:
     def submit(self, fn, *args, **kwargs) -> None:
         self.host.submit(fn, *args, **kwargs)
 
+    def pressure_depth(self) -> int:
+        """Combined backlog an admission controller should gate on: the
+        inbound apply queue PLUS the outbound host-stage queue (pending
+        WAL appends / encodes / sends). The write tier's ingest plane
+        (PR 16) sheds writers on this — a deep host queue means acks
+        would stack behind fsync work the pipeline hasn't run yet."""
+        return len(self.apq) + self.host._q.qsize()
+
     def _apply_sequential(self, state: Any, entries: List[_Entry]) -> Any:
         """Fallback / non-foldable application, entry by entry with the
         sweep_deltas total-failure policy (a malformed payload must not

@@ -1,0 +1,823 @@
+"""Fleet write tier: `IngestPlane` (worker side) + `WriteRouter`
+(client side) — the write-path twin of `serve.plane` + `serve.router`.
+
+Reads got a fleet product in PR 14; writes still entered through
+whichever worker a client happened to hold, with no routing, no
+batching, no durability contract, and no backpressure. This module
+closes that gap:
+
+* **Owner routing.** Every update routes to the partition-owning
+  worker: the head of `topo.anchor.rendezvous_order(key, peers)` — the
+  SAME ranking the read router and the anchor election use, so the
+  fleet agrees on the owner without coordination and a reader's HRW
+  walk lands on the replica its own writes went to (cache affinity +
+  read-your-writes in one move). SWIM-``dead`` verdicts and the shared
+  circuit breakers (`serve.routing_common`) fail writes over to the
+  next candidate — idempotently, because every write carries a client
+  `write_id` the plane dedups on (a retried/failed-over delivery
+  re-acks the original `(origin, seq)`, never double-applies).
+* **Pre-wire batching.** `WriteSession` (serve/write_session.py)
+  compacts a staged burst through `ops.compaction.compact_effect_ops`
+  and ships it as ONE `net.transport` ``CCRF`` range frame — the PR 15
+  coalescing kernels firing BEFORE the wire, on the client, as the CRDT
+  scaling survey frames delta compression at the edge.
+* **Tiered durable acks.** ``applied`` = folded into the owner's
+  in-memory state; ``durable`` = pinned to the PR 11
+  ``wal.durable_seq`` watermark (the plane WAITS for the fsync
+  watermark to pass the write's step before claiming it); and
+  ``replicated_to_k`` = confirmed applied by k distinct members, which
+  the ROUTER certifies by probing the replicas themselves (the owner
+  cannot honestly attest what its peers hold). A level that cannot be
+  reached inside the ack timeout is reported as the level actually
+  achieved — never upgraded, so an ack is a contract, not a hope.
+  ``ack_before_fsync=True`` deliberately breaks that contract (acks
+  ``durable`` without waiting) — the violating arm
+  `obs.audit.certify_writes` must convict.
+* **Admission control.** The bounded ingest queue plus caller-injected
+  pressure probes (WAL durability lag, overlap-queue depth, pager
+  pressure) shed writers with an honest ``retry_after_ms`` derived from
+  the observed drain rate — the write-side mirror of the read tier's
+  `serve.queue_shed`, instead of queueing the overload invisibly.
+
+Writes ride new ``{write}``/``{write_ack}`` frames on `net.tcp` +
+`net.sim`, the bridge ``{write}`` op, and ``POST /write`` — the same
+canonical JSON codec as the read tier, byte-identical on every surface.
+The `utils.faults` point ``router.write`` fires per client attempt
+(drop == connection loss, bills the breaker) and ``serve.write`` per
+plane dispatch, so chaos drills can cut the write path at both ends.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..net.transport import FRAME_MAGIC, decode_range_frame
+from ..obs import events as obs_events
+from ..utils import faults
+from ..utils.metrics import Metrics
+from .plane import encode
+from .routing_common import BreakerBoard, candidate_order
+from .session import ClientSession
+
+ACK_APPLIED = "applied"
+ACK_DURABLE = "durable"
+ACK_REPLICATED = "replicated_to_k"
+_ACK_LEVELS = (ACK_APPLIED, ACK_DURABLE, ACK_REPLICATED)
+
+# Idempotency window: acks remembered per write_id (insertion-ordered
+# eviction). Sized like the sim transport's cancelled-qid window — a
+# retry storm dedups, a week-long drill cannot leak memory.
+_ACK_CACHE_MAX = 4096
+
+
+class _PendingWrite:
+    """One write parked between the transport thread that received it
+    and the round loop that folds it at the next step boundary."""
+
+    __slots__ = ("ops", "write_id", "done", "seq", "error")
+
+    def __init__(self, ops: List[Any], write_id: Optional[str]):
+        self.ops = ops
+        self.write_id = write_id
+        self.done = threading.Event()
+        self.seq = -1
+        self.error: Optional[str] = None
+
+
+class IngestPlane:
+    """Worker-side write front door. Transport threads `handle()` raw
+    ``{write}`` payloads; the worker's round loop `drain()`s the queue
+    at each step boundary, folding every parked write into the live
+    state so a write's ``seq`` IS the step whose WAL record and gossip
+    delta carry it — durability and replication watermarks come for
+    free from the machinery that already tracks steps.
+
+    Injected capabilities (all optional, degrade honestly when absent):
+
+    durable_fn     () -> int: the WAL's fsync watermark
+                   (`harness.wal.ElasticWal.durable_seq`). None = no WAL:
+                   ``durable`` acks honestly downgrade to ``applied``.
+    watermarks_fn  () -> {origin: seq}: this worker's applied
+                   watermarks (`ServePlane.applied_watermarks` shape) —
+                   rides every ack so routers learn, and answers the
+                   replication probes `WriteRouter` certifies
+                   ``replicated_to_k`` with.
+    pressure_fns   iterable of () -> Optional[int]: admission probes
+                   (WAL durability lag, overlap-queue depth, pager
+                   pressure). A non-None return sheds the write with
+                   that retry_after_ms hint.
+    """
+
+    def __init__(
+        self,
+        member: str,
+        metrics: Optional[Metrics] = None,
+        durable_fn: Optional[Callable[[], int]] = None,
+        watermarks_fn: Optional[Callable[[], Dict[str, int]]] = None,
+        pressure_fns: Tuple[Callable[[], Optional[int]], ...] = (),
+        queue_max: int = 256,
+        ack_timeout_s: float = 2.0,
+        ack_before_fsync: bool = False,
+        mono: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+        poll_s: float = 0.005,
+    ):
+        self.member = member
+        self.metrics = metrics if metrics is not None else Metrics()
+        self.durable_fn = durable_fn
+        self.watermarks_fn = watermarks_fn
+        self.pressure_fns = tuple(pressure_fns)
+        self.queue_max = max(1, int(queue_max))
+        self.ack_timeout_s = float(ack_timeout_s)
+        self.ack_before_fsync = bool(ack_before_fsync)
+        self.mono = mono
+        self.sleep = sleep
+        self.poll_s = float(poll_s)
+        self._lock = threading.Lock()
+        self._pending: List[_PendingWrite] = []
+        self._acked: Dict[str, Dict[str, Any]] = {}  # write_id -> ack doc
+        self._drain_rate = 0.0  # writes/s EWMA behind the shed hint
+
+    # -- the round-loop side -------------------------------------------------
+
+    def drain(self, seq: int, apply_fn: Callable[[List[Any]], None]) -> int:
+        """Fold every parked write into the live state at step `seq`.
+        ONE `apply_fn` call gets the whole drained batch (concatenated
+        ops, arrival order) — the server-side half of the batching
+        story. Each write is stamped ``(self.member, seq)``; transport
+        threads blocked in `handle()` wake and build their acks. A
+        raising `apply_fn` fails the batch honestly (the writes were
+        NOT applied; callers see an error, not a fake ack)."""
+        with self._lock:
+            batch, self._pending = self._pending, []
+        if not batch:
+            return 0
+        t0 = self.mono()
+        try:
+            apply_fn([op for w in batch for op in w.ops])
+        except Exception as e:  # noqa: BLE001 — surfaced per-writer
+            for w in batch:
+                w.error = f"apply failed: {e}"
+                w.done.set()
+            self.metrics.count("ingest.apply_failures")
+            return 0
+        dt = max(1e-9, self.mono() - t0)
+        inst = len(batch) / dt
+        self._drain_rate = (
+            inst if self._drain_rate == 0.0
+            else 0.8 * self._drain_rate + 0.2 * inst
+        )
+        for w in batch:
+            w.seq = int(seq)
+            w.done.set()
+        self.metrics.count("ingest.applied", len(batch))
+        return len(batch)
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def health_fields(self) -> Dict[str, Any]:
+        snap = self.metrics.snapshot()["counters"]
+        return {
+            "ingest_queue_depth": self.depth(),
+            "ingest_applied": int(snap.get("ingest.applied", 0)),
+            "ingest_unsafe_acks": int(snap.get("ingest.unsafe_acks", 0)),
+        }
+
+    # -- the transport side --------------------------------------------------
+
+    def handle(self, raw: bytes, surface: str = "local") -> bytes:
+        """bytes -> canonical ack/error bytes, total: bad requests and
+        shed decisions come back as honest error documents, never
+        exceptions (the transport would close the connection and the
+        writer could not tell a crash from a shed)."""
+        self.metrics.count("ingest.writes")
+        self.metrics.count(f"ingest.writes.{surface}")
+        try:
+            faults.fire("serve.write")  # injected stall/raise per surface
+            doc, framed = self._decode(raw)
+        except faults.InjectedFault as e:
+            return encode({"error": f"fault: {e}", "member": self.member})
+        except ValueError as e:
+            self.metrics.count("ingest.bad_requests")
+            return encode(
+                {"error": f"bad_request: {e}", "member": self.member}
+            )
+        probe = doc.get("probe")
+        if probe is not None:
+            return self._answer_probe(probe)
+        write_id = doc.get("write_id")
+        ops = doc.get("ops")
+        if not isinstance(ops, list) or not ops:
+            self.metrics.count("ingest.bad_requests")
+            return encode(
+                {"error": "bad_request: no ops", "member": self.member}
+            )
+        level = str(doc.get("ack", ACK_DURABLE))
+        if level not in _ACK_LEVELS:
+            self.metrics.count("ingest.bad_requests")
+            return encode(
+                {"error": f"bad_request: unknown ack level {level!r}",
+                 "member": self.member}
+            )
+        if framed:
+            self.metrics.count("ingest.range_frames")
+        # Idempotent re-ack: a duplicate delivery (client retry, owner
+        # failover racing the original) re-answers the ORIGINAL ack —
+        # same (origin, seq), never a second fold.
+        if write_id is not None:
+            with self._lock:
+                prior = self._acked.get(str(write_id))
+            if prior is not None:
+                self.metrics.count("ingest.duplicate_acks")
+                dup = dict(prior)
+                dup["duplicate"] = True
+                return encode(dup)
+        shed = self._admission(len(ops))
+        if shed is not None:
+            self.metrics.count(f"ingest.shed.{surface}")
+            return encode(shed)
+        w = _PendingWrite(ops, str(write_id) if write_id is not None else None)
+        with self._lock:
+            self._pending.append(w)
+        deadline = self.mono() + self.ack_timeout_s
+        w.done.wait(max(0.0, self.ack_timeout_s))
+        if not w.done.is_set():
+            # The round loop never drained us (worker wedged or dying):
+            # fail honestly rather than hang the writer. The write may
+            # still fold later — the write_id dedup makes the retry safe.
+            self.metrics.count("ingest.apply_timeouts")
+            return encode(
+                {"error": "unavailable: ingest apply timeout",
+                 "member": self.member}
+            )
+        if w.error is not None:
+            return encode({"error": w.error, "member": self.member})
+        ack = self._build_ack(w, level, deadline)
+        if w.write_id is not None:
+            with self._lock:
+                self._acked[w.write_id] = ack
+                while len(self._acked) > _ACK_CACHE_MAX:
+                    self._acked.pop(next(iter(self._acked)))
+        obs_events.emit(
+            "ingest.write", wseq=w.seq, level=ack["level"],
+            write_id=w.write_id or "", n_ops=len(ops),
+        )
+        return encode(ack)
+
+    def handler_for(self, surface: str) -> Callable[[bytes], bytes]:
+        """A bytes->bytes handler bound to one surface label, so the
+        per-surface shed/write counters attribute correctly."""
+        return lambda raw: self.handle(raw, surface=surface)
+
+    # -- internals -----------------------------------------------------------
+
+    def _decode(self, raw: bytes) -> Tuple[Dict[str, Any], bool]:
+        """(request doc, was-CCRF-framed). A `WriteSession` burst
+        arrives as one ``CCRF|lo|hi|payload`` range frame; bare JSON is
+        the degenerate single-write frame."""
+        blob = bytes(raw or b"")
+        framed = blob[:4] == FRAME_MAGIC
+        if framed:
+            _lo, _hi, blob = decode_range_frame(blob, 0)
+        try:
+            doc = json.loads(blob.decode("utf-8"))
+        except Exception as e:  # noqa: BLE001 — caller degrades
+            raise ValueError(f"undecodable write: {e}") from e
+        if not isinstance(doc, dict):
+            raise ValueError("write payload must be a JSON object")
+        return doc, framed
+
+    def _answer_probe(self, probe: Any) -> bytes:
+        """Replication probe: does THIS member's applied watermark cover
+        ``(origin, seq)``? The router counts confirmations toward
+        ``replicated_to_k`` — the replicas attest, not the owner."""
+        self.metrics.count("ingest.probes")
+        wm = self.watermarks_fn() if self.watermarks_fn is not None else {}
+        doc: Dict[str, Any] = {
+            "member": self.member,
+            "watermarks": {str(o): int(s) for o, s in (wm or {}).items()},
+        }
+        if isinstance(probe, dict):
+            o, s = str(probe.get("origin", "")), int(probe.get("seq", -1))
+            doc["covers"] = bool(doc["watermarks"].get(o, -1) >= s >= 0)
+        return encode(doc)
+
+    def _admission(self, n_ops: int) -> Optional[Dict[str, Any]]:
+        """None = admitted; else the honest shed document. Queue bound
+        first (retry_after from the observed drain rate), then the
+        injected pressure probes (WAL lag / overlap depth / pager)."""
+        with self._lock:
+            depth = len(self._pending)
+            rate = self._drain_rate
+        if depth + 1 > self.queue_max:
+            if rate <= 0.0:
+                hint = 50
+            else:
+                hint = max(1, min(5000, int(1000.0 * (depth + 1) / rate)))
+            self.metrics.count("ingest.queue_shed")
+            return {
+                "error": f"overloaded: ingest queue full ({depth} >= "
+                f"{self.queue_max})",
+                "member": self.member,
+                "retry_after_ms": hint,
+            }
+        for fn in self.pressure_fns:
+            try:
+                hint = fn()
+            except Exception:  # noqa: BLE001 — a broken probe never sheds
+                continue
+            if hint is not None:
+                self.metrics.count("ingest.pressure_shed")
+                return {
+                    "error": "overloaded: backpressure",
+                    "member": self.member,
+                    "retry_after_ms": max(1, min(5000, int(hint))),
+                }
+        return None
+
+    def _build_ack(
+        self, w: _PendingWrite, level: str, deadline: float
+    ) -> Dict[str, Any]:
+        """The ack document at the HIGHEST level achieved by `deadline`,
+        never above the requested one and never above the truth."""
+        achieved = ACK_APPLIED
+        want_durable = level in (ACK_DURABLE, ACK_REPLICATED)
+        if want_durable and self.ack_before_fsync:
+            # The deliberately-violating arm: claim durability the fsync
+            # has not delivered. certify_writes must convict this.
+            achieved = ACK_DURABLE
+            self.metrics.count("ingest.unsafe_acks")
+        elif want_durable and self.durable_fn is not None:
+            while self.mono() < deadline:
+                try:
+                    if int(self.durable_fn()) >= w.seq:
+                        achieved = ACK_DURABLE
+                        self.metrics.count("ingest.durable_acks")
+                        break
+                except Exception:  # noqa: BLE001 — treat as not-yet-durable
+                    pass
+                self.sleep(self.poll_s)
+            else:
+                self.metrics.count("ingest.ack_downgrades")
+        elif want_durable:
+            # No WAL on this worker: durability is not on offer.
+            self.metrics.count("ingest.ack_downgrades")
+        ack: Dict[str, Any] = {
+            "write_ack": True,
+            "member": self.member,
+            "origin": self.member,
+            "seq": int(w.seq),
+            "level": achieved,
+            "requested": level,
+        }
+        if w.write_id is not None:
+            ack["write_id"] = w.write_id
+        if self.watermarks_fn is not None:
+            try:
+                ack["watermarks"] = {
+                    str(o): int(s)
+                    for o, s in (self.watermarks_fn() or {}).items()
+                }
+            except Exception:  # noqa: BLE001 — watermarks are advisory
+                pass
+        return ack
+
+
+class _WriteAttempt:
+    __slots__ = ("peer", "cancel", "done", "result", "error", "t0")
+
+    def __init__(self, peer: str):
+        self.peer = peer
+        self.cancel = threading.Event()
+        self.done = threading.Event()
+        self.result: Optional[bytes] = None
+        self.error: Optional[BaseException] = None
+        self.t0 = 0.0
+
+
+class WriteRouter:
+    """Client-side write router: owner affinity, SWIM-verdict failover,
+    shared circuit breakers, bounded retries, honest sheds — the write
+    twin of `FleetRouter`, minus hedging (a write hedge is just a
+    duplicate delivery; the write_id dedup would absorb it, but the
+    failover walk already covers the latency case without doubling
+    load on a struggling fleet).
+
+    `write()` never raises and never hangs: every outcome is a decoded
+    ack document (augmented with ``"peer"``) or an honest error
+    document (``unavailable`` / ``overloaded`` + retry_after_ms).
+
+    Pass the read tier's `BreakerBoard` as `breakers` to share failure
+    evidence across both tiers of one client."""
+
+    def __init__(
+        self,
+        peers: Any,
+        write_fn: Callable[[str, bytes, float, threading.Event], bytes],
+        member: str = "writer",
+        metrics: Optional[Metrics] = None,
+        verdict_fn: Optional[Callable[[str], str]] = None,
+        timeout_s: float = 2.0,
+        retries: int = 2,
+        backoff_base_s: float = 0.05,
+        backoff_max_s: float = 1.0,
+        breaker_failures: int = 3,
+        breaker_cooldown_s: float = 2.0,
+        replication_wait_s: float = 2.0,
+        replication_poll_s: float = 0.05,
+        probe_timeout_s: float = 0.5,
+        mono: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+        poll_s: float = 0.005,
+        seed: int = 0,
+        breakers: Optional[BreakerBoard] = None,
+    ):
+        self._peers_src = peers
+        self.write_fn = write_fn
+        self.member = member
+        self.metrics = metrics if metrics is not None else Metrics()
+        self.verdict_fn = verdict_fn
+        self.timeout_s = float(timeout_s)
+        self.retries = max(0, int(retries))
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.replication_wait_s = float(replication_wait_s)
+        self.replication_poll_s = float(replication_poll_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.mono = mono
+        self.sleep = sleep
+        self.poll_s = float(poll_s)
+        self._rng = random.Random(seed)
+        self._board = (
+            breakers
+            if breakers is not None
+            else BreakerBoard(breaker_failures, breaker_cooldown_s, mono)
+        )
+        self._wid_lock = threading.Lock()
+        self._wid_n = 0
+
+    # -- introspection -------------------------------------------------------
+
+    def _peers(self) -> List[str]:
+        src = self._peers_src
+        out = src() if callable(src) else src
+        return [str(p) for p in out]
+
+    def breaker(self, peer: str):
+        return self._board.get(peer)
+
+    def route(self, key: str) -> List[str]:
+        """Owner-first candidate list: plain HRW (no staleness demotion
+        — owner affinity must not wobble with lag), dead peers and open
+        breakers dropped read-only."""
+        return candidate_order(
+            key, self._peers(), verdict_fn=self.verdict_fn,
+            breakers=self._board,
+        )
+
+    def status(self) -> Dict[str, Any]:
+        snap = self.metrics.snapshot()["counters"]
+        return {
+            "breakers": self._board.states(),
+            "counters": {
+                k: v for k, v in snap.items()
+                if k.startswith("router.write")
+            },
+        }
+
+    # -- the write path ------------------------------------------------------
+
+    def write(
+        self,
+        ops: List[Any],
+        key: str,
+        ack: str = ACK_DURABLE,
+        k: int = 2,
+        session: Optional[Any] = None,
+        write_id: Optional[str] = None,
+        payload: Optional[bytes] = None,
+    ) -> Dict[str, Any]:
+        """Route one write (or one pre-framed burst via `payload` — a
+        `WriteSession` CCRF range frame whose inner doc must carry the
+        SAME write_id). Walks the HRW owner list with bounded retries;
+        duplicate deliveries are safe because the plane re-acks by
+        write_id. On success teaches the session its own ``(origin,
+        seq)`` and flight-records ``ingest.ack`` — the feed
+        `obs.audit.certify_writes` replays."""
+        t0 = self.mono()
+        self.metrics.count("router.writes")
+        if ack not in _ACK_LEVELS:
+            return {"error": f"bad_request: unknown ack level {ack!r}"}
+        if write_id is None:
+            with self._wid_lock:
+                self._wid_n += 1
+                write_id = f"{self.member}:{self._wid_n}"
+        if payload is None:
+            payload = encode(
+                {"write_id": write_id, "ops": list(ops), "ack": ack}
+            )
+        sess = session if isinstance(session, ClientSession) else None
+
+        last_err: Optional[str] = None
+        shed_hint: Optional[int] = None
+        all_sheds = True
+        round_i = 0
+        while round_i <= self.retries:
+            order = self.route(key)
+            if not order:
+                last_err = last_err or "no eligible peers"
+                all_sheds = False
+                round_i += 1
+                self._backoff(round_i)
+                continue
+            outcome, detail = self._run_pass(order, payload)
+            if outcome == "ok":
+                resp, peer = detail
+                return self._finish_ok(
+                    t0, resp, peer, ack, k, write_id, sess
+                )
+            if outcome == "shed":
+                shed_hint = max(shed_hint or 0, int(detail or 0))
+                last_err = "overloaded"
+            else:
+                all_sheds = False
+                last_err = str(detail)
+            round_i += 1
+            if round_i <= self.retries:
+                self.metrics.count("router.write_retries")
+                self._backoff(round_i)
+        if shed_hint is not None and all_sheds:
+            self.metrics.count("router.write_shed_returns")
+            return self._finish_error(
+                t0, "overloaded", {"retry_after_ms": shed_hint}
+            )
+        return self._finish_error(
+            t0, "unavailable", {"detail": last_err},
+            counter="router.write_exhausted",
+        )
+
+    # -- one pass over the owner list ----------------------------------------
+
+    def _run_pass(
+        self, order: List[str], payload: bytes
+    ) -> Tuple[str, Any]:
+        """("ok", (resp, peer)) | ("shed", retry_after_ms) |
+        ("err", detail). A failed owner fails over to the next HRW
+        candidate (`router.write_failovers`) with the SAME write_id —
+        the plane dedups, so mid-batch failover cannot double-apply."""
+        shed_hint: Optional[int] = None
+        saw_shed = False
+        last_detail: Any = "no candidates"
+        for idx, peer in enumerate(order):
+            if idx:
+                self.metrics.count("router.write_failovers")
+            if faults.ACTIVE:
+                try:
+                    if faults.fire("router.write") == "drop":
+                        raise ConnectionError("router.write: injected drop")
+                except (faults.InjectedFault, ConnectionError) as e:
+                    self._fail(peer, e)
+                    last_detail = str(e)
+                    continue
+            verdict, detail = self._attempt(peer, payload)
+            if verdict != "ok":
+                last_detail = detail
+                continue
+            resp, who = detail
+            kind, fine = self._classify(who, resp)
+            if kind == "ok":
+                return ("ok", (fine, who))
+            if kind == "shed":
+                saw_shed = True
+                shed_hint = max(shed_hint or 0, int(fine or 0))
+                last_detail = "overloaded"
+            else:
+                last_detail = fine
+        if saw_shed:
+            return ("shed", shed_hint)
+        return ("err", last_detail)
+
+    def _attempt(self, peer: str, payload: bytes) -> Tuple[str, Any]:
+        """One write attempt on a worker thread; the main thread watches
+        the SWIM verdict (dead -> cancel + fail over NOW, not at the
+        timeout) and the deadline. Returns ("ok", (raw, peer)) or
+        ("fail", detail)."""
+        self.metrics.count("router.write_attempts")
+        self.breaker(peer).allow()  # reserve any half-open probe slot
+        att = _WriteAttempt(peer)
+        att.t0 = self.mono()
+
+        def run() -> None:
+            try:
+                att.result = self.write_fn(
+                    peer, payload, self.timeout_s, att.cancel
+                )
+            except BaseException as e:  # noqa: BLE001 — surfaced via att.error
+                att.error = e
+            finally:
+                att.done.set()
+
+        threading.Thread(
+            target=run, name=f"router-w-{peer}", daemon=True
+        ).start()
+        deadline = att.t0 + self.timeout_s
+        while not att.done.is_set():
+            if self.mono() >= deadline:
+                break
+            if (
+                self.verdict_fn is not None
+                and self.verdict_fn(peer) == "dead"
+            ):
+                # SWIM buried the owner mid-write: the write may or may
+                # not have folded — fail over and let the write_id dedup
+                # disambiguate at the successor.
+                att.cancel.set()
+                self.metrics.count("router.write_dead_reroutes")
+                self._fail(peer, TimeoutError("owner died mid-write"))
+                return ("fail", f"{peer} dead mid-write")
+            self.sleep(self.poll_s)
+        if att.done.is_set() and att.error is None:
+            self._succeed(att)
+            return ("ok", (att.result, peer))
+        att.cancel.set()
+        if att.done.is_set():
+            self._fail(peer, att.error or TimeoutError("write failed"))
+            return ("fail", f"{peer}: {att.error}")
+        self.metrics.count("router.write_timeouts")
+        self._fail(peer, TimeoutError("write deadline exceeded"))
+        return ("fail", f"{peer}: timeout after {self.timeout_s}s")
+
+    # -- response classification ---------------------------------------------
+
+    def _classify(
+        self, peer: str, raw: Optional[bytes]
+    ) -> Tuple[str, Any]:
+        try:
+            resp = json.loads(bytes(raw or b"").decode("utf-8"))
+        except Exception as e:  # noqa: BLE001 — garbage == peer failure
+            self.metrics.count("router.write_errors")
+            self._fail(peer, e)
+            return ("err", f"{peer}: undecodable ack: {e}")
+        err = resp.get("error")
+        if err is not None:
+            err_s = str(err)
+            if err_s.startswith("overloaded"):
+                # Admission control, not peer sickness: no breaker hit.
+                self.metrics.count("router.write_sheds")
+                return ("shed", resp.get("retry_after_ms", 0))
+            self.metrics.count("router.write_errors")
+            self._fail(peer, RuntimeError(err_s))
+            return ("err", f"{peer}: {err_s}")
+        if not resp.get("write_ack") or "seq" not in resp:
+            self.metrics.count("router.write_errors")
+            self._fail(peer, RuntimeError("malformed ack"))
+            return ("err", f"{peer}: malformed ack")
+        return ("ok", resp)
+
+    # -- ack finishing -------------------------------------------------------
+
+    def _finish_ok(
+        self,
+        t0: float,
+        resp: Dict[str, Any],
+        peer: str,
+        requested: str,
+        k: int,
+        write_id: str,
+        sess: Optional[ClientSession],
+    ) -> Dict[str, Any]:
+        out = dict(resp)
+        out["peer"] = peer
+        origin = str(resp.get("origin", peer))
+        seq = int(resp.get("seq", -1))
+        if (
+            requested == ACK_REPLICATED
+            and str(out.get("level")) == ACK_DURABLE
+        ):
+            confirmed = self._confirm_replication(origin, seq, int(k), peer)
+            out["replication"] = {"confirmed": confirmed, "want": int(k)}
+            if confirmed >= int(k):
+                out["level"] = ACK_REPLICATED
+                self.metrics.count("router.replicated_acks")
+            else:
+                self.metrics.count("router.replication_timeouts")
+        self.metrics.count("router.write_successes")
+        self.metrics.merge(
+            {"latencies": {"router.write": [max(0.0, self.mono() - t0)]}}
+        )
+        # The certifier's feed: what the CLIENT was told it holds.
+        obs_events.emit(
+            "ingest.ack", peer=peer, origin=origin, wseq=seq,
+            level=str(out.get("level", "")), write_id=write_id,
+            requested=requested,
+        )
+        if sess is not None and seq >= 0:
+            # Read-your-writes closes across tiers right here: the read
+            # router now routes this session only to peers whose applied
+            # watermarks cover (origin, seq).
+            sess.note_write(origin, seq)
+        return out
+
+    def _confirm_replication(
+        self, origin: str, seq: int, k: int, owner: str
+    ) -> int:
+        """Poll the replicas themselves until k distinct members
+        (counting the owner) confirm their applied watermark covers
+        ``(origin, seq)``, bounded by `replication_wait_s`."""
+        if seq < 0:
+            return 0
+        confirmed = {owner}
+        probe = encode({"probe": {"origin": origin, "seq": seq}})
+        deadline = self.mono() + self.replication_wait_s
+        cancel = threading.Event()
+        while len(confirmed) < k and self.mono() < deadline:
+            for peer in self._peers():
+                if peer in confirmed:
+                    continue
+                if (
+                    self.verdict_fn is not None
+                    and self.verdict_fn(peer) == "dead"
+                ):
+                    continue
+                try:
+                    raw = self.write_fn(
+                        peer, probe, self.probe_timeout_s, cancel
+                    )
+                    resp = json.loads(bytes(raw).decode("utf-8"))
+                except Exception:  # noqa: BLE001 — probe failure != write failure
+                    continue
+                wm = resp.get("watermarks")
+                if (
+                    resp.get("covers")
+                    or (isinstance(wm, dict) and int(wm.get(origin, -1)) >= seq)
+                ):
+                    confirmed.add(peer)
+                    self.metrics.count("router.replication_confirms")
+                if len(confirmed) >= k:
+                    break
+            if len(confirmed) < k:
+                self.sleep(self.replication_poll_s)
+        return len(confirmed)
+
+    def _finish_error(
+        self,
+        t0: float,
+        error: str,
+        extra: Dict[str, Any],
+        counter: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        if counter:
+            self.metrics.count(counter)
+        self.metrics.merge(
+            {"latencies": {"router.write": [max(0.0, self.mono() - t0)]}}
+        )
+        obs_events.emit("router.write_give_up", error=error)
+        out: Dict[str, Any] = {"error": error}
+        out.update(extra)
+        return out
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def _succeed(self, att: _WriteAttempt) -> None:
+        if self.breaker(att.peer).record_success():
+            self.metrics.count("router.write_breaker_closes")
+
+    def _fail(self, peer: str, err: BaseException) -> None:
+        if isinstance(err, TimeoutError) or "timed out" in str(err):
+            self.metrics.count("router.write_peer_timeouts")
+        if self.breaker(peer).record_failure():
+            self.metrics.count("router.write_breaker_opens")
+
+    def _backoff(self, round_i: int) -> None:
+        base = min(
+            self.backoff_max_s, self.backoff_base_s * (2 ** (round_i - 1))
+        )
+        self.sleep(base * (0.5 + self._rng.random()))  # jitter in [0.5, 1.5)
+
+
+def tcp_write_fn(
+    addrs: Any, connect_timeout_s: float = 0.5
+) -> Callable[[str, bytes, float, threading.Event], bytes]:
+    """Adapter: a `write_fn` over `net.tcp.write_peer` given `addrs` —
+    a dict (or callable returning one) of peer -> (host, port). Raises
+    KeyError for unknown peers (the router fails over)."""
+    from ..net.tcp import write_peer
+
+    def fn(
+        peer: str, payload: bytes, timeout_s: float, cancel: threading.Event
+    ) -> bytes:
+        table = addrs() if callable(addrs) else addrs
+        addr = table[peer]
+        _member, resp = write_peer(
+            tuple(addr), payload, timeout=timeout_s, cancel=cancel,
+            connect_timeout=connect_timeout_s,
+        )
+        return resp
+
+    return fn

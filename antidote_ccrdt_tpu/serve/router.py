@@ -62,115 +62,17 @@ from collections import deque
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from ..obs import events as obs_events
-from ..topo.anchor import rendezvous_order
 from ..utils import faults
 from ..utils.metrics import Metrics
+from .routing_common import (  # noqa: F401 — CircuitBreaker + states
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    BreakerBoard,
+    CircuitBreaker,
+    candidate_order,
+)
 from .session import ClientSession, gaps as session_gaps, session_doc
-
-# Breaker states (exported for tests / the dashboard).
-CLOSED = "closed"
-OPEN = "open"
-HALF_OPEN = "half_open"
-
-
-class CircuitBreaker:
-    """Per-peer closed -> open -> half-open breaker on *consecutive*
-    failures. Clock-injectable so tests drive transitions on a fake
-    clock; thread-safe because hedged attempts record from worker
-    threads."""
-
-    def __init__(
-        self,
-        fail_threshold: int = 3,
-        cooldown_s: float = 2.0,
-        mono: Callable[[], float] = time.monotonic,
-    ):
-        self.fail_threshold = max(1, int(fail_threshold))
-        self.cooldown_s = float(cooldown_s)
-        self.mono = mono
-        self._lock = threading.Lock()
-        self._state = CLOSED
-        self._consec_failures = 0
-        self._opened_at = 0.0
-        self._probing = False
-
-    @property
-    def state(self) -> str:
-        with self._lock:
-            if self._state == OPEN and (
-                self.mono() - self._opened_at >= self.cooldown_s
-            ):
-                return HALF_OPEN
-            return self._state
-
-    def allow(self) -> bool:
-        """May an attempt go to this peer now? While open: no. After the
-        cooldown: exactly ONE in-flight probe (half-open) until it
-        reports success or failure — or explicitly releases the slot.
-        RESERVES the probe slot: call only when the attempt actually
-        launches; eligibility filtering must use `would_allow()`."""
-        with self._lock:
-            if self._state == CLOSED:
-                return True
-            if self._state == OPEN:
-                if self.mono() - self._opened_at < self.cooldown_s:
-                    return False
-                self._state = HALF_OPEN
-            if self._probing:
-                return False
-            self._probing = True
-            return True
-
-    def would_allow(self) -> bool:
-        """Read-only eligibility: the same verdict `allow()` would give,
-        without reserving the half-open probe slot. `route()` filters
-        candidates with this — a candidate that is listed but never
-        actually tried must not consume (and leak) the probe."""
-        with self._lock:
-            if self._state == CLOSED:
-                return True
-            if self._state == OPEN and (
-                self.mono() - self._opened_at < self.cooldown_s
-            ):
-                return False
-            return not self._probing
-
-    def release_probe(self) -> None:
-        """Give back a reserved half-open probe without a verdict — for
-        attempts that were cancelled or abandoned (a hedge loser reaped
-        undone at the deadline, a discarded answer from a SWIM-dead
-        peer). Without this the slot would leak and exclude the peer
-        from routing forever."""
-        with self._lock:
-            self._probing = False
-
-    def record_success(self) -> bool:
-        """Returns True iff this success CLOSED a non-closed breaker."""
-        with self._lock:
-            closed_now = self._state != CLOSED
-            self._state = CLOSED
-            self._consec_failures = 0
-            self._probing = False
-            return closed_now
-
-    def record_failure(self) -> bool:
-        """Returns True iff this failure OPENED the breaker (threshold
-        crossed, or a half-open probe failed)."""
-        with self._lock:
-            self._consec_failures += 1
-            if self._state == HALF_OPEN or (
-                self._state == CLOSED
-                and self._consec_failures >= self.fail_threshold
-            ):
-                self._state = OPEN
-                self._opened_at = self.mono()
-                self._probing = False
-                return True
-            if self._state == OPEN:
-                # Failure while open (e.g. a stale in-flight attempt):
-                # restart the cooldown, it is evidence the peer is still bad.
-                self._opened_at = self.mono()
-            return False
 
 
 class _Attempt:
@@ -240,6 +142,7 @@ class FleetRouter:
         sleep: Callable[[float], None] = time.sleep,
         poll_s: float = 0.005,
         seed: int = 0,
+        breakers: Optional[BreakerBoard] = None,
     ):
         if session_mode not in ("enforce", "ignore"):
             raise ValueError("session_mode must be 'enforce' or 'ignore'")
@@ -267,7 +170,13 @@ class FleetRouter:
         self.poll_s = float(poll_s)
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
-        self._breakers: Dict[str, CircuitBreaker] = {}
+        # Shared with the write tier when the caller passes one board:
+        # a peer that fails writes is demoted for reads too.
+        self._board = (
+            breakers
+            if breakers is not None
+            else BreakerBoard(breaker_failures, breaker_cooldown_s, mono)
+        )
         # peer -> last-learned applied watermarks {origin: seq}, taught
         # by every response (success OR session_uncovered rejection).
         self._peer_watermarks: Dict[str, Dict[str, int]] = {}
@@ -283,14 +192,7 @@ class FleetRouter:
         return [str(p) for p in out]
 
     def breaker(self, peer: str) -> CircuitBreaker:
-        with self._lock:
-            br = self._breakers.get(peer)
-            if br is None:
-                br = CircuitBreaker(
-                    self.breaker_failures, self.breaker_cooldown_s, self.mono
-                )
-                self._breakers[peer] = br
-            return br
+        return self._board.get(peer)
 
     def peer_watermarks(self, peer: str) -> Optional[Dict[str, int]]:
         with self._lock:
@@ -315,8 +217,8 @@ class FleetRouter:
     def status(self) -> Dict[str, Any]:
         """Dashboard feed: per-peer breaker state + learned watermark
         height, plus the counters the column group renders."""
+        breakers = self._board.states()
         with self._lock:
-            breakers = {p: br.state for p, br in self._breakers.items()}
             wms = {
                 p: (max(wm.values()) if wm else -1)
                 for p, wm in self._peer_watermarks.items()
@@ -338,22 +240,21 @@ class FleetRouter:
         """The eligible candidate list for `key`, in preference order,
         plus a flag: True iff peers were excluded ONLY by session
         coverage (so waiting could help). HRW order, fresh-staleness
-        bucket first, dead peers and open breakers dropped."""
-        ordered = rendezvous_order(key, self._peers())
-        if self.staleness_fn is not None and self.stale_soft_s >= 0:
-            fn = self.staleness_fn
-            ordered = sorted(
-                ordered,
-                key=lambda p: 1 if (fn(p) or 0.0) > self.stale_soft_s else 0,
-            )  # stable: HRW order preserved within each bucket
+        bucket first, dead peers and open breakers dropped — the shared
+        walk (`routing_common.candidate_order`), then the read tier's
+        session-coverage filter on top."""
+        ordered = candidate_order(
+            key,
+            self._peers(),
+            verdict_fn=self.verdict_fn,
+            breakers=self._board,
+            staleness_fn=self.staleness_fn,
+            stale_soft_s=self.stale_soft_s if self.staleness_fn else -1.0,
+        )
         out: List[str] = []
         session_starved = False
         enforce = token and self.session_mode == "enforce"
         for p in ordered:
-            if self.verdict_fn is not None and self.verdict_fn(p) == "dead":
-                continue
-            if not self.breaker(p).would_allow():
-                continue
             if enforce:
                 wm = self.peer_watermarks(p)
                 # Unknown peer: optimistic — the plane re-checks and a

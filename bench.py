@@ -1902,6 +1902,11 @@ def main():
         "ici_reduce_ms_p50": mesh_scaling.get("ici_reduce_ms_p50"),
         "cross_slice_bytes": mesh_scaling.get("cross_slice_bytes"),
         "backend": backend,
+        # Host class for wall-clock gates: serve_reads_per_sec (and the
+        # other host-CPU-bound throughputs) scale with the core count, so
+        # bench_gate compares those carriers within one (backend, nproc)
+        # group only — same reason the wal e2e gate groups by backend.
+        "nproc": os.cpu_count(),
         "details_file": "benchmarks/bench_details.json" if sidecar else "stdout",
     }
     line = json.dumps(summary)

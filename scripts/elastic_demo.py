@@ -114,6 +114,51 @@ class _TopkRmvDrill:
                 state = self.apply(dense, state, s, [g])
         return state
 
+    def ingest(self, dense, state, effects, step: int, owned):
+        """Fold CLIENT effect ops (write tier, PR 16) into the lowest
+        owned replica row at `step` — one batched apply_ops dispatch, so
+        the fold lands inside this step's WAL record and delta window.
+        Effects are scalar topk_rmv tuples (`serve.effect_from_wire`):
+        ("add"|"add_r", (id, score, (dc, ts))) / ("rmv"|"rmv_r",
+        (id, {dc: ts})). Client ts stamps must be distinct from the
+        deterministic drill streams' (the demo writers use a 1e6+ ts
+        base) — identical (dc, ts) stamps would dedup under join."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from antidote_ccrdt_tpu.models.topk_rmv_dense import TopkRmvOps
+
+        adds = [p for k, p in effects if k in ("add", "add_r")]
+        rmvs = [p for k, p in effects if k in ("rmv", "rmv_r")]
+        r = min(owned) if owned else 0
+        nb, nr = max(len(adds), 1), max(len(rmvs), 1)
+        a_key = np.zeros((R, nb), np.int32)
+        a_id = np.zeros((R, nb), np.int32)
+        a_score = np.zeros((R, nb), np.int32)
+        a_dc = np.zeros((R, nb), np.int32)
+        a_ts = np.zeros((R, nb), np.int32)  # ts=0 padding elsewhere
+        r_key = np.zeros((R, nr), np.int32)
+        r_id = np.full((R, nr), -1, np.int32)  # id=-1 padding
+        r_vc = np.zeros((R, nr, DCS), np.int32)
+        for j, (id_, score, (dc, ts)) in enumerate(adds):
+            a_id[r, j], a_score[r, j] = int(id_), int(score)
+            a_dc[r, j] = int(dc) % DCS
+            a_ts[r, j] = int(ts)
+        for j, (id_, vc) in enumerate(rmvs):
+            r_id[r, j] = int(id_)
+            for d, t in vc.items():
+                if 0 <= int(d) < DCS:
+                    r_vc[r, j, int(d)] = int(t)
+        ops = TopkRmvOps(
+            add_key=jnp.asarray(a_key), add_id=jnp.asarray(a_id),
+            add_score=jnp.asarray(a_score), add_dc=jnp.asarray(a_dc),
+            add_ts=jnp.asarray(a_ts),
+            rmv_key=jnp.asarray(r_key), rmv_id=jnp.asarray(r_id),
+            rmv_vc=jnp.asarray(r_vc),
+        )
+        state, _ = dense.apply_ops(state, ops, collect_dominated=False)
+        return state
+
     def digest(self, dense, state):
         from antidote_ccrdt_tpu.harness.dense_replay import fold_rows
 
@@ -322,6 +367,10 @@ def main() -> None:
     )
     ap.add_argument("--wal-segment-bytes", type=int, default=256 << 10)
     ap.add_argument(
+        "--steps", type=int, default=0,
+        help="per-worker step count override (0 = the 10-step default; "
+        "every member of one fleet must agree)")
+    ap.add_argument(
         "--wal-durability", default="",
         choices=("", "sync", "group", "async"),
         help="WAL durability mode (harness/wal.py): sync = fsync per "
@@ -371,6 +420,12 @@ def run_worker(store, drill, dense, state, args, result_dir):
     for owned replicas, ownership-grows adoption, publish/sweep rounds,
     and a final convergence barrier; writes final-<member>.json (digest +
     alive view + metrics counters) into `result_dir`."""
+    # An `--steps` override shadows the module default for this worker:
+    # the acceptance drills that storm a fleet through warm-up, chaos
+    # and a mid-load kill need more runway than the 10-step default
+    # (0 / absent keeps the default, and every peer must agree — the
+    # final barrier seq is STEPS + dead_n).
+    STEPS = int(getattr(args, "steps", 0) or globals()["STEPS"])
     from antidote_ccrdt_tpu.obs import events as obs_events
     from antidote_ccrdt_tpu.obs import export as obs_export
     from antidote_ccrdt_tpu.obs.lag import LagTracker
@@ -435,13 +490,64 @@ def run_worker(store, drill, dense, state, args, result_dir):
     plane = serve_mod.install_from_env(
         dense, args.member, metrics=store.metrics, lag_tracker=lag_tracker
     )
-    ctx = {"ovl": None, "wal": None}  # filled below; health_extra
-    # closes over the cells (the scrape server may call before they are
-    # assigned, so the dict — not late locals — carries them)
+    ctx = {"ovl": None, "wal": None, "ingest_step": -1}  # filled below;
+    # health_extra closes over the cells (the scrape server may call
+    # before they are assigned, so the dict — not late locals — carries
+    # them)
 
     def _serve_swap(view, seq) -> None:
         if plane is not None:
             plane.swap(view, seq)
+
+    # --- write-ingest plane (tentpole, PR 16): CCRDT_INGEST=1 attaches
+    # an IngestPlane — client {write} frames park in its queue, the step
+    # loop folds them BEFORE wal.log_step captures the post view (so a
+    # write's seq IS the step whose WAL record and delta carry it), and
+    # tiered acks pin `durable` to the WAL's fsync watermark. Admission
+    # control sheds writers on WAL durability lag and overlap-queue
+    # depth with an honest retry_after_ms.
+    _ING_MAX_WAL_LAG = int(os.environ.get("CCRDT_INGEST_MAX_WAL_LAG", "64"))
+    _ING_MAX_OVL_DEPTH = int(
+        os.environ.get("CCRDT_INGEST_MAX_OVL_DEPTH", "8")
+    )
+
+    def _wal_pressure():
+        w = ctx["wal"]
+        if w is None:
+            return None
+        lag = max(0, int(w._last_appended) - int(w.durable_seq))
+        if lag > _ING_MAX_WAL_LAG:
+            return min(5000, 25 * lag)
+        return None
+
+    def _ovl_pressure():
+        o = ctx["ovl"]
+        if o is None:
+            return None
+        depth = o.pressure_depth()
+        if depth > _ING_MAX_OVL_DEPTH:
+            return min(5000, 100 * depth)
+        return None
+
+    def _ingest_watermarks() -> dict:
+        out = {str(k): int(v) for k, v in cursors.items()}
+        out[args.member] = int(ctx["ingest_step"])
+        return out
+
+    iplane = (
+        serve_mod.install_ingest_from_env(
+            args.member,
+            metrics=store.metrics,
+            durable_fn=lambda: (
+                int(ctx["wal"].durable_seq) if ctx["wal"] is not None
+                else -1
+            ),
+            watermarks_fn=_ingest_watermarks,
+            pressure_fns=(_wal_pressure, _ovl_pressure),
+        )
+        if hasattr(drill, "ingest")
+        else None
+    )
 
     def health_extra() -> dict:
         """Serving-readiness: can a load balancer route reads here?"""
@@ -470,6 +576,8 @@ def run_worker(store, drill, dense, state, args, result_dir):
         doc.update(watchdog.health_fields())
         if plane is not None:
             doc.update(plane.health_fields())
+        if iplane is not None:
+            doc.update(iplane.health_fields())
         return doc
 
     obs_http.install_from_env(
@@ -478,11 +586,17 @@ def run_worker(store, drill, dense, state, args, result_dir):
         addr_dir=result_dir,
         query_handler=plane.handler_for("http") if plane is not None else None,
         health_extra=health_extra,
+        write_handler=(
+            iplane.handler_for("http") if iplane is not None else None
+        ),
     )
     tr = getattr(store, "transport", None)
     if plane is not None and tr is not None and hasattr(tr, "install_serve"):
         # TCP fleets additionally answer {query} frames in-band.
         tr.install_serve(plane)
+    if iplane is not None and tr is not None and hasattr(tr, "install_ingest"):
+        # ... and {write} frames via the ingest plane (PR 16).
+        tr.install_ingest(iplane)
 
     # --- mesh plane (tentpole, PR 12): CCRDT_MESH=1 (or --mesh) pins this
     # worker's state onto a (dc, key) device mesh. Partitions map whole
@@ -848,6 +962,19 @@ def run_worker(store, drill, dense, state, args, result_dir):
                     pass
         else:
             state = drill.apply(dense, state, step, sorted(owned))
+        if iplane is not None:
+            # Fold parked client writes NOW — after the drill stream,
+            # BEFORE wal.log_step captures post_view below — so every
+            # write acked at this step is inside the step's WAL record
+            # and its next published delta. Transport threads blocked in
+            # handle() wake with (member, step) as their (origin, seq).
+            def _fold_ingest(ops, _s=step, _o=tuple(sorted(owned))):
+                nonlocal state
+                effects = [serve_mod.effect_from_wire(o) for o in ops]
+                state = drill.ingest(dense, state, effects, _s, _o)
+
+            iplane.drain(step, _fold_ingest)
+            ctx["ingest_step"] = step
         if ovl is not None:
             # Overlapped round: fold whatever peer windows the prefetcher
             # queued (device work — the round thread's only job), then

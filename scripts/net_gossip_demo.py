@@ -104,6 +104,9 @@ def main() -> None:
                     help="arm the per-worker crash WAL (harness/wal.py) "
                     "under this directory — see elastic_demo.py")
     ap.add_argument("--wal-segment-bytes", type=int, default=256 << 10)
+    ap.add_argument("--steps", type=int, default=0,
+                    help="per-worker step count override (0 = the "
+                    "10-step default; every member must agree)")
     args = ap.parse_args()
 
     import jax

@@ -99,6 +99,54 @@ def test_backend_groups_compare_independently(tmp_path):
     assert code == 1 and "FAIL" in verdict
 
 
+def _serve_round(tmp_path, n, rps, p99, nproc=None, doc_nproc=None):
+    path = str(tmp_path / f"BENCH_r{n:02d}.json")
+    summary = {"serve_reads_per_sec": rps, "serve_read_p99_ms": p99}
+    if nproc is not None:
+        summary["nproc"] = nproc
+    doc = {"n": n, "cmd": "bench", "rc": 0, "tail": json.dumps(summary)}
+    if doc_nproc is not None:
+        doc["nproc"] = doc_nproc
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+def test_serve_gate_groups_by_host_class(tmp_path):
+    # serve_reads_per_sec is host-CPU wall clock: a 1-core carrier must
+    # not be graded against a many-core baseline (it would flag the
+    # machine swap, not a code regression), nor reset that baseline.
+    _serve_round(tmp_path, 1, 180_000.0, 3.0)  # legacy: no nproc field
+    _serve_round(tmp_path, 2, 178_000.0, 3.2)
+    _serve_round(tmp_path, 3, 90_000.0, 6.0, nproc=1)
+    rounds = gate.load_serve_rounds(str(tmp_path))
+    assert [r[4] for r in rounds] == [None, None, 1]
+    code, verdict = gate.evaluate_serve(rounds, 0.20)
+    assert code == 0
+    assert "vacuous" in verdict and "report-only" in verdict
+    # ...but a regression WITHIN the 1-core class still fails.
+    _serve_round(tmp_path, 4, 60_000.0, 9.0, nproc=1)
+    code, verdict = gate.evaluate_serve(
+        gate.load_serve_rounds(str(tmp_path)), 0.20
+    )
+    assert code == 1 and "FAIL" in verdict
+    # ...and a regression in the legacy (None) class is still caught when
+    # the latest carrier belongs to it.
+    _serve_round(tmp_path, 5, 100_000.0, 3.1)
+    code, verdict = gate.evaluate_serve(
+        gate.load_serve_rounds(str(tmp_path)), 0.20
+    )
+    assert code == 1 and "FAIL" in verdict
+
+
+def test_serve_rounds_read_doc_level_nproc(tmp_path):
+    # A carrier rebuilt from a raw stdout capture that predates the
+    # summary-line field can still declare its host class top-level.
+    _serve_round(tmp_path, 1, 120_000.0, 4.0, doc_nproc=2)
+    rounds = gate.load_serve_rounds(str(tmp_path))
+    assert rounds[0][4] == 2
+
+
 def test_gap_gate_vacuous_then_pass_then_fail(tmp_path):
     code, verdict = gate.evaluate_gap([], 0.20)
     assert code == 0 and "vacuous" in verdict
@@ -206,6 +254,80 @@ def test_mesh_gate_compares_against_best_prior(tmp_path):
     # though r07 — the latest prior — was a disaster round.
     _mesh_round(tmp_path, 8, merges=95_000.0, ici=1.1, bytes_=4100.0)
     code, verdict = gate.evaluate_mesh(gate.load_mesh_rounds(str(tmp_path)))
+    assert code == 0 and "FAIL" not in verdict
+
+
+def _write_round(tmp_path, n, wps=None, p99=None, blip=None, passed=True):
+    path = str(tmp_path / f"WRITETIER_r{n:02d}.json")
+    doc = {"round": n}
+    if wps is not None:
+        doc["fleet_writes_per_sec"] = wps
+        doc["write_p99_ms"] = p99
+        doc["failover_blip_ms"] = blip
+    if passed is not None:
+        doc["pass"] = passed
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+def test_write_rounds_skip_partial_and_sort(tmp_path):
+    _write_round(tmp_path, 1, wps=None)  # no metrics: skipped, not zeros
+    _write_round(tmp_path, 9, wps=0.5, p99=8000.0, blip=2000.0)
+    _write_round(tmp_path, 3, wps=0.4, p99=9000.0, blip=2500.0, passed=None)
+    rounds = gate.load_write_rounds(str(tmp_path))
+    assert [r[0] for r in rounds] == [3, 9]
+    assert rounds[0][5] is None and rounds[1][5] is True
+
+
+def test_write_gate_single_round_gates_on_own_pass(tmp_path):
+    # One carrier: drift is vacuous, but the carrier's own chaos verdict
+    # still gates — a pass=false r01 must never go green.
+    _write_round(tmp_path, 1, wps=0.5, p99=8000.0, blip=2000.0)
+    code, verdict = gate.evaluate_write(gate.load_write_rounds(str(tmp_path)))
+    assert code == 0 and "vacuous" in verdict
+    _write_round(tmp_path, 1, wps=0.5, p99=8000.0, blip=2000.0, passed=False)
+    code, verdict = gate.evaluate_write(gate.load_write_rounds(str(tmp_path)))
+    assert code == 1 and "pass=false" in verdict
+
+
+def test_write_gate_double_threshold(tmp_path):
+    # Each metric moves, but each move clears only ONE of its two bars.
+    _write_round(tmp_path, 1, wps=10.0, p99=8000.0, blip=2000.0)
+    _write_round(
+        tmp_path, 2,
+        wps=9.2,       # -8% < 20%, though -0.8/s abs isn't the gate alone
+        p99=9500.0,    # +18.75% < 20%, though +1500ms < 2000ms floor
+        blip=2900.0,   # +45% > 20%, but +900ms < 1000ms floor
+    )
+    code, verdict = gate.evaluate_write(gate.load_write_rounds(str(tmp_path)))
+    assert code == 0 and "FAIL" not in verdict
+
+
+def test_write_gate_fails_each_metric(tmp_path):
+    base = dict(wps=10.0, p99=8000.0, blip=2000.0)
+    _write_round(tmp_path, 1, **base)
+    # throughput collapse: -50% AND -5/s.
+    _write_round(tmp_path, 2, wps=5.0, p99=8000.0, blip=2000.0)
+    code, verdict = gate.evaluate_write(gate.load_write_rounds(str(tmp_path)))
+    assert code == 1 and "fleet_writes_per_sec" in verdict
+    # ack-tail regression: +50% AND +4000ms.
+    _write_round(tmp_path, 2, wps=10.0, p99=12_000.0, blip=2000.0)
+    code, verdict = gate.evaluate_write(gate.load_write_rounds(str(tmp_path)))
+    assert code == 1 and "write_p99_ms" in verdict
+    # failover blip growth: +100% AND +2000ms.
+    _write_round(tmp_path, 2, wps=10.0, p99=8000.0, blip=4000.0)
+    code, verdict = gate.evaluate_write(gate.load_write_rounds(str(tmp_path)))
+    assert code == 1 and "failover_blip_ms" in verdict
+
+
+def test_write_gate_compares_against_best_prior(tmp_path):
+    _write_round(tmp_path, 1, wps=10.0, p99=8000.0, blip=2000.0)
+    _write_round(tmp_path, 2, wps=4.0, p99=20_000.0, blip=9000.0)
+    # r03 within tolerance of the BEST priors (r01 on all three), even
+    # though r02 — the latest prior — was a disaster round.
+    _write_round(tmp_path, 3, wps=9.5, p99=8500.0, blip=2100.0)
+    code, verdict = gate.evaluate_write(gate.load_write_rounds(str(tmp_path)))
     assert code == 0 and "FAIL" not in verdict
 
 

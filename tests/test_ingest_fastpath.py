@@ -103,9 +103,9 @@ def run_ingest_chaos(type_name, seed, *, compact=True, loss=0.05, dup=0.05,
         nodes = {m: GossipNode(net.join(m)) for m in names}
         states = {m: drill.init(dense) for m in names}
         # full_every=8 with a publish EVERY step: the coalesce cap (4)
-        # fills strictly inside an anchor interval, so full range frames
-        # ship mid-chaos — full_every=4 would let every anchor supersede
-        # the staged windows before a frame ever formed.
+        # fills strictly inside an anchor interval, so CAP-SIZED range
+        # frames ship mid-chaos (anchors also flush whatever is staged
+        # when they land, but those tail frames are shorter).
         pubs = {
             m: DeltaPublisher(nodes[m], dense, name=drill.publish_name,
                               full_every=8)

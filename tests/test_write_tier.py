@@ -1,0 +1,568 @@
+"""Unit + drill tests for the fleet write tier (serve/ingest.py +
+serve/write_session.py + obs.audit.certify_writes): idempotent re-ack
+under duplicate delivery, `durable` acks racing the async-durability
+watermark (honest downgrade, catch-up, and the deliberately-violating
+ack-before-fsync arm), owner failover mid-batch vs a sequential
+reference (the write_id dedup + CRDT stamp-dedup story), sim
+``{write}``/``{write_ack}`` frame plumbing with wid echo and in-flight
+cancel, admission control hints, client-certified replication, and the
+write-durability certificate's conviction of acked-but-lost writes."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from antidote_ccrdt_tpu.harness.dense_replay import fold_rows
+from antidote_ccrdt_tpu.models.topk_rmv_dense import TopkRmvOps, make_dense
+from antidote_ccrdt_tpu.net.sim import SimNet
+from antidote_ccrdt_tpu.obs import audit
+from antidote_ccrdt_tpu.serve.ingest import (
+    ACK_APPLIED,
+    ACK_DURABLE,
+    ACK_REPLICATED,
+    IngestPlane,
+    WriteRouter,
+)
+from antidote_ccrdt_tpu.serve.plane import encode
+from antidote_ccrdt_tpu.serve.routing_common import CLOSED
+from antidote_ccrdt_tpu.serve.session import ClientSession
+from antidote_ccrdt_tpu.serve.write_session import (
+    WriteSession,
+    effect_from_wire,
+    effect_to_wire,
+)
+from antidote_ccrdt_tpu.topo import rendezvous_order
+from antidote_ccrdt_tpu.utils import faults
+from antidote_ccrdt_tpu.utils.metrics import Metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+class _DrainLoop:
+    """A real background thread standing in for the worker's round
+    loop: drains the plane every couple of ms so transport threads
+    blocked in `handle()` wake. seq advances per drain tick — the
+    virtual "step" each fold lands in."""
+
+    def __init__(self, plane, apply_fn=None, period_s=0.002):
+        self.plane = plane
+        self.applied = []
+        self.seq = 0
+        self._apply = apply_fn or self.applied.extend
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            self.seq += 1
+            self.plane.drain(self.seq, self._apply)
+            time.sleep(0.002)
+
+    def stop(self):
+        self._stop.set()
+        self._t.join(2.0)
+
+
+def _wdoc(wid, ops=None, ack=ACK_DURABLE, **extra):
+    doc = {
+        "write_id": wid,
+        "ops": ops if ops is not None else [["add", [1, 5, [0, 1000001]]]],
+        "ack": ack,
+    }
+    doc.update(extra)
+    return encode(doc)
+
+
+def _plane(member="w0", **kw):
+    kw.setdefault("durable_fn", lambda: 10**9)
+    kw.setdefault("ack_timeout_s", 2.0)
+    kw.setdefault("poll_s", 0.001)
+    return IngestPlane(member, **kw)
+
+
+# --- idempotent re-ack under duplicate delivery -----------------------------
+
+
+def test_duplicate_delivery_reacks_original_seq():
+    p = _plane()
+    loop = _DrainLoop(p)
+    try:
+        a1 = json.loads(p.handle(_wdoc("c:1")).decode())
+        a2 = json.loads(p.handle(_wdoc("c:1")).decode())
+    finally:
+        loop.stop()
+    assert a1["write_ack"] and a1["level"] == ACK_DURABLE
+    assert a2["duplicate"] is True
+    assert (a2["origin"], a2["seq"]) == (a1["origin"], a1["seq"])
+    # the duplicate never re-folded: exactly one op reached apply_fn.
+    assert len(loop.applied) == 1
+    c = p.metrics.snapshot()["counters"]
+    assert c["ingest.duplicate_acks"] == 1
+    assert c["ingest.applied"] == 1
+
+
+# --- durable acks vs the async-durability watermark -------------------------
+
+
+def test_durable_ack_downgrades_honestly_when_watermark_lags():
+    # Async durability truncates the un-fsynced tail on recovery: a
+    # watermark stuck behind the fold seq means the write could still
+    # be lost, so the plane must NOT say "durable" — it reports the
+    # level actually achieved plus what was requested.
+    cell = [-1]
+    p = _plane(durable_fn=lambda: cell[0], ack_timeout_s=0.15)
+    loop = _DrainLoop(p)
+    try:
+        ack = json.loads(p.handle(_wdoc("c:1")).decode())
+    finally:
+        loop.stop()
+    assert ack["level"] == ACK_APPLIED
+    assert ack["requested"] == ACK_DURABLE
+    assert p.metrics.snapshot()["counters"]["ingest.ack_downgrades"] == 1
+
+
+def test_durable_ack_waits_out_the_racing_watermark():
+    # The watermark catches up DURING the ack wait (the fsync landing
+    # mid-race): the plane polls durable_fn and upgrades in place.
+    cell = [-1]
+    p = _plane(durable_fn=lambda: cell[0], ack_timeout_s=2.0)
+    loop = _DrainLoop(p)
+    flip = threading.Timer(0.05, lambda: cell.__setitem__(0, 10**9))
+    flip.start()
+    try:
+        ack = json.loads(p.handle(_wdoc("c:1")).decode())
+    finally:
+        flip.cancel()
+        loop.stop()
+    assert ack["level"] == ACK_DURABLE
+    assert p.metrics.snapshot()["counters"]["ingest.durable_acks"] == 1
+
+
+def test_ack_before_fsync_arm_bills_unsafe_acks():
+    # The deliberately-violating arm: durability claimed with the
+    # watermark still at -1. The plane counts every lie so the demo's
+    # certificate replay can convict it.
+    p = _plane(durable_fn=lambda: -1, ack_before_fsync=True)
+    loop = _DrainLoop(p)
+    try:
+        ack = json.loads(p.handle(_wdoc("c:1")).decode())
+    finally:
+        loop.stop()
+    assert ack["level"] == ACK_DURABLE
+    assert p.metrics.snapshot()["counters"]["ingest.unsafe_acks"] == 1
+
+
+# --- admission control ------------------------------------------------------
+
+
+def test_queue_full_sheds_with_retry_hint_and_blocked_write_times_out():
+    p = _plane(queue_max=1, ack_timeout_s=0.1, durable_fn=None)
+    first = {}
+
+    def hold():
+        first["ack"] = json.loads(p.handle(_wdoc("c:1")).decode())
+
+    t = threading.Thread(target=hold, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 1.0
+    while p.depth() < 1 and time.monotonic() < deadline:
+        time.sleep(0.001)
+    shed = json.loads(p.handle(_wdoc("c:2")).decode())
+    t.join(2.0)
+    assert shed["error"].startswith("overloaded")
+    assert isinstance(shed["retry_after_ms"], int) and shed["retry_after_ms"] >= 1
+    # nobody drained: the parked write fails honestly, never hangs.
+    assert first["ack"]["error"].startswith("unavailable")
+    c = p.metrics.snapshot()["counters"]
+    assert c["ingest.queue_shed"] == 1
+    assert c["ingest.apply_timeouts"] == 1
+
+
+def test_pressure_probe_sheds_with_its_own_hint():
+    p = _plane(pressure_fns=(lambda: 700,))
+    shed = json.loads(p.handle(_wdoc("c:1")).decode())
+    assert shed["error"].startswith("overloaded")
+    assert shed["retry_after_ms"] == 700
+    assert p.metrics.snapshot()["counters"]["ingest.pressure_shed"] == 1
+
+
+# --- replication probes -----------------------------------------------------
+
+
+def test_probe_answers_applied_coverage():
+    p = _plane(watermarks_fn=lambda: {"w0": 9})
+    yes = json.loads(p.handle(encode({"probe": {"origin": "w0", "seq": 5}})).decode())
+    no = json.loads(p.handle(encode({"probe": {"origin": "w0", "seq": 12}})).decode())
+    assert yes["covers"] is True and no["covers"] is False
+    assert yes["watermarks"] == {"w0": 9}
+
+
+# --- the write router -------------------------------------------------------
+
+
+def _router(peers, write_fn, **kw):
+    kw.setdefault("retries", 1)
+    kw.setdefault("backoff_base_s", 0.0)
+    kw.setdefault("backoff_max_s", 0.0)
+    kw.setdefault("poll_s", 0.001)
+    return WriteRouter(peers, write_fn, **kw)
+
+
+def test_route_is_owner_first_and_drops_dead_peers():
+    peers = ["a", "b", "c"]
+    order = rendezvous_order("k0", peers)
+    r = _router(peers, lambda *a: b"")
+    assert r.route("k0") == order
+    dead = order[0]
+    r2 = _router(
+        peers, lambda *a: b"",
+        verdict_fn=lambda p: "dead" if p == dead else "alive",
+    )
+    assert r2.route("k0") == [p for p in order if p != dead]
+
+
+def test_all_peer_sheds_propagate_retry_after_without_breaker_bills():
+    def write_fn(peer, payload, timeout_s, cancel):
+        return encode(
+            {"error": "overloaded: test", "member": peer, "retry_after_ms": 123}
+        )
+
+    r = _router(["a", "b"], write_fn)
+    out = r.write([["add", [1, 1, [0, 1]]]], "k0")
+    assert out["error"] == "overloaded" and out["retry_after_ms"] == 123
+    # admission control is not peer sickness: breakers stay closed.
+    assert r.breaker("a").state == CLOSED and r.breaker("b").state == CLOSED
+    assert r.metrics.snapshot()["counters"]["router.write_sheds"] >= 2
+
+
+def test_exhausted_walk_returns_unavailable():
+    def write_fn(peer, payload, timeout_s, cancel):
+        raise ConnectionError("down")
+
+    r = _router(["a", "b"], write_fn)
+    out = r.write([["add", [1, 1, [0, 1]]]], "k0")
+    assert out["error"] == "unavailable"
+    assert r.metrics.snapshot()["counters"]["router.write_exhausted"] == 1
+
+
+def test_replicated_to_k_certified_by_peer_probes():
+    def write_fn(peer, payload, timeout_s, cancel):
+        doc = json.loads(payload.decode())
+        if "probe" in doc:
+            return encode({"member": peer, "covers": True, "watermarks": {}})
+        return encode({
+            "write_ack": True, "member": peer, "origin": peer, "seq": 5,
+            "level": ACK_DURABLE, "requested": ACK_REPLICATED,
+        })
+
+    r = _router(["a", "b", "c"], write_fn, replication_wait_s=1.0)
+    out = r.write([["add", [1, 1, [0, 1]]]], "k0", ack=ACK_REPLICATED, k=2)
+    assert out["level"] == ACK_REPLICATED
+    assert out["replication"]["confirmed"] >= 2
+    assert r.metrics.snapshot()["counters"]["router.replicated_acks"] == 1
+
+
+def test_replication_shortfall_downgrades_honestly():
+    def write_fn(peer, payload, timeout_s, cancel):
+        doc = json.loads(payload.decode())
+        if "probe" in doc:
+            return encode({"member": peer, "covers": False, "watermarks": {}})
+        return encode({
+            "write_ack": True, "member": peer, "origin": peer, "seq": 5,
+            "level": ACK_DURABLE, "requested": ACK_REPLICATED,
+        })
+
+    r = _router(
+        ["a", "b"], write_fn,
+        replication_wait_s=0.05, replication_poll_s=0.01,
+    )
+    out = r.write([["add", [1, 1, [0, 1]]]], "k0", ack=ACK_REPLICATED, k=2)
+    assert out["level"] == ACK_DURABLE  # never above the truth
+    assert out["replication"] == {"confirmed": 1, "want": 2}
+    assert r.metrics.snapshot()["counters"]["router.replication_timeouts"] == 1
+
+
+def test_ack_teaches_session_read_your_writes():
+    def write_fn(peer, payload, timeout_s, cancel):
+        return encode({
+            "write_ack": True, "member": peer, "origin": peer, "seq": 7,
+            "level": ACK_DURABLE, "requested": ACK_DURABLE,
+        })
+
+    sess = ClientSession(session_id="s-wt")
+    r = _router(["a"], write_fn)
+    out = r.write([["add", [1, 1, [0, 1]]]], "k0", session=sess)
+    assert out["write_ack"]
+    # the cross-tier hook: the READ router routes this session only to
+    # peers whose applied watermarks cover (a, 7) from here on.
+    assert sess.token.floor() == {"a": 7}
+
+
+# --- owner failover mid-batch vs the sequential reference -------------------
+
+_DCS = 2
+
+
+def _fold(dense, state, effects):
+    """Fold scalar add effects into replica row 0 — the single-row twin
+    of the elastic demo drill's `ingest` fold."""
+    adds = [p for k, p in effects if k in ("add", "add_r")]
+    nb = max(len(adds), 1)
+    a_id = np.zeros((1, nb), np.int32)
+    a_score = np.zeros((1, nb), np.int32)
+    a_dc = np.zeros((1, nb), np.int32)
+    a_ts = np.zeros((1, nb), np.int32)
+    for j, (id_, score, (dc, ts)) in enumerate(adds):
+        a_id[0, j], a_score[0, j] = int(id_), int(score)
+        a_dc[0, j], a_ts[0, j] = int(dc) % _DCS, int(ts)
+    ops = TopkRmvOps(
+        add_key=jnp.zeros((1, nb), jnp.int32), add_id=jnp.asarray(a_id),
+        add_score=jnp.asarray(a_score), add_dc=jnp.asarray(a_dc),
+        add_ts=jnp.asarray(a_ts),
+        rmv_key=jnp.zeros((1, 1), jnp.int32),
+        rmv_id=jnp.full((1, 1), -1, jnp.int32),
+        rmv_vc=jnp.zeros((1, 1, _DCS), jnp.int32),
+    )
+    state, _ = dense.apply_ops(state, ops, collect_dominated=False)
+    return state
+
+
+def _digest(dense, state):
+    obs = dense.value(fold_rows(dense, state, range(1)))[0][0]
+    return sorted((int(i), int(s)) for (i, s) in obs)
+
+
+class _Worker:
+    def __init__(self, name, dense):
+        self.name = name
+        self.dense = dense
+        self.state = dense.init(1, 1)
+        self._lock = threading.Lock()
+        self.plane = _plane(name)
+        self.loop = _DrainLoop(self.plane, self._apply)
+
+    def _apply(self, ops):
+        effects = [effect_from_wire(o) for o in ops]
+        with self._lock:
+            self.state = _fold(self.dense, self.state, effects)
+
+    def stop(self):
+        self.loop.stop()
+
+
+def test_owner_failover_mid_batch_matches_sequential_reference():
+    # Worst-case duplicate fold: the owner APPLIES every batch, then the
+    # ack is lost on the wire. The router fails over to the successor
+    # with the SAME write_id; the successor (a different plane — no
+    # dedup cache to help) folds the batch again. Convergence must still
+    # hold: after merging both workers, the (dc, ts)-stamped adds dedup
+    # under join and the fleet equals a sequential reference that saw
+    # each effect exactly once.
+    dense = make_dense(n_ids=32, n_dcs=_DCS, size=8, slots_per_id=2)
+    wa, wb = _Worker("A", dense), _Worker("B", dense)
+    planes = {"A": wa.plane, "B": wb.plane}
+    drops = {"n": 0}
+
+    def write_fn(peer, payload, timeout_s, cancel):
+        raw = planes[peer].handle(payload, surface="test")
+        if peer == "A":
+            drops["n"] += 1
+            raise ConnectionError("ack lost after fold")
+        return raw
+
+    r = _router(["A", "B"], write_fn, retries=2, timeout_s=5.0)
+    # A key whose rendezvous OWNER is A — the failover path must start
+    # at the worker that folds-then-drops.
+    key = next(
+        f"k{i}" for i in range(64)
+        if rendezvous_order(f"k{i}", ["A", "B"])[0] == "A"
+    )
+    rng = np.random.default_rng(7)  # seeded drill
+    ids = [int(i) for i in rng.permutation(32)[:16]]
+    effects = [
+        ("add", (ids[i], (i + 1) * 3, (i % _DCS, 1_000_000 + i)))
+        for i in range(16)
+    ]
+    try:
+        for lo in range(0, 16, 4):
+            batch = [effect_to_wire(e) for e in effects[lo:lo + 4]]
+            out = r.write(batch, key=key, ack=ACK_DURABLE,
+                          write_id=f"c:{lo}")
+            assert out.get("write_ack"), out
+            assert out["peer"] == "B"  # failover completed every batch
+    finally:
+        wa.stop()
+        wb.stop()
+    # A really folded batches before the acks were lost; after three
+    # straight failures its breaker opens and the remaining batches go
+    # straight to B — duplicate folds AND breaker-skipped folds both
+    # land in the same merge.
+    assert drops["n"] == 3
+    c = r.metrics.snapshot()["counters"]
+    assert c["router.write_failovers"] >= 3
+    assert c["router.write_breaker_opens"] >= 1
+    merged = dense.merge(wa.state, wb.state)
+    ref = _fold(dense, dense.init(1, 1), effects)
+    assert _digest(dense, merged) == _digest(dense, ref)
+
+
+# --- sim transport plumbing -------------------------------------------------
+
+
+def test_sim_write_frames_roundtrip_with_wid_echo():
+    net = SimNet(seed=3, latency=(0.001, 0.002))
+    a = net.join("a")
+    b = net.join("b")
+    p = _plane("b")
+    loop = _DrainLoop(p)
+    b.install_ingest(p)
+    try:
+        a.write("b", _wdoc("x:1"), wid=b"x:1")
+        net.run_until(net.time + 1.0)
+    finally:
+        loop.stop()
+    assert b"x:1" in a.write_results
+    who, raw = a.write_results[b"x:1"]
+    ack = json.loads(raw.decode())
+    assert who == "b" and ack["write_ack"] and ack["origin"] == "b"
+    assert net.metrics.snapshot()["counters"]["net.writes"] == 1
+
+
+def test_sim_cancelled_write_ack_is_dropped_in_flight():
+    net = SimNet(seed=3, latency=(0.001, 0.002))
+    a = net.join("a")
+    b = net.join("b")
+    p = _plane("b")
+    loop = _DrainLoop(p)
+    b.install_ingest(p)
+    try:
+        a.write("b", _wdoc("x:2"), wid=b"x:2")
+        a.cancel_write(b"x:2")  # router failed over before the ack
+        net.run_until(net.time + 1.0)
+    finally:
+        loop.stop()
+    assert b"x:2" not in a.write_results
+    c = net.metrics.snapshot()["counters"]
+    assert c["net.write_cancelled_drops"] == 1
+
+
+def test_sim_write_without_plane_degrades_honestly():
+    net = SimNet(seed=3, latency=(0.001, 0.002))
+    a = net.join("a")
+    net.join("b")  # no ingest plane installed
+    a.write("b", _wdoc("x:3"), wid=b"x:3")
+    net.run_until(net.time + 1.0)
+    _who, raw = a.write_results[b"x:3"]
+    assert json.loads(raw.decode())["error"] == "no ingest plane"
+
+
+# --- the write session (client-edge batching) -------------------------------
+
+
+def test_write_session_compacts_burst_and_ships_one_frame():
+    p = _plane("w0")
+    loop = _DrainLoop(p)
+    sess = ClientSession(session_id="s-ws")
+    r = _router(
+        ["w0"],
+        lambda peer, payload, t, c: p.handle(payload, surface="test"),
+    )
+    ws = WriteSession(
+        r, "topk_rmv", session=sess, session_id="c0", m_keep=2,
+    )
+    try:
+        # 8 adds for ONE id: the dense model keeps slots_per_id slots,
+        # so compaction (m_keep=2) may ship at most 2 survivors.
+        for i in range(8):
+            ws.stage("k0", ("add", (7, 10 + i, (0, 1_000_100 + i))))
+        res = ws.flush()
+    finally:
+        loop.stop()
+    assert len(res) == 1 and res[0].get("write_ack"), res
+    assert res[0]["raw_ops"] == 8 and res[0]["shipped_ops"] <= 2
+    assert ws.coalesce_ratio() >= 4.0
+    # the burst hit the plane as ONE CCRF range frame...
+    c = p.metrics.snapshot()["counters"]
+    assert c["ingest.range_frames"] == 1
+    assert c["ingest.writes"] == 1
+    # ...and the ack taught the session its own (origin, seq).
+    assert sess.token.floor() == {"w0": res[0]["seq"]}
+
+
+def test_effect_wire_roundtrip():
+    effects = [
+        ("add", (3, 50, (1, 1000007))),
+        ("rmv", (3, {0: 12, 1: 9})),
+    ]
+    assert [effect_from_wire(effect_to_wire(e)) for e in effects] == effects
+
+
+# --- the write-durability certificate ---------------------------------------
+
+
+def _acks(origin, through, level=ACK_DURABLE):
+    return [
+        {"kind": "ingest.ack", "member": "client", "origin": origin,
+         "wseq": s, "level": level, "write_id": f"c:{s}"}
+        for s in range(1, through + 1)
+    ]
+
+
+def test_certify_writes_convicts_acked_but_lost():
+    # Durable acks through 20, fsync evidence through 12, no clean
+    # exit, no survivor coverage: [13, 20] is acked-but-lost.
+    logs = {
+        "client": _acks("w1", 20),
+        "w1": [{"kind": "wal.durable", "member": "w1", "through": 12}],
+    }
+    cert = audit.certify_writes(logs=logs)
+    assert cert["ok"] is False
+    ce = cert["counterexample"]["acked_but_lost"][0]
+    assert ce["origin"] == "w1"
+    assert ce["uncovered"] == [13, 20]
+    assert "c:13" in ce["lost_write_ids"]
+    assert audit.verify_certificate(cert)
+
+
+def test_certify_writes_passes_on_fsync_coverage():
+    logs = {
+        "client": _acks("w1", 20),
+        "w1": [{"kind": "wal.durable", "member": "w1", "through": 20}],
+    }
+    cert = audit.certify_writes(logs=logs)
+    assert cert["ok"] is True and audit.verify_certificate(cert)
+
+
+def test_certify_writes_accepts_survivor_coverage():
+    # The owner's disk burned, but a surviving member applied the
+    # origin's delta stream through the acked seq: the fleet holds it.
+    logs = {
+        "client": _acks("w1", 20),
+        "w1": [{"kind": "wal.append", "member": "w1", "seq": 1}],
+        "w2": [{"kind": "delta.apply", "member": "w2", "origin": "w1",
+                "dseq": 20}],
+    }
+    cert = audit.certify_writes(logs=logs)
+    assert cert["ok"] is True
+
+
+def test_certify_writes_never_convicts_applied_level():
+    # `applied` promises nothing across a crash: reported, not convicted.
+    logs = {
+        "client": _acks("w1", 20, level=ACK_APPLIED),
+        "w1": [{"kind": "wal.append", "member": "w1", "seq": 1}],
+    }
+    cert = audit.certify_writes(logs=logs)
+    assert cert["ok"] is True
+    assert cert["acks_by_level"] == {ACK_APPLIED: 20}

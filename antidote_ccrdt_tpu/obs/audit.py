@@ -641,6 +641,7 @@ def certify_writes(
     obs_dir: Optional[str] = None,
     logs: Optional[Dict[str, List[Dict[str, Any]]]] = None,
     meta: Optional[Dict[str, Any]] = None,
+    strict_exactly_once: bool = False,
 ) -> Dict[str, Any]:
     """Replay the flight log's ``ingest.ack`` events (what the write
     tier TOLD clients they hold) against the fleet's durability and
@@ -675,9 +676,21 @@ def certify_writes(
     client writes). ``applied``-level acks promise nothing across a
     crash and are reported but never convicted.
 
+    The certificate also audits DUPLICATION, the converse of loss: the
+    planes emit one ``ingest.fold`` event per folded write_id, so a
+    write_id folded more than once — the at-least-once owner-failover
+    case, where the first owner applied the batch but its ack was lost
+    and the successor applied it again — lands in the ``duplicates``
+    section with the folding (member, wseq) sites. By default this is
+    reported, not convicted (the registered CRDT types absorb duplicate
+    folds under their stamped join); pass ``strict_exactly_once=True``
+    to make any duplicate application fail certification — the right
+    setting when the op stream is not duplicate-tolerant.
+
     Returns a signed certificate; on failure `ok` is False and
     `counterexample` names the lost seq range per origin plus the
-    acked write_ids inside it."""
+    acked write_ids inside it (and, under strict mode, the duplicated
+    write_ids with their fold sites)."""
     if logs is None:
         logs = obs_events.scan_dir(obs_dir) if obs_dir else {}
     # -- the promises: client-side acks, grouped by origin ------------
@@ -774,7 +787,33 @@ def certify_writes(
                     wid for s, _l, wid in hard if s > cover and wid
                 )[:8],
             })
+    # -- duplication: one ingest.fold per write_id, fleet-wide ---------
+    folds: Dict[str, List[Dict[str, Any]]] = {}
+    for fname in sorted(logs):
+        evs = logs[fname]
+        applier = next(
+            (str(e["member"]) for e in evs if e.get("member")), fname
+        )
+        for e in evs:
+            if e.get("kind") != "ingest.fold" or not e.get("write_id"):
+                continue
+            folds.setdefault(str(e["write_id"]), []).append(
+                {"member": str(e.get("member") or applier),
+                 "wseq": int(e.get("wseq", -1))}
+            )
+    dup_examples = [
+        {"write_id": wid, "folds": sites}
+        for wid, sites in sorted(folds.items())
+        if len(sites) > 1
+    ]
+    duplicates = {
+        "n_folded_write_ids": len(folds),
+        "n_duplicated": len(dup_examples),
+        "examples": dup_examples[:8],
+    }
     checks = {"acked_durability_coverage": not exposures}
+    if strict_exactly_once:
+        checks["exactly_once_application"] = not dup_examples
     ok = all(checks.values())
     doc: Dict[str, Any] = {
         "kind": WRITE_CERTIFICATE_KIND,
@@ -786,15 +825,22 @@ def certify_writes(
         "acks_by_level": by_level,
         "n_origins": len(acks),
         "origins": per_origin,
+        "duplicates": duplicates,
         "n_flight_logs": len(logs),
         "meta": meta or {},
     }
     if not ok:
-        doc["counterexample"] = {"acked_but_lost": exposures[:5]}
+        cx: Dict[str, Any] = {}
+        if exposures:
+            cx["acked_but_lost"] = exposures[:5]
+        if strict_exactly_once and dup_examples:
+            cx["duplicate_applications"] = dup_examples[:5]
+        doc["counterexample"] = cx
     sign_certificate(doc)
     obs_events.emit(
         "audit.write_certificate", ok=ok,
         n_exposed=len(exposures),
+        n_duplicated=len(dup_examples),
         signature=doc["signature"][:16],
     )
     return doc

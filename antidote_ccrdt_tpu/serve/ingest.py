@@ -13,9 +13,21 @@ closes that gap:
   walk lands on the replica its own writes went to (cache affinity +
   read-your-writes in one move). SWIM-``dead`` verdicts and the shared
   circuit breakers (`serve.routing_common`) fail writes over to the
-  next candidate — idempotently, because every write carries a client
-  `write_id` the plane dedups on (a retried/failed-over delivery
-  re-acks the original `(origin, seq)`, never double-applies).
+  next candidate. Delivery semantics are explicit, not wishful:
+  redelivery to the SAME plane is exactly-once — every write carries a
+  client `write_id` the plane tracks from enqueue (in-flight registry)
+  through fold (drain-time ack cache), so a retry attaches to the
+  original or re-acks its ``(origin, seq)``, never re-folds. Failover
+  to a DIFFERENT member is **at-least-once**: if the dead owner
+  actually folded before its ack was lost (slow drain, killed after
+  apply — its delta gossips or its WAL recovers), the successor folds
+  the batch again under its own ``(origin, seq)``. The registered CRDT
+  types absorb that duplicate under join (stamped adds dedup on merge);
+  every fold emits an ``ingest.fold`` flight event carrying its
+  write_id, so `obs.audit.certify_writes` reports cross-member
+  duplicate applications and, with ``strict_exactly_once=True``,
+  convicts them for deployments whose op streams are not
+  duplicate-tolerant.
 * **Pre-wire batching.** `WriteSession` (serve/write_session.py)
   compacts a staged burst through `ops.compaction.compact_effect_ops`
   and ships it as ONE `net.transport` ``CCRF`` range frame — the PR 15
@@ -140,6 +152,11 @@ class IngestPlane:
         self._lock = threading.Lock()
         self._pending: List[_PendingWrite] = []
         self._acked: Dict[str, Dict[str, Any]] = {}  # write_id -> ack doc
+        # write_id -> its parked _PendingWrite, from enqueue until the
+        # drain that folds it records the ack. A duplicate delivery in
+        # this window attaches to the original instead of enqueueing a
+        # second fold.
+        self._inflight: Dict[str, _PendingWrite] = {}
         self._drain_rate = 0.0  # writes/s EWMA behind the shed hint
 
     # -- the round-loop side -------------------------------------------------
@@ -149,9 +166,13 @@ class IngestPlane:
         ONE `apply_fn` call gets the whole drained batch (concatenated
         ops, arrival order) — the server-side half of the batching
         story. Each write is stamped ``(self.member, seq)``; transport
-        threads blocked in `handle()` wake and build their acks. A
+        threads blocked in `handle()` wake and build their acks. The
+        write_id ack is recorded HERE, not in `handle()`: a write whose
+        handler timed out before the fold still lands in the dedup
+        cache, so a client retry re-acks instead of re-applying. A
         raising `apply_fn` fails the batch honestly (the writes were
-        NOT applied; callers see an error, not a fake ack)."""
+        NOT applied and leave no dedup entry; callers see an error, and
+        a retry legitimately re-applies)."""
         with self._lock:
             batch, self._pending = self._pending, []
         if not batch:
@@ -160,6 +181,10 @@ class IngestPlane:
         try:
             apply_fn([op for w in batch for op in w.ops])
         except Exception as e:  # noqa: BLE001 — surfaced per-writer
+            with self._lock:
+                for w in batch:
+                    if w.write_id is not None:
+                        self._inflight.pop(w.write_id, None)
             for w in batch:
                 w.error = f"apply failed: {e}"
                 w.done.set()
@@ -171,9 +196,35 @@ class IngestPlane:
             inst if self._drain_rate == 0.0
             else 0.8 * self._drain_rate + 0.2 * inst
         )
+        with self._lock:
+            for w in batch:
+                w.seq = int(seq)
+                if w.write_id is None:
+                    continue
+                # Atomically retire the in-flight entry and record the
+                # base ack: a duplicate delivery sees exactly one of
+                # them, never a gap it could re-apply through.
+                self._inflight.pop(w.write_id, None)
+                self._acked[w.write_id] = {
+                    "write_ack": True,
+                    "member": self.member,
+                    "origin": self.member,
+                    "seq": int(seq),
+                    "level": ACK_APPLIED,
+                    "write_id": w.write_id,
+                }
+            while len(self._acked) > _ACK_CACHE_MAX:
+                self._acked.pop(next(iter(self._acked)))
         for w in batch:
-            w.seq = int(seq)
             w.done.set()
+            if w.write_id is not None:
+                # Fleet-visible fold evidence: certify_writes replays
+                # these to surface a write_id folded by >1 member (the
+                # at-least-once failover case).
+                obs_events.emit(
+                    "ingest.fold", member=self.member, wseq=int(seq),
+                    write_id=w.write_id, n_ops=len(w.ops),
+                )
         self.metrics.count("ingest.applied", len(batch))
         return len(batch)
 
@@ -227,30 +278,54 @@ class IngestPlane:
             )
         if framed:
             self.metrics.count("ingest.range_frames")
-        # Idempotent re-ack: a duplicate delivery (client retry, owner
-        # failover racing the original) re-answers the ORIGINAL ack —
-        # same (origin, seq), never a second fold.
-        if write_id is not None:
-            with self._lock:
-                prior = self._acked.get(str(write_id))
-            if prior is not None:
-                self.metrics.count("ingest.duplicate_acks")
-                dup = dict(prior)
-                dup["duplicate"] = True
-                return encode(dup)
-        shed = self._admission(len(ops))
+        wid = str(write_id) if write_id is not None else None
+        deadline = self.mono() + self.ack_timeout_s
+        # Pressure probes run OUTSIDE the lock (a probe may call back
+        # into this plane's own introspection); the verdict is applied
+        # under the lock below, after dedup has had first refusal.
+        pressure = self._pressure_shed()
+        w = _PendingWrite(ops, wid)
+        prior: Optional[Dict[str, Any]] = None
+        orig: Optional[_PendingWrite] = None
+        shed: Optional[Dict[str, Any]] = None
+        shed_kind = ""
+        with self._lock:
+            # Dedup first — a duplicate delivery (client retry, owner
+            # redelivery racing the original) is re-acked or attached
+            # to the in-flight original, NEVER shed and never enqueued
+            # a second time.
+            if wid is not None:
+                prior = self._acked.get(wid)
+                if prior is None:
+                    orig = self._inflight.get(wid)
+            if prior is None and orig is None:
+                if pressure is not None:
+                    shed, shed_kind = pressure, "pressure"
+                elif len(self._pending) + 1 > self.queue_max:
+                    # Bound check and append share this one lock hold:
+                    # N racing handlers cannot all pass the depth test
+                    # and push the queue past queue_max.
+                    shed = self._queue_shed_doc(len(self._pending))
+                    shed_kind = "queue"
+                else:
+                    self._pending.append(w)
+                    if wid is not None:
+                        self._inflight[wid] = w
+        if prior is not None:
+            return self._reack(prior, level, deadline)
+        if orig is not None:
+            return self._await_inflight(orig, level, deadline)
         if shed is not None:
+            self.metrics.count(f"ingest.{shed_kind}_shed")
             self.metrics.count(f"ingest.shed.{surface}")
             return encode(shed)
-        w = _PendingWrite(ops, str(write_id) if write_id is not None else None)
-        with self._lock:
-            self._pending.append(w)
-        deadline = self.mono() + self.ack_timeout_s
         w.done.wait(max(0.0, self.ack_timeout_s))
         if not w.done.is_set():
             # The round loop never drained us (worker wedged or dying):
             # fail honestly rather than hang the writer. The write may
-            # still fold later — the write_id dedup makes the retry safe.
+            # still fold later — it stays registered in-flight, and the
+            # drain records its ack, so a retry with this write_id
+            # attaches or re-acks instead of re-applying.
             self.metrics.count("ingest.apply_timeouts")
             return encode(
                 {"error": "unavailable: ingest apply timeout",
@@ -258,12 +333,9 @@ class IngestPlane:
             )
         if w.error is not None:
             return encode({"error": w.error, "member": self.member})
-        ack = self._build_ack(w, level, deadline)
+        ack = self._build_ack(w.seq, w.write_id, level, deadline)
         if w.write_id is not None:
-            with self._lock:
-                self._acked[w.write_id] = ack
-                while len(self._acked) > _ACK_CACHE_MAX:
-                    self._acked.pop(next(iter(self._acked)))
+            self._store_ack(w.write_id, ack)
         obs_events.emit(
             "ingest.write", wseq=w.seq, level=ack["level"],
             write_id=w.write_id or "", n_ops=len(ops),
@@ -308,32 +380,16 @@ class IngestPlane:
             doc["covers"] = bool(doc["watermarks"].get(o, -1) >= s >= 0)
         return encode(doc)
 
-    def _admission(self, n_ops: int) -> Optional[Dict[str, Any]]:
-        """None = admitted; else the honest shed document. Queue bound
-        first (retry_after from the observed drain rate), then the
-        injected pressure probes (WAL lag / overlap depth / pager)."""
-        with self._lock:
-            depth = len(self._pending)
-            rate = self._drain_rate
-        if depth + 1 > self.queue_max:
-            if rate <= 0.0:
-                hint = 50
-            else:
-                hint = max(1, min(5000, int(1000.0 * (depth + 1) / rate)))
-            self.metrics.count("ingest.queue_shed")
-            return {
-                "error": f"overloaded: ingest queue full ({depth} >= "
-                f"{self.queue_max})",
-                "member": self.member,
-                "retry_after_ms": hint,
-            }
+    def _pressure_shed(self) -> Optional[Dict[str, Any]]:
+        """First non-None verdict from the injected pressure probes
+        (WAL lag / overlap depth / pager) as an honest shed document;
+        None = no pressure. Never called under the plane lock."""
         for fn in self.pressure_fns:
             try:
                 hint = fn()
             except Exception:  # noqa: BLE001 — a broken probe never sheds
                 continue
             if hint is not None:
-                self.metrics.count("ingest.pressure_shed")
                 return {
                     "error": "overloaded: backpressure",
                     "member": self.member,
@@ -341,8 +397,72 @@ class IngestPlane:
                 }
         return None
 
+    def _queue_shed_doc(self, depth: int) -> Dict[str, Any]:
+        """The queue-full shed document (retry_after from the observed
+        drain rate). Caller holds the plane lock."""
+        rate = self._drain_rate
+        if rate <= 0.0:
+            hint = 50
+        else:
+            hint = max(1, min(5000, int(1000.0 * (depth + 1) / rate)))
+        return {
+            "error": f"overloaded: ingest queue full ({depth} >= "
+            f"{self.queue_max})",
+            "member": self.member,
+            "retry_after_ms": hint,
+        }
+
+    def _store_ack(self, wid: str, ack: Dict[str, Any]) -> None:
+        with self._lock:
+            self._acked[wid] = ack
+            while len(self._acked) > _ACK_CACHE_MAX:
+                self._acked.pop(next(iter(self._acked)))
+
+    def _reack(
+        self, prior: Dict[str, Any], level: str, deadline: float
+    ) -> bytes:
+        """Re-answer a duplicate delivery from the recorded ack — same
+        ``(origin, seq)``, no second fold. A drain-time base ack sits at
+        ``applied``; if this delivery asks for durability, wait the
+        watermark out against the ORIGINAL fold's seq and upgrade the
+        cached doc, so a retry after an ack timeout still gets the level
+        it paid for."""
+        self.metrics.count("ingest.duplicate_acks")
+        ack = dict(prior)
+        want = _ACK_LEVELS.index(level)
+        have = _ACK_LEVELS.index(str(ack.get("level", ACK_APPLIED)))
+        if want > have:
+            ack = self._build_ack(
+                int(ack["seq"]), str(ack.get("write_id") or "") or None,
+                level, deadline,
+            )
+            if _ACK_LEVELS.index(ack["level"]) > have and ack.get("write_id"):
+                self._store_ack(ack["write_id"], dict(ack))
+        ack["duplicate"] = True
+        return encode(ack)
+
+    def _await_inflight(
+        self, orig: _PendingWrite, level: str, deadline: float
+    ) -> bytes:
+        """A duplicate delivery racing its still-parked original: wait
+        on the ORIGINAL's fold instead of enqueueing a second
+        _PendingWrite (two concurrent deliveries must fold once)."""
+        self.metrics.count("ingest.duplicate_acks")
+        orig.done.wait(max(0.0, deadline - self.mono()))
+        if not orig.done.is_set():
+            self.metrics.count("ingest.apply_timeouts")
+            return encode(
+                {"error": "unavailable: ingest apply timeout",
+                 "member": self.member}
+            )
+        if orig.error is not None:
+            return encode({"error": orig.error, "member": self.member})
+        ack = self._build_ack(orig.seq, orig.write_id, level, deadline)
+        ack["duplicate"] = True
+        return encode(ack)
+
     def _build_ack(
-        self, w: _PendingWrite, level: str, deadline: float
+        self, seq: int, write_id: Optional[str], level: str, deadline: float
     ) -> Dict[str, Any]:
         """The ack document at the HIGHEST level achieved by `deadline`,
         never above the requested one and never above the truth."""
@@ -356,7 +476,7 @@ class IngestPlane:
         elif want_durable and self.durable_fn is not None:
             while self.mono() < deadline:
                 try:
-                    if int(self.durable_fn()) >= w.seq:
+                    if int(self.durable_fn()) >= seq:
                         achieved = ACK_DURABLE
                         self.metrics.count("ingest.durable_acks")
                         break
@@ -372,12 +492,12 @@ class IngestPlane:
             "write_ack": True,
             "member": self.member,
             "origin": self.member,
-            "seq": int(w.seq),
+            "seq": int(seq),
             "level": achieved,
             "requested": level,
         }
-        if w.write_id is not None:
-            ack["write_id"] = w.write_id
+        if write_id is not None:
+            ack["write_id"] = write_id
         if self.watermarks_fn is not None:
             try:
                 ack["watermarks"] = {
@@ -404,10 +524,10 @@ class _WriteAttempt:
 class WriteRouter:
     """Client-side write router: owner affinity, SWIM-verdict failover,
     shared circuit breakers, bounded retries, honest sheds — the write
-    twin of `FleetRouter`, minus hedging (a write hedge is just a
-    duplicate delivery; the write_id dedup would absorb it, but the
-    failover walk already covers the latency case without doubling
-    load on a struggling fleet).
+    twin of `FleetRouter`, minus hedging (a write hedge lands on a
+    SECOND member, where the per-plane write_id dedup cannot see the
+    first delivery — a guaranteed duplicate fold; the failover walk
+    covers the latency case without it).
 
     `write()` never raises and never hangs: every outcome is a decoded
     ack document (augmented with ``"peer"``) or an honest error
@@ -506,8 +626,9 @@ class WriteRouter:
         """Route one write (or one pre-framed burst via `payload` — a
         `WriteSession` CCRF range frame whose inner doc must carry the
         SAME write_id). Walks the HRW owner list with bounded retries;
-        duplicate deliveries are safe because the plane re-acks by
-        write_id. On success teaches the session its own ``(origin,
+        redelivery to the same plane is deduped by write_id, while
+        failover to a different member is at-least-once (see
+        `_run_pass`). On success teaches the session its own ``(origin,
         seq)`` and flight-records ``ingest.ack`` — the feed
         `obs.audit.certify_writes` replays."""
         t0 = self.mono()
@@ -569,8 +690,13 @@ class WriteRouter:
     ) -> Tuple[str, Any]:
         """("ok", (resp, peer)) | ("shed", retry_after_ms) |
         ("err", detail). A failed owner fails over to the next HRW
-        candidate (`router.write_failovers`) with the SAME write_id —
-        the plane dedups, so mid-batch failover cannot double-apply."""
+        candidate (`router.write_failovers`) with the SAME write_id.
+        Redelivery to the same plane dedups (in-flight registry + ack
+        cache); failover to a DIFFERENT member is at-least-once — if
+        the first owner folded before dying, the successor re-applies
+        under its own (origin, seq) and the CRDT join must absorb the
+        duplicate (certify_writes surfaces it via ingest.fold
+        evidence)."""
         shed_hint: Optional[int] = None
         saw_shed = False
         last_detail: Any = "no candidates"

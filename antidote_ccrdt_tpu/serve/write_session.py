@@ -154,9 +154,13 @@ class WriteSession:
         except Exception:  # noqa: BLE001 — unknown type etc.: ship raw
             self.metrics.count("write_session.compact_fallbacks")
             compacted = list(effects)
-        self.raw_ops += raw_n
-        self.shipped_ops += len(compacted)
         with self._lock:
+            # Provenance counters advance under the SAME lock hold that
+            # assigns the write_id and computes lo: concurrent flushes
+            # (auto-flush racing an explicit flush()) get disjoint
+            # [lo, hi] ranges and an exact coalesce_ratio.
+            self.raw_ops += raw_n
+            self.shipped_ops += len(compacted)
             self._wid_n += 1
             wid = f"{self.session_id}:{self._wid_n}"
             lo = self.raw_ops - raw_n

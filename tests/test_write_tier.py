@@ -21,6 +21,7 @@ from antidote_ccrdt_tpu.harness.dense_replay import fold_rows
 from antidote_ccrdt_tpu.models.topk_rmv_dense import TopkRmvOps, make_dense
 from antidote_ccrdt_tpu.net.sim import SimNet
 from antidote_ccrdt_tpu.obs import audit
+from antidote_ccrdt_tpu.obs import events as obs_events
 from antidote_ccrdt_tpu.serve.ingest import (
     ACK_APPLIED,
     ACK_DURABLE,
@@ -112,6 +113,59 @@ def test_duplicate_delivery_reacks_original_seq():
     assert c["ingest.applied"] == 1
 
 
+def test_retry_after_apply_timeout_reacks_the_drain_time_ack():
+    # An apply-timeout must NOT break idempotency: the write stays
+    # registered in-flight and the drain records its ack, so a client
+    # retry with the same write_id re-acks the original fold — at the
+    # durability level it asks for — instead of applying a second time.
+    p = _plane(ack_timeout_s=0.05)  # nobody drains: the first call times out
+    out1 = json.loads(p.handle(_wdoc("c:9")).decode())
+    assert out1["error"].startswith("unavailable")
+    applied = []
+    p.drain(17, applied.extend)  # the wedged round loop finally drains
+    out2 = json.loads(p.handle(_wdoc("c:9")).decode())  # client retry
+    assert out2["duplicate"] is True
+    assert (out2["origin"], out2["seq"]) == ("w0", 17)
+    assert out2["level"] == ACK_DURABLE  # upgraded against the fold's seq
+    assert len(applied) == 1  # the retry never re-folded
+    c = p.metrics.snapshot()["counters"]
+    assert c["ingest.applied"] == 1
+    assert c["ingest.duplicate_acks"] == 1
+    assert c["ingest.apply_timeouts"] == 1
+
+
+def test_concurrent_duplicate_deliveries_fold_once():
+    # Two racing deliveries of one write_id (client retry overtaking a
+    # slow original on the same worker) used to both miss the post-ack
+    # cache and both enqueue. The in-flight registry parks the second
+    # on the first's fold: one _PendingWrite, one apply, two acks.
+    p = _plane(ack_timeout_s=2.0)
+    acks = []
+    acks_lock = threading.Lock()
+
+    def deliver():
+        out = json.loads(p.handle(_wdoc("c:7")).decode())
+        with acks_lock:
+            acks.append(out)
+
+    ts = [threading.Thread(target=deliver, daemon=True) for _ in range(2)]
+    for t in ts:
+        t.start()
+    deadline = time.monotonic() + 1.0
+    while p.depth() < 1 and time.monotonic() < deadline:
+        time.sleep(0.001)
+    time.sleep(0.05)  # let the second delivery attach (not enqueue)
+    assert p.depth() == 1  # ONE parked write, never two
+    applied = []
+    p.drain(5, applied.extend)
+    for t in ts:
+        t.join(3.0)
+    assert len(applied) == 1  # the duplicate never reached apply_fn
+    assert [a["seq"] for a in acks] == [5, 5]
+    assert any(a.get("duplicate") for a in acks)
+    assert p.metrics.snapshot()["counters"]["ingest.duplicate_acks"] == 1
+
+
 # --- durable acks vs the async-durability watermark -------------------------
 
 
@@ -187,6 +241,46 @@ def test_queue_full_sheds_with_retry_hint_and_blocked_write_times_out():
     c = p.metrics.snapshot()["counters"]
     assert c["ingest.queue_shed"] == 1
     assert c["ingest.apply_timeouts"] == 1
+
+
+def test_admission_bound_holds_under_concurrent_handlers():
+    # The depth test and the append share one lock hold: N racing
+    # handlers cannot all pass the bound and push the queue past
+    # queue_max — exactly queue_max park, the rest shed honestly.
+    p = _plane(queue_max=2, ack_timeout_s=1.0, durable_fn=None)
+    outs = []
+    outs_lock = threading.Lock()
+
+    def deliver(i):
+        out = json.loads(p.handle(_wdoc(f"c:{i}")).decode())
+        with outs_lock:
+            outs.append(out)
+
+    ts = [
+        threading.Thread(target=deliver, args=(i,), daemon=True)
+        for i in range(8)
+    ]
+    for t in ts:
+        t.start()
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline:
+        c = p.metrics.snapshot()["counters"]
+        if c.get("ingest.queue_shed", 0) >= 6:
+            break
+        time.sleep(0.005)
+    assert p.depth() == 2  # never past queue_max
+    applied = []
+    p.drain(3, applied.extend)
+    for t in ts:
+        t.join(3.0)
+    acked = [o for o in outs if o.get("write_ack")]
+    shed = [
+        o for o in outs
+        if str(o.get("error", "")).startswith("overloaded")
+    ]
+    assert len(acked) == 2 and len(shed) == 6
+    assert len(applied) == 2
+    assert p.metrics.snapshot()["counters"]["ingest.queue_shed"] == 6
 
 
 def test_pressure_probe_sheds_with_its_own_hint():
@@ -370,6 +464,10 @@ def test_owner_failover_mid_batch_matches_sequential_reference():
     # under join and the fleet equals a sequential reference that saw
     # each effect exactly once.
     dense = make_dense(n_ids=32, n_dcs=_DCS, size=8, slots_per_id=2)
+    # Fresh recorder: the process ring is bounded, so a full-suite run
+    # may have filled it already — an index slice over the ring would
+    # miss this drill's folds once eviction starts.
+    obs_events.reset("failover-drill")
     wa, wb = _Worker("A", dense), _Worker("B", dense)
     planes = {"A": wa.plane, "B": wb.plane}
     drops = {"n": 0}
@@ -401,9 +499,13 @@ def test_owner_failover_mid_batch_matches_sequential_reference():
                           write_id=f"c:{lo}")
             assert out.get("write_ack"), out
             assert out["peer"] == "B"  # failover completed every batch
+        # Acks are synchronous, so every fold event is on the ring by
+        # now; capture before the recorder is restored below.
+        folds = obs_events.events("ingest.fold")
     finally:
         wa.stop()
         wb.stop()
+        obs_events.reset("?")
     # A really folded batches before the acks were lost; after three
     # straight failures its breaker opens and the remaining batches go
     # straight to B — duplicate folds AND breaker-skipped folds both
@@ -415,6 +517,23 @@ def test_owner_failover_mid_batch_matches_sequential_reference():
     merged = dense.merge(wa.state, wb.state)
     ref = _fold(dense, dense.init(1, 1), effects)
     assert _digest(dense, merged) == _digest(dense, ref)
+    # The at-least-once failover duplicates are NOT invisible: each
+    # plane emitted ingest.fold per write_id, and the strict
+    # exactly-once certificate convicts the cross-member re-folds the
+    # join just absorbed (the honest contract for non-idempotent ops).
+    # (Fold events only: this in-process drill has no WAL evidence, so
+    # the durability axis would convict vacuously and mask the check.)
+    strict = audit.certify_writes(
+        logs={"drill": folds}, strict_exactly_once=True
+    )
+    assert strict["duplicates"]["n_duplicated"] == drops["n"]
+    assert strict["ok"] is False
+    dup = strict["counterexample"]["duplicate_applications"][0]
+    assert {f["member"] for f in dup["folds"]} == {"A", "B"}
+    # ...while the default certificate reports them without convicting.
+    loose = audit.certify_writes(logs={"drill": folds})
+    assert loose["ok"] is True
+    assert loose["duplicates"]["n_duplicated"] == drops["n"]
 
 
 # --- sim transport plumbing -------------------------------------------------
@@ -555,6 +674,37 @@ def test_certify_writes_accepts_survivor_coverage():
     }
     cert = audit.certify_writes(logs=logs)
     assert cert["ok"] is True
+
+
+def test_certify_writes_duplicate_folds_reported_and_strictly_convicted():
+    # Owner w1 folded c:5, died before its ack shipped, and the
+    # successor w2 folded it again: the fold evidence names both sites.
+    # Default contract (at-least-once, join absorbs) reports; strict
+    # exactly-once convicts with the duplicated write_ids.
+    logs = {
+        "client": _acks("w1", 5),
+        "w1": [
+            {"kind": "wal.durable", "member": "w1", "through": 9},
+            {"kind": "ingest.fold", "member": "w1", "wseq": 5,
+             "write_id": "c:5"},
+        ],
+        "w2": [
+            {"kind": "ingest.fold", "member": "w2", "wseq": 7,
+             "write_id": "c:5"},
+        ],
+    }
+    cert = audit.certify_writes(logs=logs)
+    assert cert["ok"] is True
+    assert cert["duplicates"]["n_folded_write_ids"] == 1
+    assert cert["duplicates"]["n_duplicated"] == 1
+    assert cert["duplicates"]["examples"][0]["write_id"] == "c:5"
+    strict = audit.certify_writes(logs=logs, strict_exactly_once=True)
+    assert strict["ok"] is False
+    assert strict["checks"]["exactly_once_application"] is False
+    dup = strict["counterexample"]["duplicate_applications"][0]
+    assert dup["write_id"] == "c:5"
+    assert {f["member"] for f in dup["folds"]} == {"w1", "w2"}
+    assert audit.verify_certificate(strict)
 
 
 def test_certify_writes_never_convicts_applied_level():

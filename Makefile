@@ -23,7 +23,7 @@ PY ?= python
 # meaningful.
 COVER_THRESHOLD ?= 88
 
-.PHONY: all compile test cover typecheck xref native bench benchall dryrun net-demo chaos crash-demo obs-demo topo-demo spans-demo overlap-demo partition-demo serve-demo audit-demo multichip-demo working-set-demo read-tier-demo write-tier-demo bench-gate clean
+.PHONY: all compile test cover typecheck xref native bench benchall dryrun net-demo chaos crash-demo obs-demo topo-demo spans-demo overlap-demo partition-demo serve-demo audit-demo multichip-demo working-set-demo read-tier-demo write-tier-demo rtrace-demo bench-gate clean
 
 all: compile xref typecheck cover
 
@@ -248,6 +248,22 @@ read-tier-demo:
 # `make chaos`.
 write-tier-demo:
 	env JAX_PLATFORMS=cpu $(PY) scripts/write_tier_demo.py
+
+# Request-tracing gate (slow, real processes): a 4-worker TCP serving
+# fleet under seeded chaos with the rtrace plane armed at sample=1.0 —
+# every routed read mints a trace context that rides the {query} frame,
+# workers echo their enqueue->drain->kernel stage marks back in the
+# response, and the client reassembles ClockSync-aligned waterfalls
+# without scraping. Gated on >=99% of sampled completions reassembling
+# gap-free, attribution buckets covering >=90% of client-observed
+# latency at p50 AND at the p99 request, the OpenMetrics read-latency
+# exemplar resolving to a real stored trace with its dominant bucket
+# named, the mid-load SIGKILL rendering as a dead_reroute hop inside a
+# stored waterfall, and tracing overhead <=5% of reads/sec vs the same
+# fleet's interleaved CCRDT_RTRACE=0 kill-switch windows. Writes
+# RTRACE_r01.json (the carrier bench_gate's evaluate_rtrace compares).
+rtrace-demo:
+	env JAX_PLATFORMS=cpu $(PY) scripts/rtrace_demo.py
 
 # Span-tracing demo (slow, real processes): a 3-worker TCP fleet with
 # the round-phase span plane armed (CCRDT_SPANS=1) — every worker's

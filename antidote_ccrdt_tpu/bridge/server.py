@@ -1614,7 +1614,10 @@ class BridgeServer:
             # {query, Payload} -> serve-plane response bytes, verbatim.
             # Same canonical request/response codec as the tcp frame and
             # POST /query, so host-language clients get byte-identical
-            # answers on every surface.
+            # answers on every surface — including an rtrace "trace"
+            # context in the request and the "rtrace" echo in the
+            # response, which this op carries opaquely like any other
+            # payload byte.
             handler = self.query_handler
             if handler is None:
                 raise ValueError("no serve plane installed")

@@ -231,7 +231,9 @@ class SimTransport:
     def install_serve(self, plane) -> None:
         """Attach a serve plane (or any bytes->bytes handler), exactly
         as `TcpTransport.install_serve` — sim drills exercise the same
-        query path chaos-deterministically."""
+        query path chaos-deterministically. Payloads are opaque here
+        too: an rtrace ``"trace"`` context and the response ``"rtrace"``
+        echo round-trip byte-identically with the tcp surface."""
         handler_for = getattr(plane, "handler_for", None)
         if callable(handler_for):
             self.query_handler = handler_for("sim")

@@ -592,7 +592,10 @@ class TcpTransport:
         `{query, Payload}` frames are answered with `{query_resp,
         Member, ResponseBytes}` on the same connection. A real
         `ServePlane` gets its "tcp"-labelled handler so sheds on this
-        surface are countable apart from bridge/HTTP ones."""
+        surface are countable apart from bridge/HTTP ones. Payload is
+        opaque: an rtrace context (``"trace"`` in the canonical JSON
+        doc) and the response-borne ``"rtrace"`` echo ride these frames
+        byte-for-byte with no frame-format change."""
         handler_for = getattr(plane, "handler_for", None)
         if callable(handler_for):
             self.query_handler = handler_for("tcp")
@@ -604,7 +607,10 @@ class TcpTransport:
         `{write, Payload}` frames are answered with `{write_ack, Member,
         AckBytes}` on the same connection — the write tier's twin of
         `install_serve`. A real `IngestPlane` gets its "tcp"-labelled
-        handler so write sheds on this surface count separately."""
+        handler so write sheds on this surface count separately. Like
+        the query frames, the payload (bare JSON or a CCRF range frame)
+        is opaque — a ``"trace"`` context inside it and the ack's
+        ``"rtrace"`` echo propagate unchanged."""
         handler_for = getattr(plane, "handler_for", None)
         if callable(handler_for):
             self.write_handler = handler_for("tcp")

@@ -56,6 +56,18 @@ Wired event kinds:
                                         reads answered, replica swaps)
     sim.drop / sim.crash / sim.partition / sim.heal
     proc.start / proc.exit
+    ingest.write / ingest.fold / ingest.ack   (write tier; request plane)
+    session.write / session.read              (read-tier session audit feed)
+    rtrace.trace                              (request tracing, obs/rtrace.py)
+
+Request plane: the high-rate per-request kinds (`REQUEST_KINDS` +
+``rtrace.*``) are isolated into per-kind rings and their own
+``flight-req-<member>-<pid>.jsonl`` spill — a request flood can never
+evict another kind's audit evidence (certify_sessions/certify_writes
+replay session.* and ingest.ack/ingest.fold) nor anything in the main
+ring. `events()` merges both planes on the shared seq axis; `scan_dir`
+picks up both spill streams; lifecycle events are written to both files
+so each is self-describing about clean exit vs crash.
 
 This module is stdlib-only and imported by nearly every runtime layer —
 it must never import back into the package.
@@ -74,48 +86,118 @@ from typing import Any, Dict, Iterator, List, Optional
 
 ENV_DIR = "CCRDT_OBS_DIR"
 DEFAULT_RING = 4096
+DEFAULT_REQUEST_RING = 1 << 16
+
+# High-rate per-REQUEST kinds: one (or more) of these fires for every
+# routed read/write the fleet serves, so a request storm arrives at
+# 10^4-10^5 events while the control-plane kinds above trickle. They
+# live in their OWN per-kind rings (+ their own spill stream) so a
+# flood of one kind can never evict another kind's audit evidence —
+# certify_sessions replays session.write/session.read, certify_writes
+# replays ingest.ack/ingest.fold, and the PR 14/16 failover drills used
+# to work around exactly this eviction with oversize fresh recorders.
+# `rtrace.*` events (obs/rtrace.py) are request-plane by prefix.
+REQUEST_KINDS = frozenset({
+    "serve.query", "ingest.write", "ingest.ack", "ingest.fold",
+    "session.write", "session.read", "router.give_up",
+    "router.write_give_up", "fault.hit",
+})
+
+
+def _is_request_kind(kind: str) -> bool:
+    return kind in REQUEST_KINDS or kind.startswith("rtrace.")
 
 
 class FlightRecorder:
-    """One process's bounded event ring + optional line-buffered spill."""
+    """One process's bounded event rings + optional line-buffered spill.
+
+    Two planes share one seq axis (so merged replay order is total):
+
+    * the MAIN ring holds control-plane events (gossip, SWIM, WAL,
+      topo, ...) at `ring` capacity;
+    * request-plane kinds (`REQUEST_KINDS` + ``rtrace.*``) get one ring
+      EACH at `req_ring` capacity and spill to a separate
+      ``flight-req-*`` stream — per-kind isolation means a serve.query
+      flood can never evict ingest.fold/ingest.ack audit evidence, and
+      nothing request-shaped can touch the main ring at all.
+    """
 
     def __init__(
         self,
         member: str = "?",
         ring: int = DEFAULT_RING,
         spill_path: Optional[str] = None,
+        req_ring: int = DEFAULT_REQUEST_RING,
+        req_spill_path: Optional[str] = None,
     ):
         self.member = member
         self.ring: collections.deque = collections.deque(maxlen=ring)
+        self.req_ring_max = int(req_ring)
+        self.req_rings: Dict[str, collections.deque] = {}
         self.spill_path = spill_path
+        self.req_spill_path = req_spill_path
         self._seq = 0
         self._lock = threading.Lock()
         self._fh = None
+        self._req_fh = None
         if spill_path is not None:
             os.makedirs(os.path.dirname(spill_path) or ".", exist_ok=True)
             # buffering=1: line-buffered — each event reaches the kernel
             # when its newline is written, which is what makes the spill
             # a usable post-SIGKILL flight record.
             self._fh = open(spill_path, "a", buffering=1)
+        if req_spill_path is not None:
+            os.makedirs(
+                os.path.dirname(req_spill_path) or ".", exist_ok=True
+            )
+            self._req_fh = open(req_spill_path, "a", buffering=1)
 
     def emit(self, kind: str, **fields: Any) -> Dict[str, Any]:
         ev: Dict[str, Any] = {"kind": kind, "member": self.member}
         ev.update(fields)
+        req = _is_request_kind(kind)
         with self._lock:
             ev["seq"] = self._seq
             self._seq += 1
             ev["t"] = round(time.time(), 6)
-            self.ring.append(ev)
-            if self._fh is not None:
+            if req:
+                ring = self.req_rings.get(kind)
+                if ring is None:
+                    ring = self.req_rings[kind] = collections.deque(
+                        maxlen=self.req_ring_max
+                    )
+                ring.append(ev)
+            else:
+                self.ring.append(ev)
+            fh = self._req_fh if req else self._fh
+            if fh is None and req:
+                fh = self._fh  # request spill follows the main spill
+            fhs = [fh] if fh is not None else []
+            if kind in ("proc.start", "proc.exit") \
+                    and self._req_fh is not None:
+                # Lifecycle events land in BOTH spills: every flight
+                # file must be self-describing about whether its
+                # incarnation exited cleanly (certify_writes reads the
+                # absence of proc.exit as a crash dump, per file).
+                fhs.append(self._req_fh)
+            for f in fhs:
                 try:
-                    self._fh.write(json.dumps(ev, default=str) + "\n")
+                    f.write(json.dumps(ev, default=str) + "\n")
                 except (OSError, ValueError):
                     pass  # a full/closed spill must never crash the caller
         return ev
 
     def events(self, kind: Optional[str] = None) -> List[Dict[str, Any]]:
         with self._lock:
-            evs = list(self.ring)
+            if kind is not None:
+                src = self.req_rings.get(kind) if _is_request_kind(kind) \
+                    else self.ring
+                evs = list(src) if src is not None else []
+            else:
+                evs = list(self.ring)
+                for ring in self.req_rings.values():
+                    evs.extend(ring)
+                evs.sort(key=lambda e: e["seq"])
         if kind is None:
             return evs
         return [e for e in evs if e["kind"] == kind]
@@ -132,12 +214,14 @@ class FlightRecorder:
 
     def close(self) -> None:
         with self._lock:
-            if self._fh is not None:
-                try:
-                    self._fh.close()
-                except OSError:
-                    pass
-                self._fh = None
+            for attr in ("_fh", "_req_fh"):
+                fh = getattr(self, attr)
+                if fh is not None:
+                    try:
+                        fh.close()
+                    except OSError:
+                        pass
+                    setattr(self, attr, None)
 
 
 # -- module-level recorder (the surface the runtime layers use) -------------
@@ -168,15 +252,22 @@ def configure(
     ring: int = DEFAULT_RING,
     spill_dir: Optional[str] = None,
     crash_hooks: bool = True,
+    req_ring: int = DEFAULT_REQUEST_RING,
 ) -> FlightRecorder:
-    """Replace the process recorder: set its identity, ring bound, and
+    """Replace the process recorder: set its identity, ring bounds, and
     (optionally) the spill directory. Emits ``proc.start`` so every log
     opens with the incarnation's identity and pid."""
     global _recorder
-    old, spill = _recorder, None
+    old, spill, req_spill = _recorder, None, None
     if spill_dir is not None:
         spill = os.path.join(spill_dir, f"flight-{member}-{os.getpid()}.jsonl")
-    _recorder = FlightRecorder(member=member, ring=ring, spill_path=spill)
+        req_spill = os.path.join(
+            spill_dir, f"flight-req-{member}-{os.getpid()}.jsonl"
+        )
+    _recorder = FlightRecorder(
+        member=member, ring=ring, spill_path=spill,
+        req_ring=req_ring, req_spill_path=req_spill,
+    )
     old.close()
     if crash_hooks and spill is not None:
         _install_exit_hooks()
@@ -196,9 +287,13 @@ def install_from_env(
     return bool(d)
 
 
-def reset(member: str = "?", ring: int = DEFAULT_RING) -> FlightRecorder:
+def reset(
+    member: str = "?",
+    ring: int = DEFAULT_RING,
+    req_ring: int = DEFAULT_REQUEST_RING,
+) -> FlightRecorder:
     """Fresh in-memory recorder (tests)."""
-    return configure(member, ring=ring, crash_hooks=False)
+    return configure(member, ring=ring, crash_hooks=False, req_ring=req_ring)
 
 
 def _install_exit_hooks() -> None:

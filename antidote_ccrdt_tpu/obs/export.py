@@ -70,6 +70,7 @@ def prometheus_text(
     prefix: str = "ccrdt",
     labels: Optional[Dict[str, str]] = None,
     buckets: Tuple[float, ...] = DEFAULT_BUCKETS,
+    exemplars: Optional[Dict[str, Tuple[str, float]]] = None,
 ) -> str:
     """Render a `Metrics` (or a `snapshot()` dict) as Prometheus
     exposition text. Counters/gauges share one value dict upstream, so
@@ -79,7 +80,19 @@ def prometheus_text(
     `buckets` (each bucket includes everything at or below its bound,
     `+Inf` always equals `_count`), plus `_sum`/`_count` — derived from
     the raw samples `Metrics` keeps, so fleet aggregation can sum bucket
-    counts across workers."""
+    counts across workers.
+
+    `exemplars` maps a latency family name to ``(trace_id, ms)``; each
+    gets an OpenMetrics exemplar (`` # {trace_id="..."} value``) on the
+    bucket its value falls in, so a dashboard's p99 panel links to the
+    STORED request trace that latency came from (``scripts/
+    ccrdt_rtrace.py waterfall <id>`` decomposes it). By default the live
+    rtrace plane's exemplars are used — dark plane, no exemplars, and
+    the output is byte-identical to the pre-exemplar format."""
+    if exemplars is None:
+        from . import rtrace
+
+        exemplars = rtrace.exemplars()
     snap = _as_snapshot(src)
     lines: List[str] = []
     for name in sorted(snap.get("counters", {})):
@@ -101,14 +114,34 @@ def prometheus_text(
         else:
             cum = np.zeros(len(buckets), dtype=int)
             total, count = 0.0, 0
+        ex = (exemplars or {}).get(name)
+        ex_s = float(ex[1]) / 1e3 if ex else None  # exemplar ms -> s
+        ex_bucket = None
+        if ex_s is not None:
+            # The exemplar annotates the first bucket that contains its
+            # value (OpenMetrics requires value <= le); past the ladder
+            # it rides +Inf.
+            ex_bucket = next(
+                (le for le in buckets if ex_s <= le), "+Inf"
+            )
         for le, c in zip(buckets, cum):
             ll = 'le="%g"' % le
-            lines.append(f"{m}_bucket{_labels(labels, ll)} {int(c)}")
+            suffix = ""
+            if ex_bucket == le:
+                suffix = f' # {{trace_id="{ex[0]}"}} {_num_f(ex_s)}'
+            lines.append(f"{m}_bucket{_labels(labels, ll)} {int(c)}{suffix}")
         inf = 'le="+Inf"'
-        lines.append(f"{m}_bucket{_labels(labels, inf)} {count}")
+        suffix = ""
+        if ex_bucket == "+Inf":
+            suffix = f' # {{trace_id="{ex[0]}"}} {_num_f(ex_s)}'
+        lines.append(f"{m}_bucket{_labels(labels, inf)} {count}{suffix}")
         lines.append(f"{m}_sum{_labels(labels)} {_num(total)}")
         lines.append(f"{m}_count{_labels(labels)} {count}")
     return "\n".join(lines) + "\n"
+
+
+def _num_f(v: float) -> str:
+    return "%g" % float(v)
 
 
 def _num(v: float) -> str:

@@ -9,7 +9,10 @@ framework) serving:
   `export.prometheus_text` (histogram buckets included), with a
   per-worker ``member`` label so a Prometheus scraping the whole fleet
   can tell the series apart. Content-Type is the Prometheus text
-  exposition type.
+  exposition type. When the rtrace plane is armed the read/write
+  latency histograms carry OpenMetrics exemplars (``#
+  {trace_id="..."}``) pointing at the stored request trace behind the
+  worst observed latency.
 * ``GET /healthz``  — `{"ok": true, "member": ..., "uptime_s": ...}`,
   the liveness probe a supervisor or k8s deployment points at. With a
   ``health_extra`` callable installed, the doc gains serving-readiness
@@ -24,6 +27,11 @@ framework) serving:
   is the canonical write payload (bare JSON or a ``CCRF`` range frame),
   the response the canonical tiered ack bytes — byte-identical to the
   tcp ``{write}`` frame and the bridge op. 404 until installed.
+
+Both POST surfaces carry an rtrace ``"trace"`` context in the request
+doc and the ``"rtrace"`` echo in the response opaquely — the body bytes
+are handed to the plane verbatim, so request tracing works identically
+over HTTP, tcp, sim, and the bridge.
 
 Failure behavior mirrors the transports' "degrade, never hang" rule: a
 snapshot/render failure returns a 500 with the error text — the scrape

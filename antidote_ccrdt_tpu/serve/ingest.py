@@ -68,7 +68,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..net.transport import FRAME_MAGIC, decode_range_frame
-from ..obs import events as obs_events
+from ..obs import events as obs_events, rtrace
 from ..utils import faults
 from ..utils.metrics import Metrics
 from .plane import encode
@@ -88,9 +88,17 @@ _ACK_CACHE_MAX = 4096
 
 class _PendingWrite:
     """One write parked between the transport thread that received it
-    and the round loop that folds it at the next step boundary."""
+    and the round loop that folds it at the next step boundary.
 
-    __slots__ = ("ops", "write_id", "done", "seq", "error")
+    `t_stage` / `t_fold` / `kernel_ms` are rtrace stage marks on the
+    plane's monotonic clock (stage = parked, fold = drained+applied);
+    they ride the response echo only when the request carried a trace
+    context."""
+
+    __slots__ = (
+        "ops", "write_id", "done", "seq", "error",
+        "t_stage", "t_fold", "kernel_ms",
+    )
 
     def __init__(self, ops: List[Any], write_id: Optional[str]):
         self.ops = ops
@@ -98,6 +106,9 @@ class _PendingWrite:
         self.done = threading.Event()
         self.seq = -1
         self.error: Optional[str] = None
+        self.t_stage = 0.0
+        self.t_fold = 0.0
+        self.kernel_ms = 0.0
 
 
 class IngestPlane:
@@ -190,7 +201,8 @@ class IngestPlane:
                 w.done.set()
             self.metrics.count("ingest.apply_failures")
             return 0
-        dt = max(1e-9, self.mono() - t0)
+        t_fold = self.mono()
+        dt = max(1e-9, t_fold - t0)
         inst = len(batch) / dt
         self._drain_rate = (
             inst if self._drain_rate == 0.0
@@ -199,6 +211,8 @@ class IngestPlane:
         with self._lock:
             for w in batch:
                 w.seq = int(seq)
+                w.t_fold = t_fold
+                w.kernel_ms = dt * 1e3
                 if w.write_id is None:
                     continue
                 # Atomically retire the in-flight entry and record the
@@ -249,6 +263,7 @@ class IngestPlane:
         writer could not tell a crash from a shed)."""
         self.metrics.count("ingest.writes")
         self.metrics.count(f"ingest.writes.{surface}")
+        m_in = self.mono()
         try:
             faults.fire("serve.write")  # injected stall/raise per surface
             doc, framed = self._decode(raw)
@@ -262,6 +277,7 @@ class IngestPlane:
         probe = doc.get("probe")
         if probe is not None:
             return self._answer_probe(probe)
+        ctx = rtrace.server_trace(doc)
         write_id = doc.get("write_id")
         ops = doc.get("ops")
         if not isinstance(ops, list) or not ops:
@@ -285,6 +301,7 @@ class IngestPlane:
         # under the lock below, after dedup has had first refusal.
         pressure = self._pressure_shed()
         w = _PendingWrite(ops, wid)
+        w.t_stage = m_in
         prior: Optional[Dict[str, Any]] = None
         orig: Optional[_PendingWrite] = None
         shed: Optional[Dict[str, Any]] = None
@@ -312,13 +329,13 @@ class IngestPlane:
                     if wid is not None:
                         self._inflight[wid] = w
         if prior is not None:
-            return self._reack(prior, level, deadline)
+            return self._reack(prior, level, deadline, ctx, m_in)
         if orig is not None:
-            return self._await_inflight(orig, level, deadline)
+            return self._await_inflight(orig, level, deadline, ctx, m_in)
         if shed is not None:
             self.metrics.count(f"ingest.{shed_kind}_shed")
             self.metrics.count(f"ingest.shed.{surface}")
-            return encode(shed)
+            return encode(self._attach_echo(shed, ctx, m_in, shed=True))
         w.done.wait(max(0.0, self.ack_timeout_s))
         if not w.done.is_set():
             # The round loop never drained us (worker wedged or dying):
@@ -327,20 +344,32 @@ class IngestPlane:
             # drain records its ack, so a retry with this write_id
             # attaches or re-acks instead of re-applying.
             self.metrics.count("ingest.apply_timeouts")
-            return encode(
+            return encode(self._attach_echo(
                 {"error": "unavailable: ingest apply timeout",
-                 "member": self.member}
-            )
+                 "member": self.member}, ctx, m_in,
+            ))
         if w.error is not None:
-            return encode({"error": w.error, "member": self.member})
+            return encode(self._attach_echo(
+                {"error": w.error, "member": self.member}, ctx, m_in,
+            ))
+        t_ba = self.mono()
         ack = self._build_ack(w.seq, w.write_id, level, deadline)
+        dwait_ms = max(0.0, (self.mono() - t_ba) * 1e3)
         if w.write_id is not None:
             self._store_ack(w.write_id, ack)
         obs_events.emit(
             "ingest.write", wseq=w.seq, level=ack["level"],
             write_id=w.write_id or "", n_ops=len(ops),
         )
-        return encode(ack)
+        # Per-tier time-to-ack histogram (receipt -> ack built, at the
+        # tier actually ACHIEVED) — rides every scrape surface.
+        self.metrics.observe(
+            f"ingest.ack_ms.{ack['level']}",
+            max(0.0, (self.mono() - m_in) * 1e3),
+        )
+        return encode(self._attach_echo(
+            ack, ctx, m_in, w=w, durable_wait_ms=round(dwait_ms, 3),
+        ))
 
     def handler_for(self, surface: str) -> Callable[[bytes], bytes]:
         """A bytes->bytes handler bound to one surface label, so the
@@ -412,6 +441,30 @@ class IngestPlane:
             "retry_after_ms": hint,
         }
 
+    def _attach_echo(
+        self,
+        doc: Dict[str, Any],
+        ctx: Optional[Dict[str, Any]],
+        m_in: float,
+        w: Optional[_PendingWrite] = None,
+        **extra: Any,
+    ) -> Dict[str, Any]:
+        """Attach the rtrace server echo to a response doc — only when
+        the request carried a trace context (untraced responses stay
+        byte-identical to the pre-trace wire format). Returns a COPY:
+        the success ack is also cached for write_id dedup, and a stale
+        echo must never ride a future re-ack."""
+        if ctx is None:
+            return doc
+        marks: Dict[str, Any] = {"m_in": m_in, "m_out": self.mono()}
+        if w is not None and w.t_fold > 0.0:
+            marks["m_stage"] = w.t_stage
+            marks["m_fold"] = w.t_fold
+            extra.setdefault("kernel_ms", round(w.kernel_ms, 3))
+        out = dict(doc)
+        out["rtrace"] = rtrace.server_echo(ctx, self.member, marks, **extra)
+        return out
+
     def _store_ack(self, wid: str, ack: Dict[str, Any]) -> None:
         with self._lock:
             self._acked[wid] = ack
@@ -419,7 +472,8 @@ class IngestPlane:
                 self._acked.pop(next(iter(self._acked)))
 
     def _reack(
-        self, prior: Dict[str, Any], level: str, deadline: float
+        self, prior: Dict[str, Any], level: str, deadline: float,
+        ctx: Optional[Dict[str, Any]] = None, m_in: float = 0.0,
     ) -> bytes:
         """Re-answer a duplicate delivery from the recorded ack — same
         ``(origin, seq)``, no second fold. A drain-time base ack sits at
@@ -439,10 +493,18 @@ class IngestPlane:
             if _ACK_LEVELS.index(ack["level"]) > have and ack.get("write_id"):
                 self._store_ack(ack["write_id"], dict(ack))
         ack["duplicate"] = True
-        return encode(ack)
+        if m_in > 0.0:
+            self.metrics.observe(
+                f"ingest.ack_ms.{ack.get('level', ACK_APPLIED)}",
+                max(0.0, (self.mono() - m_in) * 1e3),
+            )
+        # Failover retries land here: a minimal dup echo keeps their
+        # waterfalls complete even though this delivery never folded.
+        return encode(self._attach_echo(ack, ctx, m_in, dup=True))
 
     def _await_inflight(
-        self, orig: _PendingWrite, level: str, deadline: float
+        self, orig: _PendingWrite, level: str, deadline: float,
+        ctx: Optional[Dict[str, Any]] = None, m_in: float = 0.0,
     ) -> bytes:
         """A duplicate delivery racing its still-parked original: wait
         on the ORIGINAL's fold instead of enqueueing a second
@@ -451,15 +513,22 @@ class IngestPlane:
         orig.done.wait(max(0.0, deadline - self.mono()))
         if not orig.done.is_set():
             self.metrics.count("ingest.apply_timeouts")
-            return encode(
+            return encode(self._attach_echo(
                 {"error": "unavailable: ingest apply timeout",
-                 "member": self.member}
-            )
+                 "member": self.member}, ctx, m_in,
+            ))
         if orig.error is not None:
-            return encode({"error": orig.error, "member": self.member})
+            return encode(self._attach_echo(
+                {"error": orig.error, "member": self.member}, ctx, m_in,
+            ))
         ack = self._build_ack(orig.seq, orig.write_id, level, deadline)
         ack["duplicate"] = True
-        return encode(ack)
+        if m_in > 0.0:
+            self.metrics.observe(
+                f"ingest.ack_ms.{ack['level']}",
+                max(0.0, (self.mono() - m_in) * 1e3),
+            )
+        return encode(self._attach_echo(ack, ctx, m_in, w=orig, dup=True))
 
     def _build_ack(
         self, seq: int, write_id: Optional[str], level: str, deadline: float
@@ -622,6 +691,7 @@ class WriteRouter:
         session: Optional[Any] = None,
         write_id: Optional[str] = None,
         payload: Optional[bytes] = None,
+        trace: Optional[rtrace.Trace] = None,
     ) -> Dict[str, Any]:
         """Route one write (or one pre-framed burst via `payload` — a
         `WriteSession` CCRF range frame whose inner doc must carry the
@@ -630,7 +700,9 @@ class WriteRouter:
         failover to a different member is at-least-once (see
         `_run_pass`). On success teaches the session its own ``(origin,
         seq)`` and flight-records ``ingest.ack`` — the feed
-        `obs.audit.certify_writes` replays."""
+        `obs.audit.certify_writes` replays. A `WriteSession` that
+        pre-framed its burst mints the trace itself (the context must
+        sit INSIDE the CCRF payload) and hands it over via `trace`."""
         t0 = self.mono()
         self.metrics.count("router.writes")
         if ack not in _ACK_LEVELS:
@@ -639,29 +711,48 @@ class WriteRouter:
             with self._wid_lock:
                 self._wid_n += 1
                 write_id = f"{self.member}:{self._wid_n}"
+        tr = trace
+        if tr is None and payload is None and rtrace.ACTIVE:
+            tr = rtrace.begin("write", key, t0)
         if payload is None:
-            payload = encode(
-                {"write_id": write_id, "ops": list(ops), "ack": ack}
-            )
+            doc: Dict[str, Any] = {
+                "write_id": write_id, "ops": list(ops), "ack": ack,
+            }
+            if tr is not None:
+                w = tr.wire()
+                if w:
+                    doc["trace"] = w
+            payload = encode(doc)
         sess = session if isinstance(session, ClientSession) else None
 
         last_err: Optional[str] = None
         shed_hint: Optional[int] = None
         all_sheds = True
         round_i = 0
+        first_route = True
         while round_i <= self.retries:
+            # First route hop opens at t0: write_id mint + CCRF/JSON
+            # payload build is route-bucket work, not a coverage gap.
+            t_route = t0 if first_route else self.mono()
+            first_route = False
             order = self.route(key)
+            if tr is not None:
+                tr.hop("route", t_route, self.mono(),
+                       candidates=list(order),
+                       breakers={p: s for p, s
+                                 in self._board.states().items()
+                                 if s != "closed"})
             if not order:
                 last_err = last_err or "no eligible peers"
                 all_sheds = False
                 round_i += 1
-                self._backoff(round_i)
+                self._backoff(round_i, tr)
                 continue
-            outcome, detail = self._run_pass(order, payload)
+            outcome, detail = self._run_pass(order, payload, tr)
             if outcome == "ok":
                 resp, peer = detail
                 return self._finish_ok(
-                    t0, resp, peer, ack, k, write_id, sess
+                    t0, resp, peer, ack, k, write_id, sess, tr
                 )
             if outcome == "shed":
                 shed_hint = max(shed_hint or 0, int(detail or 0))
@@ -672,21 +763,22 @@ class WriteRouter:
             round_i += 1
             if round_i <= self.retries:
                 self.metrics.count("router.write_retries")
-                self._backoff(round_i)
+                self._backoff(round_i, tr)
         if shed_hint is not None and all_sheds:
             self.metrics.count("router.write_shed_returns")
             return self._finish_error(
-                t0, "overloaded", {"retry_after_ms": shed_hint}
+                t0, "overloaded", {"retry_after_ms": shed_hint}, tr=tr,
             )
         return self._finish_error(
             t0, "unavailable", {"detail": last_err},
-            counter="router.write_exhausted",
+            counter="router.write_exhausted", tr=tr,
         )
 
     # -- one pass over the owner list ----------------------------------------
 
     def _run_pass(
-        self, order: List[str], payload: bytes
+        self, order: List[str], payload: bytes,
+        tr: Optional[rtrace.Trace] = None,
     ) -> Tuple[str, Any]:
         """("ok", (resp, peer)) | ("shed", retry_after_ms) |
         ("err", detail). A failed owner fails over to the next HRW
@@ -711,12 +803,12 @@ class WriteRouter:
                     self._fail(peer, e)
                     last_detail = str(e)
                     continue
-            verdict, detail = self._attempt(peer, payload)
+            verdict, detail = self._attempt(peer, payload, tr)
             if verdict != "ok":
                 last_detail = detail
                 continue
-            resp, who = detail
-            kind, fine = self._classify(who, resp)
+            resp, who, a0, a1 = detail
+            kind, fine = self._classify(who, resp, tr, a0, a1)
             if kind == "ok":
                 return ("ok", (fine, who))
             if kind == "shed":
@@ -729,15 +821,21 @@ class WriteRouter:
             return ("shed", shed_hint)
         return ("err", last_detail)
 
-    def _attempt(self, peer: str, payload: bytes) -> Tuple[str, Any]:
+    def _attempt(
+        self, peer: str, payload: bytes,
+        tr: Optional[rtrace.Trace] = None,
+    ) -> Tuple[str, Any]:
         """One write attempt on a worker thread; the main thread watches
         the SWIM verdict (dead -> cancel + fail over NOW, not at the
-        timeout) and the deadline. Returns ("ok", (raw, peer)) or
-        ("fail", detail)."""
+        timeout) and the deadline. Returns
+        ("ok", (raw, peer, t_send, t_recv)) or ("fail", detail)."""
+        t_entry = self.mono()
         self.metrics.count("router.write_attempts")
         self.breaker(peer).allow()  # reserve any half-open probe slot
         att = _WriteAttempt(peer)
-        att.t0 = self.mono()
+        # Window opens at _attempt entry so breaker/thread setup lands
+        # in the attempt (wire) bucket instead of escaping attribution.
+        att.t0 = t_entry
 
         def run() -> None:
             try:
@@ -754,7 +852,8 @@ class WriteRouter:
         ).start()
         deadline = att.t0 + self.timeout_s
         while not att.done.is_set():
-            if self.mono() >= deadline:
+            now = self.mono()
+            if now >= deadline:
                 break
             if (
                 self.verdict_fn is not None
@@ -766,23 +865,38 @@ class WriteRouter:
                 att.cancel.set()
                 self.metrics.count("router.write_dead_reroutes")
                 self._fail(peer, TimeoutError("owner died mid-write"))
+                if tr is not None:
+                    tr.hop("dead_reroute", now, peer=peer)
+                    tr.hop("attempt", att.t0, now, peer=peer, ok=False,
+                           err="dead mid-write")
                 return ("fail", f"{peer} dead mid-write")
             self.sleep(self.poll_s)
+        now = self.mono()
         if att.done.is_set() and att.error is None:
             self._succeed(att)
-            return ("ok", (att.result, peer))
+            if tr is not None:
+                tr.hop("attempt", att.t0, now, peer=peer, ok=True)
+            return ("ok", (att.result, peer, att.t0, now))
         att.cancel.set()
         if att.done.is_set():
             self._fail(peer, att.error or TimeoutError("write failed"))
+            if tr is not None:
+                tr.hop("attempt", att.t0, now, peer=peer, ok=False,
+                       err=str(att.error))
             return ("fail", f"{peer}: {att.error}")
         self.metrics.count("router.write_timeouts")
         self._fail(peer, TimeoutError("write deadline exceeded"))
+        if tr is not None:
+            tr.hop("attempt", att.t0, now, peer=peer, ok=False,
+                   err="timeout")
         return ("fail", f"{peer}: timeout after {self.timeout_s}s")
 
     # -- response classification ---------------------------------------------
 
     def _classify(
-        self, peer: str, raw: Optional[bytes]
+        self, peer: str, raw: Optional[bytes],
+        tr: Optional[rtrace.Trace] = None,
+        t_send: Optional[float] = None, t_recv: Optional[float] = None,
     ) -> Tuple[str, Any]:
         try:
             resp = json.loads(bytes(raw or b"").decode("utf-8"))
@@ -790,6 +904,15 @@ class WriteRouter:
             self.metrics.count("router.write_errors")
             self._fail(peer, e)
             return ("err", f"{peer}: undecodable ack: {e}")
+        echo = resp.pop("rtrace", None) if isinstance(resp, dict) else None
+        if tr is not None and isinstance(echo, dict) \
+                and t_send is not None and t_recv is not None:
+            tr.absorb_echo(echo, t_send, t_recv)
+        if tr is not None and t_recv is not None:
+            # Ack decode/verdict time rides the route bucket (mirrors
+            # the read router) so sub-ms writes keep full coverage.
+            tr.hop("route", t_recv, self.mono(), step="classify",
+                   peer=peer)
         err = resp.get("error")
         if err is not None:
             err_s = str(err)
@@ -817,6 +940,7 @@ class WriteRouter:
         k: int,
         write_id: str,
         sess: Optional[ClientSession],
+        tr: Optional[rtrace.Trace] = None,
     ) -> Dict[str, Any]:
         out = dict(resp)
         out["peer"] = peer
@@ -826,7 +950,11 @@ class WriteRouter:
             requested == ACK_REPLICATED
             and str(out.get("level")) == ACK_DURABLE
         ):
+            t_probe = self.mono()
             confirmed = self._confirm_replication(origin, seq, int(k), peer)
+            if tr is not None:
+                tr.hop("ack_probe", t_probe, self.mono(),
+                       confirmed=int(confirmed), want=int(k))
             out["replication"] = {"confirmed": confirmed, "want": int(k)}
             if confirmed >= int(k):
                 out["level"] = ACK_REPLICATED
@@ -834,9 +962,9 @@ class WriteRouter:
             else:
                 self.metrics.count("router.replication_timeouts")
         self.metrics.count("router.write_successes")
-        self.metrics.merge(
-            {"latencies": {"router.write": [max(0.0, self.mono() - t0)]}}
-        )
+        dt = max(0.0, self.mono() - t0)
+        self.metrics.merge({"latencies": {"router.write": [dt]}})
+        rtrace.commit(tr, "ok", dt * 1e3)
         # The certifier's feed: what the CLIENT was told it holds.
         obs_events.emit(
             "ingest.ack", peer=peer, origin=origin, wseq=seq,
@@ -855,21 +983,32 @@ class WriteRouter:
     ) -> int:
         """Poll the replicas themselves until k distinct members
         (counting the owner) confirm their applied watermark covers
-        ``(origin, seq)``, bounded by `replication_wait_s`."""
+        ``(origin, seq)``, bounded by `replication_wait_s`.
+
+        The peers are probed in PARALLEL (one thread each): with p
+        replicas at probe RTT t, the serial walk cost O(p·t) per ack
+        and a single slow replica stalled every probe behind it. Each
+        thread re-polls only ITS peer until it confirms; the first k
+        confirmations release the waiter immediately (`enough`), and
+        stragglers are cancelled rather than waited out."""
         if seq < 0:
             return 0
         confirmed = {owner}
+        if len(confirmed) >= k:
+            return len(confirmed)
+        lock = threading.Lock()
+        enough = threading.Event()
         probe = encode({"probe": {"origin": origin, "seq": seq}})
         deadline = self.mono() + self.replication_wait_s
         cancel = threading.Event()
-        while len(confirmed) < k and self.mono() < deadline:
-            for peer in self._peers():
-                if peer in confirmed:
-                    continue
+
+        def probe_peer(peer: str) -> None:
+            while not enough.is_set() and self.mono() < deadline:
                 if (
                     self.verdict_fn is not None
                     and self.verdict_fn(peer) == "dead"
                 ):
+                    self.sleep(self.replication_poll_s)
                     continue
                 try:
                     raw = self.write_fn(
@@ -877,19 +1016,41 @@ class WriteRouter:
                     )
                     resp = json.loads(bytes(raw).decode("utf-8"))
                 except Exception:  # noqa: BLE001 — probe failure != write failure
+                    self.sleep(self.replication_poll_s)
                     continue
                 wm = resp.get("watermarks")
                 if (
                     resp.get("covers")
-                    or (isinstance(wm, dict) and int(wm.get(origin, -1)) >= seq)
+                    or (isinstance(wm, dict)
+                        and int(wm.get(origin, -1)) >= seq)
                 ):
-                    confirmed.add(peer)
-                    self.metrics.count("router.replication_confirms")
-                if len(confirmed) >= k:
-                    break
-            if len(confirmed) < k:
+                    with lock:
+                        confirmed.add(peer)
+                        self.metrics.count("router.replication_confirms")
+                        if len(confirmed) >= k:
+                            enough.set()
+                    return
                 self.sleep(self.replication_poll_s)
-        return len(confirmed)
+
+        threads = [
+            threading.Thread(
+                target=probe_peer, args=(p,),
+                name=f"router-probe-{p}", daemon=True,
+            )
+            for p in self._peers() if p != owner
+        ]
+        for t in threads:
+            t.start()
+        while (
+            not enough.is_set()
+            and self.mono() < deadline
+            and any(t.is_alive() for t in threads)
+        ):
+            self.sleep(self.poll_s)
+        enough.set()   # release pollers still sleeping out the deadline
+        cancel.set()   # and any probe blocked in the transport
+        with lock:
+            return len(confirmed)
 
     def _finish_error(
         self,
@@ -897,13 +1058,19 @@ class WriteRouter:
         error: str,
         extra: Dict[str, Any],
         counter: Optional[str] = None,
+        tr: Optional[rtrace.Trace] = None,
     ) -> Dict[str, Any]:
         if counter:
             self.metrics.count(counter)
-        self.metrics.merge(
-            {"latencies": {"router.write": [max(0.0, self.mono() - t0)]}}
-        )
+        dt = max(0.0, self.mono() - t0)
+        self.metrics.merge({"latencies": {"router.write": [dt]}})
         obs_events.emit("router.write_give_up", error=error)
+        if tr is not None:
+            outcome = "shed" if error == "overloaded" else "failed"
+            if outcome == "failed" \
+                    and "timeout" in str(extra.get("detail", "")):
+                outcome = "deadline"
+            rtrace.commit(tr, outcome, dt * 1e3)
         out: Dict[str, Any] = {"error": error}
         out.update(extra)
         return out
@@ -920,11 +1087,16 @@ class WriteRouter:
         if self.breaker(peer).record_failure():
             self.metrics.count("router.write_breaker_opens")
 
-    def _backoff(self, round_i: int) -> None:
+    def _backoff(
+        self, round_i: int, tr: Optional[rtrace.Trace] = None
+    ) -> None:
         base = min(
             self.backoff_max_s, self.backoff_base_s * (2 ** (round_i - 1))
         )
+        a = self.mono()
         self.sleep(base * (0.5 + self._rng.random()))  # jitter in [0.5, 1.5)
+        if tr is not None:
+            tr.hop("backoff", a, self.mono(), round=round_i)
 
 
 def tcp_write_fn(

@@ -66,6 +66,7 @@ from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..obs import events as obs_events
+from ..obs import rtrace
 from ..utils import faults
 from ..utils.metrics import Metrics
 from . import kernels
@@ -106,12 +107,18 @@ def request_bytes(
     queries: List[Dict[str, Any]],
     max_staleness_s: Optional[float] = None,
     session: Optional[Dict[str, int]] = None,
+    trace: Optional[Dict[str, Any]] = None,
 ) -> bytes:
     doc: Dict[str, Any] = {"queries": list(queries)}
     if max_staleness_s is not None:
         doc["max_staleness_s"] = float(max_staleness_s)
     if session:
         doc["session"] = {str(o): int(s) for o, s in session.items()}
+    if trace:
+        # Request-scoped trace context (obs/rtrace.py): rides INSIDE the
+        # canonical doc, so every transport carries it opaquely and a
+        # legacy peer simply ignores the key.
+        doc["trace"] = dict(trace)
     return encode(doc)
 
 
@@ -124,7 +131,7 @@ def _ceil6(x: float) -> float:
 class _Pending:
     __slots__ = (
         "queries", "max_staleness", "session", "done", "results", "error",
-        "watermarks",
+        "watermarks", "t_enq", "t_drain", "t_done",
     )
 
     def __init__(
@@ -142,6 +149,12 @@ class _Pending:
         # The applied-watermark claim for THIS caller's results: the wm
         # of the oldest snapshot any of its answers came from.
         self.watermarks: Optional[Dict[str, int]] = None
+        # Stage marks on the plane's mono clock (enqueue -> drain ->
+        # done), echoed to traced requests so the client waterfall can
+        # split queue_wait from kernel time.
+        self.t_enq = 0.0
+        self.t_drain = 0.0
+        self.t_done = 0.0
 
 
 class _Batcher:
@@ -153,10 +166,12 @@ class _Batcher:
     a lone request drains itself immediately, a burst coalesces."""
 
     def __init__(self, exec_batch: Callable[[List[_Pending]], None],
-                 queue_max: int, metrics: Metrics):
+                 queue_max: int, metrics: Metrics,
+                 mono: Callable[[], float] = time.monotonic):
         self._exec = exec_batch
         self.queue_max = max(1, int(queue_max))
         self.metrics = metrics
+        self._mono = mono
         self._cv = threading.Condition()
         self._pending: List[_Pending] = []
         self._busy = False
@@ -176,6 +191,7 @@ class _Batcher:
             max_staleness: Optional[float],
             session: Optional[Dict[str, int]] = None) -> _Pending:
         p = _Pending(queries, max_staleness, session)
+        p.t_enq = self._mono()
         with self._cv:
             depth = sum(len(x.queries) for x in self._pending)
             if depth + len(queries) > self.queue_max:
@@ -192,8 +208,14 @@ class _Batcher:
                 batch, self._pending = self._pending, []
         if not p.done:
             t0 = time.perf_counter()
+            t_drain = self._mono()
+            for x in batch:
+                x.t_drain = t_drain
             try:
                 self._exec(batch)
+                t_done = self._mono()
+                for x in batch:
+                    x.t_done = t_done
                 dt = time.perf_counter() - t0
                 if dt > 0:
                     inst = sum(len(x.queries) for x in batch) / dt
@@ -252,7 +274,9 @@ class ServePlane:
             OrderedDict()
         )
         self._meta_lock = threading.Lock()
-        self._batcher = _Batcher(self._exec_batch, queue_max, self.metrics)
+        self._batcher = _Batcher(
+            self._exec_batch, queue_max, self.metrics, mono=mono
+        )
 
     # -- write side: the round thread ---------------------------------------
 
@@ -306,9 +330,28 @@ class ServePlane:
         if faults.ACTIVE:
             faults.fire("serve.query")  # injected stall/raise per surface
         t0 = time.perf_counter()
+        m_in = self.mono()
+        ctx = None  # request trace context (obs/rtrace.py), when carried
         self.metrics.count("serve.requests")
+
+        def _echo(doc: Dict[str, Any], p: Optional[_Pending] = None,
+                  **extra: Any) -> Dict[str, Any]:
+            """Attach the server-side hop timings iff the request was
+            traced — an untraced request's response stays byte-identical
+            to the pre-trace wire format (tri-surface parity)."""
+            if ctx is None:
+                return doc
+            marks = {"m_in": m_in, "m_out": self.mono()}
+            if p is not None:
+                marks.update(m_q=p.t_enq, m_drain=p.t_drain,
+                             m_done=p.t_done)
+            doc["rtrace"] = rtrace.server_echo(ctx, self.member, marks,
+                                               **extra)
+            return doc
+
         try:
             req = json.loads(bytes(raw).decode("utf-8"))
+            ctx = rtrace.server_trace(req)
             queries = req["queries"]
             if not isinstance(queries, list) or not all(
                 isinstance(q, dict) for q in queries
@@ -328,22 +371,22 @@ class ServePlane:
             p = self._batcher.run(queries, ms, sess)
         except Overloaded as e:
             self.metrics.count(f"serve.queue_shed.{surface}")
-            return encode({
+            return encode(_echo({
                 "member": self.member, "error": f"overloaded: {e}",
                 "retry_after_ms": e.retry_after_ms,
-            })
+            }))
         except SessionUncovered as e:
             # Honest refusal: serving would violate the session token.
             # The watermarks tell the router exactly how far behind we
             # are so it can route (or wait) intelligently.
             self.metrics.count("serve.session_uncovered")
-            return encode({
+            return encode(_echo({
                 "member": self.member, "error": f"session_uncovered: {e}",
                 "watermarks": e.watermarks,
-            })
+            }))
         except Exception as e:  # noqa: BLE001 — the batch never hangs a caller
             self.metrics.count("serve.errors")
-            return encode({"member": self.member, "error": str(e)})
+            return encode(_echo({"member": self.member, "error": str(e)}))
         results = p.results or []
         self.metrics.merge(
             {"latencies": {"serve.read": [time.perf_counter() - t0]}}
@@ -354,7 +397,11 @@ class ServePlane:
         }
         if p.watermarks is not None:
             doc["watermarks"] = p.watermarks
-        return encode(doc)
+        return encode(_echo(
+            doc, p,
+            kernel_ms=round(max(0.0, p.t_done - p.t_drain) * 1e3, 3),
+            queued=len(queries),
+        ))
 
     def handler_for(self, surface: str) -> Callable[[bytes], bytes]:
         """A `handle` bound to a surface label — what `install_serve`

@@ -61,7 +61,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
-from ..obs import events as obs_events
+from ..obs import events as obs_events, rtrace
 from ..utils import faults
 from ..utils.metrics import Metrics
 from .routing_common import (  # noqa: F401 — CircuitBreaker + states
@@ -290,11 +290,19 @@ class FleetRouter:
         ) or {}
         if key is None:
             key = str(queries[0].get("key", "")) if queries else ""
+        tr = rtrace.begin("read", key, t0) if rtrace.ACTIVE else None
         doc: Dict[str, Any] = {"queries": list(queries)}
         if max_staleness_s is not None:
             doc["max_staleness_s"] = float(max_staleness_s)
         if token and self.session_mode == "enforce":
             doc["session"] = token
+        if tr is not None:
+            # Only head-sampled traces ride the wire (server echo cost
+            # scales with the sample rate); the payload stays opaque to
+            # every transport, so no frame format changes.
+            w = tr.wire()
+            if w:
+                doc["trace"] = w
         payload = (
             json.dumps(doc, sort_keys=True, separators=(",", ":")) + "\n"
         ).encode("utf-8")
@@ -304,8 +312,23 @@ class FleetRouter:
         all_sheds = True  # falsified by any non-shed failure
         session_wait_deadline: Optional[float] = None
         round_i = 0
+        first_route = True
         while round_i <= self.retries:
+            # The first route hop opens at t0 so request prep (token +
+            # payload build) lands in the route bucket instead of
+            # leaking out of attribution coverage.
+            t_route = t0 if first_route else self.mono()
+            first_route = False
             order, starved = self.route(key, token)
+            if tr is not None:
+                # The route decision IS evidence: candidate order plus
+                # the breaker verdicts that shaped it (closed breakers
+                # shape nothing, so only open/half-open ride along).
+                tr.hop("route", t_route, self.mono(),
+                       candidates=list(order), starved=bool(starved),
+                       breakers={p: s for p, s
+                                 in self._board.states().items()
+                                 if s != "closed"})
             if not order:
                 if starved:
                     # Every live peer is excluded only by session
@@ -317,22 +340,25 @@ class FleetRouter:
                         self.metrics.count("router.session_waits")
                     if now < session_wait_deadline:
                         self.sleep(self.session_poll_s)
+                        if tr is not None:
+                            tr.hop("backoff", now, self.mono(),
+                                   reason="session_wait")
                         continue
                     return self._finish_error(
                         t0, "session_unsatisfiable",
                         {"gaps": self._session_gaps(token)},
-                        counter="router.session_unsatisfiable",
+                        counter="router.session_unsatisfiable", tr=tr,
                     )
                 last_err = last_err or "no eligible peers"
                 all_sheds = False
                 round_i += 1
-                self._backoff(round_i)
+                self._backoff(round_i, tr)
                 continue
-            outcome = self._run_pass(order, payload, token)
+            outcome = self._run_pass(order, payload, token, tr)
             kind, detail = outcome[0], outcome[1]
             if kind == "ok":
                 resp, peer = detail
-                return self._finish_ok(t0, resp, peer, sess, token)
+                return self._finish_ok(t0, resp, peer, sess, token, tr)
             if kind == "uncovered":
                 # Every candidate refused on session coverage (and
                 # taught us its watermarks): this is replication lag,
@@ -345,9 +371,12 @@ class FleetRouter:
                     return self._finish_error(
                         t0, "session_unsatisfiable",
                         {"gaps": self._session_gaps(token)},
-                        counter="router.session_unsatisfiable",
+                        counter="router.session_unsatisfiable", tr=tr,
                     )
                 self.sleep(self.session_poll_s)
+                if tr is not None:
+                    tr.hop("backoff", now, self.mono(),
+                           reason="session_wait")
                 continue
             if kind == "shed":
                 shed_hint = max(shed_hint or 0, int(detail or 0))
@@ -358,21 +387,22 @@ class FleetRouter:
             round_i += 1
             if round_i <= self.retries:
                 self.metrics.count("router.retries")
-                self._backoff(round_i)
+                self._backoff(round_i, tr)
         if shed_hint is not None and all_sheds:
             self.metrics.count("router.shed_returns")
             return self._finish_error(
-                t0, "overloaded", {"retry_after_ms": shed_hint}
+                t0, "overloaded", {"retry_after_ms": shed_hint}, tr=tr,
             )
         return self._finish_error(
             t0, "unavailable", {"detail": last_err},
-            counter="router.exhausted",
+            counter="router.exhausted", tr=tr,
         )
 
     # -- one pass over the candidate list ------------------------------------
 
     def _run_pass(
-        self, order: List[str], payload: bytes, token: Dict[str, int]
+        self, order: List[str], payload: bytes, token: Dict[str, int],
+        tr: Optional[rtrace.Trace] = None,
     ) -> Tuple[str, Any]:
         """Walk `order` once. Returns ("ok", (resp, peer)) on success;
         ("uncovered", detail) when EVERY outcome was a session-coverage
@@ -410,10 +440,10 @@ class FleetRouter:
                         self.metrics.count("router.failovers")
                     continue
             hedge_peer = order[idx + 1] if idx + 1 < len(order) else None
-            verdict, detail = self._attempt(peer, hedge_peer, payload)
+            verdict, detail = self._attempt(peer, hedge_peer, payload, tr)
             if verdict == "ok":
-                resp, who = detail
-                kind, fine = self._classify(who, resp, token)
+                resp, who, a0, a1 = detail
+                kind, fine = self._classify(who, resp, token, tr, a0, a1)
                 if kind == "ok":
                     return ("ok", (fine, who))
                 if kind == "shed":
@@ -432,8 +462,8 @@ class FleetRouter:
                 continue
             if verdict == "hedge_ok":
                 # The hedge (order[idx+1]) answered; classify under ITS name.
-                resp, who = detail
-                kind, fine = self._classify(who, resp, token)
+                resp, who, a0, a1 = detail
+                kind, fine = self._classify(who, resp, token, tr, a0, a1)
                 if kind == "ok":
                     return ("ok", (fine, who))
                 if kind == "shed":
@@ -462,14 +492,22 @@ class FleetRouter:
         return ("err", last_detail)
 
     def _attempt(
-        self, peer: str, hedge_peer: Optional[str], payload: bytes
+        self, peer: str, hedge_peer: Optional[str], payload: bytes,
+        tr: Optional[rtrace.Trace] = None,
     ) -> Tuple[str, Any]:
-        """One (possibly hedged) attempt. Returns ("ok", (raw, peer)),
-        ("hedge_ok", (raw, hedge_peer)), or ("fail", detail). The main
-        thread watches: completion, the peer's SWIM verdict (dead ->
-        cancel + reroute), the hedge trigger, and the deadline."""
+        """One (possibly hedged) attempt. Returns
+        ("ok", (raw, peer, t_send, t_recv)),
+        ("hedge_ok", (raw, hedge_peer, t_send, t_recv)), or
+        ("fail", detail). The main thread watches: completion, the
+        peer's SWIM verdict (dead -> cancel + reroute), the hedge
+        trigger, and the deadline."""
+        t_entry = self.mono()
         self.metrics.count("router.attempts")
         primary = self._launch(peer, payload)
+        # The attempt window opens at _attempt entry: breaker/thread
+        # launch setup is attempt cost, and the waterfall's wire bucket
+        # (attempt union minus server time) must account for it.
+        primary.t0 = t_entry
         hedge: Optional[_Attempt] = None
         deadline = primary.t0 + self.timeout_s
         hedge_at = self._hedge_at(peer, primary.t0, hedge_peer)
@@ -498,14 +536,19 @@ class FleetRouter:
                 primary_dead = True
                 primary.cancel.set()
                 self.metrics.count("router.dead_reroutes")
+                if tr is not None:
+                    tr.hop("dead_reroute", now, peer=peer)
                 if hedge is None:
                     self._fail(peer, TimeoutError("peer died mid-query"))
+                    if tr is not None:
+                        tr.hop("attempt", primary.t0, now, peer=peer,
+                               ok=False, err="dead mid-query")
                     return ("fail", f"{peer} dead mid-query")
                 # A hedge is still running — let it finish out the deadline.
                 hedge_at = None
                 deadline = min(deadline, now + self.timeout_s)
             if primary_dead and hedge is not None and hedge.done.is_set():
-                return self._settle(primary, hedge, peer, dead=True)
+                return self._settle(primary, hedge, peer, dead=True, tr=tr)
             if (
                 hedge is None
                 and hedge_at is not None
@@ -513,9 +556,12 @@ class FleetRouter:
                 and not primary.done.is_set()
             ):
                 self.metrics.count("router.hedges")
+                if tr is not None:
+                    tr.hop("hedge_launch", now, peer=hedge_peer,
+                           primary=peer)
                 hedge = self._launch(hedge_peer, payload)  # type: ignore[arg-type]
             self.sleep(self.poll_s)
-        return self._settle(primary, hedge, peer, dead=primary_dead)
+        return self._settle(primary, hedge, peer, dead=primary_dead, tr=tr)
 
     def _settle(
         self,
@@ -523,22 +569,31 @@ class FleetRouter:
         hedge: Optional[_Attempt],
         peer: str,
         dead: bool = False,
+        tr: Optional[rtrace.Trace] = None,
     ) -> Tuple[str, Any]:
         """Pick the winner, cancel the loser, bill the hedge. Every
         attempt that LAUNCHED resolves its breaker here — success,
         failure, or an explicit `release_probe` for cancelled/undone
         attempts — so a half-open probe reservation can never leak."""
+        now = self.mono()
         p_ok = primary.done.is_set() and primary.error is None
         h_ok = (
             hedge is not None and hedge.done.is_set() and hedge.error is None
         )
+
+        def _att_hop(att: _Attempt, ok: bool, **f: Any) -> None:
+            if tr is not None:
+                tr.hop("attempt", att.t0, now, peer=att.peer, ok=ok, **f)
+
         if p_ok and not dead:
             if hedge is not None:
                 hedge.cancel.set()
                 self.metrics.count("router.hedge_wasted")
                 self._abandon(hedge)
+                _att_hop(hedge, False, hedge=True, wasted=True)
             self._succeed(primary)
-            return ("ok", (primary.result, primary.peer))
+            _att_hop(primary, True)
+            return ("ok", (primary.result, primary.peer, primary.t0, now))
         if h_ok:
             primary.cancel.set()
             if p_ok:
@@ -546,28 +601,37 @@ class FleetRouter:
                 # the hedge, so give back any probe the primary held
                 # rather than billing a failure for a discarded success.
                 self.breaker(peer).release_probe()
+                _att_hop(primary, False, discarded="dead")
             else:
                 self._fail(peer, primary.error or TimeoutError(
                     "peer died mid-query" if dead else "hedged out"
                 ))
+                _att_hop(primary, False,
+                         err="dead mid-query" if dead else "hedged out")
             self.metrics.count("router.hedge_wins")
             self._succeed(hedge)  # type: ignore[arg-type]
-            return ("hedge_ok", (hedge.result, hedge.peer))  # type: ignore[union-attr]
+            _att_hop(hedge, True, hedge=True)  # type: ignore[arg-type]
+            return ("hedge_ok",  # type: ignore[union-attr]
+                    (hedge.result, hedge.peer, hedge.t0, now))
         # Nobody won: cancel stragglers, bill the failure(s).
         primary.cancel.set()
         if hedge is not None:
             hedge.cancel.set()
             self._abandon(hedge)
+            _att_hop(hedge, False, hedge=True)
         if primary.done.is_set() and primary.error is not None:
             self._fail(peer, primary.error)
+            _att_hop(primary, False, err=str(primary.error))
             return ("fail", f"{peer}: {primary.error}")
         if p_ok:
             # (dead=True) The primary answered but SWIM buried it and no
             # hedge won: discard the answer, give the probe slot back.
             self.breaker(peer).release_probe()
+            _att_hop(primary, False, discarded="dead")
             return ("fail", f"{peer} dead mid-query")
         self.metrics.count("router.timeouts")
         self._fail(peer, TimeoutError("query deadline exceeded"))
+        _att_hop(primary, False, err="timeout")
         return ("fail", f"{peer}: timeout after {self.timeout_s}s")
 
     def _abandon(self, att: _Attempt) -> None:
@@ -609,7 +673,9 @@ class FleetRouter:
     # -- response classification --------------------------------------------
 
     def _classify(
-        self, peer: str, raw: Optional[bytes], token: Dict[str, int]
+        self, peer: str, raw: Optional[bytes], token: Dict[str, int],
+        tr: Optional[rtrace.Trace] = None,
+        t_send: Optional[float] = None, t_recv: Optional[float] = None,
     ) -> Tuple[str, Any]:
         """("ok", resp_dict) | ("shed", retry_after_ms) |
         ("uncovered", detail) | ("err", detail)."""
@@ -619,7 +685,18 @@ class FleetRouter:
             self.metrics.count("router.errors")
             self._fail(peer, e)
             return ("err", f"{peer}: undecodable response: {e}")
+        echo = resp.pop("rtrace", None) if isinstance(resp, dict) else None
+        if tr is not None and isinstance(echo, dict) \
+                and t_send is not None and t_recv is not None:
+            # (attempt send, server mid, attempt recv) is an NTP
+            # exchange: absorb feeds the plane's ClockSync too.
+            tr.absorb_echo(echo, t_send, t_recv)
         self._learn_watermarks(peer, resp.get("watermarks"))
+        if tr is not None and t_recv is not None:
+            # Decode + verdict classification is routing-plane work;
+            # recording it keeps sub-ms requests' coverage honest.
+            tr.hop("route", t_recv, self.mono(), step="classify",
+                   peer=peer)
         err = resp.get("error")
         if err is not None:
             err_s = str(err)
@@ -668,11 +745,16 @@ class FleetRouter:
         p99 = xs[min(len(xs) - 1, int(0.99 * len(xs)))]
         return t0 + p99
 
-    def _backoff(self, round_i: int) -> None:
+    def _backoff(
+        self, round_i: int, tr: Optional[rtrace.Trace] = None
+    ) -> None:
         base = min(
             self.backoff_max_s, self.backoff_base_s * (2 ** (round_i - 1))
         )
+        a = self.mono()
         self.sleep(base * (0.5 + self._rng.random()))  # jitter in [0.5, 1.5)
+        if tr is not None:
+            tr.hop("backoff", a, self.mono(), round=round_i)
 
     def _session_gaps(self, token: Dict[str, int]) -> Dict[str, Any]:
         """Best-known per-origin (have, want) shortfall across peers —
@@ -697,11 +779,12 @@ class FleetRouter:
         peer: str,
         sess: Optional[ClientSession],
         token: Dict[str, int],
+        tr: Optional[rtrace.Trace] = None,
     ) -> Dict[str, Any]:
         self.metrics.count("router.successes")
-        self.metrics.merge(
-            {"latencies": {"router.read": [max(0.0, self.mono() - t0)]}}
-        )
+        dt = max(0.0, self.mono() - t0)
+        self.metrics.merge({"latencies": {"router.read": [dt]}})
+        rtrace.commit(tr, "ok", dt * 1e3)
         wm = resp.get("watermarks")
         if sess is not None and isinstance(wm, dict):
             # Flight-record the accepted read with the floor it HAD to
@@ -724,13 +807,22 @@ class FleetRouter:
         error: str,
         extra: Dict[str, Any],
         counter: Optional[str] = None,
+        tr: Optional[rtrace.Trace] = None,
     ) -> Dict[str, Any]:
         if counter:
             self.metrics.count(counter)
-        self.metrics.merge(
-            {"latencies": {"router.read": [max(0.0, self.mono() - t0)]}}
-        )
+        dt = max(0.0, self.mono() - t0)
+        self.metrics.merge({"latencies": {"router.read": [dt]}})
         obs_events.emit("router.give_up", error=error)
+        if tr is not None:
+            outcome = {
+                "overloaded": "shed",
+                "session_unsatisfiable": "uncovered",
+            }.get(error, "failed")
+            if outcome == "failed" \
+                    and "timeout" in str(extra.get("detail", "")):
+                outcome = "deadline"
+            rtrace.commit(tr, outcome, dt * 1e3)
         out: Dict[str, Any] = {"error": error}
         out.update(extra)
         return out

@@ -23,6 +23,7 @@ import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..net.transport import encode_range_frame
+from ..obs import rtrace
 from ..utils.metrics import Metrics
 from .ingest import ACK_DURABLE, WriteRouter
 from .plane import encode
@@ -173,12 +174,21 @@ class WriteSession:
         }
         if self.ack == "replicated_to_k":
             doc["k"] = self.k
+        # The trace context must ride INSIDE the CCRF frame (the plane
+        # sees only the inner doc), so the session mints it here and
+        # hands the Trace to the router for hop recording + commit.
+        tr = rtrace.begin("write", key) if rtrace.ACTIVE else None
+        if tr is not None:
+            tr.t0 = self.router.mono()
+            w = tr.wire()
+            if w:
+                doc["trace"] = w
         # The burst is ONE range frame: [lo, hi] spans the raw staged
         # ops this shipment covers — coalescing provenance on the wire.
         payload = encode_range_frame(lo, lo + raw_n - 1, encode(doc))
         out = self.router.write(
             wire_ops, key, ack=self.ack, k=self.k, session=self.session,
-            write_id=wid, payload=payload,
+            write_id=wid, payload=payload, trace=tr,
         )
         if out.get("error") is not None:
             self.metrics.count("write_session.errors")

@@ -1279,6 +1279,129 @@ def evaluate_write(
     return code, "\n".join(lines)
 
 
+def load_rtrace_rounds(
+    bench_dir: str,
+) -> List[Tuple[int, str, float, float, float, Optional[bool]]]:
+    """[(round_no, path, traced_reads_per_sec, overhead_pct,
+    coverage_p50, passed)] for every ``RTRACE_r<NN>.json`` carrier
+    committed by scripts/rtrace_demo.py. Carriers missing any of the
+    three metric keys are skipped, not zeros; ``passed`` is the
+    carrier's own chaos-check verdict (None when absent)."""
+    out: List[Tuple[int, str, float, float, float, Optional[bool]]] = []
+    for p in sorted(glob.glob(os.path.join(bench_dir, "RTRACE_r*.json"))):
+        m = re.search(r"RTRACE_r(\d+)\.json$", os.path.basename(p))
+        if not m:
+            continue
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        keys = ("traced_reads_per_sec", "overhead_pct", "coverage_p50")
+        if not all(isinstance(doc.get(k), (int, float)) for k in keys):
+            continue
+        passed = doc.get("pass")
+        out.append((
+            int(m.group(1)), p,
+            float(doc["traced_reads_per_sec"]),
+            float(doc["overhead_pct"]),
+            float(doc["coverage_p50"]),
+            bool(passed) if isinstance(passed, bool) else None,
+        ))
+    out.sort(key=lambda r: r[0])
+    return out
+
+
+def evaluate_rtrace(
+    rounds: List[Tuple[int, str, float, float, float, Optional[bool]]],
+    tolerance: float = 0.20,
+    overhead_ceiling_pct: float = 5.0,
+    coverage_floor_abs: float = 0.05,
+) -> Tuple[int, str]:
+    """(exit_code, verdict) for the request-tracing plane over the
+    RTRACE carriers — the write gate's shape, with TWO unconditional
+    claims that fire even on the very first round:
+
+    * the latest carrier's own ``pass`` verdict must be True — the demo
+      checks gap-free waterfalls, attribution coverage, the p99
+      exemplar resolving to a stored trace, and the dead_reroute hop,
+      and a carrier that failed its own checks must never gate green;
+    * ``overhead_pct`` — sampled-on throughput loss vs the carrier's
+      own ``CCRDT_RTRACE=0`` rerun — must stay under
+      `overhead_ceiling_pct` ABSOLUTE: tracing that taxes the serve
+      read path more than 5% is not an observability plane, it is a
+      perf regression wearing one's clothes;
+    * ``coverage_p50`` must not FALL more than `tolerance` relative and
+      `coverage_floor_abs` absolute under the best prior — attribution
+      silently un-explaining latency is the trace-plane analogue of a
+      counter going dark (vacuous with fewer than two rounds)."""
+    if not rounds:
+        return 0, (
+            "rtrace-gate: no RTRACE carriers — nothing to compare, "
+            "passing vacuously"
+        )
+    latest = rounds[-1]
+    latest_n, _p, _rps, latest_ov, latest_cov, latest_pass = latest
+    code = 0
+    lines: List[str] = []
+
+    if latest_pass is False:
+        code = 1
+        lines.append(
+            f"rtrace-gate: r{latest_n:02d} carries pass=false\n"
+            "FAIL: the latest rtrace drill failed its own chaos checks — "
+            "regenerate the carrier with `make rtrace-demo` and fix what "
+            "it names before gating on drift"
+        )
+    else:
+        lines.append(
+            f"rtrace-gate: r{latest_n:02d} chaos checks "
+            f"{'passed' if latest_pass else 'absent (legacy carrier)'}"
+        )
+
+    verdict = (
+        f"rtrace-gate: r{latest_n:02d} overhead_pct = {latest_ov:.2f} "
+        f"(ceiling {overhead_ceiling_pct:.1f}% absolute, vs the "
+        "carrier's own CCRDT_RTRACE=0 rerun)"
+    )
+    if latest_ov > overhead_ceiling_pct:
+        code = 1
+        lines.append(
+            f"{verdict}\nFAIL: tracing taxes the serve read path "
+            f"{latest_ov:.2f}% — over the {overhead_ceiling_pct:.1f}% "
+            "budget"
+        )
+    else:
+        lines.append(f"{verdict}\nOK: within budget")
+
+    if len(rounds) < 2:
+        lines.append(
+            f"rtrace-gate: only {len(rounds)} round(s) carry the rtrace "
+            "metrics — no drift to compare, passing vacuously"
+        )
+        return code, "\n".join(lines)
+
+    best_cov_n, best_cov = best_prior_carrier(rounds, 4, "max")
+    cov_floor = min(
+        best_cov * (1.0 - tolerance), best_cov - coverage_floor_abs
+    )
+    verdict = (
+        f"rtrace-gate: r{latest_n:02d} coverage_p50 = {latest_cov:.4f} "
+        f"vs best prior r{best_cov_n:02d} = {best_cov:.4f} (floor "
+        f"-{tolerance:.0%} and -{coverage_floor_abs:.2f}: {cov_floor:.4f})"
+    )
+    if latest_cov < cov_floor:
+        code = 1
+        lines.append(
+            f"{verdict}\nFAIL: attribution coverage lost "
+            f"{best_cov - latest_cov:.4f} — hop instrumentation is "
+            "going dark somewhere on the request path"
+        )
+    else:
+        lines.append(f"{verdict}\nOK: within tolerance")
+    return code, "\n".join(lines)
+
+
 def attribution_drift(
     rounds: List[Tuple[int, str, float, float]]
 ) -> List[str]:
@@ -1380,6 +1503,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"{wps:,.2f} acked bursts/s, p99 {p99:,.0f}ms, "
             f"failover blip {blip:,.0f}ms"
         )
+    rtrc = load_rtrace_rounds(args.bench_dir)
+    for n, p, rps, ov, cov, passed in rtrc:
+        tag = "pass" if passed else ("FAIL" if passed is False else "?")
+        print(
+            f"  rtrace r{n:02d} {os.path.basename(p)} [{tag}]: "
+            f"{rps:,.0f} traced reads/s, overhead {ov:.2f}%, "
+            f"coverage p50 {cov:.1%}"
+        )
     pgr = load_pager_rounds(args.bench_dir)
     for n, p, hit, miss, cm in pgr:
         cm_note = f", {cm:,.0f} cold merges/s" if cm is not None else ""
@@ -1421,8 +1552,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     print(router_verdict)
     write_code, write_verdict = evaluate_write(wtr, args.tolerance)
     print(write_verdict)
+    rtrace_code, rtrace_verdict = evaluate_rtrace(rtrc, args.tolerance)
+    print(rtrace_verdict)
     return max(code, gap_code, ing_code, part_code, serve_code, audit_code,
-               wal_code, mesh_code, pager_code, router_code, write_code)
+               wal_code, mesh_code, pager_code, router_code, write_code,
+               rtrace_code)
 
 
 if __name__ == "__main__":

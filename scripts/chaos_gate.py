@@ -628,7 +628,52 @@ def main() -> int:
           f"via {int(i_counters.get('ingest.coalesced_frames', 0))} "
           f"coalesced frames ({int(i_counters.get('ingest.coalesced_ops', 0))} "
           f"windows), {int(i_counters.get('overlap.dropped_deltas', 0))} "
-          "shed deltas healed")
+          f"shed deltas healed")
+
+    # -- leg 11: the request-tracing plane (obs/rtrace.py) -----------------
+    from test_rtrace import run_rtrace_chaos
+    from antidote_ccrdt_tpu.obs import rtrace as obs_rtrace
+
+    rt = run_rtrace_chaos(seed=7)
+    obs_rtrace.uninstall()
+    rc = rt["counters"]
+    print("== rtrace chaos drill (seed=7, serve stalls + flaky peer + "
+          "rtrace.record fault, 50% head sampling) ==")
+    print("  " + " ".join(
+        f"rtrace.{k}={int(rc.get(k, 0))}"
+        for k in ("minted", "sampled", "committed", "forced", "degraded")
+    ) + f" complete={rt['n_complete']}/{rt['n_sampled_ok']}"
+        f" coverage_p50={rt['coverage_p50']}")
+    rt_zeroed = sorted(
+        k for k in ("minted", "sampled", "committed", "forced", "degraded")
+        if not rc.get(k, 0)
+    )
+    if rt_zeroed:
+        print("FAIL: rtrace counters regressed to zero (the tracing "
+              f"plane went dark under chaos): {rt_zeroed}")
+        return 1
+    if rt["complete_frac"] < 0.99:
+        print(f"FAIL: only {rt['n_complete']}/{rt['n_sampled_ok']} sampled "
+              "completed requests reconstruct gap-free waterfalls "
+              f"({rt['complete_frac']:.1%} < 99%) — hops are being "
+              "orphaned or evicted")
+        return 1
+    if rt["n_forced_traces"] != rt["n_forced_reqs"]:
+        print(f"FAIL: {rt['n_forced_reqs']} shed/failed requests but only "
+              f"{rt['n_forced_traces']} forced traces stored — failures "
+              "must be traced at 100% regardless of head sampling")
+        return 1
+    if rt["coverage_p50"] < 0.9:
+        print("FAIL: median attribution coverage "
+              f"{rt['coverage_p50']:.1%} < 90% — client-observed latency "
+              "is leaking out of the route/wire/queue/kernel buckets")
+        return 1
+    print(f"OK: rtrace leg — {rt['n_complete']}/{rt['n_sampled_ok']} "
+          "sampled completions reconstruct gap-free waterfalls, "
+          f"{rt['n_forced_traces']}/{rt['n_forced_reqs']} failures force-"
+          f"traced, attribution coverage p50 {rt['coverage_p50']:.1%}, "
+          f"{int(rc.get('degraded', 0))} degraded trace(s) never failed "
+          "a request")
     return 0
 
 

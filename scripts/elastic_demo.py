@@ -466,6 +466,14 @@ def run_worker(store, drill, dense, state, args, result_dir):
         obs_spans.set_metrics(store.metrics)
     else:
         obs_spans.install_from_env(args.member, store.metrics)
+    # Request-trace plane (CCRDT_RTRACE, PR 18): per-request hop records
+    # + server echoes on the serve/ingest planes below. Armed here so a
+    # worker that ALSO acts as a client (drills running in-process
+    # routers) mints traces, and so health/scrape surfaces export the
+    # rtrace counters.
+    from antidote_ccrdt_tpu.obs import rtrace as obs_rtrace
+
+    obs_rtrace.install_from_env(args.member, metrics=store.metrics)
     lag_tracker = LagTracker(args.member)
     confident_stale = max(1.5 * args.timeout, 0.6)
     # Divergence watchdog (obs/audit.py): always armed — with no
@@ -578,6 +586,7 @@ def run_worker(store, drill, dense, state, args, result_dir):
             doc.update(plane.health_fields())
         if iplane is not None:
             doc.update(iplane.health_fields())
+        doc.update(obs_rtrace.health_fields())
         return doc
 
     obs_http.install_from_env(
@@ -784,6 +793,13 @@ def run_worker(store, drill, dense, state, args, result_dir):
                 if k.startswith("mesh.")
             },
             "audit": watchdog.status_fields(),
+            # rtrace plane counters (dashboard tail column): the live
+            # plane mirrors them into metrics as rtrace.* on every bump.
+            "rtrace": {
+                k[len("rtrace."):]: v
+                for k, v in counters.items()
+                if k.startswith("rtrace.")
+            },
         }
         path = os.path.join(result_dir, f"obs-{args.member}.json")
         tmp = f"{path}.tmp-{os.getpid()}"
@@ -1181,6 +1197,22 @@ def run_worker(store, drill, dense, state, args, result_dir):
     with open(os.path.join(result_dir, f"final-{args.member}.json"), "w") as f:
         json.dump(out, f)
     print(json.dumps(out), flush=True)
+
+    # Env-gated post-drill serve linger: keep the process (and its
+    # daemon serve plane) alive after the final barrier so a supervisor
+    # can measure the serve path against a QUIESCED worker — no
+    # stepping, no per-step JIT recompiles, no gossip churn. The
+    # supervisor ends the linger early by dropping <root>/serve-stop;
+    # the deadline bounds it if the supervisor dies first.
+    try:
+        linger_s = float(os.environ.get("CCRDT_SERVE_LINGER_S", "0") or 0.0)
+    except ValueError:
+        linger_s = 0.0
+    if linger_s > 0:
+        stop_f = os.path.join(result_dir, "serve-stop")
+        deadline = time.time() + linger_s
+        while time.time() < deadline and not os.path.exists(stop_f):
+            time.sleep(0.1)
 
 
 if __name__ == "__main__":

@@ -272,6 +272,27 @@ def _fmt_router(status: Optional[Dict[str, Any]], member: str) -> str:
     )
 
 
+def _fmt_rtrace(status: Optional[Dict[str, Any]]) -> str:
+    """Request-tracing column group (obs/rtrace.py): traces minted /
+    committed this process, forced commits (shed / failed / deadline —
+    always stored regardless of sampling), and degraded traces (the
+    ``rtrace.record`` fault point fired — tracing dropped out, the
+    request itself survived). "-" means the plane is dark (CCRDT_RTRACE
+    unset/0) or this process routes nothing."""
+    rt = (status or {}).get("rtrace") or {}
+    if not rt:
+        return "-"
+    out = (
+        f"mint {int(rt.get('minted', 0))} "
+        f"com {int(rt.get('committed', 0))} "
+        f"fc {int(rt.get('forced', 0))}"
+    )
+    deg = int(rt.get("degraded", 0))
+    if deg:
+        out += f" DEG {deg}"
+    return out
+
+
 def render_frame(root: str, clear: bool = True) -> str:
     rows = scrape_root(root)
     lines = []
@@ -282,7 +303,7 @@ def render_frame(root: str, clear: bool = True) -> str:
         f"{'member':<10}{'zone':<6}{'hb-age':>8} {'state':<9}{'snap':>5} "
         f"{'delta-window':<14}{'wal m:last/dur':>14}  {'sendq':<16}"
         f"{'lag (peer:ops/secs)':<26}  {'serving':<34}  "
-        f"{'pager':<18}  {'audit':<32}  {'router'}"
+        f"{'pager':<18}  {'audit':<32}  {'router':<42}  {'rtrace'}"
     )
     lines.append(hdr)
     lines.append("-" * len(hdr))
@@ -318,7 +339,8 @@ def render_frame(root: str, clear: bool = True) -> str:
             f"{window:<14}{_fmt_wal(st):>14}  "
             f"{_fmt_sendq(st):<16}{_fmt_lag(st):<26}  "
             f"{_fmt_serve(st, m):<34}  {_fmt_pager(st):<18}  "
-            f"{_fmt_audit(st):<32}  {_fmt_router(st, m)}"
+            f"{_fmt_audit(st):<32}  {_fmt_router(st, m):<42}  "
+            f"{_fmt_rtrace(st)}"
         )
     return "\n".join(lines)
 

@@ -217,9 +217,10 @@ def main() -> int:
     from antidote_ccrdt_tpu.utils import faults
     from antidote_ccrdt_tpu.utils.metrics import Metrics
 
-    # The session storm emits ~2 flight events per query; the default
-    # 4096 ring would evict the early writes the certifier replays.
-    obs_events.reset("router", ring=1 << 16)
+    # Session events are request-plane (per-kind rings in obs/events.py)
+    # so the query storm can no longer evict the early session.write
+    # evidence the certifier replays — a default recorder suffices.
+    obs_events.reset("router")
 
     failures = []
     victim = rendezvous_order("k0", MEMBERS)[0]

@@ -300,10 +300,11 @@ def main() -> int:  # noqa: PLR0915 — one linear acceptance drill
     from antidote_ccrdt_tpu.utils import faults
     from antidote_ccrdt_tpu.utils.metrics import Metrics
 
-    # The write storm emits ~3 flight events per burst (ack + session
-    # teach + read route); the default 4096 ring would evict the early
-    # acks the durability certifier replays.
-    obs_events.reset("writer", ring=1 << 16)
+    # Ack/session/fold events are request-plane (per-kind rings in
+    # obs/events.py) so the write storm can no longer evict the early
+    # acks the durability certifier replays — a default recorder
+    # suffices.
+    obs_events.reset("writer")
 
     failures = []
     victim = rendezvous_order("k0", MEMBERS)[0]

@@ -1,0 +1,596 @@
+"""Tests for the request-scoped tracing plane (obs/rtrace.py, PR 18):
+core mint/sample/commit semantics, tri-surface propagation parity (the
+trace context and its echo ride the canonical JSON doc byte-identically
+over tcp / sim / bridge / HTTP; legacy peers without the field still
+interop; the ``rtrace.record`` fault point degrades a trace to untraced
+without ever failing the request), end-to-end read/write waterfalls
+with attribution coverage, forced commits for shed/failed outcomes,
+OpenMetrics exemplars resolving to stored traces, request-flood
+eviction isolation in the flight recorder, and the seeded
+`run_rtrace_chaos` drill scripts/chaos_gate.py re-runs as leg 11."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from antidote_ccrdt_tpu import serve
+from antidote_ccrdt_tpu.bridge.client import BridgeClient
+from antidote_ccrdt_tpu.bridge.server import BridgeServer
+from antidote_ccrdt_tpu.net.sim import SimNet
+from antidote_ccrdt_tpu.net.tcp import TcpTransport, query_peer
+from antidote_ccrdt_tpu.obs import events as obs_events
+from antidote_ccrdt_tpu.obs import export as obs_export
+from antidote_ccrdt_tpu.obs import http as obs_http
+from antidote_ccrdt_tpu.obs import rtrace
+from antidote_ccrdt_tpu.serve import FleetRouter
+from antidote_ccrdt_tpu.serve.ingest import (
+    ACK_DURABLE,
+    ACK_REPLICATED,
+    IngestPlane,
+    WriteRouter,
+)
+from antidote_ccrdt_tpu.utils import faults
+from antidote_ccrdt_tpu.utils.metrics import Metrics
+
+from tests.test_serve import R, _apply, _engine
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes():
+    faults.uninstall()
+    rtrace.uninstall()
+    yield
+    faults.uninstall()
+    rtrace.uninstall()
+
+
+# -- fixtures ----------------------------------------------------------------
+
+
+def _live_plane(member="w0", metrics=None, **kw):
+    dense = _engine()
+    plane = serve.ServePlane(
+        dense, member=member, metrics=metrics or Metrics(), **kw
+    )
+    state = _apply(dense, dense.init(R, 1), [1, 2, 3], [50, 40, 30])
+    plane.swap(state, 4)
+    return plane
+
+
+def _traced_frozen_plane(metrics=None):
+    """Like test_serve_parity's frozen plane, but the clock must freeze
+    BEFORE construction: the batcher binds `mono` at init, and the echo
+    stage marks it stamps must be identical across surface calls."""
+    t = time.monotonic()
+    dense = _engine()
+    plane = serve.ServePlane(
+        dense, member="w0", metrics=metrics or Metrics(), mono=lambda: t
+    )
+    state = _apply(dense, dense.init(R, 1), [1, 2, 3], [50, 40, 30])
+    plane.swap(state, 4)
+    return plane
+
+
+class _DrainLoop:
+    def __init__(self, plane, period_s=0.002):
+        self.plane = plane
+        self.applied = []
+        self.seq = 0
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            self.seq += 1
+            self.plane.drain(self.seq, self.applied.extend)
+            time.sleep(0.002)
+
+    def stop(self):
+        self._stop.set()
+        self._t.join(2.0)
+
+
+def _ingest_plane(member="w0", **kw):
+    kw.setdefault("durable_fn", lambda: 10**9)
+    kw.setdefault("ack_timeout_s", 2.0)
+    kw.setdefault("poll_s", 0.001)
+    return IngestPlane(member, **kw)
+
+
+def _router(peers, query_fn, **kw):
+    kw.setdefault("metrics", Metrics())
+    kw.setdefault("hedge", False)
+    kw.setdefault("retries", 1)
+    kw.setdefault("timeout_s", 2.0)
+    kw.setdefault("backoff_base_s", 0.001)
+    kw.setdefault("poll_s", 0.001)
+    return FleetRouter(peers, query_fn, **kw)
+
+
+def _wrouter(peers, write_fn, **kw):
+    kw.setdefault("retries", 1)
+    kw.setdefault("backoff_base_s", 0.0)
+    kw.setdefault("backoff_max_s", 0.0)
+    kw.setdefault("poll_s", 0.001)
+    return WriteRouter(peers, write_fn, **kw)
+
+
+OPS = [["add", [1, 5, [0, 1000001]]]]
+
+
+# -- core plane semantics ----------------------------------------------------
+
+
+def test_dark_plane_mints_nothing():
+    assert rtrace.begin("read", "k0") is None
+    assert rtrace.ACTIVE is False
+    assert rtrace.counters() == {}
+    assert rtrace.traces() == []
+
+
+def test_kill_switch_wins_over_explicit_install():
+    assert rtrace.install("w0", env={"CCRDT_RTRACE": "0"}) is None
+    assert not rtrace.installed()
+    assert rtrace.install_from_env("w0", env={"CCRDT_RTRACE": "0"}) is False
+    assert rtrace.install_from_env("w0", env={}) is False
+    assert rtrace.install_from_env(
+        "w0", env={"CCRDT_RTRACE": "1", "CCRDT_RTRACE_SAMPLE": "0.25"}
+    ) is True
+    assert rtrace._PLANE.sample == 0.25
+
+
+def test_sampling_is_deterministic_in_the_trace_id():
+    rtrace.install("w0", sample=0.5)
+    a = [rtrace.begin("read", f"k{i}") for i in range(200)]
+    rtrace.install("w0", sample=0.5)  # same member+pid -> same ids
+    b = [rtrace.begin("read", f"k{i}") for i in range(200)]
+    assert [t.sampled for t in a] == [t.sampled for t in b]
+    assert 0 < sum(t.sampled for t in a) < 200
+
+
+def test_commit_ring_slow_ring_and_forced_outcomes():
+    rtrace.install("w0", sample=0.0, slow=4)
+    # Unsampled ok traces survive only through the slow ring: the 4
+    # slowest of these 10 must be the ones kept.
+    for i in range(10):
+        tr = rtrace.begin("read", f"k{i}")
+        tr.hop("route", 0.0, 0.001, candidates=["w0"])
+        assert tr.wire() is None  # unsampled: servers asked to do nothing
+        rtrace.commit(tr, "ok", float(i))
+    slow = rtrace.slowest(10)
+    assert [t["ms"] for t in slow] == [9.0, 8.0, 7.0, 6.0]
+    assert rtrace.traces() == []  # main ring: nothing sampled or forced
+    # A shed outcome commits regardless of sampling.
+    tr = rtrace.begin("read", "k-shed")
+    tr.hop("route", 0.0, 0.001, candidates=[])
+    rtrace.commit(tr, "shed", 0.5)
+    kept = rtrace.traces()
+    assert [t["outcome"] for t in kept] == ["shed"]
+    c = rtrace.counters()
+    assert c["minted"] == 11 and c["forced"] == 1
+    assert c["committed"] == 11 and c.get("skipped", 0) == 0
+    # ...and the flight recorder saw one rtrace.trace event per commit.
+    assert len(obs_events.events("rtrace.trace")) >= 11
+
+
+def test_record_fault_degrades_trace_not_caller():
+    rtrace.install("w0", sample=1.0)
+    faults.install({"rtrace.record": [{"action": "raise", "at": [1]}]},
+                   seed=7)
+    tr = rtrace.begin("read", "k0")
+    tr.hop("route", 0.0, 0.001)      # fires ok
+    tr.hop("attempt", 0.001, 0.002)  # injected raise -> degrade
+    assert tr.dead is True
+    tr.hop("attempt", 0.002, 0.003)  # silently ignored
+    assert tr.wire() is None
+    assert rtrace.commit(tr, "ok", 1.0) is False
+    assert rtrace.counters()["degraded"] == 1
+    assert rtrace.traces() == []
+
+
+# -- tri-surface propagation parity (satellite) ------------------------------
+
+
+TRACED_CTX = {"id": "t-parity-1", "hs": 3}
+QS = [{"op": "value", "key": 0}, {"op": "topk", "key": 0, "k": 2}]
+REQ_PLAIN = serve.request_bytes(QS, max_staleness_s=60.0)
+REQ_TRACED = serve.request_bytes(QS, max_staleness_s=60.0, trace=TRACED_CTX)
+
+
+def _post(addr, payload, timeout=5.0):
+    return urllib.request.urlopen(
+        urllib.request.Request(
+            f"http://{addr[0]}:{addr[1]}/query", data=payload, method="POST"
+        ),
+        timeout=timeout,
+    )
+
+
+def test_traced_request_byte_identical_over_all_four_surfaces():
+    plane = _traced_frozen_plane()
+    want = plane.handle(REQ_TRACED)
+    echo = json.loads(want.decode())["rtrace"]
+    assert echo["id"] == "t-parity-1" and echo["peer"] == "w0"
+    assert {"m_in", "m_out", "m_q", "m_drain", "m_done"} <= set(echo)
+
+    t = TcpTransport("w0")
+    t.install_serve(plane)
+    try:
+        member, tcp_resp = query_peer(t.address, REQ_TRACED, timeout=5.0)
+        assert member == "w0"
+    finally:
+        t.close()
+
+    with obs_http.MetricsHttpServer(
+        plane.metrics, "w0", query_handler=plane.handle
+    ) as srv:
+        with _post(srv.address, REQ_TRACED) as r:
+            assert r.status == 200
+            http_resp = r.read()
+
+    bs = BridgeServer(port=0).start()
+    bs.install_serve(plane)
+    try:
+        cl = BridgeClient("127.0.0.1", bs.address[1])
+        bridge_resp = cl.query(REQ_TRACED)
+        cl.close()
+    finally:
+        bs.close()
+
+    net = SimNet(seed=3)
+    a, b = net.join("a"), net.join("b")
+    b.install_serve(plane)
+    a.query("b", REQ_TRACED)
+    net.advance(1.0)
+
+    assert tcp_resp == want
+    assert http_resp == want
+    assert bridge_resp == want
+    assert a.query_resps == [("b", want)]
+
+
+def test_untraced_request_stays_byte_identical_to_legacy_wire_format():
+    plane = _traced_frozen_plane()
+    plain = json.loads(plane.handle(REQ_PLAIN).decode())
+    assert "rtrace" not in plain
+    traced = json.loads(plane.handle(REQ_TRACED).decode())
+    # The echo is the ONLY delta a trace context introduces.
+    traced.pop("rtrace")
+    assert traced == plain
+
+
+def test_kill_switch_suppresses_the_echo(monkeypatch):
+    plane = _traced_frozen_plane()
+    monkeypatch.setenv("CCRDT_RTRACE", "0")
+    assert plane.handle(REQ_TRACED) == plane.handle(REQ_PLAIN)
+
+
+def test_legacy_peer_without_echo_still_interops():
+    """An armed client routing at a pre-trace peer: the query succeeds
+    and the trace commits; only waterfall completeness honestly degrades
+    (no server echo to attach)."""
+    rtrace.install("client", sample=1.0)
+
+    def qfn(peer, payload, timeout_s, cancel):
+        doc = json.loads(payload.decode())
+        assert "trace" in doc  # context rode the wire...
+        return (json.dumps({   # ...but the legacy peer ignores it
+            "member": peer, "n": 1, "watermarks": {peer: 9},
+            "results": [{"value": [], "as_of_seq": 9,
+                         "staleness_bound_s": 0.0}],
+        }) + "\n").encode()
+
+    r = _router(["w0"], qfn)
+    out = r.query([{"op": "value", "key": 0}], key="k0")
+    assert out.get("error") is None and out["peer"] == "w0"
+    (tr,) = rtrace.traces("read")
+    assert tr["outcome"] == "ok" and tr["server"] == []
+    ok, why = rtrace.complete(tr)
+    assert ok is False and "no server echo" in why
+
+
+def test_record_fault_never_fails_the_routed_query():
+    rtrace.install("client", sample=1.0)
+    plane = _live_plane("w0")
+    faults.install({"rtrace.record": [{"action": "raise", "at": [0]}]},
+                   seed=7)
+    r = _router(["w0"],
+                lambda p, payload, t, c: plane.handle(payload))
+    out = r.query([{"op": "value", "key": 0}], key="k0")
+    assert out.get("error") is None
+    assert out["results"][0]["value"]
+    assert rtrace.counters()["degraded"] == 1
+    assert rtrace.traces() == []  # degraded to untraced, noted, no commit
+
+
+# -- end-to-end waterfalls ---------------------------------------------------
+
+
+def test_read_trace_end_to_end_waterfall_and_attribution():
+    rtrace.install("client", sample=1.0)
+    plane = _live_plane("w0")
+    r = _router(["w0"],
+                lambda p, payload, t, c: plane.handle(payload))
+    out = r.query(QS, key="k0", max_staleness_s=60.0)
+    assert out.get("error") is None
+    (tr,) = rtrace.traces("read")
+    ok, why = rtrace.complete(tr)
+    assert ok, why
+    kinds = [h["k"] for h in tr["hops"]]
+    assert kinds[0] == "route" and "attempt" in kinds
+    (echo,) = tr["server"]
+    assert echo["peer"] == "w0" and echo["m_drain"] >= echo["m_q"]
+    attr = rtrace.attribute(tr)
+    assert attr["total"] == tr["ms"] > 0
+    assert attr["coverage"] > 0.5
+    known = sum(attr[b] for b in rtrace.BUCKETS if b != "hedge_overlap")
+    assert known == pytest.approx(attr["coverage"] * attr["total"])
+    rows = rtrace.waterfall(tr)
+    names = {row["name"] for row in rows}
+    assert {"route", "attempt", "server", "queue_wait", "kernel"} <= names
+    # Server rows were mapped onto the client axis: they sit inside the
+    # request window, not at raw monotonic offsets.
+    for row in rows:
+        assert -50.0 <= row["t0_ms"] <= tr["ms"] + 50.0
+    rep = rtrace.attribution_report([tr])
+    assert rep["n"] == 1 and rep["p99_trace_id"] == tr["id"]
+    assert rep["p99_dominant_bucket"] in rtrace.BUCKETS
+    assert "rtrace attribution" in rtrace.format_report(rep)
+
+
+def test_read_exemplar_links_p99_to_a_stored_trace():
+    rtrace.install("client", sample=1.0)
+    plane = _live_plane("w0")
+    metrics = Metrics()
+    r = _router(["w0"],
+                lambda p, payload, t, c: plane.handle(payload),
+                metrics=metrics)
+    for _ in range(3):
+        assert r.query(QS, key="k0").get("error") is None
+    fam = rtrace.exemplars()["router.read"]
+    assert rtrace.find(fam[0]) is not None  # resolves to a stored trace
+    text = obs_export.prometheus_text(metrics)
+    assert f'# {{trace_id="{fam[0]}"}}' in text
+    # Dark plane -> byte-identical pre-exemplar output.
+    rtrace.uninstall()
+    assert "trace_id" not in obs_export.prometheus_text(metrics)
+
+
+def test_failed_and_shed_reads_are_always_traced():
+    rtrace.install("client", sample=0.0)  # head sampling fully off
+
+    def down(peer, payload, timeout_s, cancel):
+        raise ConnectionError("injected outage")
+
+    r = _router(["w0", "w1"], down, retries=1)
+    out = r.query(QS, key="k0")
+    assert out["error"] == "unavailable"
+    # queue_max=1 with a 2-query batch: deterministic shed.
+    shed_plane = _live_plane("w0", queue_max=1)
+    rs = _router(["w0"],
+                 lambda p, payload, t, c: shed_plane.handle(payload),
+                 retries=0)
+    out = rs.query(QS, key="k0")
+    assert out["error"] == "overloaded"
+    got = sorted(t["outcome"] for t in rtrace.traces("read"))
+    assert got == ["failed", "shed"]
+    c = rtrace.counters()
+    assert c["forced"] == 2 and c.get("sampled", 0) == 0
+    for tr in rtrace.traces("read"):
+        ok, why = rtrace.complete(tr)
+        assert ok, why  # failure traces need no server cooperation
+
+
+def test_write_trace_end_to_end_with_ingest_echo():
+    rtrace.install("client", sample=1.0)
+    p = _ingest_plane("w0")
+    loop = _DrainLoop(p)
+    try:
+        r = _wrouter(
+            ["w0"],
+            lambda peer, payload, t, c: p.handle(payload, surface="test"),
+        )
+        out = r.write(OPS, key="k0", ack=ACK_DURABLE, write_id="c:1")
+    finally:
+        loop.stop()
+    assert out.get("write_ack") and out["peer"] == "w0"
+    (tr,) = rtrace.traces("write")
+    ok, why = rtrace.complete(tr)
+    assert ok, why
+    (echo,) = tr["server"]
+    assert {"m_in", "m_out", "m_stage", "m_fold"} <= set(echo)
+    assert "durable_wait_ms" in echo
+    attr = rtrace.attribute(tr)
+    assert attr["coverage"] > 0.5
+    assert p.metrics.snapshot()["latencies"]["ingest.ack_ms.durable"]
+
+
+def test_replicated_ack_probe_rides_the_waterfall():
+    rtrace.install("client", sample=1.0)
+    p = _ingest_plane("w0")
+    loop = _DrainLoop(p)
+    probes = []
+
+    def wfn(peer, payload, timeout_s, cancel):
+        doc, _ = p._decode(payload)
+        if doc.get("probe"):
+            probes.append(peer)
+            return (json.dumps({
+                "member": peer, "covers": True,
+            }) + "\n").encode()
+        return p.handle(payload, surface="test")
+
+    try:
+        r = _wrouter(["w0", "w1"], wfn, replication_wait_s=0.2,
+                     replication_poll_s=0.005)
+        out = r.write(OPS, key="k0", ack=ACK_REPLICATED, k=2,
+                      write_id="c:2")
+    finally:
+        loop.stop()
+    assert out.get("write_ack"), out
+    (tr,) = rtrace.traces("write")
+    probe_hops = [h for h in tr["hops"] if h["k"] == "ack_probe"]
+    assert probe_hops and probe_hops[0]["want"] == 2
+    assert probes  # the peers really were probed
+    attr = rtrace.attribute(tr)
+    assert attr["ack_probe"] > 0.0
+
+
+def test_parallel_replication_probes_confirm_k_from_slow_peers():
+    """Satellite regression: with k-1 peers each ~60ms from confirming,
+    the parallel probe fan-out confirms inside ~one peer's wait; the old
+    sequential walk would need the sum and blow the window."""
+    rtrace.install("client", sample=1.0)
+    p = _ingest_plane("w0")
+    loop = _DrainLoop(p)
+    t0 = time.monotonic()
+
+    def wfn(peer, payload, timeout_s, cancel):
+        doc, _ = p._decode(payload)
+        if doc.get("probe"):
+            return (json.dumps({
+                "member": peer,
+                "covers": time.monotonic() - t0 > 0.06,
+            }) + "\n").encode()
+        return p.handle(payload, surface="test")
+
+    try:
+        r = _wrouter(["w0", "w1", "w2", "w3"], wfn,
+                     replication_wait_s=0.15, replication_poll_s=0.005)
+        out = r.write(OPS, key="k0", ack=ACK_REPLICATED, k=4,
+                      write_id="c:3")
+    finally:
+        loop.stop()
+    assert out.get("write_ack"), out
+    rep = out.get("replication") or {}
+    assert rep.get("confirmed", 0) >= 4, out
+    assert out["level"] == ACK_REPLICATED
+
+
+# -- request-flood eviction isolation (satellite) ----------------------------
+
+
+def test_request_flood_cannot_evict_audit_evidence():
+    obs_events.reset("iso", ring=64, req_ring=128)
+    try:
+        obs_events.emit("ingest.fold", write_id="c:1", origin="iso", wseq=1)
+        obs_events.emit("ingest.ack", origin="iso", wseq=1,
+                        level="durable", write_id="c:1")
+        obs_events.emit("delta.apply", origin="peer", dseq=4)
+        for i in range(4096):
+            obs_events.emit("serve.query", n=1)
+        for i in range(4096):
+            obs_events.emit("rtrace.trace", id=f"t{i}", outcome="ok")
+        # Every per-kind ring is bounded...
+        assert len(obs_events.events("serve.query")) == 128
+        assert len(obs_events.events("rtrace.trace")) == 128
+        # ...and the flood evicted NOTHING outside its own kind: the
+        # certifiers' audit evidence and the control-plane ring survive.
+        assert [e["write_id"] for e in obs_events.events("ingest.fold")] \
+            == ["c:1"]
+        assert [e["level"] for e in obs_events.events("ingest.ack")] \
+            == ["durable"]
+        assert [e["kind"] for e in obs_events.recorder().ring
+                if e["kind"] == "delta.apply"] == ["delta.apply"]
+        # The merged view stays totally ordered on the shared seq axis.
+        merged = obs_events.events()
+        assert [e["seq"] for e in merged] == sorted(e["seq"] for e in merged)
+    finally:
+        obs_events.reset("?")
+
+
+# -- seeded chaos drill (chaos_gate leg) -------------------------------------
+
+
+def run_rtrace_chaos(seed=7, n=80):
+    """Seeded rtrace chaos drill, shared by the test below and
+    scripts/chaos_gate.py leg 11: a 3-peer read fleet under injected
+    serve stalls + a flaky peer + rtrace.record degradation, then
+    all-down and shed arms. Returns counters + waterfall completeness +
+    forced-trace coverage for the gate to assert on."""
+    import random
+
+    faults.uninstall()
+    rtrace.uninstall()
+    obs_events.reset("rtrace-chaos")
+    rtrace.install("rtrace-chaos", sample=0.5)
+    rng = random.Random(seed)
+    peers = ["w0", "w1", "w2"]
+    planes = {m: _live_plane(m) for m in peers}
+    faults.install({
+        "serve.query": [{"action": "delay", "rate": 0.05,
+                         "delay_s": 0.001}],
+        "rtrace.record": [{"action": "raise", "at": [40]}],
+    }, seed=seed)
+
+    def qfn(peer, payload, timeout_s, cancel):
+        if peer == "w1" and rng.random() < 0.3:
+            raise ConnectionError("injected flake")
+        return planes[peer].handle(payload)
+
+    r = _router(peers, qfn, retries=2, seed=seed)
+    n_ok = n_err = 0
+    for i in range(n):
+        out = r.query([{"op": "value", "key": 0}], key=f"k{i % 16}",
+                      max_staleness_s=60.0)
+        if out.get("error") is None:
+            n_ok += 1
+        else:
+            n_err += 1
+    faults.uninstall()
+
+    # Failure arms: every shed/failed request must commit a trace even
+    # with head sampling at 50%.
+    def down(peer, payload, timeout_s, cancel):
+        raise ConnectionError("injected outage")
+
+    n_forced_reqs = 0
+    rf = _router(peers, down, retries=0)
+    for i in range(6):
+        assert rf.query([{"op": "value", "key": 0}], key=f"f{i}")["error"] \
+            == "unavailable"
+        n_forced_reqs += 1
+    shed_plane = _live_plane("w0", queue_max=1)
+    rs = _router(["w0"],
+                 lambda p, payload, t, c: shed_plane.handle(payload),
+                 retries=0)
+    for i in range(6):
+        assert rs.query(QS, key=f"s{i}")["error"] == "overloaded"
+        n_forced_reqs += 1
+
+    trs = rtrace.traces("read")
+    sampled_ok = [t for t in trs
+                  if t["outcome"] == "ok" and t.get("sampled")]
+    n_complete = sum(1 for t in sampled_ok if rtrace.complete(t)[0])
+    forced = [t for t in trs if t["outcome"] in rtrace.FORCED_OUTCOMES]
+    rep = rtrace.attribution_report(sampled_ok)
+    return {
+        "counters": rtrace.counters(),
+        "n_ok": n_ok,
+        "n_err": n_err,
+        "n_sampled_ok": len(sampled_ok),
+        "n_complete": n_complete,
+        "complete_frac": (n_complete / len(sampled_ok))
+        if sampled_ok else 0.0,
+        "n_forced_reqs": n_forced_reqs,
+        "n_forced_traces": len(forced),
+        "coverage_p50": rep.get("coverage_p50", 0.0),
+        "report": rep,
+    }
+
+
+def test_rtrace_chaos_drill_holds_the_gate():
+    res = run_rtrace_chaos(seed=7)
+    c = res["counters"]
+    for k in ("minted", "sampled", "committed", "forced", "degraded"):
+        assert c.get(k, 0) > 0, (k, c)
+    assert res["n_ok"] > 0 and res["n_sampled_ok"] > 0
+    assert res["complete_frac"] >= 0.99, res
+    assert res["n_forced_traces"] == res["n_forced_reqs"], res
+    assert res["coverage_p50"] >= 0.9, res["report"]

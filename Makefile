@@ -23,7 +23,7 @@ PY ?= python
 # meaningful.
 COVER_THRESHOLD ?= 88
 
-.PHONY: all compile test cover typecheck xref native bench benchall dryrun net-demo chaos crash-demo obs-demo topo-demo spans-demo overlap-demo partition-demo serve-demo audit-demo multichip-demo working-set-demo read-tier-demo write-tier-demo rtrace-demo bench-gate clean
+.PHONY: all compile test cover typecheck xref native bench benchall dryrun net-demo chaos crash-demo obs-demo topo-demo spans-demo overlap-demo partition-demo serve-demo audit-demo multichip-demo working-set-demo read-tier-demo write-tier-demo rtrace-demo devprof-demo bench-gate clean
 
 all: compile xref typecheck cover
 
@@ -104,7 +104,11 @@ net-demo:
 # from the victim pre-kill), honest retry_after_ms sheds, the
 # router.write* counters lit, and certify_writes signing ZERO
 # acked-but-lost writes while the ack-before-fsync arm FAILS with the
-# lost seq range named; refreshes WRITETIER_r01.json.
+# lost seq range named; refreshes WRITETIER_r01.json. Last comes the
+# device observatory (scripts/devprof_demo.py): a stepping fleet's
+# recompile storm must be 100% attributed to (site, changed axis), the
+# warm-up arm must collapse it >=5x, and the CCRDT_DEVPROF=0 arm must
+# be byte-identical at <=2% armed overhead; refreshes DEVPROF_r01.json.
 chaos:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py tests/test_wal.py tests/test_fault_matrix.py -q -p no:cacheprovider
 	env JAX_PLATFORMS=cpu $(PY) scripts/chaos_gate.py
@@ -115,6 +119,7 @@ chaos:
 	env JAX_PLATFORMS=cpu $(PY) scripts/working_set_demo.py
 	env JAX_PLATFORMS=cpu $(PY) scripts/read_tier_demo.py
 	env JAX_PLATFORMS=cpu $(PY) scripts/write_tier_demo.py
+	env JAX_PLATFORMS=cpu $(PY) scripts/devprof_demo.py
 
 # Throughput regression gate: best merges_per_sec of the latest
 # BENCH_r*.json round must stay within 20% of the best prior round —
@@ -264,6 +269,18 @@ write-tier-demo:
 # RTRACE_r01.json (the carrier bench_gate's evaluate_rtrace compares).
 rtrace-demo:
 	env JAX_PLATFORMS=cpu $(PY) scripts/rtrace_demo.py
+
+# Device-observatory demo (slow, subprocess arms): a seeded stepping
+# 3-worker fleet whose growing topk_rmv shapes provoke a recompile
+# storm — gated on 100% of compiles attributed to (site, changed
+# axis), capacity growth named as the dominant churn source, the
+# CCRDT_DEVPROF_WARMUP=1 arm collapsing steady-state recompiles >=5x
+# via shape padding + the boot-time prewarm ladder, observatory
+# overhead <=2% on alternating CCRDT_DEVPROF=0 A/B rounds, and the
+# kill-switch arm byte-identical. Writes DEVPROF_r01.json (the carrier
+# bench_gate's evaluate_devprof compares).
+devprof-demo:
+	env JAX_PLATFORMS=cpu $(PY) scripts/devprof_demo.py
 
 # Span-tracing demo (slow, real processes): a 3-worker TCP fleet with
 # the round-phase span plane armed (CCRDT_SPANS=1) — every worker's

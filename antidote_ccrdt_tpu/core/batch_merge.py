@@ -40,6 +40,7 @@ from typing import Any, Dict, Iterator, List, Sequence
 import numpy as np
 
 from .behaviour import registry
+from ..obs import devprof
 from ..obs import profile
 from ..obs import spans as obs_spans
 
@@ -137,26 +138,42 @@ def snapshot_state(state):
         else None
     )
     try:
+        if profile.ACTIVE or devprof.ACTIVE:
+            with profile.dispatch(
+                "batch_merge.snapshot", fn=_COPY_SLOT[0], operands=(state,)
+            ):
+                return _COPY_SLOT[0](state)
         return _COPY_SLOT[0](state)
     finally:
         obs_spans.end(tok)
 
 
-def merge_into(merge, state, incoming, donate_incoming: bool = True):
+def merge_into(
+    merge,
+    state,
+    incoming,
+    donate_incoming: bool = True,
+    site: str = "batch_merge.into",
+):
     """One window's merge through the donated slot: `state ⊔ incoming`,
     with `incoming`'s buffers donated to the result. The caller must own
     `incoming` outright (an expanded peer delta / fetched snapshot it
-    will never touch again); `state` is left intact."""
-    slot = merge_slots(merge)["donate_rhs" if donate_incoming else "plain"]
+    will never touch again); `state` is left intact. `site` labels the
+    dispatch for spans/devprof — the pager relabels its cold-fold and
+    full-join calls so compile churn attributes to the right tier."""
+    donation = "donate_rhs" if donate_incoming else "plain"
+    slot = merge_slots(merge)[donation]
     tok = (
-        obs_spans.begin("round.device_dispatch", site="batch_merge.into", n=2)
+        obs_spans.begin("round.device_dispatch", site=site, n=2)
         if obs_spans.ACTIVE
         else None
     )
     try:
-        if profile.ACTIVE:
+        if profile.ACTIVE or devprof.ACTIVE:
+            # fn=slot: the jit wrapper actually dispatched, so the
+            # compile classification watches the right cache.
             with profile.dispatch(
-                "batch_merge.into", fn=merge, operands=(incoming,)
+                site, fn=slot, operands=(incoming,), donation=donation
             ):
                 return slot(state, incoming)
         return slot(state, incoming)
@@ -184,13 +201,21 @@ def host_device() -> Iterator[None]:
         yield
 
 
-def host_merge_into(merge, state, incoming, donate_incoming: bool = True):
+def host_merge_into(
+    merge,
+    state,
+    incoming,
+    donate_incoming: bool = True,
+    site: str = "batch_merge.into",
+):
     """`merge_into`, but dispatched on the host CPU backend: the cold
     tier's fold primitive. `state`/`incoming` created inside the
     `host_device` region stay CPU-committed, so the jit slot compiles a
     CPU executable and the fold never touches HBM."""
     with host_device():
-        return merge_into(merge, state, incoming, donate_incoming=donate_incoming)
+        return merge_into(
+            merge, state, incoming, donate_incoming=donate_incoming, site=site
+        )
 
 
 def fold_states(merge, states: Sequence[Any]):
@@ -246,6 +271,7 @@ def _batched_fold(merge, batch: Any, donate: bool = False):
     import jax
     import jax.numpy as jnp
 
+    donation = "donate_both" if donate else ""
     step = merge_slots(merge)["donate_both"] if donate else merge
     n = jax.tree.leaves(batch)[0].shape[0]
     while n > 1:
@@ -258,8 +284,15 @@ def _batched_fold(merge, batch: Any, donate: bool = False):
             else None
         )
         try:
-            if profile.ACTIVE:
-                with profile.dispatch("batch_merge.fold", fn=merge, operands=(lhs, rhs)):
+            if profile.ACTIVE or devprof.ACTIVE:
+                # fn=step: the callable actually dispatched (the donated
+                # jit slot, or the engine's class-level jitted merge).
+                with profile.dispatch(
+                    "batch_merge.fold",
+                    fn=step,
+                    operands=(lhs, rhs),
+                    donation=donation,
+                ):
                     merged = step(lhs, rhs)
             else:
                 merged = step(lhs, rhs)
@@ -275,6 +308,75 @@ def _batched_fold(merge, batch: Any, donate: bool = False):
             batch = merged
         n = (n + 1) // 2
     return batch
+
+
+# Dense engines keyed by (type, capacities). The converter mergers below
+# size capacities exactly from their inputs, so before this memo every
+# call built a FRESH engine — and the engines' class-level jitted methods
+# key their caches on the static `self`, meaning every call recompiled
+# even at identical shapes. Reusing one engine per capacity tuple is the
+# recompile-churn fix the devprof observatory measures (ISSUE 19).
+# Entries are tiny (capacity ints + a bound-method pin); the jit caches
+# they key live on the CLASS attributes and grow either way.
+_DENSE_MEMO: Dict[Any, Any] = {}
+
+
+def _memo_dense(type_name: str, **caps):
+    key = (type_name, tuple(sorted(caps.items())))
+    eng = _DENSE_MEMO.get(key)
+    if eng is None:
+        eng = registry.make_dense(type_name, **caps)
+        _DENSE_MEMO[key] = eng
+    return eng
+
+
+def prewarm_topk_rmv(
+    size: int, n_ids: int = 1, n_dcs: int = 1, max_slots: int = 1
+) -> int:
+    """Boot-time warm-up (``CCRDT_DEVPROF_WARMUP=1``): pre-trace the
+    topk_rmv fold dispatch across the padded capacity ladder up to
+    `max_slots` live adds per id, so a stepping fleet's first rounds —
+    and every later bucket crossing — hit a warm jit cache instead of
+    provoking an inline recompile. Shapes match `_merge_topk_rmv`'s
+    fold dispatches exactly: [1, 1, U, M] halves of a power-of-two
+    padded batch. Returns the number of ladder rungs traced."""
+    import jax.numpy as jnp
+
+    from ..models.topk_rmv_dense import TopkRmvDenseState
+
+    U, D = devprof.pad_dim(n_ids), devprof.pad_dim(n_dcs)
+    rungs = 0
+    m = 1
+    while True:
+        m = devprof.pad_dim(m)
+        dense = _memo_dense(
+            "topk_rmv", n_ids=U, n_dcs=D, size=size, slots_per_id=m
+        )
+
+        def blank():
+            return TopkRmvDenseState(
+                slot_score=jnp.full((1, 1, U, m), _I32_MIN, jnp.int32),
+                slot_dc=jnp.zeros((1, 1, U, m), jnp.int32),
+                slot_ts=jnp.zeros((1, 1, U, m), jnp.int32),
+                rmv_vc=jnp.zeros((1, 1, U, D), jnp.int32),
+                vc=jnp.zeros((1, 1, D), jnp.int32),
+                lossy=jnp.zeros((1, 1), bool),
+            )
+
+        lhs, rhs = blank(), blank()
+        if profile.ACTIVE or devprof.ACTIVE:
+            # Boot compiles attribute to their own site, so steady-state
+            # churn gates can exclude the deliberate warm-up cost.
+            with profile.dispatch(
+                "batch_merge.prewarm", fn=dense.merge, operands=(lhs, rhs)
+            ):
+                dense.merge(lhs, rhs)
+        else:
+            dense.merge(lhs, rhs)
+        rungs += 1
+        if m >= max_slots:
+            return rungs
+        m *= 2
 
 
 def batch_merge(type_name: str, states: Sequence[Any]) -> Any:
@@ -342,7 +444,7 @@ def _merge_topk(states):
     ids = sorted({i for st in states for i in st.entries})
     if not ids:
         return TopkState({}, size)
-    dense = registry.make_dense("topk", n_ids=len(ids), size=size)
+    dense = _memo_dense("topk", n_ids=len(ids), size=size)
     import jax.numpy as jnp
 
     from ..models.topk import TopkDenseState
@@ -381,7 +483,7 @@ def _merge_leaderboard(states):
     )
     if not ids:
         return LeaderboardState({}, {}, frozenset(), NIL, size)
-    dense = registry.make_dense("leaderboard", n_players=len(ids), size=size)
+    dense = _memo_dense("leaderboard", n_players=len(ids), size=size)
     idx = {w: i for i, w in enumerate(ids)}
     score = np.full((len(states), 1, len(ids)), _I32_MIN, np.int32)
     banned = np.zeros((len(states), 1, len(ids)), bool)
@@ -418,7 +520,7 @@ def _merge_topk_rmv(states):
     import jax.numpy as jnp
 
     from ..models.topk_rmv import NIL, TopkRmvState, _min_observed
-    from ..models.topk_rmv_dense import TopkRmvDenseState, _sort_slots, make_dense
+    from ..models.topk_rmv_dense import TopkRmvDenseState, _sort_slots
 
     size = states[0].size
     if any(s.size != size for s in states):
@@ -444,6 +546,14 @@ def _merge_topk_rmv(states):
         for w, es in st.masked.items():
             union.setdefault(w, set()).update(es)
     M = max((len(es) for es in union.values()), default=1)
+    if devprof.WARMUP:
+        # Warm-up buckets (CCRDT_DEVPROF_WARMUP=1): pad capacities to
+        # the next power of two so a stepping fleet's growing shapes
+        # stay inside one jit bucket instead of recompiling per step.
+        # Bit-identity safe: padded slots carry the absent-entry
+        # sentinels (_I32_MIN score / 0 ts / 0 vc) that the extraction
+        # loops below already skip.
+        U, D, M = devprof.pad_dim(U), devprof.pad_dim(D), devprof.pad_dim(M)
     id_idx = {w: i for i, w in enumerate(ids)}
     dc_idx = {d: i for i, d in enumerate(dcs)}
 
@@ -465,7 +575,7 @@ def _merge_topk_rmv(states):
         for d, t in st.vc.items():
             vc[r, 0, dc_idx[d]] = _check_i32(t)
 
-    dense = make_dense(n_ids=U, n_dcs=D, size=size, slots_per_id=M)
+    dense = _memo_dense("topk_rmv", n_ids=U, n_dcs=D, size=size, slots_per_id=M)
     # Canonicalize rows to the slot invariant (sorted desc, dup-free) that
     # the rank-arithmetic merge requires, then fold.
     s_, d_, t_, _ = _sort_slots(

@@ -80,6 +80,7 @@ import numpy as np
 from . import partition as pt
 from . import serial
 from .batch_merge import host_device, host_merge_into, merge_into
+from ..obs import devprof, profile
 from ..obs import spans as obs_spans
 from ..utils import faults
 from ..utils.metrics import Metrics
@@ -389,7 +390,14 @@ class PartitionPager:
                 faults.fire("pager.hydrate")
             payload = self._load_payload(part)
             _name, psnap = serial.loads_dense(payload, self._like_delta)
-            state = pt.apply_psnap(self.dense, state, psnap)
+            if profile.ACTIVE or devprof.ACTIVE:
+                # No single jit cache to watch (apply_psnap scatters
+                # eagerly), but the dispatch timing + h2d bytes of a
+                # hydration are device-observatory evidence.
+                with profile.dispatch("pager.hydrate", operands=(psnap,)):
+                    state = pt.apply_psnap(self.dense, state, psnap)
+            else:
+                state = pt.apply_psnap(self.dense, state, psnap)
             if self._cold is not None:
                 with host_device():
                     self._cold = clear_parts(self.dense, self._cold, [part], self.P)
@@ -466,7 +474,9 @@ class PartitionPager:
                 expanded = dl.expand_delta(self.dense, delta)
             else:
                 expanded = dl.expand_table_delta(self.dense, self._cold, delta)
-        self._cold = host_merge_into(self.dense.merge, self._cold, expanded)
+        self._cold = host_merge_into(
+            self.dense.merge, self._cold, expanded, site="pager.cold_fold"
+        )
 
     def _refresh_cold(self, parts: Iterable[int]) -> None:
         """Re-derive payload + digest for cold partitions whose substrate
@@ -608,7 +618,9 @@ class PartitionPager:
             lambda x: jnp.asarray(np.asarray(x)), self._cold
         )
         self.metrics.count("pager.full_joins")
-        return merge_into(self.dense.merge, state, cold_dev)
+        return merge_into(
+            self.dense.merge, state, cold_dev, site="pager.full_join"
+        )
 
     # --- payload tiers (RAM -> disk) ----------------------------------------
 
@@ -669,6 +681,10 @@ class PartitionPager:
         m.set("pager.cold_parts", len(self.universe) - len(self.resident))
         m.set("pager.host_bytes", self.host_bytes())
         m.set("pager.spilled_parts", len(self._spilled))
+        if devprof.ACTIVE:
+            # HBM occupancy vs CCRDT_PAGER_HBM_BUDGET + high-watermark,
+            # into the device observatory's own metrics registry.
+            devprof.note_pager(self.resident_bytes(), self.hbm_budget)
 
     def export_gauges(self) -> None:
         self._export()

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.behaviour import DenseCCRDT, MergeKind
+from ..obs import devprof, profile
 from ..utils.metrics import Metrics
 
 
@@ -55,7 +56,16 @@ def fold_rows(dense: DenseCCRDT, state: Any, contributors: Sequence[int]) -> Any
     n = len(idx)
     while n > 1:
         half = n // 2
-        merged = dense.merge(_rows(acc, slice(0, half)), _rows(acc, slice(half, 2 * half)))
+        lhs, rhs = _rows(acc, slice(0, half)), _rows(acc, slice(half, 2 * half))
+        if profile.ACTIVE or devprof.ACTIVE:
+            # dense.merge is the engine's class-level jitted method, so
+            # the observatory watches its real compilation cache here.
+            with profile.dispatch(
+                "dense_replay.fold_rows", fn=dense.merge, operands=(lhs, rhs)
+            ):
+                merged = dense.merge(lhs, rhs)
+        else:
+            merged = dense.merge(lhs, rhs)
         if n % 2:
             merged = jax.tree.map(
                 lambda m, t: jnp.concatenate([m, t], axis=0),

@@ -36,6 +36,7 @@ from __future__ import annotations
 from typing import Any, Dict, Optional
 
 from ..core import batch_merge
+from ..obs import devprof, profile
 from ..obs import spans as obs_spans
 from ..utils import faults
 from ..utils.jaxcompat import shard_map
@@ -115,7 +116,19 @@ def ici_reduce(
         else None
     )
     try:
-        if metrics is not None:
+        if profile.ACTIVE or devprof.ACTIVE:
+            with profile.dispatch(
+                "mesh.ici_reduce",
+                fn=fn,
+                operands=(state,),
+                donation="donate" if donate else "plain",
+            ):
+                if metrics is not None:
+                    with metrics.timer("mesh.ici_reduce"):
+                        out = fn(state)
+                else:
+                    out = fn(state)
+        elif metrics is not None:
             with metrics.timer("mesh.ici_reduce"):
                 out = fn(state)
         else:

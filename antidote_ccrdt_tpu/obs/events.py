@@ -105,7 +105,15 @@ REQUEST_KINDS = frozenset({
 
 
 def _is_request_kind(kind: str) -> bool:
-    return kind in REQUEST_KINDS or kind.startswith("rtrace.")
+    # `devprof.*` (compile events, obs/devprof.py) ride the request
+    # plane too: a recompile storm is exactly the burst shape the
+    # per-kind rings exist to isolate, and the line-buffered req spill
+    # is what makes compile evidence survive a SIGKILL.
+    return (
+        kind in REQUEST_KINDS
+        or kind.startswith("rtrace.")
+        or kind.startswith("devprof.")
+    )
 
 
 class FlightRecorder:

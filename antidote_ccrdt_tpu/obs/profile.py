@@ -21,16 +21,24 @@ call, no context-manager allocation, nothing on the per-merge hot path.
 Enable per-process with `install(metrics)` / `installed()` /
 `install_from_env` (``CCRDT_PROFILE=1``, same supervisor->worker env
 propagation as ``CCRDT_FAULTS``/``CCRDT_OBS_DIR``/``CCRDT_HTTP_PORT``).
+
+Since ISSUE 19 the compile/execute classification itself lives in
+`obs/devprof.py` (the device observatory): `dispatch` delegates to
+:func:`devprof.observe`, which samples the jit cache ONCE and feeds
+both the legacy ``profile.*`` family (names unchanged for scrape
+compat — the parity test pins them) and the devprof compile events.
+One source of truth, no double counting.
 """
 
 from __future__ import annotations
 
 import contextlib
 import os
-import time
 from typing import Any, Iterable, Optional
 
 from ..utils.metrics import Metrics
+from . import devprof
+from .devprof import _cache_size, _leaf_nbytes  # noqa: F401 — re-exported
 
 ENV_FLAG = "CCRDT_PROFILE"
 
@@ -79,85 +87,35 @@ def install_from_env(
     return True
 
 
-# -- introspection helpers ----------------------------------------------------
-
-
-def _cache_size(fn: Any) -> Optional[int]:
-    """Size of a jitted callable's compilation cache, or None when the
-    callable doesn't expose one (plain functions, partials, older JAX).
-    Defensive on purpose: profiling must never break a dispatch."""
-    try:
-        sizer = fn._cache_size  # jax.jit-wrapped callables
-    except AttributeError:
-        return None
-    try:
-        return int(sizer())
-    except Exception:  # noqa: BLE001 — any introspection failure = unknown
-        return None
-
-
-def _leaf_nbytes(operands: Iterable[Any]) -> int:
-    """Total .nbytes across array leaves of `operands`. Dispatch sites
-    pass registered pytrees (the dense engine states), so flattening
-    goes through jax when available; without jax, plain containers
-    still traverse."""
-    try:
-        import jax
-
-        leaves = jax.tree.leaves(list(operands))
-    except Exception:  # noqa: BLE001 — profiling must never break a dispatch
-        leaves = []
-        stack = list(operands)
-        while stack:
-            x = stack.pop()
-            if isinstance(x, (tuple, list)):
-                stack.extend(x)
-            elif isinstance(x, dict):
-                stack.extend(x.values())
-            else:
-                leaves.append(x)
-    total = 0
-    for x in leaves:
-        nb = getattr(x, "nbytes", None)
-        if isinstance(nb, int):
-            total += nb
-    return total
+# -- the dispatch wrapper ---------------------------------------------------
+#
+# The cache-introspection helpers (`_cache_size`, `_leaf_nbytes`) moved
+# to obs/devprof.py and are re-exported above unchanged.
 
 
 @contextlib.contextmanager
-def dispatch(name: str, fn: Any = None, operands: Iterable[Any] = ()):
+def dispatch(
+    name: str,
+    fn: Any = None,
+    operands: Iterable[Any] = (),
+    donation: str = "",
+):
     """Time one dispatch of `name`. Guard the call site with
-    ``if profile.ACTIVE:`` — this context manager assumes profiling is
-    on (it records into the installed registry, or silently no-ops if
-    raced with `uninstall`).
+    ``if profile.ACTIVE or devprof.ACTIVE:`` — this context manager
+    assumes at least one plane is on (it silently no-ops if raced with
+    `uninstall`).
 
-    With `fn` (the jitted callable), the jit cache size is sampled
-    before/after to classify the dispatch as compile (cache grew) or
-    execute, and counted as a jit hit/miss. With `operands`, host->
-    device bytes are accumulated from array leaves."""
+    Thin delegation to `devprof.observe`: the observatory samples the
+    jit cache once, classifies compile vs execute, and — when profiling
+    is installed — emits the legacy ``profile.dispatch.<name>`` /
+    ``profile.compile.<name>`` / ``profile.execute.<name>`` histograms,
+    ``profile.jit_hits``/``profile.jit_misses`` counters, and
+    ``profile.h2d_bytes`` exactly as before."""
     m = _METRICS
-    if m is None:
+    if m is None and not devprof.ACTIVE:
         yield
         return
-    before = _cache_size(fn) if fn is not None else None
-    t0 = time.perf_counter()
-    try:
+    with devprof.observe(
+        name, fn=fn, operands=operands, donation=donation, profile_metrics=m
+    ):
         yield
-    finally:
-        dt = time.perf_counter() - t0
-        _record(m, f"profile.dispatch.{name}", dt)
-        if before is not None:
-            after = _cache_size(fn)
-            if after is not None and after > before:
-                m.count("profile.jit_misses")
-                _record(m, f"profile.compile.{name}", dt)
-            else:
-                m.count("profile.jit_hits")
-                _record(m, f"profile.execute.{name}", dt)
-        nbytes = _leaf_nbytes(operands)
-        if nbytes:
-            m.count("profile.h2d_bytes", nbytes)
-
-
-def _record(m: Metrics, name: str, dt: float) -> None:
-    m.merge({"counters": {}, "latencies": {name: [dt]}})

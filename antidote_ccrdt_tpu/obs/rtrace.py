@@ -505,6 +505,7 @@ def attribute(tr: Dict[str, Any],
     total = float(tr.get("ms", 0.0))
     out = {b: 0.0 for b in BUCKETS}
     out["total"] = total
+    out["kernel_compile"] = 0.0  # sub-annotation of kernel, not a bucket
     hops = tr.get("hops", ())
     atts: List[Tuple[float, float]] = []
     for h in hops:
@@ -543,6 +544,14 @@ def attribute(tr: Dict[str, Any],
         kn = min(kn, max(0.0, att_ms - qw))
         ap = min(ap, max(0.0, att_ms - qw - kn))
         out["queue_wait"], out["kernel"] = qw, kn
+        # Attribution honesty (ISSUE 19): when the device observatory
+        # saw compiles inside this hop's window, the echo carries their
+        # total as `compile_ms` and the kernel bucket gets a
+        # sub-annotation splitting compile-storm latency from genuine
+        # kernel time. Clipped to the kernel bucket — compile time IS
+        # kernel-bucket time, just dishonestly labeled before this.
+        cms = max(0.0, float(e.get("compile_ms", 0.0)))
+        out["kernel_compile"] = min(cms, kn)
         out["ack_probe"] += ap
         server_ms = qw + kn + ap
     # Wire = time the request was genuinely in flight (the attempts'
@@ -649,6 +658,14 @@ def attribution_report(
         "p99_trace_id": ex_tr.get("id"),
         "p99_dominant_bucket": dom,
         "p99_dominant_ms": round(ex_row.get(dom, 0.0), 3),
+        # The p99 trace's compile share (devprof sub-annotation of the
+        # kernel bucket): how much of the tail was recompile churn.
+        "p99_kernel_compile_ms": round(
+            ex_row.get("kernel_compile", 0.0), 3
+        ),
+        "p99_compile_share": round(
+            ex_row.get("kernel_compile", 0.0) / ex_row["total"], 4
+        ) if ex_row["total"] > 0 else 0.0,
         "buckets_ms_p50": {
             b: round(_pctl([r[b] for r in rows], 0.50), 3) for b in BUCKETS
         },
@@ -667,7 +684,9 @@ def format_report(rep: Dict[str, Any]) -> str:
         f"p50 {rep['total_ms_p50']:.2f}ms p99 {rep['total_ms_p99']:.2f}ms "
         f"(coverage p50 {rep['coverage_p50']:.1%})",
         f"  p99 trace {rep['p99_trace_id']}: dominant bucket "
-        f"{rep['p99_dominant_bucket']} ({rep['p99_dominant_ms']:.2f}ms)",
+        f"{rep['p99_dominant_bucket']} ({rep['p99_dominant_ms']:.2f}ms), "
+        f"compile share {rep.get('p99_compile_share', 0.0):.1%} "
+        f"({rep.get('p99_kernel_compile_ms', 0.0):.2f}ms)",
     ]
     for b in BUCKETS:
         lines.append(

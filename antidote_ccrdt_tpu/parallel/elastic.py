@@ -54,6 +54,7 @@ import os
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..net.transport import FsTransport, GossipNode
+from ..obs import devprof
 from ..obs import events as obs_events
 from ..obs import profile
 from ..obs import spans as obs_spans
@@ -634,7 +635,7 @@ def sweep_deltas(
                     else None
                 )
                 try:
-                    if profile.ACTIVE:
+                    if profile.ACTIVE or devprof.ACTIVE:
                         with profile.dispatch("elastic.delta_apply", operands=(delta,)):
                             state = _apply(state, delta)
                     else:
@@ -686,7 +687,7 @@ def sweep_deltas(
                             # Cold slices of the peer fold host-side;
                             # the device merge sees only the hot rest.
                             peer = pager.absorb_peer(peer)
-                        if profile.ACTIVE:
+                        if profile.ACTIVE or devprof.ACTIVE:
                             with profile.dispatch(
                                 "elastic.snap_merge", fn=dense.merge, operands=(peer,)
                             ):
@@ -769,7 +770,7 @@ def sweep(
             else None
         )
         try:
-            if profile.ACTIVE:
+            if profile.ACTIVE or devprof.ACTIVE:
                 with profile.dispatch(
                     "elastic.sweep_merge", fn=dense.merge, operands=(peer,)
                 ):

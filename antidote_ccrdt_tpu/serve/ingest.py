@@ -68,7 +68,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..net.transport import FRAME_MAGIC, decode_range_frame
-from ..obs import events as obs_events, rtrace
+from ..obs import devprof, events as obs_events, rtrace
 from ..utils import faults
 from ..utils.metrics import Metrics
 from .plane import encode
@@ -461,6 +461,12 @@ class IngestPlane:
             marks["m_stage"] = w.t_stage
             marks["m_fold"] = w.t_fold
             extra.setdefault("kernel_ms", round(w.kernel_ms, 3))
+        if devprof.ACTIVE:
+            # Device-observatory compile time inside this hop's window —
+            # the write path's kernel-bucket honesty sub-annotation.
+            cms = devprof.compile_ms_in_window(m_in, marks["m_out"])
+            if cms > 0.0:
+                extra.setdefault("compile_ms", cms)
         out = dict(doc)
         out["rtrace"] = rtrace.server_echo(ctx, self.member, marks, **extra)
         return out

@@ -34,6 +34,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs import devprof, profile
+
 
 class SnapshotView:
     """Host-side materialization of one snapshot: everything query
@@ -74,10 +76,29 @@ def materialize(dense: Any, state: Any) -> SnapshotView:
     if hasattr(eng, "value"):
         # Score-table engines: value() is the reference observable —
         # per-key ranked (id, score) lists, already host-materialized.
-        table = eng.value(folded)[0]
+        # The device dispatch inside is the engine's jitted observe,
+        # whose cache the observatory watches.
+        if profile.ACTIVE or devprof.ACTIVE:
+            with profile.dispatch(
+                "serve.materialize",
+                fn=getattr(eng, "observe", None),
+                operands=(folded,),
+            ):
+                table = eng.value(folded)[0]
+        else:
+            table = eng.value(folded)[0]
         return SnapshotView("table", table=table, n_keys=len(table))
 
-    obs = np.asarray(jax.device_get(eng.observe(folded)))[0]  # drop row axis
+    if profile.ACTIVE or devprof.ACTIVE:
+        with profile.dispatch(
+            "serve.materialize",
+            fn=getattr(eng, "observe", None),
+            operands=(folded,),
+        ):
+            obs = eng.observe(folded)
+    else:
+        obs = eng.observe(folded)
+    obs = np.asarray(jax.device_get(obs))[0]  # drop row axis
     if obs.ndim <= 1:
         arr = obs.reshape(-1)
         return SnapshotView("scalar", arr=arr, n_keys=arr.shape[0])

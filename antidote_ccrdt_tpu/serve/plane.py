@@ -65,6 +65,7 @@ import time
 from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..obs import devprof
 from ..obs import events as obs_events
 from ..obs import rtrace
 from ..utils import faults
@@ -345,6 +346,13 @@ class ServePlane:
             if p is not None:
                 marks.update(m_q=p.t_enq, m_drain=p.t_drain,
                              m_done=p.t_done)
+            if devprof.ACTIVE:
+                # Compile time the device observatory saw inside this
+                # hop's window — the kernel bucket's honesty
+                # sub-annotation (obs/rtrace.py attribute()).
+                cms = devprof.compile_ms_in_window(m_in, marks["m_out"])
+                if cms > 0.0:
+                    extra.setdefault("compile_ms", cms)
             doc["rtrace"] = rtrace.server_echo(ctx, self.member, marks,
                                                **extra)
             return doc
@@ -593,4 +601,6 @@ class ServePlane:
             }
         if self.pager is not None:
             out.update(self.pager.health_fields())
+        if devprof.ACTIVE:
+            out.update(devprof.health_fields())
         return out

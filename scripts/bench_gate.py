@@ -1402,6 +1402,155 @@ def evaluate_rtrace(
     return code, "\n".join(lines)
 
 
+def load_devprof_rounds(
+    bench_dir: str,
+) -> List[Tuple[int, str, float, float, float, Optional[bool]]]:
+    """[(round_no, path, recompiles_per_100_rounds, compile_ms_share_pct,
+    overhead_pct, passed)] for every ``DEVPROF_r<NN>.json`` carrier
+    committed by scripts/devprof_demo.py. Carriers missing any of the
+    three metric keys are skipped, not zeros; ``passed`` is the
+    carrier's own check verdict (None when absent)."""
+    out: List[Tuple[int, str, float, float, float, Optional[bool]]] = []
+    for p in sorted(glob.glob(os.path.join(bench_dir, "DEVPROF_r*.json"))):
+        m = re.search(r"DEVPROF_r(\d+)\.json$", os.path.basename(p))
+        if not m:
+            continue
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        keys = (
+            "recompiles_per_100_rounds", "compile_ms_share_pct",
+            "overhead_pct",
+        )
+        if not all(isinstance(doc.get(k), (int, float)) for k in keys):
+            continue
+        passed = doc.get("pass")
+        out.append((
+            int(m.group(1)), p,
+            float(doc["recompiles_per_100_rounds"]),
+            float(doc["compile_ms_share_pct"]),
+            float(doc["overhead_pct"]),
+            bool(passed) if isinstance(passed, bool) else None,
+        ))
+    out.sort(key=lambda r: r[0])
+    return out
+
+
+def evaluate_devprof(
+    rounds: List[Tuple[int, str, float, float, float, Optional[bool]]],
+    tolerance: float = 0.20,
+    overhead_ceiling_pct: float = 2.0,
+    recompile_floor_abs: float = 2.0,
+    share_floor_abs: float = 0.5,
+) -> Tuple[int, str]:
+    """(exit_code, verdict) for the device observatory over the DEVPROF
+    carriers — the rtrace gate's shape, with TWO unconditional claims
+    that fire even on the very first round:
+
+    * the latest carrier's own ``pass`` verdict must be True — the demo
+      checks 100% compile attribution, capacity growth named dominant,
+      the >=5x warm-up cut, and the byte-identical kill-switch arm, and
+      a carrier that failed its own checks must never gate green;
+    * ``overhead_pct`` — armed-vs-CCRDT_DEVPROF=0 wall time on paired
+      alternating rounds — must stay under `overhead_ceiling_pct`
+      ABSOLUTE: an observatory that taxes every dispatch more than 2%
+      is a perf regression wearing telemetry's clothes;
+    * steady-state ``recompiles_per_100_rounds`` and
+      ``compile_ms_share_pct`` must not RISE more than `tolerance`
+      relative and their absolute floors under the best (lowest) prior
+      carrier — compile churn creeping back into the warm steady state
+      is exactly the regression this plane exists to catch (vacuous
+      with fewer than two rounds)."""
+    if not rounds:
+        return 0, (
+            "devprof-gate: no DEVPROF carriers — nothing to compare, "
+            "passing vacuously"
+        )
+    latest = rounds[-1]
+    latest_n, _p, latest_rc, latest_sh, latest_ov, latest_pass = latest
+    code = 0
+    lines: List[str] = []
+
+    if latest_pass is False:
+        code = 1
+        lines.append(
+            f"devprof-gate: r{latest_n:02d} carries pass=false\n"
+            "FAIL: the latest devprof drill failed its own checks — "
+            "regenerate the carrier with `make devprof-demo` and fix "
+            "what it names before gating on drift"
+        )
+    else:
+        lines.append(
+            f"devprof-gate: r{latest_n:02d} checks "
+            f"{'passed' if latest_pass else 'absent (legacy carrier)'}"
+        )
+
+    verdict = (
+        f"devprof-gate: r{latest_n:02d} overhead_pct = {latest_ov:.2f} "
+        f"(ceiling {overhead_ceiling_pct:.1f}% absolute, vs the "
+        "carrier's own CCRDT_DEVPROF=0 paired rounds)"
+    )
+    if latest_ov > overhead_ceiling_pct:
+        code = 1
+        lines.append(
+            f"{verdict}\nFAIL: the armed observatory taxes dispatches "
+            f"{latest_ov:.2f}% — over the {overhead_ceiling_pct:.1f}% "
+            "budget"
+        )
+    else:
+        lines.append(f"{verdict}\nOK: within budget")
+
+    if len(rounds) < 2:
+        lines.append(
+            f"devprof-gate: only {len(rounds)} round(s) carry the "
+            "devprof metrics — no drift to compare, passing vacuously"
+        )
+        return code, "\n".join(lines)
+
+    best_rc_n, best_rc = best_prior_carrier(rounds, 2, "min")
+    rc_ceiling = max(
+        best_rc * (1.0 + tolerance), best_rc + recompile_floor_abs
+    )
+    verdict = (
+        f"devprof-gate: r{latest_n:02d} recompiles_per_100_rounds = "
+        f"{latest_rc:.1f} vs best prior r{best_rc_n:02d} = {best_rc:.1f} "
+        f"(ceiling +{tolerance:.0%} and +{recompile_floor_abs:.0f}: "
+        f"{rc_ceiling:.1f})"
+    )
+    if latest_rc > rc_ceiling:
+        code = 1
+        lines.append(
+            f"{verdict}\nFAIL: steady-state recompiles crept up "
+            f"{latest_rc - best_rc:.1f}/100 rounds — a shape bucket or "
+            "the prewarm ladder regressed"
+        )
+    else:
+        lines.append(f"{verdict}\nOK: within tolerance")
+
+    best_sh_n, best_sh = best_prior_carrier(rounds, 3, "min")
+    sh_ceiling = max(
+        best_sh * (1.0 + tolerance), best_sh + share_floor_abs
+    )
+    verdict = (
+        f"devprof-gate: r{latest_n:02d} compile_ms_share_pct = "
+        f"{latest_sh:.2f} vs best prior r{best_sh_n:02d} = {best_sh:.2f} "
+        f"(ceiling +{tolerance:.0%} and +{share_floor_abs:.1f}: "
+        f"{sh_ceiling:.2f})"
+    )
+    if latest_sh > sh_ceiling:
+        code = 1
+        lines.append(
+            f"{verdict}\nFAIL: compile time is eating "
+            f"{latest_sh:.2f}% of steady-state wall time — XLA is "
+            "re-tracing where it used to hit cache"
+        )
+    else:
+        lines.append(f"{verdict}\nOK: within tolerance")
+    return code, "\n".join(lines)
+
+
 def attribution_drift(
     rounds: List[Tuple[int, str, float, float]]
 ) -> List[str]:
@@ -1511,6 +1660,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"{rps:,.0f} traced reads/s, overhead {ov:.2f}%, "
             f"coverage p50 {cov:.1%}"
         )
+    dvp = load_devprof_rounds(args.bench_dir)
+    for n, p, rc, sh, ov, passed in dvp:
+        tag = "pass" if passed else ("FAIL" if passed is False else "?")
+        print(
+            f"  devprof r{n:02d} {os.path.basename(p)} [{tag}]: "
+            f"{rc:.1f} recompiles/100 rounds, compile share {sh:.2f}%, "
+            f"overhead {ov:.2f}%"
+        )
     pgr = load_pager_rounds(args.bench_dir)
     for n, p, hit, miss, cm in pgr:
         cm_note = f", {cm:,.0f} cold merges/s" if cm is not None else ""
@@ -1554,9 +1711,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     print(write_verdict)
     rtrace_code, rtrace_verdict = evaluate_rtrace(rtrc, args.tolerance)
     print(rtrace_verdict)
+    devprof_code, devprof_verdict = evaluate_devprof(dvp, args.tolerance)
+    print(devprof_verdict)
     return max(code, gap_code, ing_code, part_code, serve_code, audit_code,
                wal_code, mesh_code, pager_code, router_code, write_code,
-               rtrace_code)
+               rtrace_code, devprof_code)
 
 
 if __name__ == "__main__":

@@ -82,6 +82,14 @@ every fast-path counter lit — coalesced frames/ops on the wire, decoded
 leaves staged to device, cross-member folds fused, and the delta shed
 (hole-healing under compaction) actually exercised.
 
+The devprof leg (PR 18) runs the seeded stepping drill from
+tests/test_devprof.py: three workers grow topk_rmv state every round,
+the fold's slots-per-id axis moves, and the device observatory
+(obs/devprof.py) must attribute 100% of the resulting recompiles to
+(site, changed axis) — with topk_rmv capacity growth named as the
+dominant churn source, the devprof.* counters lit, and the
+CCRDT_DEVPROF=0 kill-switch arm byte-identical and fully dark.
+
 Run:  python scripts/chaos_gate.py
 Make: part of `make chaos` (after the pytest leg).
 """
@@ -674,6 +682,51 @@ def main() -> int:
           f"traced, attribution coverage p50 {rt['coverage_p50']:.1%}, "
           f"{int(rc.get('degraded', 0))} degraded trace(s) never failed "
           "a request")
+
+    # -- leg 12: the device observatory (obs/devprof.py) -------------------
+    from test_devprof import run_devprof_drill
+
+    dv = run_devprof_drill(seed=7)
+    dc = dv["counters"]
+    print("== devprof stepping drill (seed=7, 3 workers, growing "
+          "topk_rmv shapes) ==")
+    print(f"  devprof.compiles={int(dc.get('devprof.compiles', 0))} "
+          f"devprof.dispatches={int(dc.get('devprof.dispatches', 0))} "
+          f"capacity_growth={dv['n_capacity_growth']}/{dv['n_compiles']}")
+    dv_zeroed = sorted(
+        k for k in ("devprof.compiles", "devprof.dispatches")
+        if not dc.get(k, 0)
+    )
+    if dv_zeroed:
+        print("FAIL: devprof counters regressed to zero (the compile "
+              f"observatory went dark under the storm): {dv_zeroed}")
+        return 1
+    if dv["unattributed"]:
+        print(f"FAIL: {dv['unattributed']}/{dv['n_compiles']} compile "
+              "events lack a site, changed axis, or signature — every "
+              "recompile must name what moved")
+        return 1
+    if dv["n_capacity_growth"] < dv["n_compiles"] - 1:
+        print("FAIL: the dominant churn source is not the topk_rmv "
+              f"capacity axis ({dv['n_capacity_growth']} of "
+              f"{dv['n_compiles']} compiles name slot_score axis3) — "
+              "attribution is pointing at the wrong axis")
+        return 1
+    if dv["digest_on"] != dv["digest_off"]:
+        print("FAIL: the CCRDT_DEVPROF=0 kill-switch arm diverged from "
+              "the observed arm — observation is perturbing merge "
+              "results")
+        return 1
+    if dv["off_devprof_counters"] or dv["off_events"]:
+        print("FAIL: the kill-switch arm still emitted devprof counters/"
+              f"events ({dv['off_devprof_counters']} counter keys, "
+              f"{dv['off_events']} events) — CCRDT_DEVPROF=0 must be "
+              "fully dark")
+        return 1
+    print(f"OK: devprof leg — {dv['n_compiles']} storm compiles all "
+          "attributed to (site, changed axis), "
+          f"{dv['n_capacity_growth']} naming topk_rmv capacity growth, "
+          "kill-switch arm byte-identical and dark")
     return 0
 
 

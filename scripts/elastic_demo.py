@@ -454,6 +454,12 @@ def run_worker(store, drill, dense, state, args, result_dir):
     obs_events.install_from_env(args.member)
     obs_export.install_atexit_dump(store.metrics, args.member)
     obs_profile.install_from_env(store.metrics)
+    # Device observatory (CCRDT_DEVPROF, default-armed; =0 kills): every
+    # jit slot cache reports compile churn + signature diffs through it,
+    # and the pager/live-buffer memory gauges ride the same registry.
+    from antidote_ccrdt_tpu.obs import devprof as obs_devprof
+
+    obs_devprof.install_from_env(store.metrics)
     # Span plane (CCRDT_SPANS): round-phase spans spill next to the
     # flight log and mirror into metrics as span.* latency series, so
     # both live scrape surfaces prove the plane is lit.
@@ -800,6 +806,20 @@ def run_worker(store, drill, dense, state, args, result_dir):
                 for k, v in counters.items()
                 if k.startswith("rtrace.")
             },
+            # Device observatory (dashboard churn column + the
+            # watermarks CLI): trailing-minute recompiles, worst churn
+            # site, and the device-memory gauges.
+            "devprof": (
+                dict(
+                    obs_devprof.status_fields(),
+                    **{
+                        k[len("devprof_"):]: v
+                        for k, v in obs_devprof.health_fields().items()
+                    },
+                )
+                if obs_devprof.ACTIVE
+                else {}
+            ),
         }
         path = os.path.join(result_dir, f"obs-{args.member}.json")
         tmp = f"{path}.tmp-{os.getpid()}"

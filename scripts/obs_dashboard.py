@@ -293,6 +293,27 @@ def _fmt_rtrace(status: Optional[Dict[str, Any]]) -> str:
     return out
 
 
+def _fmt_devprof(status: Optional[Dict[str, Any]]) -> str:
+    """Device-observatory column group (obs/devprof.py): recompiles
+    over the trailing minute, the worst churn site (basename'd to keep
+    the column narrow), and pager HBM occupancy vs budget. "-" means
+    the plane is dark (CCRDT_DEVPROF=0) or no status dump yet."""
+    dv = (status or {}).get("devprof") or {}
+    if not dv:
+        return "-"
+    worst = str(dv.get("worst_site") or "-")
+    if "." in worst:
+        worst = worst.rsplit(".", 1)[-1]
+    out = (
+        f"rc/m {dv.get('recompiles_per_min', 0):.0f} "
+        f"{worst}:{int(dv.get('worst_site_compiles', 0))}"
+    )
+    occ = dv.get("hbm_occupancy")
+    if isinstance(occ, (int, float)) and occ > 0:
+        out += f" hbm {occ:.0%}"
+    return out
+
+
 def render_frame(root: str, clear: bool = True) -> str:
     rows = scrape_root(root)
     lines = []
@@ -303,7 +324,8 @@ def render_frame(root: str, clear: bool = True) -> str:
         f"{'member':<10}{'zone':<6}{'hb-age':>8} {'state':<9}{'snap':>5} "
         f"{'delta-window':<14}{'wal m:last/dur':>14}  {'sendq':<16}"
         f"{'lag (peer:ops/secs)':<26}  {'serving':<34}  "
-        f"{'pager':<18}  {'audit':<32}  {'router':<42}  {'rtrace'}"
+        f"{'pager':<18}  {'audit':<32}  {'router':<42}  {'rtrace':<24}  "
+        f"{'devprof'}"
     )
     lines.append(hdr)
     lines.append("-" * len(hdr))
@@ -340,7 +362,7 @@ def render_frame(root: str, clear: bool = True) -> str:
             f"{_fmt_sendq(st):<16}{_fmt_lag(st):<26}  "
             f"{_fmt_serve(st, m):<34}  {_fmt_pager(st):<18}  "
             f"{_fmt_audit(st):<32}  {_fmt_router(st, m):<42}  "
-            f"{_fmt_rtrace(st)}"
+            f"{_fmt_rtrace(st):<24}  {_fmt_devprof(st)}"
         )
     return "\n".join(lines)
 

@@ -1,0 +1,403 @@
+"""Device observatory (obs/devprof.py): signature-diff axis naming,
+kill-switch gating, fault-point degradation, warm-up storm collapse,
+pager HBM gauges, SIGKILL spill of compile events, profile.* parity
+(single source of truth), and the seeded stepping drill chaos_gate leg
+12 reuses (`run_devprof_drill`)."""
+
+import hashlib
+import os
+import random
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from antidote_ccrdt_tpu.obs import devprof, events, profile
+from antidote_ccrdt_tpu.utils import faults
+from antidote_ccrdt_tpu.utils.metrics import Metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    profile.uninstall()
+    devprof.uninstall()
+    devprof.set_warmup(False)
+    yield
+    profile.uninstall()
+    devprof.uninstall()
+    devprof.set_warmup(False)
+
+
+# -- signature diffs --------------------------------------------------------
+
+
+def test_signature_diff_names_growth_axis():
+    a = {"slot_score": np.zeros((1, 1, 4, 4), np.int32)}
+    b = {"slot_score": np.zeros((1, 1, 4, 8), np.int32)}
+    d = devprof.signature_diff(
+        devprof.signature((a,)), devprof.signature((b,))
+    )
+    assert len(d) == 1
+    assert "slot_score" in d[0]
+    assert "axis3 4->8" in d[0]
+
+
+def test_signature_diff_dtype_and_rank_and_donation():
+    a = np.zeros((4,), np.int32)
+    b = np.zeros((4,), np.float32)
+    d = devprof.signature_diff(
+        devprof.signature((a,)), devprof.signature((b,))
+    )
+    assert any("dtype int32->float32" in c for c in d)
+    r = devprof.signature_diff(
+        devprof.signature((np.zeros((4,), np.int32),)),
+        devprof.signature((np.zeros((4, 2), np.int32),)),
+    )
+    assert any("rank 1->2" in c for c in r)
+    dn = devprof.signature_diff(
+        devprof.signature((a,), donation="plain"),
+        devprof.signature((a,), donation="donate_rhs"),
+    )
+    assert dn == ["donation plain->donate_rhs"]
+
+
+def test_signature_diff_sharding_change():
+    class _Leaf:
+        def __init__(self, sharding):
+            self.shape, self.dtype = (4,), "int32"
+            self.sharding = sharding
+
+    d = devprof.signature_diff(
+        devprof.signature(({"x": _Leaf("mesh0")},)),
+        devprof.signature(({"x": _Leaf("mesh1")},)),
+    )
+    assert any("sharding mesh0->mesh1" in c for c in d)
+
+
+def test_signature_diff_first_trace_and_retrace():
+    s = devprof.signature((np.zeros((4,), np.int32),))
+    assert devprof.signature_diff(None, s) == ["first_trace"]
+    s2 = devprof.signature((np.zeros((4,), np.int32),))
+    assert devprof.signature_diff(s, s2) == ["retrace"]
+
+
+def test_pad_dim_buckets():
+    assert [devprof.pad_dim(n) for n in (0, 1, 2, 3, 5, 8, 9)] == [
+        1, 1, 2, 4, 8, 8, 16,
+    ]
+
+
+# -- kill switch ------------------------------------------------------------
+
+
+def test_kill_switch_env_gating():
+    m = Metrics()
+    # Default-armed: unset means ON, explicit "0"/"false"/"off" kills.
+    assert devprof.install_from_env(m, env={}) is True
+    assert devprof.ACTIVE
+    devprof.uninstall()
+    for off in ("0", "false", "off", "no"):
+        assert devprof.install_from_env(m, env={devprof.ENV_FLAG: off}) is False
+        assert not devprof.ACTIVE
+    assert devprof.install_from_env(
+        m, env={devprof.ENV_FLAG: "1", devprof.ENV_WARMUP: "1"}
+    ) is True
+    assert devprof.WARMUP
+
+
+def test_disabled_is_zero_cost_no_trace():
+    pytest.importorskip("jax")
+    from antidote_ccrdt_tpu.core.batch_merge import batch_merge
+    from antidote_ccrdt_tpu.models.topk import TopkState
+
+    events.reset("devprof-off")
+    assert not devprof.ACTIVE and not profile.ACTIVE
+    merged = batch_merge(
+        "topk", [TopkState({chr(97 + i): i + 1}, 2) for i in range(3)]
+    )
+    assert merged.entries == {"c": 3, "b": 2}
+    assert not [e for e in events.events() if e["kind"].startswith("devprof.")]
+
+
+# -- fault point ------------------------------------------------------------
+
+
+def test_record_fault_degrades_to_unobserved_never_blocks():
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    fn = jax.jit(lambda a, b: a + b)
+    m = Metrics()
+    events.reset("devprof-fault")
+    devprof.install(m)
+    with faults.injected({
+        devprof.FAULT_RECORD: [
+            {"action": "raise", "at": [0]},
+            {"action": "drop", "at": [1]},
+        ]
+    }):
+        outs = []
+        for shape in ((4,), (8,), (16,)):
+            a = jnp.zeros(shape, jnp.int32)
+            with devprof.observe("unit.fault", fn=fn, operands=(a, a)):
+                outs.append(fn(a, a).block_until_ready())
+    assert len(outs) == 3  # every dispatch completed despite the faults
+    snap = m.snapshot()["counters"]
+    assert snap["devprof.unobserved"] == 2
+    assert snap["devprof.compiles"] == 1  # only the unfaulted dispatch
+
+
+# -- warm-up ----------------------------------------------------------------
+
+
+def _step(sc, states, r, seed):
+    rng = random.Random((seed << 16) ^ r)
+    out = []
+    for wi, st in enumerate(states):
+        st, _ = sc.update(
+            ("add", (1, 100 + rng.randrange(100),
+                     (f"dc{wi}", r * len(states) + wi + 1))),
+            st,
+        )
+        out.append(st)
+    return out
+
+
+def test_warmup_eliminates_first_round_compiles():
+    pytest.importorskip("jax")
+    from antidote_ccrdt_tpu.core import batch_merge
+    from antidote_ccrdt_tpu.models.topk_rmv import TopkRmvScalar
+
+    events.reset("devprof-warm")
+    m = Metrics()
+    devprof.install(m)
+    devprof.set_warmup(True)
+    # Pre-trace the ladder past anything 4 rounds of 3 workers can need
+    # (M reaches 12; the ladder tops out at the 16 rung).
+    assert batch_merge.prewarm_topk_rmv(13, n_ids=1, n_dcs=3, max_slots=13) > 0
+    boot = m.snapshot()["counters"].get("devprof.compiles", 0)
+    sc = TopkRmvScalar()
+    states = [sc.new(13) for _ in range(3)]
+    for r in range(4):
+        states = _step(sc, states, r, seed=99)
+        batch_merge.batch_merge("topk_rmv", list(states))
+    steady = m.snapshot()["counters"].get("devprof.compiles", 0) - boot
+    assert steady == 0
+    # Every boot compile attributed to the dedicated prewarm site.
+    assert all(
+        e["site"] == "batch_merge.prewarm"
+        for e in events.events()
+        if e["kind"] == "devprof.compile"
+    )
+
+
+# -- pager HBM telemetry ----------------------------------------------------
+
+
+def test_pager_hbm_gauge_vs_budget():
+    m = Metrics()
+    devprof.install(m)
+    devprof.note_pager(50, 200)
+    devprof.note_pager(150, 200)
+    devprof.note_pager(100, 200)
+    c = m.snapshot()["counters"]
+    assert c["devprof.hbm_used_bytes"] == 100
+    assert c["devprof.hbm_budget_bytes"] == 200
+    assert c["devprof.hbm_occupancy"] == 0.5
+    assert c["devprof.hbm_peak_bytes"] == 150  # high-watermark sticks
+    h = devprof.health_fields()
+    assert h["devprof_hbm_occupancy"] == 0.5
+    assert h["devprof_hbm_peak_bytes"] == 150
+
+
+# -- SIGKILL spill ----------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_sigkill_spills_compile_events(tmp_path):
+    pytest.importorskip("jax")
+    code = f"""
+import os, signal
+os.environ["JAX_PLATFORMS"] = "cpu"
+from antidote_ccrdt_tpu.obs import devprof, events
+from antidote_ccrdt_tpu.utils.metrics import Metrics
+from antidote_ccrdt_tpu.core import batch_merge
+from antidote_ccrdt_tpu.models.topk import TopkState
+events.configure("w0", spill_dir={str(tmp_path)!r})
+devprof.install(Metrics())
+states = [TopkState({{chr(97 + i): i + 1}}, 2) for i in range(4)]
+batch_merge.batch_merge("topk", states)
+os.kill(os.getpid(), signal.SIGKILL)
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True,
+        timeout=120,
+    )
+    assert proc.returncode == -signal.SIGKILL, proc.stderr.decode()
+    logs = events.scan_dir(str(tmp_path))
+    compiles = [
+        e
+        for evs in logs.values()
+        for e in evs
+        if e.get("kind") == "devprof.compile"
+    ]
+    assert compiles, "compile events must survive the SIGKILL via spill"
+    assert all(e.get("site") and e.get("axis") for e in compiles)
+    # No clean-exit marker anywhere: the spill is crash evidence.
+    assert not any(
+        e.get("kind") == "proc.exit" for evs in logs.values() for e in evs
+    )
+
+
+# -- profile.* parity (single source of truth) ------------------------------
+
+
+def test_profile_parity_with_and_without_devprof():
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    def arm(with_devprof):
+        fn = jax.jit(lambda a, b: a + b)  # fresh cache per arm
+        m, dm = Metrics(), Metrics()
+        profile.install(m)
+        if with_devprof:
+            devprof.install(dm)
+        for shape in ((4,), (4,), (8,)):
+            a = jnp.zeros(shape, jnp.int32)
+            with profile.dispatch("unit.par", fn=fn, operands=(a, a)):
+                fn(a, a).block_until_ready()
+        profile.uninstall()
+        devprof.uninstall()
+        return m.snapshot(), dm.snapshot()
+
+    base, _ = arm(False)
+    both, dsnap = arm(True)
+    # The legacy family is untouched by the devprof plane riding along.
+    for k in ("profile.jit_misses", "profile.jit_hits", "profile.h2d_bytes"):
+        assert base["counters"][k] == both["counters"][k]
+    assert base["counters"]["profile.jit_misses"] == 2
+    assert base["counters"]["profile.jit_hits"] == 1
+    assert sorted(k for k in base["latencies"]) == sorted(
+        k for k in both["latencies"]
+    )
+    # One cache sample, two families: devprof counted the same compiles.
+    assert dsnap["counters"]["devprof.compiles"] == 2
+    # And the devprof registry never grows profile.* names (no double
+    # bookkeeping in one registry).
+    assert not any(k.startswith("profile.") for k in dsnap["counters"])
+
+
+def test_devprof_only_records_without_profile():
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+    from antidote_ccrdt_tpu.core import batch_merge
+    from antidote_ccrdt_tpu.models.topk import TopkState
+
+    events.reset("devprof-solo")
+    m = Metrics()
+    devprof.install(m)
+    assert not profile.ACTIVE
+    batch_merge.batch_merge(
+        "topk", [TopkState({chr(97 + i): 2 * i + 1}, 3) for i in range(5)]
+    )
+    c = m.snapshot()["counters"]
+    assert c["devprof.dispatches"] >= 3
+    assert not any(k.startswith("profile.") for k in c)
+
+
+# -- the seeded stepping drill (chaos_gate leg 12 imports this) -------------
+
+
+def _canon(st):
+    return (
+        sorted((w, sorted(es)) for w, es in st.masked.items()),
+        sorted((w, sorted(v.items())) for w, v in st.removals.items()),
+        sorted(st.vc.items()),
+        sorted(st.observed.items()),
+        st.min,
+        st.size,
+    )
+
+
+def run_devprof_drill(seed: int = 7, rounds: int = 6, workers: int = 3):
+    """Seeded stepping fleet drill: `workers` topk_rmv scalar states grow
+    one live add per id per round, and every round batch-merges the fleet
+    — the shape growth provokes one recompile per round at
+    batch_merge.fold, which the observatory must attribute to the
+    slots-per-id axis. Runs an observed arm and a CCRDT_DEVPROF=0 arm on
+    the same seed; the kill-switch arm must be byte-identical.
+
+    Returns the dict chaos_gate leg 12 gates on."""
+    pytest.importorskip("jax")
+    from antidote_ccrdt_tpu.core import batch_merge
+    from antidote_ccrdt_tpu.models.topk_rmv import TopkRmvScalar
+
+    # Distinct `size` per seed: capacity is part of the engine-memo key,
+    # so the drill always exercises fresh jit caches even after other
+    # tests in the same process merged topk_rmv states.
+    size = 17 + (seed % 13)
+
+    def arm(observed):
+        events.reset("devprof-drill")
+        m = Metrics()
+        if observed:
+            devprof.install(m)
+        else:
+            assert devprof.install_from_env(
+                m, env={devprof.ENV_FLAG: "0"}
+            ) is False
+        sc = TopkRmvScalar()
+        states = [sc.new(size) for _ in range(workers)]
+        merged = []
+        for r in range(rounds):
+            states = _step(sc, states, r, seed)
+            merged.append(batch_merge.batch_merge("topk_rmv", list(states)))
+        evs = [e for e in events.events() if e["kind"] == "devprof.compile"]
+        counters = dict(m.snapshot()["counters"])
+        devprof.uninstall()
+        digest = hashlib.sha256(
+            repr([_canon(s) for s in merged]).encode()
+        ).hexdigest()
+        return counters, evs, digest
+
+    counters, evs, digest_on = arm(True)
+    off_counters, off_evs, digest_off = arm(False)
+    unattributed = sum(
+        1
+        for e in evs
+        if not e.get("site") or not e.get("axis") or not e.get("signature")
+    )
+    growth = [
+        e for e in evs if "slot_score" in e.get("axis", "")
+        and "axis3" in e.get("axis", "")
+    ]
+    return {
+        "counters": counters,
+        "events": evs,
+        "unattributed": unattributed,
+        "n_compiles": len(evs),
+        "n_capacity_growth": len(growth),
+        "digest_on": digest_on,
+        "digest_off": digest_off,
+        "off_devprof_counters": sum(
+            1 for k in off_counters if k.startswith("devprof.")
+        ),
+        "off_events": len(off_evs),
+    }
+
+
+def test_stepping_drill_attributes_every_compile():
+    dv = run_devprof_drill(seed=7)
+    assert dv["n_compiles"] >= 4  # the storm is real
+    assert dv["unattributed"] == 0  # ...and fully attributed
+    # topk_rmv capacity growth (slots-per-id axis) dominates the churn:
+    # every compile after the first names the growing axis.
+    assert dv["n_capacity_growth"] >= dv["n_compiles"] - 1
+    assert dv["counters"]["devprof.compiles"] == dv["n_compiles"]
+    assert dv["digest_on"] == dv["digest_off"]  # kill switch: bit-identical
+    assert dv["off_devprof_counters"] == 0
+    assert dv["off_events"] == 0
